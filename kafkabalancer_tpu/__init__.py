@@ -1,0 +1,40 @@
+"""kafkabalancer_tpu — a TPU-native (JAX/XLA) Kafka partition rebalancer.
+
+A brand-new framework with the capabilities of the reference Go tool
+(kjelle/kafkabalancer): it reads a cluster's partition->replica assignment,
+computes the reassignment operation(s) that most reduce broker-load unbalance
+subject to constraints, and emits Kafka reassignment JSON.
+
+Package layout (reference layer map: SURVEY.md §1):
+
+- ``models``   — data model (``Partition``, ``PartitionList``) and
+  ``RebalanceConfig`` (reference: kafkabalancer.go:16-66, balancer.go:12-32).
+- ``codecs``   — input/output codecs (reference: codecs.go).
+- ``balancer`` — the step pipeline and the greedy oracle solver, a faithful
+  behavioural re-implementation of the reference planner used for golden
+  parity (reference: balancer.go, steps.go, utils.go).
+- ``ops``      — the TPU compute path: tensorization of the ragged partition
+  list into dense device arrays, the JAX cost model, and vectorized
+  candidate-move scoring (no reference analog; replaces the O(P*R*B^2)
+  scan at steps.go:145-232 with one batched pass).
+- ``solvers``  — TPU solver backends (single-move, fused multi-move,
+  beam search, what-if sweeps).
+- ``parallel`` — device-mesh parallelism (shard_map sweeps, collectives).
+- ``utils``    — Go-flag-style argument parsing and the buffered stderr
+  logger (reference: logbuf/logbuf.go).
+- ``cli``      — the command-line entry point preserving the reference's
+  flag set and exit-code contract (reference: kafkabalancer.go:68-242).
+
+JAX is imported lazily (only when a TPU solver/codepath is requested) so the
+default greedy CLI path has no JAX import cost.
+"""
+
+from kafkabalancer_tpu.models import (  # noqa: F401
+    Partition,
+    PartitionList,
+    RebalanceConfig,
+    default_rebalance_config,
+)
+from kafkabalancer_tpu.balancer import Balance, BalanceError  # noqa: F401
+
+__version__ = "0.1.0"

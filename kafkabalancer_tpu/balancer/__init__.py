@@ -1,0 +1,2 @@
+from kafkabalancer_tpu.balancer.pipeline import Balance, balance  # noqa: F401
+from kafkabalancer_tpu.balancer.steps import BalanceError  # noqa: F401

@@ -1,0 +1,352 @@
+"""The greedy planning steps — a faithful behavioural rebuild of the
+reference's step pipeline (steps.go), used as the parity oracle for the TPU
+solver and as the default ``-solver=greedy`` backend.
+
+Differences from the reference are intentional and documented:
+
+- Steps are pure with respect to the input list (except
+  :func:`fill_defaults`, which fills defaults in place exactly like the
+  reference, steps.go:39-66). A step that proposes a change returns a new
+  ``PartitionList`` holding a *copy* of the changed partition; the caller
+  applies it explicitly (``cli.apply_assignment``). The reference instead
+  leaks mutations through slice aliasing (SURVEY.md §2.2) — observable
+  single-move outputs are identical, but multi-move sessions that trigger
+  replica add/remove repairs are well-defined here and corrupt state there.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from kafkabalancer_tpu.balancer.costmodel import (
+    get_bl,
+    get_broker_list,
+    get_broker_list_by_load,
+    get_broker_list_by_load_bl,
+    get_broker_load,
+    get_unbalance_bl,
+)
+from kafkabalancer_tpu.models import Partition, PartitionList, RebalanceConfig
+from kafkabalancer_tpu.models.partition import single_partition_list
+
+
+class BalanceError(Exception):
+    """A planning failure (maps to CLI exit code 3)."""
+
+
+def replace_replica(p: Partition, orig: int, repl: int) -> PartitionList:
+    """Reference ``replacepl`` (utils.go:166-197), acting on a copy.
+
+    ``repl == -1`` deletes the replica; if ``repl`` is already present the
+    two positions are swapped (a leadership exchange without data movement,
+    utils.go:181-188); otherwise the slot is overwritten in place.
+
+    The returned copy carries a ``_source`` reference to the partition object
+    it was derived from so the CLI can apply the change to the live list by
+    identity — the explicit analog of the reference's slice aliasing, and
+    the only correct match when duplicate topic+partition entries exist.
+    """
+    src = p
+    p = p.copy()
+    p._source = src  # type: ignore[attr-defined]
+    for idx, bid in enumerate(p.replicas):
+        if bid == orig:
+            if repl == -1:
+                del p.replicas[idx]
+            else:
+                try:
+                    existing = p.replicas.index(repl)
+                except ValueError:
+                    existing = -1
+                if existing > -1:
+                    p.replicas[idx], p.replicas[existing] = (
+                        p.replicas[existing],
+                        p.replicas[idx],
+                    )
+                else:
+                    p.replicas[idx] = repl
+            return single_partition_list(p)
+    raise AssertionError(f"partition {p} replicas don't contain {orig}")
+
+
+def add_replica(p: Partition, b: int) -> PartitionList:
+    """Reference ``addpl`` (utils.go:199-202), acting on a copy."""
+    src = p
+    p = p.copy()
+    p._source = src  # type: ignore[attr-defined]
+    p.replicas.append(b)
+    return single_partition_list(p)
+
+
+def validate_weights(
+    pl: PartitionList, _cfg: RebalanceConfig
+) -> Optional[PartitionList]:
+    """All-or-nothing weights, no negatives (steps.go:7-23).
+
+    Quirk preserved: when partition 0 lacks a weight but a later one has
+    one, the error names partition 0 (steps.go:15).
+    """
+    has_weights = pl.partitions[0].weight != 0
+
+    for p in pl.partitions:
+        if has_weights and p.weight == 0:
+            raise BalanceError(f"partition {p} has no weight")
+        if not has_weights and p.weight != 0:
+            raise BalanceError(f"partition {pl.partitions[0]} has no weight")
+        if p.weight < 0:
+            raise BalanceError(f"partition {p} has negative weight")
+
+    return None
+
+
+def validate_replicas(
+    pl: PartitionList, _cfg: RebalanceConfig
+) -> Optional[PartitionList]:
+    """No duplicate broker within a partition's replica set (steps.go:27-36)."""
+    for p in pl.partitions:
+        if len(set(p.replicas)) != len(p.replicas):
+            raise BalanceError(f"partition {p} has duplicated replicas")
+    return None
+
+
+def fill_defaults(
+    pl: PartitionList, cfg: RebalanceConfig
+) -> Optional[PartitionList]:
+    """Fill Weight/Brokers/NumReplicas defaults in place (steps.go:39-66)."""
+    if pl.partitions[0].weight == 0:
+        for p in pl.partitions:
+            p.weight = 1.0
+
+    brokers = cfg.brokers
+    if brokers is None:
+        brokers = get_broker_list(pl)
+    for p in pl.partitions:
+        if p.brokers is None:
+            p.brokers = brokers
+
+    for p in pl.partitions:
+        if p.num_replicas == 0:
+            p.num_replicas = len(p.replicas)
+
+    return None
+
+
+def remove_extra_replicas(
+    pl: PartitionList, _cfg: RebalanceConfig
+) -> Optional[PartitionList]:
+    """Shrink over-replicated partitions (steps.go:70-89).
+
+    Scans allowed brokers ascending by (load, ID) and removes the replica on
+    the first one currently holding a replica — i.e. the *least-loaded*
+    holder. (The reference README's scenario describes the opposite; code
+    and test are authoritative, SURVEY.md §2.5.) May remove the leader,
+    promoting the first follower. No MinReplicas gate.
+    """
+    loads = get_broker_load(pl)
+
+    for p in pl.iter_partitions():
+        if p.num_replicas >= len(p.replicas):
+            continue
+
+        for b in get_broker_list_by_load(loads, p.brokers):
+            if b in p.replicas:
+                return replace_replica(p, b, -1)
+
+        raise BalanceError(f"partition {p} unable to pick replica to remove")
+
+    return None
+
+
+def add_missing_replicas(
+    pl: PartitionList, _cfg: RebalanceConfig
+) -> Optional[PartitionList]:
+    """Grow under-replicated partitions (steps.go:93-113).
+
+    Scans allowed brokers *descending* from most-loaded (the reference's
+    ``idx--`` loop, steps.go:102-106) and adds a replica on the first broker
+    not already holding one — i.e. the most-loaded eligible non-member.
+    """
+    loads = get_broker_load(pl)
+
+    for p in pl.iter_partitions():
+        if p.num_replicas <= len(p.replicas):
+            continue
+
+        for b in reversed(get_broker_list_by_load(loads, p.brokers)):
+            if b not in p.replicas:
+                return add_replica(p, b)
+
+        raise BalanceError(f"partition {p} unable to pick replica to add")
+
+    return None
+
+
+def move_disallowed_replicas(
+    pl: PartitionList, _cfg: RebalanceConfig
+) -> Optional[PartitionList]:
+    """Move replicas off brokers outside the partition's allowed set
+    (steps.go:117-143), to the most-loaded allowed non-member broker
+    (descending scan, steps.go:129-135).
+
+    Candidates come from the observed-load table only — no zero-fill of
+    ``cfg.brokers`` (unlike ``move``), so a brand-new empty broker can never
+    be the target of a disallowed-replica move (SURVEY.md §2.5).
+    """
+    loads = get_broker_load(pl)
+    bl = get_bl(loads)
+
+    for p in pl.iter_partitions():
+        brokers_by_load = get_broker_list_by_load_bl(bl, p.brokers)
+
+        for rid in p.replicas:
+            if rid in brokers_by_load:
+                continue
+
+            for b in reversed(brokers_by_load):
+                if b in p.replicas:
+                    continue
+                return replace_replica(p, rid, b)
+
+            raise BalanceError(
+                f"partition {p} unable to pick replica to replace broker {rid}"
+            )
+
+    return None
+
+
+def greedy_move(
+    pl: PartitionList, cfg: RebalanceConfig, leaders: bool
+) -> Optional[PartitionList]:
+    """The greedy single-move search (reference ``move``, steps.go:145-232).
+
+    Semantics pinned for parity:
+
+    - the broker table ``bl`` is sorted once by (load, ID) up front; both the
+      source-replica scan and the target scan iterate in that fixed order;
+    - first-strict-improver selection: a candidate replaces the incumbent
+      only when its unbalance is strictly lower (steps.go:211), so the first
+      candidate in (partition, replica, bl-rank) order achieving the global
+      minimum wins;
+    - the what-if delta adds/subtracts the plain follower weight even when
+      moving a leader — the leader premium is *not* re-applied during the
+      simulation (steps.go:185, :207). This under-models leader moves but is
+      observable reference behaviour (SURVEY.md §3.3);
+    - brokers from ``cfg.brokers`` with no observed load are zero-filled and
+      are valid targets (steps.go:151-155);
+    - accept only if the improvement exceeds ``min_unbalance``
+      (steps.go:227).
+    """
+    best: Optional[tuple] = None
+
+    loads = get_broker_load(pl)
+    for bid in cfg.brokers or []:
+        if bid not in loads:
+            loads[bid] = 0.0  # a broker with no load is a valid target
+
+    bl = get_bl(loads)
+
+    su = get_unbalance_bl(bl)
+    cu = su
+
+    for p in pl.iter_partitions():
+        if p.num_replicas < cfg.min_replicas_for_rebalancing:
+            continue
+
+        movable = p.replicas[0:1] if leaders else p.replicas[1:]
+
+        for r in movable:
+            ridx = -1
+            rload = 0.0
+            for idx, (bid, bload) in enumerate(bl):
+                if bid == r:
+                    ridx = idx
+                    rload = bload
+                    bl[idx][1] -= p.weight
+            if ridx == -1:
+                raise BalanceError(
+                    f"assertion failed: replica {r} not in broker loads {bl}"
+                )
+
+            for idx in range(len(bl)):
+                bid = bl[idx][0]
+                if bid not in p.brokers:
+                    continue
+                # the slot's current holder set — the target must be new
+                if bid in p.replicas:
+                    continue
+
+                bload = bl[idx][1]
+                bl[idx][1] += p.weight
+                u = get_unbalance_bl(bl)
+
+                if u < cu:
+                    cu = u
+                    best = (p, r, bid)
+
+                bl[idx][1] = bload
+
+            bl[ridx][1] = rload
+
+    if cu < su - cfg.min_unbalance:
+        p, r, b = best
+        return replace_replica(p, r, b)
+
+    return None
+
+
+def distribute_leaders(
+    pl: PartitionList, cfg: RebalanceConfig
+) -> Optional[PartitionList]:
+    """Leadership-only rebalancing (reference ``distributeLeaders``,
+    steps.go:234-282).
+
+    Bails when total unbalance is below ``min_unbalance`` (steps.go:249-253);
+    otherwise hands leadership of the first eligible partition led by the
+    most-loaded broker to the globally least-loaded broker. When that target
+    is already a follower this becomes an in-place swap (leadership transfer
+    without data movement) via :func:`replace_replica`.
+    """
+    loads = get_broker_load(pl)
+    for bid in cfg.brokers or []:
+        if bid not in loads:
+            loads[bid] = 0.0
+
+    bl = get_bl(loads)
+
+    su = get_unbalance_bl(bl)
+    if su < cfg.min_unbalance:
+        return None
+
+    heavy = bl[-1][0]
+    led = [p for p in pl.iter_partitions() if p.replicas[0] == heavy]
+    for p in led:
+        if p.num_replicas < cfg.min_replicas_for_rebalancing:
+            continue
+        return replace_replica(p, p.replicas[0], bl[0][0])
+
+    return None
+
+
+def reassign_leaders(
+    pl: PartitionList, cfg: RebalanceConfig
+) -> Optional[PartitionList]:
+    """Gate on ``rebalance_leaders`` (steps.go:301-307)."""
+    if not cfg.rebalance_leaders:
+        return None
+    return distribute_leaders(pl, cfg)
+
+
+def move_leaders(
+    pl: PartitionList, cfg: RebalanceConfig
+) -> Optional[PartitionList]:
+    """Leader moves, gated on ``allow_leader_rebalancing`` (steps.go:292-298)."""
+    if not cfg.allow_leader_rebalancing:
+        return None
+    return greedy_move(pl, cfg, True)
+
+
+def move_non_leaders(
+    pl: PartitionList, cfg: RebalanceConfig
+) -> Optional[PartitionList]:
+    """Follower moves — always enabled (steps.go:286-288)."""
+    return greedy_move(pl, cfg, False)

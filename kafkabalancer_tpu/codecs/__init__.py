@@ -1,0 +1,12 @@
+from kafkabalancer_tpu.codecs.readers import (  # noqa: F401
+    CodecError,
+    get_partition_list_from_reader,
+)
+from kafkabalancer_tpu.codecs.writer import (  # noqa: F401
+    filter_partition_list,
+    write_partition_list,
+)
+from kafkabalancer_tpu.codecs.zookeeper import (  # noqa: F401
+    get_partition_list_from_zookeeper,
+    parse_zk_connection_string,
+)

@@ -1,0 +1,115 @@
+"""Zookeeper input codec.
+
+Reference: ``GetPartitionListFromZookeeper`` (codecs.go:95-135), built on the
+kazoo-go client. The rebuild parses the connection string itself (so the
+error contract is reproducible without a network stack) and performs the
+actual reads through the Python ``kazoo`` client when it is importable; when
+it is not, connection attempts fail with a codec error (CLI exit code 2),
+which preserves the reference's observable behaviour for every tested path
+(the reference's happy ZK path is itself untested, SURVEY.md §4).
+
+Connection string format (kazoo-go semantics): ``host:port[,host:port...]
+[/chroot]``. Every node must be a ``host:port`` pair (Go validates with
+``net.SplitHostPort``), which is what makes ``-from-zk=.`` fail with
+``failed parsing zk connection string`` (kafkabalancer_test.go:145-154).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from kafkabalancer_tpu.codecs.readers import CodecError
+from kafkabalancer_tpu.models import Partition, PartitionList
+
+
+def parse_zk_connection_string(conn: str) -> Tuple[List[Tuple[str, int]], str]:
+    """Parse ``host:port,host:port/chroot`` into (nodes, chroot).
+
+    Raises ValueError on malformed input, mirroring kazoo-go's
+    ``ParseConnectionString`` (every node must be host:port).
+    """
+    if conn == "":
+        raise ValueError("empty connection string")
+    node_part, sep, chroot = conn.partition("/")
+    if sep:
+        chroot = "/" + chroot
+    nodes: List[Tuple[str, int]] = []
+    for addr in node_part.split(","):
+        host, colon, port_s = addr.rpartition(":")
+        if not colon:
+            raise ValueError(f"missing port in address {addr!r}")
+        if host == "":
+            raise ValueError(f"missing host in address {addr!r}")
+        try:
+            port = int(port_s)
+        except ValueError:
+            raise ValueError(f"invalid port in address {addr!r}") from None
+        if not 0 < port < 65536:
+            raise ValueError(f"invalid port in address {addr!r}")
+        nodes.append((host, port))
+    return nodes, chroot
+
+
+def get_partition_list_from_zookeeper(
+    conn: str, topics: Optional[List[str]] = None
+) -> PartitionList:
+    """Read the cluster's partition list from Zookeeper.
+
+    Walks ``/brokers/topics/<topic>`` state the same way the reference walks
+    ``zk.Topics()`` -> ``topic.Partitions()`` (codecs.go:104-131), applying
+    the topic filter (codecs.go:110-112). ``weight`` / ``num_consumers``
+    enrichment is left unset, matching the reference's commented-out TODO
+    (codecs.go:128-129).
+    """
+    topics = topics or []
+    try:
+        nodes, chroot = parse_zk_connection_string(conn)
+    except ValueError as exc:
+        raise CodecError(f"failed parsing zk connection string: {exc}") from None
+
+    try:
+        from kazoo.client import KazooClient  # type: ignore
+    except ImportError:
+        raise CodecError(
+            "failed reading topic list from zk: kazoo client library not available"
+        ) from None
+
+    import json as _json
+
+    hosts = ",".join(f"{h}:{p}" for h, p in nodes) + chroot
+    zk = KazooClient(hosts=hosts, read_only=True)
+    try:
+        try:
+            zk.start(timeout=10)
+            topic_names = zk.get_children("/brokers/topics")
+        except Exception as exc:
+            raise CodecError(f"failed reading topic list from zk: {exc}") from None
+
+        pl = PartitionList()
+        for topic in sorted(topic_names):
+            if topics and topic not in topics:
+                continue
+            try:
+                data, _stat = zk.get(f"/brokers/topics/{topic}")
+                state = _json.loads(data.decode("utf-8"))
+                # {"version":N,"partitions":{"0":[1,2],...}}
+                part_map = state.get("partitions", {})
+            except Exception as exc:
+                raise CodecError(
+                    f"failed reading partition list for topic {topic} from zk: {exc}"
+                ) from None
+            for pid_s in sorted(part_map, key=int):
+                pl.append(
+                    Partition(
+                        topic=topic,
+                        partition=int(pid_s),
+                        replicas=[int(r) for r in part_map[pid_s]],
+                    )
+                )
+        return pl
+    finally:
+        try:
+            zk.stop()
+            zk.close()
+        except Exception:
+            pass

@@ -1,0 +1,8 @@
+from kafkabalancer_tpu.models.partition import (  # noqa: F401
+    Partition,
+    PartitionList,
+)
+from kafkabalancer_tpu.models.config import (  # noqa: F401
+    RebalanceConfig,
+    default_rebalance_config,
+)

@@ -1,0 +1,43 @@
+"""Rebalance configuration.
+
+Reference: ``RebalanceConfig`` / ``DefaultRebalanceConfig``
+(balancer.go:12-32). CLI flag defaults are sourced from
+:func:`default_rebalance_config` so library and CLI defaults cannot drift
+(kafkabalancer.go:86-91).
+
+Note: the reference's default ``MinUnbalance`` is 0.01 in code
+(balancer.go:29); the reference README's claim of 1e-05 is stale
+(SURVEY.md §2.4). ``complete_partition`` is carried in the config for flag
+default purposes but — like the reference — is acted on by the CLI main loop,
+not by any balancing step (kafkabalancer.go:212-220).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+@dataclass
+class RebalanceConfig:
+    allow_leader_rebalancing: bool = False
+    rebalance_leaders: bool = False
+    min_replicas_for_rebalancing: int = 2
+    min_unbalance: float = 0.01
+    complete_partition: bool = True
+    brokers: Optional[List[int]] = None
+
+    # --- extensions beyond the reference CLI (TPU backends) ---
+    solver: str = "greedy"  # greedy | tpu | beam
+
+
+def default_rebalance_config() -> RebalanceConfig:
+    """Reference ``DefaultRebalanceConfig()`` (balancer.go:24-32)."""
+    return RebalanceConfig(
+        allow_leader_rebalancing=False,
+        rebalance_leaders=False,
+        min_replicas_for_rebalancing=2,
+        min_unbalance=0.01,
+        complete_partition=True,
+        brokers=None,
+    )

@@ -1,0 +1,18 @@
+"""Test harness configuration.
+
+Tests run on CPU with 8 virtual XLA devices so multi-chip sharding logic
+(mesh/shard_map paths) is exercised without TPU hardware, and with x64
+enabled so the cost model matches the float64 greedy oracle bit-for-bit.
+This must happen before the first ``import jax`` anywhere in the test
+process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")

@@ -1,0 +1,203 @@
+"""Codec tests.
+
+Ports the reference's codec suite (codecs_test.go:9-62) and adds coverage
+for the Go-byte-compatible writer (float formatting, omitempty, null
+partitions, HTML escaping) and the unique filter.
+"""
+
+import io
+
+import pytest
+
+from kafkabalancer_tpu.codecs import (
+    CodecError,
+    filter_partition_list,
+    get_partition_list_from_reader,
+    write_partition_list,
+)
+from kafkabalancer_tpu.codecs.writer import encode_partition_list, format_go_float
+from kafkabalancer_tpu.codecs.zookeeper import parse_zk_connection_string
+from kafkabalancer_tpu.models import Partition, PartitionList
+
+JSON_FIXTURE = """{"version":1,
+   "partitions":[{"topic":"foo1","partition":2,"replicas":[1,2]},
+                 {"topic":"foo1","partition":0,"replicas":[1,2]},
+                 {"topic":"foo2","partition":2,"replicas":[1,2]},
+                 {"topic":"foo2","partition":0,"replicas":[1,3]},
+                 {"topic":"foo1","partition":1,"replicas":[1,3]},
+                 {"topic":"foo2","partition":1,"replicas":[1,4]}]
+  }"""
+
+TEXT_FIXTURE = """Topic:test\tPartitionCount:9\tReplicationFactor:3\tConfigs:
+\tTopic: test\tPartition: 0\tLeader: 2\tReplicas: 2,0,1\tIsr: 0,1,2
+\tTopic: test\tPartition: 1\tLeader: 0\tReplicas: 0,1,2\tIsr: 0,1,2
+\tTopic: test\tPartition: 2\tLeader: 1\tReplicas: 1,2,0\tIsr: 0,1,2
+\tTopic: test\tPartition: 3\tLeader: 2\tReplicas: 2,1,0\tIsr: 0,1,2
+\tTopic: test\tPartition: 4\tLeader: 0\tReplicas: 0,2,1\tIsr: 0,1,2
+\tTopic: test\tPartition: 5\tLeader: 1\tReplicas: 1,0,2\tIsr: 0,1,2
+\tTopic: test\tPartition: 6\tLeader: 2\tReplicas: 2,0,1\tIsr: 0,1,2
+\tTopic: test\tPartition: 7\tLeader: 0\tReplicas: 0,1,2\tIsr: 0,1,2
+\tTopic: test\tPartition: 8\tLeader: 1\tReplicas: 1,2,0\tIsr: 0,1,2"""
+
+
+class TestParsingJSON:
+    def test_parses(self):
+        pl = get_partition_list_from_reader(JSON_FIXTURE, True, [])
+        assert pl.version == 1
+        assert len(pl) == 6
+        assert pl.partitions[0] == Partition(topic="foo1", partition=2, replicas=[1, 2])
+
+    def test_wrong_version(self):
+        with pytest.raises(CodecError, match="wrong partition list version: expected 1, got 2"):
+            get_partition_list_from_reader('{"version":2,"partitions":[]}', True, [])
+
+    def test_malformed(self):
+        with pytest.raises(CodecError, match="failed parsing json"):
+            get_partition_list_from_reader("::malformed::", True, [])
+
+    def test_empty(self):
+        with pytest.raises(CodecError, match="empty partition list"):
+            get_partition_list_from_reader('{"version":1,"partitions":[]}', True, [])
+
+    def test_extension_fields(self):
+        j = (
+            '{"version":1,"partitions":[{"topic":"t","partition":0,"replicas":[1,2],'
+            '"weight":2.5,"num_replicas":3,"brokers":[1,2,3],"num_consumers":4}]}'
+        )
+        pl = get_partition_list_from_reader(j, True, [])
+        p = pl.partitions[0]
+        assert p.weight == 2.5
+        assert p.num_replicas == 3
+        assert p.brokers == [1, 2, 3]
+        assert p.num_consumers == 4
+
+
+class TestWritingJSON:
+    def test_round_trip(self):
+        pl = get_partition_list_from_reader(JSON_FIXTURE, True, [])
+        out = io.StringIO()
+        write_partition_list(out, pl)
+        assert out.getvalue() == (
+            '{"version":1,"partitions":['
+            '{"topic":"foo1","partition":2,"replicas":[1,2]},'
+            '{"topic":"foo1","partition":0,"replicas":[1,2]},'
+            '{"topic":"foo2","partition":2,"replicas":[1,2]},'
+            '{"topic":"foo2","partition":0,"replicas":[1,3]},'
+            '{"topic":"foo1","partition":1,"replicas":[1,3]},'
+            '{"topic":"foo2","partition":1,"replicas":[1,4]}]}\n'
+        )
+
+    def test_nil_partitions_encodes_null(self):
+        # Go marshals a nil slice as null (kafkabalancer.go:42 has no
+        # omitempty): an empty plan prints {"version":1,"partitions":null}.
+        assert (
+            encode_partition_list(PartitionList(version=1, partitions=None))
+            == '{"version":1,"partitions":null}\n'
+        )
+
+    def test_version_forced_to_1(self):
+        out = encode_partition_list(PartitionList(version=7, partitions=[]))
+        assert out == '{"version":1,"partitions":[]}\n'
+
+    def test_omitempty_fields(self):
+        p = Partition(
+            topic="t", partition=0, replicas=[1, 2], weight=1.0,
+            num_replicas=2, brokers=[1, 2, 3], num_consumers=0,
+        )
+        out = encode_partition_list(PartitionList(version=1, partitions=[p]))
+        assert out == (
+            '{"version":1,"partitions":[{"topic":"t","partition":0,'
+            '"replicas":[1,2],"weight":1,"num_replicas":2,"brokers":[1,2,3]}]}\n'
+        )
+
+    def test_html_escaping(self):
+        p = Partition(topic="a<b>&c", partition=0, replicas=[1])
+        out = encode_partition_list(PartitionList(version=1, partitions=[p]))
+        assert '"topic":"a\\u003cb\\u003e\\u0026c"' in out
+
+    def test_write_failure(self):
+        class FailWriter:
+            def write(self, _):
+                raise OSError("fail")
+
+        with pytest.raises(CodecError, match="failed serializing json"):
+            write_partition_list(FailWriter(), PartitionList(version=1, partitions=[]))
+
+
+class TestGoFloatFormat:
+    @pytest.mark.parametrize(
+        "value,expected",
+        [
+            (1.0, "1"),
+            (1.5, "1.5"),
+            (0.3, "0.3"),
+            (-2.0, "-2"),
+            (100.0, "100"),
+            (0.0, "0"),
+            (5e-05, "0.00005"),
+            (1.25e-05, "0.0000125"),
+            (1e-06, "0.000001"),
+            (9.9e-07, "9.9e-7"),
+            (1e-07, "1e-7"),
+            (1e-10, "1e-10"),
+            (1e21, "1e+21"),
+            (1.5e21, "1.5e+21"),
+            (1e20, "100000000000000000000"),
+            (123456.789, "123456.789"),
+            (0.1 + 0.2, "0.30000000000000004"),
+        ],
+    )
+    def test_matches_go(self, value, expected):
+        assert format_go_float(value) == expected
+
+
+class TestParsingText:
+    def test_describe_output(self):
+        pl = get_partition_list_from_reader(TEXT_FIXTURE, False, [])
+        assert len(pl) == 9
+        assert pl.partitions[0] == Partition(topic="test", partition=0, replicas=[2, 0, 1])
+        assert pl.partitions[8] == Partition(topic="test", partition=8, replicas=[1, 2, 0])
+
+    def test_topic_filter(self):
+        with pytest.raises(CodecError, match="empty partition list"):
+            get_partition_list_from_reader(TEXT_FIXTURE, False, ["other"])
+        pl = get_partition_list_from_reader(TEXT_FIXTURE, False, ["test"])
+        assert len(pl) == 9
+
+    def test_non_matching_lines_skipped(self):
+        with pytest.raises(CodecError, match="empty partition list"):
+            get_partition_list_from_reader("random\nnoise\n", False, [])
+
+
+class TestFilterPartitionList:
+    def test_first_wins(self):
+        pl = PartitionList(
+            version=1,
+            partitions=[
+                Partition(topic="a", partition=1, replicas=[1, 2]),
+                Partition(topic="a", partition=1, replicas=[3, 4]),
+                Partition(topic="b", partition=1, replicas=[5]),
+                Partition(topic="a", partition=2, replicas=[6]),
+                Partition(topic="a", partition=1, replicas=[7]),
+            ],
+        )
+        out = filter_partition_list(pl)
+        assert [p.replicas for p in out.partitions] == [[1, 2], [5], [6]]
+        assert out.version == 1
+
+
+class TestZkConnString:
+    def test_valid(self):
+        nodes, chroot = parse_zk_connection_string("zk1:2181,zk2:2181/kafka")
+        assert nodes == [("zk1", 2181), ("zk2", 2181)]
+        assert chroot == "/kafka"
+
+    def test_no_chroot(self):
+        nodes, chroot = parse_zk_connection_string("localhost:2282")
+        assert nodes == [("localhost", 2282)]
+        assert chroot == ""
+
+    @pytest.mark.parametrize("bad", [".", "", "host", "host:", "host:x", ":2181", "h:0"])
+    def test_invalid(self, bad):
+        with pytest.raises(ValueError):
+            parse_zk_connection_string(bad)
