@@ -1,0 +1,5 @@
+"""``python -m kafkabalancer_tpu`` — the CLI entry point."""
+
+from kafkabalancer_tpu.cli import main
+
+main()

@@ -1,0 +1,320 @@
+"""Command-line entry point.
+
+Reference: ``main``/``run`` (kafkabalancer.go:68-242). The full lifecycle —
+flag parsing, input acquisition, the main reassignment loop with
+complete-partition extension, output filtering and writing — is preserved,
+including the exit-code contract asserted by the reference's CLI tests
+(kafkabalancer_test.go):
+
+    0 = ok, 1 = input file open failure, 2 = get-partition-list failure,
+    3 = config/balance failure, 4 = output write failure.
+
+Extensions beyond the reference flag set:
+
+- ``-solver={greedy,tpu,beam}``: selects the optimization backend. The
+  default ``greedy`` is the drop-in parity path; ``tpu`` scores all
+  candidate moves in one vectorized JAX pass (and fuses multi-move sessions
+  on device when profitable); ``beam`` adds N-way beam search.
+
+State threading: the reference carries moves across ``Balance`` calls via
+slice aliasing (SURVEY.md §2.2) — emitted plan entries alias the live
+assignment, so with ``-max-reassign>1`` every emitted entry for a partition
+shows its *final* replica set. We reproduce that observable behaviour
+explicitly: accepted changes are applied to the live list in place and the
+output accumulates references to the live partitions. (The reference's
+state corruption when replica add/remove repairs fire in multi-move
+sessions is *not* reproduced; repairs here update state cleanly.)
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import List, Optional
+
+from kafkabalancer_tpu.balancer import BalanceError, balance
+from kafkabalancer_tpu.codecs import (
+    CodecError,
+    filter_partition_list,
+    get_partition_list_from_reader,
+    get_partition_list_from_zookeeper,
+    write_partition_list,
+)
+from kafkabalancer_tpu.models import (
+    Partition,
+    PartitionList,
+    RebalanceConfig,
+    default_rebalance_config,
+)
+from kafkabalancer_tpu.models.partition import empty_partition_list
+from kafkabalancer_tpu.utils import BufferingWriter, FlagSet, Logger
+from kafkabalancer_tpu.utils.flags import go_atoi
+
+
+def _fmt_cfg(cfg: RebalanceConfig) -> str:
+    """Go ``%+v`` of RebalanceConfig (kafkabalancer.go:175)."""
+    brokers = "[]" if not cfg.brokers else "[" + " ".join(map(str, cfg.brokers)) + "]"
+    return (
+        "{AllowLeaderRebalancing:%s RebalanceLeaders:%s "
+        "MinReplicasForRebalancing:%d MinUnbalance:%s CompletePartition:%s "
+        "Brokers:%s}"
+        % (
+            str(cfg.allow_leader_rebalancing).lower(),
+            str(cfg.rebalance_leaders).lower(),
+            cfg.min_replicas_for_rebalancing,
+            cfg.min_unbalance,
+            str(cfg.complete_partition).lower(),
+            brokers,
+        )
+    )
+
+
+def apply_assignment(pl: PartitionList, changed: Partition) -> Partition:
+    """Apply an accepted change to the live list in place; returns the live
+    partition so the output list can alias it (see module docstring).
+
+    Matches by object identity via the ``_source`` reference the solver
+    attaches to its proposal (the explicit analog of the reference's slice
+    aliasing); duplicate topic+partition entries are legal input (that is
+    what ``-unique`` exists for), so a key-based match would be ambiguous.
+    """
+    src = getattr(changed, "_source", None)
+    if src is not None:
+        for p in pl.iter_partitions():
+            if p is src:
+                p.replicas[:] = changed.replicas
+                return p
+    for p in pl.iter_partitions():
+        if p.compare(changed):
+            p.replicas[:] = changed.replicas
+            return p
+    raise BalanceError(f"changed partition {changed} not in input list")
+
+
+def run(i, o, e, args: List[str]) -> int:
+    """Testable CLI body; reference ``run`` (kafkabalancer.go:72-242)."""
+    be = BufferingWriter(e)
+    logger = Logger(be)
+    log = logger.printf
+    profiler = None
+
+    try:
+        defaults = default_rebalance_config()
+
+        f = FlagSet(args[0] if args else "kafkabalancer", output=be)
+        f_json = f.bool("input-json", False, "Parse the input as JSON")
+        f_input = f.string(
+            "input",
+            "",
+            "Name of the file to read (if no file is specified read from "
+            "stdin, can not be used with -from-zk)",
+        )
+        f_zk = f.string(
+            "from-zk", "", "Zookeeper connection string (can not be used with -input)"
+        )
+        f_max = f.int("max-reassign", 1, "Maximum number of reassignments to generate")
+        f_full = f.bool(
+            "full-output",
+            False,
+            "Output the full partition list: by default only the changes are printed",
+        )
+        f_unique = f.bool("unique", False, "Output only unique topic+partition")
+        f_pprof = f.bool("pprof", False, "Enable CPU profiling")
+        f_allow_leader = f.bool(
+            "allow-leader",
+            defaults.allow_leader_rebalancing,
+            "Consider the partition leader eligible for rebalancing",
+        )
+        f_rebalance_leader = f.bool(
+            "rebalance-leader", defaults.rebalance_leaders, "Force rebalance leadership"
+        )
+        f_complete = f.bool(
+            "complete-partition",
+            defaults.complete_partition,
+            "Force to always complete a topic+partition's replicas to be valid.",
+        )
+        f_topics = f.string("topics", "", "Only process these commaseparated topics")
+        f_min_replicas = f.int(
+            "min-replicas",
+            defaults.min_replicas_for_rebalancing,
+            "Minimum number of replicas for a partition to be eligible for rebalancing",
+        )
+        f_min_unbalance = f.float(
+            "min-unbalance",
+            defaults.min_unbalance,
+            "Minimum unbalance value required to perform rebalancing",
+        )
+        f_brokers = f.string("broker-ids", "auto", "Comma-separated list of broker IDs")
+        f_solver = f.string(
+            "solver",
+            "greedy",
+            "Optimization backend: greedy (reference parity), tpu "
+            "(vectorized JAX/XLA candidate scoring), beam (N-way beam search)",
+        )
+        f_help = f.bool("help", False, "Display usage")
+
+        def usage():
+            be.write(f"Usage of {args[0] if args else 'kafkabalancer'}:\n")
+            f.print_defaults()
+
+        f.usage = usage
+        # ContinueOnError semantics: parse errors print the error + usage and
+        # execution continues with the flags parsed so far
+        # (the reference ignores Parse's return value, kafkabalancer.go:98).
+        f.parse(args[1:] if args else [])
+
+        if f_pprof.value:
+            import cProfile
+
+            profiler = cProfile.Profile()
+            profiler.enable()
+
+        if f_help.value:
+            usage()
+            return 0
+
+        brokers: Optional[List[int]] = None
+        if f_brokers.value != "auto":
+            brokers = []
+            for broker in f_brokers.value.split(","):
+                try:
+                    brokers.append(go_atoi(broker))
+                except ValueError:
+                    log(
+                        'failed parsing broker list "%s": strconv.Atoi: '
+                        'parsing "%s": invalid syntax'
+                        % (f_brokers.value, broker)
+                    )
+                    usage()
+                    return 3
+
+        if f_max.value < 0:
+            log('invalid number of max reassignments "%d"' % f_max.value)
+            usage()
+            return 3
+
+        if f_input.value != "" and f_zk.value != "":
+            log("can't specify both -input and -from-zk")
+            usage()
+            return 3
+
+        in_stream = i
+        close_input = False
+        if f_input.value != "":
+            try:
+                in_stream = open(f_input.value, "r")
+                close_input = True
+            except OSError as exc:
+                log(f"failed opening file {f_input.value}: {exc}")
+                return 1
+
+        topics = [t for t in f_topics.value.split(",") if len(t) >= 1]
+
+        try:
+            try:
+                if f_zk.value != "":
+                    pl = get_partition_list_from_zookeeper(f_zk.value, topics)
+                else:
+                    pl = get_partition_list_from_reader(in_stream, f_json.value, topics)
+            except CodecError as exc:
+                log(f"failed getting partition list: {exc}")
+                return 2
+        finally:
+            if close_input:
+                in_stream.close()
+
+        # complete_partition is deliberately NOT copied into cfg: the
+        # reference builds its RebalanceConfig without it
+        # (kafkabalancer.go:167-173, so Go logs CompletePartition:false) and
+        # acts on the *flag* in the main loop; we mirror both.
+        cfg = RebalanceConfig(
+            allow_leader_rebalancing=f_allow_leader.value,
+            rebalance_leaders=f_rebalance_leader.value,
+            min_replicas_for_rebalancing=f_min_replicas.value,
+            min_unbalance=f_min_unbalance.value,
+            complete_partition=False,
+            brokers=brokers,
+            solver=f_solver.value,
+        )
+
+        log(f"rebalance config: {_fmt_cfg(cfg)}")
+
+        # --- the main reassignment loop (kafkabalancer.go:177-221) -------
+        opl = empty_partition_list()
+        completing = False
+        c_partition: Optional[Partition] = None
+        r = f_max.value
+        while r > 0:
+            try:
+                ppl = balance(pl, cfg, log=log)
+            except BalanceError as exc:
+                log(f"failed optimizing distribution: {exc}")
+                return 3
+
+            if len(ppl) == 0:
+                break
+
+            # Apply every accepted change to the live list first: in the
+            # reference the change is already applied (through slice
+            # aliasing) before the loop inspects it, so even a move that
+            # fails the complete-partition comparison below is visible in
+            # -full-output (kafkabalancer.go:193-207 + SURVEY.md §2.2).
+            lives = [apply_assignment(pl, changed) for changed in ppl.partitions]
+
+            if not completing:
+                opl.append(*lives)
+            else:
+                stop = False
+                for changed, live in zip(ppl.partitions, lives):
+                    if c_partition.compare(changed):
+                        opl.append(live)
+                    else:
+                        log(f"Partition {changed} did not compare.")
+                        stop = True
+                        break
+                if stop:
+                    break
+
+            r -= 1
+            # when the budget is exhausted, keep granting one extra iteration
+            # as long as each next move still targets the same topic+partition
+            # (complete-partition mode, kafkabalancer.go:212-220)
+            if r == 0 and f_complete.value:
+                r = 1
+                if not completing:
+                    c_partition = ppl.partitions[-1]
+                    completing = True
+                    log(f"Forcing complete of Partition: {c_partition}")
+
+        be.flush(True)
+
+        if f_full.value:
+            opl = pl
+
+        if f_unique.value:
+            opl = filter_partition_list(opl)
+
+        log("Writing %d changes." % len(opl))
+
+        try:
+            write_partition_list(o, opl)
+        except CodecError as exc:
+            log(f"failed writing partition list: {exc}")
+            return 4
+
+        return 0
+    finally:
+        if profiler is not None:
+            profiler.disable()
+            try:
+                profiler.dump_stats("cpu.pprof")
+            except OSError:
+                pass
+        be.close()
+
+
+def main() -> None:
+    sys.exit(run(sys.stdin, sys.stdout, sys.stderr, ["kafkabalancer"] + sys.argv[1:]))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,2 @@
+from kafkabalancer_tpu.utils.flags import FlagSet  # noqa: F401
+from kafkabalancer_tpu.utils.logbuf import BufferingWriter, Logger  # noqa: F401

@@ -1,0 +1,309 @@
+"""CLI end-to-end tests.
+
+Ports the reference's CLI suite (kafkabalancer_test.go:11-166): ``run()`` is
+called directly with in-memory stdio and argument vectors — full-pipeline
+integration without subprocesses — asserting exit codes and stderr
+substrings. The fixture (tests/data/test.json) matches the reference's
+test/test.json: 8 partitions / 2 topics / brokers {1..4}, deliberately
+unbalanced toward broker 1.
+"""
+
+import io
+import json
+import os
+
+import pytest
+
+from kafkabalancer_tpu.cli import run
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "data", "test.json")
+
+
+def run_cli(args, stdin=""):
+    out, err = io.StringIO(), io.StringIO()
+    rv = run(io.StringIO(stdin), out, err, ["kafkabalancer"] + args)
+    return rv, out.getvalue(), err.getvalue()
+
+
+def fixture_text():
+    with open(FIXTURE) as fh:
+        return fh.read()
+
+
+class TestExitCodeMatrix:
+    def test_help(self):  # kafkabalancer_test.go:11-21
+        rv, _out, err = run_cli(["-help"], stdin=fixture_text())
+        assert rv == 0
+        assert "Usage of kafkabalancer:" in err
+
+    def test_stdin(self):  # kafkabalancer_test.go:23-30
+        rv, out, _err = run_cli(["-input-json"], stdin=fixture_text())
+        assert rv == 0
+        assert json.loads(out)["version"] == 1
+
+    def test_file(self):  # kafkabalancer_test.go:32-38
+        rv, _out, _err = run_cli(["-input-json", f"-input={FIXTURE}"])
+        assert rv == 0
+
+    def test_file_and_zk(self):  # kafkabalancer_test.go:40-49
+        rv, _out, err = run_cli(
+            ["-input-json", f"-input={FIXTURE}", "-from-zk=localhost:2282"]
+        )
+        assert rv == 3
+        assert "can't specify both -input and -from-zk" in err
+
+    def test_empty_input(self):  # kafkabalancer_test.go:51-60
+        rv, _out, err = run_cli(["-input-json"], stdin="")
+        assert rv == 2
+        assert "failed getting partition list:" in err
+
+    def test_malformed_input(self):  # kafkabalancer_test.go:62-71
+        rv, _out, err = run_cli(["-input-json"], stdin="::malformed::")
+        assert rv == 2
+        assert "failed getting partition list:" in err
+
+    def test_file_missing(self):  # kafkabalancer_test.go:73-79
+        rv, _out, _err = run_cli(["-input-json", "-input=tests/data/missing.json"])
+        assert rv == 1
+
+    def test_broker_list(self):  # kafkabalancer_test.go:81-87
+        rv, _out, _err = run_cli(
+            ["-input-json", f"-input={FIXTURE}", "-broker-ids=1,2,3"]
+        )
+        assert rv == 0
+
+    def test_broker_list_malformed(self):  # kafkabalancer_test.go:89-98
+        rv, _out, err = run_cli(
+            ["-input-json", f"-input={FIXTURE}", "-broker-ids=malformed"]
+        )
+        assert rv == 3
+        assert "failed parsing broker list" in err
+
+    def test_max_reassign_malformed(self):  # kafkabalancer_test.go:100-109
+        rv, _out, err = run_cli(
+            ["-input-json", f"-input={FIXTURE}", "-max-reassign=-1"]
+        )
+        assert rv == 3
+        assert "invalid number of max reassignments" in err
+
+    def test_max_reassign_huge(self):  # kafkabalancer_test.go:111-117
+        rv, _out, _err = run_cli(
+            ["-input-json", f"-input={FIXTURE}", "-max-reassign=1000"]
+        )
+        assert rv == 0
+
+    def test_full_output(self):  # kafkabalancer_test.go:119-125
+        rv, out, _err = run_cli(["-input-json", f"-input={FIXTURE}", "-full-output"])
+        assert rv == 0
+        assert len(json.loads(out)["partitions"]) == 8
+
+    def test_broken_output_stream(self):  # kafkabalancer_test.go:127-143
+        class FailWriter:
+            def write(self, _):
+                raise OSError("fail")
+
+        err = io.StringIO()
+        rv = run(
+            io.StringIO(""),
+            FailWriter(),
+            err,
+            ["kafkabalancer", "-input-json", f"-input={FIXTURE}"],
+        )
+        assert rv == 4
+        assert "failed writing partition list" in err.getvalue()
+
+    def test_broken_zk_conn_string(self):  # kafkabalancer_test.go:145-154
+        rv, _out, err = run_cli(["-from-zk=."])
+        assert rv == 2
+        assert "failed parsing zk connection string" in err
+
+    def test_broken_data(self):  # kafkabalancer_test.go:156-166
+        j = (
+            '{"version":1,"partitions":[{"topic":"foo1","partition":1,'
+            '"replicas":[1,2],"num_replicas":3}]}'
+        )
+        rv, _out, err = run_cli(["-input-json"], stdin=j)
+        assert rv == 3
+        assert "unable to pick replica to add" in err
+
+
+class TestPlanOutput:
+    def test_single_move_output(self):
+        """One move on the fixture: broker 1 is overloaded; the plan moves a
+        follower off it. Output is a version-1 reassignment JSON with exactly
+        one partition (default -max-reassign=1, complete-partition keeps
+        extending only while the same partition is chosen)."""
+        rv, out, _err = run_cli(["-input-json"], stdin=fixture_text())
+        assert rv == 0
+        obj = json.loads(out)
+        assert obj["version"] == 1
+        assert len(obj["partitions"]) >= 1
+        p = obj["partitions"][0]
+        # the fixture's heavy broker is 1: the first accepted move takes a
+        # follower away from it
+        assert 1 not in p["replicas"] or p["replicas"][0] == 1
+
+    def test_unique_filter(self):
+        rv, out, _err = run_cli(
+            ["-input-json", "-unique", "-max-reassign=10"], stdin=fixture_text()
+        )
+        assert rv == 0
+        obj = json.loads(out)
+        keys = [(p["topic"], p["partition"]) for p in obj["partitions"]]
+        assert len(keys) == len(set(keys))
+
+    def test_no_changes_emits_null_partitions(self):
+        """A converged assignment produces {"version":1,"partitions":null} —
+        the reference's nil-slice JSON encoding (kafkabalancer.go:177)."""
+        j = json.dumps(
+            {
+                "version": 1,
+                "partitions": [
+                    {"topic": "a", "partition": 0, "replicas": [1, 2]},
+                    {"topic": "a", "partition": 1, "replicas": [2, 1]},
+                ],
+            }
+        )
+        rv, out, _err = run_cli(["-input-json"], stdin=j)
+        assert rv == 0
+        assert out == '{"version":1,"partitions":null}\n'
+
+    def test_max_reassign_zero(self):
+        rv, out, _err = run_cli(
+            ["-input-json", "-max-reassign=0"], stdin=fixture_text()
+        )
+        assert rv == 0
+        assert out == '{"version":1,"partitions":null}\n'
+
+    def test_multi_move_entries_alias_final_state(self):
+        """With -max-reassign>1 every emitted entry for a partition shows its
+        final replica set — the reference's aliasing behaviour (SURVEY.md
+        §2.2), reproduced deliberately."""
+        rv, out, _err = run_cli(
+            ["-input-json", "-max-reassign=50"], stdin=fixture_text()
+        )
+        assert rv == 0
+        obj = json.loads(out)
+        final = {}
+        for p in obj["partitions"]:
+            final[(p["topic"], p["partition"])] = p["replicas"]
+        for p in obj["partitions"]:
+            assert p["replicas"] == final[(p["topic"], p["partition"])]
+
+    def test_topics_filter_text_input(self):
+        text = (
+            "\tTopic: keep\tPartition: 0\tLeader: 1\tReplicas: 1,2\tIsr: 1,2\n"
+            "\tTopic: drop\tPartition: 0\tLeader: 1\tReplicas: 1,2\tIsr: 1,2\n"
+        )
+        rv, out, _err = run_cli(
+            ["-topics=keep", "-full-output"], stdin=text
+        )
+        assert rv == 0
+        obj = json.loads(out)
+        assert [p["topic"] for p in obj["partitions"]] == ["keep"]
+
+
+class TestReviewRegressions:
+    """Regression tests for parity bugs found in review."""
+
+    def test_unavailable_solver_exits_3(self):
+        rv, _out, err = run_cli(
+            ["-input-json", "-solver=bogus"], stdin=fixture_text()
+        )
+        assert rv == 3
+        assert "failed optimizing distribution" in err
+
+    def test_config_log_matches_reference(self):
+        """The reference never copies CompletePartition into cfg
+        (kafkabalancer.go:167-173) so it always logs
+        CompletePartition:false."""
+        rv, _out, err = run_cli(["-input-json"], stdin=fixture_text())
+        assert rv == 0
+        assert (
+            "rebalance config: {AllowLeaderRebalancing:false "
+            "RebalanceLeaders:false MinReplicasForRebalancing:2 "
+            "MinUnbalance:0.01 CompletePartition:false Brokers:[]}" in err
+        )
+
+    def test_go_strict_broker_ids(self):
+        for bad in ["1,1_0", "1, 2", " 1", "1,+ 2"]:
+            rv, _out, err = run_cli(
+                ["-input-json", f"-broker-ids={bad}"], stdin=fixture_text()
+            )
+            assert rv == 3, bad
+            assert "failed parsing broker list" in err
+
+    def test_go_strict_max_reassign(self):
+        # Go's flag package rejects underscores in -max-reassign; the parse
+        # error prints usage and (ContinueOnError parity) execution continues
+        # with the default value.
+        rv, _out, err = run_cli(
+            ["-input-json", "-max-reassign=1_0"], stdin=fixture_text()
+        )
+        assert 'invalid value "1_0" for flag -max-reassign' in err
+        assert rv == 0
+
+
+class TestEmptyReplicasEncoding:
+    def test_empty_replicas_round_trip(self):
+        """Go encodes a decoded empty replicas list as [] (non-nil slice)."""
+        from kafkabalancer_tpu.codecs.writer import encode_partition_list
+        from kafkabalancer_tpu.models import Partition, PartitionList
+
+        out = encode_partition_list(
+            PartitionList(
+                version=1,
+                partitions=[Partition(topic="a", partition=0, replicas=[])],
+            )
+        )
+        assert '"replicas":[]' in out
+
+    def test_duplicate_topic_partition_terminates(self):
+        """Duplicate topic+partition entries are legal (-unique exists for
+        them); the change must be applied to the partition instance the
+        solver actually selected (identity match), or the repair loop never
+        converges."""
+        j = (
+            '{"version":1,"partitions":['
+            '{"topic":"t","partition":0,"replicas":[1,2]},'
+            '{"topic":"t","partition":0,"replicas":[1,2,3],"num_replicas":2}]}'
+        )
+        rv, out, _err = run_cli(["-input-json"], stdin=j)
+        assert rv == 0
+        obj = json.loads(out)
+        assert len(obj["partitions"]) >= 1
+        # the over-replicated duplicate was shrunk
+        assert obj["partitions"][0]["replicas"] == [1, 2]
+
+    def test_noncomparing_move_still_applied_for_full_output(self):
+        """A move rejected by the complete-partition comparison has already
+        been applied in the reference (slice aliasing) before the loop
+        breaks, so -full-output includes it (kafkabalancer.go:193-207)."""
+        rv, out, err = run_cli(
+            ["-input-json", "-full-output"], stdin=fixture_text()
+        )
+        assert rv == 0
+        assert "did not compare" in err
+        obj = json.loads(out)
+        by_key = {(p["topic"], p["partition"]): p["replicas"] for p in obj["partitions"]}
+        # the second (non-comparing) move rebalanced foo1,2 off broker 1
+        assert by_key[("foo1", 2)] != [1, 2]
+
+    def test_empty_replicas_partition_converges_like_reference(self):
+        """All-zero/empty load tables propagate NaN through the objective
+        exactly like Go (utils.go:130 divides 0/0 without panicking), so the
+        planner reports no candidate changes and exits 0."""
+        j = '{"version":1,"partitions":[{"topic":"t","partition":0,"replicas":[]}]}'
+        rv, out, _err = run_cli(["-input-json"], stdin=j)
+        assert rv == 0
+        assert out == '{"version":1,"partitions":null}\n'
+        # zero-filled explicit brokers with zero total load: same outcome
+        rv, out, _err = run_cli(["-input-json", "-broker-ids=1,2"], stdin=j)
+        assert rv == 0
+        assert out == '{"version":1,"partitions":null}\n'
+
+    def test_bool_flag_error_text(self):
+        rv, _out, err = run_cli(
+            ["-input-json=x"], stdin=fixture_text()
+        )
+        assert 'invalid boolean value "x" for -input-json: parse error' in err
