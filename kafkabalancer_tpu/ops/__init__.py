@@ -1,0 +1,12 @@
+"""TPU compute ops: tensorization of the ragged partition model into dense
+device arrays, and the JAX cost model (broker loads + unbalance objective).
+
+This layer has no reference analog — the reference's cost model lives in
+utils.go as scalar Go loops; here the same math is expressed as fixed-shape
+array programs so XLA can fuse and vectorize it (SURVEY.md §7 step 2-3).
+"""
+
+from kafkabalancer_tpu.ops.tensorize import DensePlan, tensorize
+from kafkabalancer_tpu.ops import cost
+
+__all__ = ["DensePlan", "tensorize", "cost"]

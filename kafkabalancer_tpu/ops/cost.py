@@ -1,0 +1,92 @@
+"""JAX cost model: broker loads and the asymmetric unbalance objective.
+
+Reproduces the reference's math exactly (modulo float accumulation order,
+which XLA chooses; parity tests use the float64 oracle with tight
+tolerances):
+
+- **Load model** (utils.go:92-105): per partition, the leader broker
+  (``replicas[0]``) accrues ``weight * (len(replicas) + num_consumers)``;
+  every follower accrues ``weight``.
+- **Objective** (utils.go:119-147): with ``rel_b = load_b/avg - 1``, the
+  unbalance is ``Σ rel²`` over overloaded brokers plus ``Σ rel²/2`` over
+  underloaded brokers — overload counts double. Degenerate inputs follow
+  IEEE semantics like Go: all-zero loads give a NaN objective (0/0), which
+  the solvers reject as "no improvement" exactly like the reference's
+  always-false NaN comparisons.
+- **Broker ordering** (utils.go:14-28): ascending by (load, broker-ID); the
+  ID tie-break is part of observable output determinism, so the sort is a
+  two-key lexicographic ``lax.sort``.
+
+All functions are shape-polymorphic jittable array programs; padded brokers
+(``bvalid`` false) carry zero load, contribute nothing to the objective, and
+sort to the end of the ranking.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def broker_loads(replicas, weights, nrep_cur, ncons, num_brokers: int):
+    """Per-broker load vector ``[B]`` (utils.go:92-105).
+
+    ``replicas``: [P, R] dense broker indices (-1 pad); ``weights``: [P];
+    ``nrep_cur``: [P] replica counts; ``ncons``: [P] num_consumers.
+    """
+    P, R = replicas.shape
+    slot = jnp.arange(R)[None, :]
+    valid = slot < nrep_cur[:, None]
+    # leader premium: slot 0 carries weight*(len+num_consumers), others weight
+    w = jnp.where(
+        slot == 0,
+        weights[:, None] * (nrep_cur[:, None].astype(weights.dtype) + ncons[:, None]),
+        weights[:, None],
+    )
+    w = jnp.where(valid, w, 0.0)
+    idx = jnp.where(valid, replicas, 0)
+    return jnp.zeros(num_brokers, dtype=weights.dtype).at[idx.reshape(-1)].add(
+        w.reshape(-1)
+    )
+
+
+def overload_penalty(loads, avg):
+    """Per-broker objective term: ``rel²`` if overloaded else ``rel²/2``
+    (utils.go:134-143)."""
+    rel = loads / avg - 1.0
+    return rel * rel * jnp.where(rel > 0, 1.0, 0.5)
+
+
+def unbalance(loads, bvalid, nb):
+    """The scalar objective over the valid brokers (utils.go:119-147).
+
+    ``nb`` is the real broker count (padded entries excluded). NaN/inf
+    propagate per IEEE like the Go code's float64 division.
+    """
+    masked = jnp.where(bvalid, loads, 0.0)
+    avg = jnp.sum(masked) / nb
+    pen = overload_penalty(loads, avg)
+    return jnp.sum(jnp.where(bvalid, pen, 0.0))
+
+
+def rank_brokers(loads, bvalid):
+    """Ascending (load, broker-index) ranking of the valid brokers
+    (utils.go:14-28, utils.go:107-117).
+
+    Returns ``(loads_rank, perm, rank_of)`` where ``perm[rank] = broker
+    index`` and ``rank_of[broker index] = rank``. Padded brokers sort to the
+    end (load forced to +inf) so valid brokers occupy ranks ``[0, nb)``.
+    When the valid set is the move universe (observed ∪ cfg.brokers — see
+    ``tensorize.broker_universe``) this is exactly the reference ``bl``
+    table of ``move()`` incl. its zero-fill (steps.go:150-157); callers
+    needing the *observed-only* table (e.g. disallowed-replica evacuation,
+    steps.go:122) must pass a narrower validity mask.
+    """
+    B = loads.shape[0]
+    iota = jnp.arange(B, dtype=jnp.int32)
+    sort_load = jnp.where(bvalid, loads, jnp.inf)
+    _, _, perm = lax.sort((sort_load, iota, iota), num_keys=2)
+    loads_rank = loads[perm]
+    rank_of = jnp.zeros(B, dtype=jnp.int32).at[perm].set(iota)
+    return loads_rank, perm, rank_of

@@ -1,0 +1,42 @@
+"""JAX runtime configuration for the solver layer.
+
+The cost model runs in float64 by default so solver decisions (argmin
+tie-breaks, min-unbalance threshold checks) agree with the float64 greedy
+oracle; TPU executes f64 in software, so the throughput paths (multi-move
+scan, sweeps, benchmarks) accept a dtype override down to float32.
+
+Set ``KAFKABALANCER_TPU_NO_X64=1`` to leave the process-global JAX x64 flag
+alone (solver parity then degrades to float32 tolerances).
+"""
+
+from __future__ import annotations
+
+import os
+
+_configured = False
+
+
+def ensure_x64() -> None:
+    """Enable JAX x64 once, before the first trace of any solver function."""
+    global _configured
+    if _configured:
+        return
+    _configured = True
+    if os.environ.get("KAFKABALANCER_TPU_NO_X64"):
+        return
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+
+def next_bucket(n: int, minimum: int = 8) -> int:
+    """Round ``n`` up to a power-of-two bucket (≥ ``minimum``).
+
+    Bucketing keeps jit cache hits high across calls with slightly different
+    partition/broker counts — XLA compiles once per (P_pad, R_pad, B_pad)
+    triple, not once per input.
+    """
+    b = minimum
+    while b < n:
+        b *= 2
+    return b

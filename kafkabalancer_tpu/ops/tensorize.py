@@ -1,0 +1,171 @@
+"""Ragged → dense encoding of a partition assignment.
+
+The reference operates on ragged Go slices (``Partition.Replicas`` of
+varying length, per-partition allowed-broker sets, sparse broker-ID space —
+kafkabalancer.go:49-58). XLA wants fixed shapes, so this module losslessly
+encodes a :class:`PartitionList` into padded dense arrays plus a broker-ID ↔
+dense-index mapping, and decodes solver results back to the ragged form.
+
+Conventions:
+
+- The broker *universe* is the sorted union of observed replica brokers
+  (utils.go:49-64 "auto" discovery) and any configured/extra broker IDs —
+  configured brokers with no observed load are valid move targets
+  (steps.go:151-155), so they must exist in the dense space.
+- ``replicas[p, r]`` holds dense broker indices; slot 0 is the leader
+  (Kafka convention, utils.go:96-101). Padding is ``-1``.
+- All arrays are padded to power-of-two buckets (see
+  :func:`kafkabalancer_tpu.ops.runtime.next_bucket`) with validity masks, so
+  recompilation happens per bucket, not per input size.
+- Padded partitions have zero weight, no replicas, and all-false allowed
+  masks; padded brokers are never allowed targets and hold zero load.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from kafkabalancer_tpu.models import Partition, PartitionList, RebalanceConfig
+from kafkabalancer_tpu.ops.runtime import next_bucket
+
+
+@dataclass
+class DensePlan:
+    """Dense encoding of a partition assignment (see module docstring).
+
+    Shapes: P = partition bucket, R = replica-slot bucket, B = broker bucket.
+    """
+
+    broker_ids: np.ndarray  # [nb] int64 — universe, sorted ascending
+    weights: np.ndarray  # [P] f64
+    replicas: np.ndarray  # [P, R] int32 dense broker idx, -1 pad
+    nrep_cur: np.ndarray  # [P] int32 — len(partition.replicas)
+    nrep_tgt: np.ndarray  # [P] int32 — partition.num_replicas
+    ncons: np.ndarray  # [P] f64 — partition.num_consumers
+    allowed: np.ndarray  # [P, B] bool — per-partition allowed brokers
+    member: np.ndarray  # [P, B] bool — broker currently holds a replica
+    pvalid: np.ndarray  # [P] bool
+    bvalid: np.ndarray  # [B] bool
+    partitions: List[Partition]  # originals, index-aligned with rows
+
+    @property
+    def np_(self) -> int:
+        """Number of real partitions."""
+        return len(self.partitions)
+
+    @property
+    def nb(self) -> int:
+        """Number of real brokers."""
+        return len(self.broker_ids)
+
+    def broker_index(self, broker_id: int) -> int:
+        idx = int(np.searchsorted(self.broker_ids, broker_id))
+        if idx >= len(self.broker_ids) or self.broker_ids[idx] != broker_id:
+            raise KeyError(f"broker {broker_id} not in dense universe")
+        return idx
+
+    def decode_replicas(self, replicas: np.ndarray, nrep_cur: np.ndarray) -> List[List[int]]:
+        """Dense replica matrix → per-partition broker-ID lists (real rows)."""
+        out: List[List[int]] = []
+        for p in range(self.np_):
+            n = int(nrep_cur[p])
+            out.append([int(self.broker_ids[int(replicas[p, s])]) for s in range(n)])
+        return out
+
+
+def broker_universe(
+    pl: PartitionList,
+    cfg: Optional[RebalanceConfig] = None,
+    extra_brokers: Iterable[int] = (),
+) -> np.ndarray:
+    """Sorted broker universe: observed ∪ cfg.brokers ∪ extra.
+
+    Deliberately does NOT include per-partition ``p.brokers`` entries: the
+    reference's ``move()`` builds its load table from observed brokers plus
+    ``cfg.Brokers`` zero-fill only (steps.go:150-155), so a broker allowed
+    solely by a partition's own broker list but holding no replica never
+    appears in ``bl`` and can never be a move target. Per-partition allowed
+    brokers outside this universe are likewise dropped from the dense
+    ``allowed`` mask.
+    """
+    seen = set(int(b) for b in extra_brokers)
+    for p in pl.iter_partitions():
+        seen.update(p.replicas)
+    if cfg is not None and cfg.brokers:
+        seen.update(cfg.brokers)
+    return np.asarray(sorted(seen), dtype=np.int64)
+
+
+def tensorize(
+    pl: PartitionList,
+    cfg: Optional[RebalanceConfig] = None,
+    extra_brokers: Sequence[int] = (),
+    min_bucket: int = 8,
+) -> DensePlan:
+    """Encode ``pl`` (post-``fill_defaults``: weights, brokers, num_replicas
+    populated) into a :class:`DensePlan`.
+
+    ``extra_brokers`` extends the universe with IDs that appear in no replica
+    list and no config — used by what-if sweeps that add brokers.
+    """
+    parts = list(pl.iter_partitions())
+    ids = broker_universe(pl, cfg, extra_brokers)
+    nb = len(ids)
+    np_real = len(parts)
+
+    rmax = max((len(p.replicas) for p in parts), default=0)
+    # replica slots can grow by at most the add-missing repair; solvers never
+    # extend past num_replicas, so bucket on the max of both
+    rmax = max(rmax, max((p.num_replicas for p in parts), default=0))
+
+    P = next_bucket(np_real, min_bucket)
+    R = next_bucket(rmax, 2)
+    B = next_bucket(nb, min_bucket)
+
+    weights = np.zeros(P, dtype=np.float64)
+    replicas = np.full((P, R), -1, dtype=np.int32)
+    nrep_cur = np.zeros(P, dtype=np.int32)
+    nrep_tgt = np.zeros(P, dtype=np.int32)
+    ncons = np.zeros(P, dtype=np.float64)
+    allowed = np.zeros((P, B), dtype=bool)
+    member = np.zeros((P, B), dtype=bool)
+    pvalid = np.zeros(P, dtype=bool)
+    bvalid = np.zeros(B, dtype=bool)
+    bvalid[:nb] = True
+
+    idx_of = {int(b): j for j, b in enumerate(ids)}
+
+    for i, p in enumerate(parts):
+        pvalid[i] = True
+        weights[i] = p.weight
+        nrep_cur[i] = len(p.replicas)
+        nrep_tgt[i] = p.num_replicas
+        ncons[i] = p.num_consumers
+        for s, bid in enumerate(p.replicas):
+            bidx = idx_of[int(bid)]
+            replicas[i, s] = bidx
+            member[i, bidx] = True
+        if p.brokers is None:
+            allowed[i, :nb] = True
+        else:
+            for bid in p.brokers:
+                j = idx_of.get(int(bid))
+                if j is not None:  # allowed-but-unobserved: see broker_universe
+                    allowed[i, j] = True
+
+    return DensePlan(
+        broker_ids=ids,
+        weights=weights,
+        replicas=replicas,
+        nrep_cur=nrep_cur,
+        nrep_tgt=nrep_tgt,
+        ncons=ncons,
+        allowed=allowed,
+        member=member,
+        pvalid=pvalid,
+        bvalid=bvalid,
+        partitions=parts,
+    )

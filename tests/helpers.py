@@ -1,0 +1,48 @@
+"""Shared test helpers: random cluster-instance generation.
+
+Instances are always valid inputs (all-or-nothing weights, no duplicate
+replicas within a partition) and are generated *post-defaults-shaped* when
+``filled=True`` (weights ≥ smallest positive, brokers set, num_replicas =
+len(replicas)) so solver-layer tests can skip the pipeline head.
+"""
+
+import random
+
+from kafkabalancer_tpu.models import Partition, PartitionList
+
+
+def random_partition_list(
+    rng: random.Random,
+    n_partitions: int,
+    n_brokers: int,
+    max_rf: int = 3,
+    weighted: bool = True,
+    with_consumers: bool = False,
+    restrict_brokers: bool = False,
+    filled: bool = False,
+) -> PartitionList:
+    broker_ids = sorted(rng.sample(range(1, n_brokers * 3), n_brokers))
+    parts = []
+    for i in range(n_partitions):
+        rf = rng.randint(1, min(max_rf, n_brokers))
+        replicas = rng.sample(broker_ids, rf)
+        brokers = None
+        if restrict_brokers and rng.random() < 0.3:
+            extra = [b for b in broker_ids if b not in replicas]
+            brokers = sorted(replicas + rng.sample(extra, min(len(extra), 2)))
+        p = Partition(
+            topic=f"topic{i % max(1, n_partitions // 4)}",
+            partition=i,
+            replicas=replicas,
+            weight=round(rng.uniform(0.5, 4.0), 3) if weighted else 0.0,
+            num_consumers=rng.randint(0, 3) if with_consumers else 0,
+            brokers=brokers,
+        )
+        if filled:
+            if not weighted:
+                p.weight = 1.0
+            if p.brokers is None:
+                p.brokers = list(broker_ids)
+            p.num_replicas = len(p.replicas)
+        parts.append(p)
+    return PartitionList(version=1, partitions=parts)

@@ -1,0 +1,148 @@
+"""Tensorization round-trip and JAX cost-model parity tests.
+
+The float64 host oracle (kafkabalancer_tpu.balancer.costmodel, itself pinned
+against the Go reference by the golden tests) is the ground truth; the JAX
+cost model must agree to float64 round-off."""
+
+import math
+import random
+
+import numpy as np
+import pytest
+
+from helpers import random_partition_list
+
+from kafkabalancer_tpu.balancer import steps as _s
+from kafkabalancer_tpu.balancer.costmodel import (
+    get_bl,
+    get_broker_load,
+    get_unbalance_bl,
+)
+from kafkabalancer_tpu.models import default_rebalance_config
+from kafkabalancer_tpu.ops import cost, tensorize
+from kafkabalancer_tpu.ops.runtime import ensure_x64, next_bucket
+
+ensure_x64()
+
+import jax.numpy as jnp  # noqa: E402
+
+
+def filled(pl, cfg=None):
+    cfg = cfg or default_rebalance_config()
+    _s.fill_defaults(pl, cfg)
+    return pl
+
+
+def test_next_bucket():
+    assert next_bucket(0) == 8
+    assert next_bucket(8) == 8
+    assert next_bucket(9) == 16
+    assert next_bucket(1000) == 1024
+
+
+def test_tensorize_round_trip():
+    rng = random.Random(7)
+    for trial in range(8):
+        pl = filled(
+            random_partition_list(
+                rng, rng.randint(1, 40), rng.randint(2, 12),
+                weighted=bool(trial % 2), with_consumers=True,
+                restrict_brokers=True, max_rf=4,
+            )
+        )
+        dp = tensorize(pl)
+        decoded = dp.decode_replicas(dp.replicas, dp.nrep_cur)
+        for p, reps in zip(pl.partitions, decoded):
+            assert reps == p.replicas
+        # member/allowed masks agree with the ragged truth
+        for i, p in enumerate(pl.partitions):
+            for j, bid in enumerate(dp.broker_ids):
+                assert dp.member[i, j] == (bid in p.replicas)
+                assert dp.allowed[i, j] == (bid in p.brokers)
+        # padding invariants
+        assert not dp.pvalid[dp.np_ :].any()
+        assert not dp.allowed[dp.np_ :].any()
+        assert (dp.weights[dp.np_ :] == 0).all()
+        assert not dp.bvalid[dp.nb :].any()
+
+
+def test_tensorize_extra_brokers_extend_universe():
+    rng = random.Random(3)
+    pl = filled(random_partition_list(rng, 5, 4))
+    base = tensorize(pl)
+    ext = tensorize(pl, extra_brokers=[99999, 100000])
+    assert ext.nb == base.nb + 2
+    assert 99999 in ext.broker_ids
+
+
+def test_broker_loads_matches_oracle():
+    rng = random.Random(11)
+    for _ in range(8):
+        pl = filled(
+            random_partition_list(
+                rng, rng.randint(1, 60), rng.randint(2, 15),
+                with_consumers=True, max_rf=5,
+            )
+        )
+        dp = tensorize(pl)
+        loads = np.asarray(
+            cost.broker_loads(
+                jnp.asarray(dp.replicas), jnp.asarray(dp.weights),
+                jnp.asarray(dp.nrep_cur), jnp.asarray(dp.ncons),
+                dp.bvalid.shape[0],
+            )
+        )
+        oracle = get_broker_load(pl)
+        for j, bid in enumerate(dp.broker_ids):
+            assert loads[j] == pytest.approx(oracle.get(int(bid), 0.0), rel=1e-13)
+        assert (loads[dp.nb :] == 0).all()
+
+
+def test_unbalance_matches_oracle():
+    rng = random.Random(13)
+    for _ in range(8):
+        pl = filled(
+            random_partition_list(
+                rng, rng.randint(1, 60), rng.randint(2, 15), with_consumers=True
+            )
+        )
+        dp = tensorize(pl)
+        loads = cost.broker_loads(
+            jnp.asarray(dp.replicas), jnp.asarray(dp.weights),
+            jnp.asarray(dp.nrep_cur), jnp.asarray(dp.ncons), dp.bvalid.shape[0],
+        )
+        u = float(cost.unbalance(loads, jnp.asarray(dp.bvalid), float(dp.nb)))
+        oracle = get_unbalance_bl(get_bl(get_broker_load(pl)))
+        assert u == pytest.approx(oracle, rel=1e-12, abs=1e-15)
+
+
+def test_unbalance_nan_on_all_zero_loads():
+    # all-zero loads: avg = 0, rel = 0/0 = NaN → NaN objective, like the Go
+    # float64 path (utils.go:129-134 via IEEE division)
+    u = float(cost.unbalance(jnp.zeros(4), jnp.ones(4, bool), 4.0))
+    assert math.isnan(u)
+
+
+def test_rank_brokers_matches_bl_order():
+    rng = random.Random(17)
+    for _ in range(8):
+        pl = filled(random_partition_list(rng, 30, rng.randint(2, 12)))
+        dp = tensorize(pl)
+        loads_np = np.zeros(dp.bvalid.shape[0])
+        oracle_loads = get_broker_load(pl)
+        for j, bid in enumerate(dp.broker_ids):
+            loads_np[j] = oracle_loads.get(int(bid), 0.0)
+        loads_rank, perm, rank_of = cost.rank_brokers(
+            jnp.asarray(loads_np), jnp.asarray(dp.bvalid)
+        )
+        bl = get_bl(oracle_loads)
+        ranked_ids = [int(dp.broker_ids[int(perm[r])]) for r in range(dp.nb)]
+        assert ranked_ids == [bid for bid, _ in bl]
+        np.testing.assert_allclose(
+            np.asarray(loads_rank)[: dp.nb], [load for _, load in bl], rtol=1e-13
+        )
+        # rank_of inverts perm
+        perm_np = np.asarray(perm)
+        assert (np.asarray(rank_of)[perm_np] == np.arange(len(perm_np))).all()
+        # padded brokers rank last
+        assert (perm_np[dp.nb :] >= dp.nb).all() or dp.nb == dp.bvalid.shape[0]
