@@ -70,6 +70,76 @@ def unbalance(loads, bvalid, nb):
     return jnp.sum(jnp.where(bvalid, pen, 0.0))
 
 
+def move_candidate_scores(
+    loads,
+    replicas,
+    allowed_rank,
+    member_rank,
+    bvalid,
+    bvalid_rank,
+    perm,
+    rank_of,
+    weights,
+    nrep_cur,
+    nrep_tgt,
+    pvalid,
+    nb,
+    min_replicas,
+):
+    """Rank-1 what-if scores for every ``(partition, replica slot, target)``
+    move candidate — the shared core of the tpu and scan solvers.
+
+    A move shifts weight ``w`` from source ``s`` to target ``t``, leaving
+    the total (and thus average) load unchanged, so the reference's O(B)
+    objective recompute (steps.go:205-208) collapses to
+
+        u = Σ_b f(load_b) − f(load_s) − f(load_t)
+                          + f(load_s − w) + f(load_t + w)
+
+    with ``f`` the asymmetric penalty (utils.go:134-143). The what-if delta
+    uses the plain follower weight even for leader moves — the premium is
+    *not* re-simulated (steps.go:185/:207, SURVEY.md §3.3).
+
+    The target axis is in ascending (load, ID) bl-rank order (``perm``/
+    ``rank_of`` from :func:`rank_brokers`); masking covers target
+    eligibility (allowed ∧ not already a replica ∧ real broker,
+    steps.go:193-201), slot validity, and the ``num_replicas ≥
+    min_replicas`` gate (steps.go:168-170) — but NOT leader/follower slot
+    selection, which the caller applies on the slot axis
+    (steps.go:172-175). Returns ``(u_masked [P, R, B], su)`` with
+    ineligible candidates at +inf.
+    """
+    loads_rank = loads[perm]
+    avg = jnp.sum(jnp.where(bvalid, loads, 0.0)) / nb
+    F = jnp.where(bvalid_rank, overload_penalty(loads_rank, avg), 0.0)
+    su = jnp.sum(F)
+
+    w = weights[:, None]  # [P, 1]
+    s = jnp.clip(replicas, 0)  # [P, R] dense idx (pad-safe)
+    F_s = F[rank_of[s]]  # [P, R]
+    f_s_new = overload_penalty(loads[s] - w, avg)  # [P, R]
+    f_t_new = overload_penalty(loads_rank[None, :] + w, avg)  # [P, B]
+
+    u = (
+        su
+        - F_s[:, :, None]
+        - F[None, None, :]
+        + f_s_new[:, :, None]
+        + f_t_new[:, None, :]
+    )  # [P, R, B]
+
+    R = replicas.shape[1]
+    slot = jnp.arange(R)[None, :]
+    srcmask = (
+        (slot < nrep_cur[:, None])
+        & pvalid[:, None]
+        & (nrep_tgt >= min_replicas)[:, None]
+    )  # [P, R]
+    tmask = allowed_rank & ~member_rank & bvalid_rank  # [P, B]
+    mask = srcmask[:, :, None] & tmask[:, None, :]
+    return jnp.where(mask, u, jnp.inf), su
+
+
 def rank_brokers(loads, bvalid):
     """Ascending (load, broker-index) ranking of the valid brokers
     (utils.go:14-28, utils.go:107-117).

@@ -22,7 +22,12 @@ def ensure_x64() -> None:
     if _configured:
         return
     _configured = True
-    if os.environ.get("KAFKABALANCER_TPU_NO_X64"):
+    if os.environ.get("KAFKABALANCER_TPU_NO_X64", "").lower() in (
+        "1",
+        "true",
+        "yes",
+        "on",
+    ):
         return
     import jax
 

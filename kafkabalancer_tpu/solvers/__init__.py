@@ -1,0 +1,13 @@
+"""Optimization backends.
+
+- :mod:`kafkabalancer_tpu.solvers.tpu` — vectorized single-move search: all
+  ``(partition, replica, target)`` candidates scored in one fused XLA pass
+  (replaces the reference's O(P·R·B²) scalar scan, steps.go:145-232).
+- :mod:`kafkabalancer_tpu.solvers.scan` — multi-move sessions fused
+  on-device with ``lax.while_loop`` (replaces the host-side
+  ``-max-reassign`` outer loop, kafkabalancer.go:177-221).
+- :mod:`kafkabalancer_tpu.solvers.beam` (planned, not yet shipped) — N-way
+  beam search over move sequences (the upstream's planned-but-never-built
+  feature, README.md:94-100). Until it lands, ``-solver=beam`` runs the
+  tpu backend.
+"""

@@ -1,0 +1,287 @@
+"""Multi-move planning sessions fused on device.
+
+The reference applies one move per ``Balance()`` call and loops on the host
+(``-max-reassign`` outer loop, kafkabalancer.go:177-221), recomputing broker
+loads from scratch each iteration. Here an entire k-move session runs as a
+single XLA ``while_loop``: per iteration the full ``[P, R, B]`` candidate
+tensor is scored (rank-1 objective update, see solvers/tpu.py), the winner
+is applied on device, and the loop exits early once no candidate clears the
+``min_unbalance`` threshold — zero host round-trips until the session ends.
+
+Semantics relative to the per-move pipeline:
+
+- step precedence per iteration matches the reference: leader candidates
+  (gated on ``allow_leader_rebalancing``) are accepted first, follower
+  candidates otherwise (balancer.go:42-43 MoveLeaders before
+  MoveNonLeaders);
+- candidate *scoring* uses the plain follower weight even for leader moves
+  (the reference's under-modelling, steps.go:185/:207), but *applying* a
+  leader move shifts the true load — weight × (replica count +
+  num_consumers) — because the next iteration of the reference recomputes
+  loads from the real assignment (utils.go:92-105);
+- tie-breaks use candidate order (partition, slot, ascending (load, ID)
+  target rank) with the *incremental* objective. The per-move ``tpu``
+  solver re-scores ties with the oracle's accumulation-order floats for
+  byte parity with Go; a fused session cannot, so mathematically tied
+  candidates may resolve differently than the reference — plan quality is
+  identical (same unbalance trajectory to float round-off);
+- ``rebalance_leaders`` (forced leadership redistribution,
+  steps.go:234-282) fires every iteration in the reference pipeline and is
+  inherently host-sequential here; :func:`plan` falls back to the per-move
+  pipeline when it is enabled.
+
+``dtype`` selects the on-device precision: float64 matches the oracle to
+round-off (TPU executes f64 in software); float32 is the throughput mode
+for large clusters where the objective's ~1e-7 relative noise is far below
+any real decision margin.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Tuple
+
+from kafkabalancer_tpu.models import Partition, PartitionList, RebalanceConfig
+from kafkabalancer_tpu.models.partition import empty_partition_list
+from kafkabalancer_tpu.ops.runtime import ensure_x64
+
+ensure_x64()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax import lax  # noqa: E402
+
+from kafkabalancer_tpu.balancer.pipeline import _COMMON_HEAD  # noqa: E402
+from kafkabalancer_tpu.ops import cost, tensorize  # noqa: E402
+from kafkabalancer_tpu.ops.runtime import next_bucket  # noqa: E402
+
+
+@partial(
+    jax.jit,
+    static_argnames=("max_moves", "allow_leader"),
+)
+def session(
+    loads,
+    replicas,
+    member,
+    allowed,
+    weights,
+    nrep_cur,
+    nrep_tgt,
+    ncons,
+    pvalid,
+    bvalid,
+    nb,
+    min_replicas,
+    min_unbalance,
+    budget,
+    *,
+    max_moves: int,
+    allow_leader: bool,
+):
+    """Run up to ``min(budget, max_moves)`` accepted moves on device.
+
+    ``max_moves`` (static) sizes the move-log buffers and is bucketed by the
+    caller so XLA compiles once per bucket; ``budget`` (dynamic) is the
+    actual reassignment budget. Returns ``(replicas, loads, n_moves,
+    move_p, move_slot, move_src, move_tgt, final_su)`` where the ``move_*``
+    arrays log the accepted moves in order (dense indices; entries past
+    ``n_moves`` are -1).
+    """
+    P, R = replicas.shape
+    B = loads.shape[0]
+    dtype = loads.dtype
+
+    move_p = jnp.full(max_moves, -1, jnp.int32)
+    move_slot = jnp.full(max_moves, -1, jnp.int32)
+    move_src = jnp.full(max_moves, -1, jnp.int32)
+    move_tgt = jnp.full(max_moves, -1, jnp.int32)
+
+    slot_iota = jnp.arange(R)[None, :]
+
+    def cond(state):
+        _, _, _, n, done, *_ = state
+        return (~done) & (n < budget) & (n < max_moves)
+
+    def body(state):
+        loads, replicas, member, n, done, mp, mslot, msrc, mtgt = state
+
+        _, perm, rank_of = cost.rank_brokers(loads, bvalid)
+        u, su = cost.move_candidate_scores(
+            loads, replicas, allowed[:, perm], member[:, perm], bvalid,
+            bvalid[perm], perm, rank_of, weights, nrep_cur, nrep_tgt,
+            pvalid, nb, min_replicas,
+        )
+
+        def best(mask_slots):
+            flat = jnp.where(mask_slots[None, :, None], u, jnp.inf).reshape(-1)
+            i = jnp.argmin(flat)
+            return flat[i], i
+
+        fol_u, fol_i = best(slot_iota[0] >= 1)
+        if allow_leader:
+            lead_u, lead_i = best(slot_iota[0] == 0)
+            accept_lead = (lead_u < su - min_unbalance) & (lead_u < su)
+        else:
+            lead_i = jnp.zeros_like(fol_i)
+            accept_lead = jnp.bool_(False)
+        accept_fol = (fol_u < su - min_unbalance) & (fol_u < su)
+
+        accept = accept_lead | accept_fol
+        chosen = jnp.where(accept_lead, lead_i, fol_i)
+
+        p, rem = jnp.divmod(chosen, R * B)
+        slot, t_rank = jnp.divmod(rem, B)
+        t_dense = perm[t_rank]
+        s_dense = replicas[p, slot]
+
+        # applied load delta: the leader premium travels with slot 0
+        # (utils.go:96-101) even though scoring used the plain weight
+        delta = jnp.where(
+            slot == 0,
+            weights[p] * (nrep_cur[p].astype(dtype) + ncons[p]),
+            weights[p],
+        )
+
+        def apply(args):
+            loads, replicas, member, mp, mslot, msrc, mtgt = args
+            loads = loads.at[s_dense].add(-delta).at[t_dense].add(delta)
+            replicas = replicas.at[p, slot].set(t_dense.astype(replicas.dtype))
+            member = member.at[p, s_dense].set(False).at[p, t_dense].set(True)
+            mp = mp.at[n].set(p.astype(jnp.int32))
+            mslot = mslot.at[n].set(slot.astype(jnp.int32))
+            msrc = msrc.at[n].set(s_dense.astype(jnp.int32))
+            mtgt = mtgt.at[n].set(t_dense.astype(jnp.int32))
+            return loads, replicas, member, mp, mslot, msrc, mtgt
+
+        loads, replicas, member, mp, mslot, msrc, mtgt = lax.cond(
+            accept,
+            apply,
+            lambda args: args,
+            (loads, replicas, member, mp, mslot, msrc, mtgt),
+        )
+        n = n + accept.astype(n.dtype)
+        return loads, replicas, member, n, ~accept, mp, mslot, msrc, mtgt
+
+    state = (
+        loads,
+        replicas,
+        member,
+        jnp.int32(0),
+        jnp.bool_(False),
+        move_p,
+        move_slot,
+        move_src,
+        move_tgt,
+    )
+    loads, replicas, member, n, _done, mp, mslot, msrc, mtgt = lax.while_loop(
+        cond, body, state
+    )
+    final_su = cost.unbalance(loads, bvalid, nb)
+    return replicas, loads, n, mp, mslot, msrc, mtgt, final_su
+
+
+def _settle_head(
+    pl: PartitionList, cfg: RebalanceConfig, budget: int
+) -> Tuple[List[Partition], int]:
+    """Run the pipeline head (validations, defaults, repairs) until no step
+    fires, applying each repair like the CLI loop does. Returns the applied
+    live partitions (each counts against the reassignment budget)."""
+    from kafkabalancer_tpu.cli import apply_assignment
+
+    out: List[Partition] = []
+    while budget > 0:
+        fired = None
+        for _name, step in _COMMON_HEAD:
+            fired = step(pl, cfg)
+            if fired is not None:
+                break
+        if fired is None:
+            break
+        for changed in fired.partitions:
+            out.append(apply_assignment(pl, changed))
+        budget -= 1
+    return out, budget
+
+
+def plan(
+    pl: PartitionList,
+    cfg: RebalanceConfig,
+    max_reassign: int,
+    dtype=None,
+) -> PartitionList:
+    """Full multi-move planning session: host-side repairs, then a fused
+    on-device move loop. The output accumulates live partitions in move
+    order exactly like the CLI main loop's ``opl`` (so entries reflect the
+    final assignment, kafkabalancer.go:177-221 + SURVEY.md §2.2); ``pl`` is
+    mutated in place like the reference's aliasing does.
+
+    Falls back to the host per-move pipeline when ``rebalance_leaders`` is
+    set (see module docstring).
+    """
+    opl = empty_partition_list()
+    if max_reassign <= 0:
+        return opl
+
+    if cfg.rebalance_leaders:
+        from kafkabalancer_tpu.balancer.pipeline import balance
+        from kafkabalancer_tpu.cli import apply_assignment
+
+        budget = max_reassign
+        while budget > 0:
+            ppl = balance(pl, cfg)
+            if len(ppl) == 0:
+                break
+            for changed in ppl.partitions:
+                opl.append(apply_assignment(pl, changed))
+            budget -= 1
+        return opl
+
+    repaired, budget = _settle_head(pl, cfg, max_reassign)
+    opl.append(*repaired)
+    if dtype is None:
+        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+    # sessions chunk at 2^20 moves per device dispatch; a larger budget
+    # re-enters with the mutated assignment until converged or exhausted
+    remaining = budget
+    while remaining > 0:
+        dp = tensorize(pl, cfg)
+        loads = cost.broker_loads(
+            jnp.asarray(dp.replicas),
+            jnp.asarray(dp.weights, dtype),
+            jnp.asarray(dp.nrep_cur),
+            jnp.asarray(dp.ncons, dtype),
+            dp.bvalid.shape[0],
+        )
+        chunk = min(remaining, 1 << 20)
+        _replicas, _loads, n, mp, mslot, _msrc, mtgt, _su = session(
+            loads,
+            jnp.asarray(dp.replicas),
+            jnp.asarray(dp.member),
+            jnp.asarray(dp.allowed),
+            jnp.asarray(dp.weights, dtype),
+            jnp.asarray(dp.nrep_cur),
+            jnp.asarray(dp.nrep_tgt),
+            jnp.asarray(dp.ncons, dtype),
+            jnp.asarray(dp.pvalid),
+            jnp.asarray(dp.bvalid),
+            jnp.asarray(dp.nb, dtype),
+            jnp.int32(cfg.min_replicas_for_rebalancing),
+            jnp.asarray(cfg.min_unbalance, dtype),
+            jnp.int32(chunk),
+            max_moves=next_bucket(chunk, 64),
+            allow_leader=cfg.allow_leader_rebalancing,
+        )
+
+        n = int(n)
+        mp, mslot, mtgt = (np.asarray(x)[:n] for x in (mp, mslot, mtgt))
+        for i in range(n):
+            part = dp.partitions[int(mp[i])]
+            part.replicas[int(mslot[i])] = int(dp.broker_ids[int(mtgt[i])])
+            opl.append(part)
+        remaining -= n
+        if n < chunk:
+            break
+    return opl

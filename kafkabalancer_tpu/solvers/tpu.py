@@ -1,0 +1,269 @@
+"""Vectorized single-move solver.
+
+Replaces the reference's greedy scalar scan (``move``, steps.go:145-232):
+instead of mutating one broker-load table through O(P·R·B) candidate
+what-ifs at O(B) objective re-evaluation each, every candidate
+``(partition p, movable replica r, target broker t)`` is scored in one
+fused XLA pass over a ``[P, R, B]`` tensor.
+
+The O(B) objective re-evaluation collapses to an O(1) rank-1 update: a move
+shifts weight ``w`` from source ``s`` to target ``t``, leaving the total
+(and thus the average) load unchanged, so
+
+    u(move) = Σ_b f(load_b) − f(load_s) − f(load_t)
+                            + f(load_s − w) + f(load_t + w)
+
+with ``f`` the asymmetric per-broker penalty (utils.go:134-143).
+
+**Exact-parity tie resolution.** The reference's full O(B) recompute per
+candidate accumulates floats in ``bl`` order, so mathematically tied
+candidates (ubiquitous with the default weight 1.0) are separated by
+last-ulp rounding noise — behaviour an order-free vectorized reduction
+cannot reproduce. The device pass therefore returns, besides the argmin,
+the top-K near-minimal candidates; the host re-scores just that window
+with the float64 oracle (same accumulation order as Go) and replays the
+reference's first-strict-improver scan (steps.go:211) over it in candidate
+order. Result: byte-identical plans to the greedy oracle at vectorized
+search cost, falling back to the full greedy scan only if the tie window
+overflows K.
+
+Parity semantics pinned against the greedy oracle:
+
+- candidate order: partitions in list order, movable slots in replica
+  order (followers = slots 1.., leader = slot 0, steps.go:172-175),
+  targets in ascending (load, broker-ID) ``bl`` rank order;
+- the what-if delta uses the plain follower weight even when moving a
+  leader (steps.go:185, :207 — the premium is *not* re-simulated;
+  SURVEY.md §3.3);
+- the load table is observed brokers ∪ ``cfg.brokers`` zero-filled
+  (steps.go:150-155), computed host-side in the oracle's accumulation
+  order so tie re-scores are bit-identical — see
+  ``tensorize.broker_universe``;
+- eligibility: ``num_replicas ≥ min_replicas_for_rebalancing``
+  (steps.go:168-170); target must be allowed and not already a replica
+  (steps.go:193-201);
+- acceptance: best unbalance < current − ``min_unbalance``
+  (steps.go:227-229), decided on exact host-rescored values; NaN
+  objectives reject every candidate exactly like Go's always-false NaN
+  comparisons.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from kafkabalancer_tpu.models import PartitionList, RebalanceConfig
+from kafkabalancer_tpu.ops.runtime import ensure_x64
+
+ensure_x64()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+from kafkabalancer_tpu.balancer import costmodel  # noqa: E402
+from kafkabalancer_tpu.balancer.steps import greedy_move, replace_replica  # noqa: E402
+from kafkabalancer_tpu.ops import cost, tensorize  # noqa: E402
+from kafkabalancer_tpu.ops.tensorize import DensePlan  # noqa: E402
+
+# Size of the near-tie window re-scored exactly on the host. Overflowing it
+# (>TIE_K mathematically tied candidates) falls back to the greedy scan.
+TIE_K = 1024
+
+
+def score_moves(
+    loads,
+    replicas,
+    allowed,
+    member,
+    weights,
+    nrep_cur,
+    nrep_tgt,
+    pvalid,
+    bvalid,
+    nb,
+    min_replicas,
+    *,
+    leaders: bool,
+    tie_k: int = 0,
+):
+    """Score every candidate move with the rank-1 objective update.
+
+    Returns ``(u_min, flat_idx, su, perm)`` and, when ``tie_k > 0``,
+    additionally ``(topk_vals, topk_idx)`` — the ``tie_k`` smallest
+    candidates. ``flat_idx`` indexes the candidate tensor flattened in
+    ``(partition, replica slot, target bl-rank)`` order; ``perm`` maps
+    bl rank → dense broker index. Inputs are dense index space
+    (:class:`kafkabalancer_tpu.ops.tensorize.DensePlan`).
+    """
+    _, R = replicas.shape
+
+    _, perm, rank_of = cost.rank_brokers(loads, bvalid)
+    u, su = cost.move_candidate_scores(
+        loads,
+        replicas,
+        allowed[:, perm],
+        member[:, perm],
+        bvalid,
+        bvalid[perm],
+        perm,
+        rank_of,
+        weights,
+        nrep_cur,
+        nrep_tgt,
+        pvalid,
+        nb,
+        min_replicas,
+    )
+
+    slot = jnp.arange(R)[None, :]
+    movable = (slot == 0) if leaders else (slot >= 1)
+    flat = jnp.where(movable[:, :, None], u, jnp.inf).reshape(-1)
+    idx = jnp.argmin(flat)
+    if tie_k <= 0:
+        return flat[idx], idx, su, perm
+    k = min(tie_k, flat.shape[0])
+    neg_vals, top_idx = lax.top_k(-flat, k)
+    return flat[idx], idx, su, perm, -neg_vals, top_idx
+
+
+_score_jit = jax.jit(score_moves, static_argnames=("leaders", "tie_k"))
+
+
+def _oracle_loads(pl: PartitionList, cfg: RebalanceConfig):
+    """Broker loads in the oracle's accumulation order, with the reference
+    ``move()`` zero-fill of configured brokers (steps.go:150-155)."""
+    loads = costmodel.get_broker_load(pl)
+    for bid in cfg.brokers or []:
+        if bid not in loads:
+            loads[bid] = 0.0
+    return loads
+
+
+def _exact_rescore(
+    bl: List[List], rank_of_idx: np.ndarray, w: float, s_dense: int, t_dense: int
+) -> float:
+    """Exact objective of one candidate: mutate a copy of ``bl`` like the
+    reference (source −w, target +w; steps.go:179-208) and accumulate the
+    objective in ``bl`` order — bit-identical to the Go scan."""
+    s_rank = int(rank_of_idx[s_dense])
+    t_rank = int(rank_of_idx[t_dense])
+    # save/assign restore like the reference (steps.go:218, :221) — a ±w
+    # round-trip would not restore the cells bitwise
+    s_old = bl[s_rank][1]
+    t_old = bl[t_rank][1]
+    bl[s_rank][1] = s_old - w
+    bl[t_rank][1] = t_old + w
+    u = costmodel.get_unbalance_bl(bl)
+    bl[s_rank][1] = s_old
+    bl[t_rank][1] = t_old
+    return u
+
+
+def find_best_move(
+    dp: DensePlan, cfg: RebalanceConfig, leaders: bool, loads_map=None
+) -> Optional[Tuple[int, int, int]]:
+    """Best accepted move on a dense plan, or ``None`` if no candidate
+    improves by more than ``cfg.min_unbalance``.
+
+    Returns ``(partition row, source broker ID, target broker ID)``.
+    ``None`` also signals the caller must fall back to the greedy scan
+    (tie-window overflow) via the :class:`TieOverflow` exception instead.
+    """
+    nb = dp.nb
+    B = dp.bvalid.shape[0]
+    R = dp.replicas.shape[1]
+
+    if loads_map is None:
+        pl = PartitionList(version=1, partitions=dp.partitions)
+        loads_map = _oracle_loads(pl, cfg)
+    loads_np = np.zeros(B, dtype=np.float64)
+    for bid, load in loads_map.items():
+        loads_np[dp.broker_index(bid)] = load
+
+    out = _score_jit(
+        jnp.asarray(loads_np),
+        jnp.asarray(dp.replicas),
+        jnp.asarray(dp.allowed),
+        jnp.asarray(dp.member),
+        jnp.asarray(dp.weights),
+        jnp.asarray(dp.nrep_cur),
+        jnp.asarray(dp.nrep_tgt),
+        jnp.asarray(dp.pvalid),
+        jnp.asarray(dp.bvalid),
+        float(nb),
+        int(cfg.min_replicas_for_rebalancing),
+        leaders=leaders,
+        tie_k=TIE_K,
+    )
+    u_min, _idx, _su, perm, tie_vals, tie_idx = (np.asarray(x) for x in out)
+    u_min = float(u_min)
+    if not np.isfinite(u_min):  # no candidate, or NaN objective (zero loads)
+        return None
+
+    # --- host-exact tie resolution (module docstring) --------------------
+    bl = costmodel.get_bl(loads_map)  # oracle bl, (load, ID) ascending
+    su = costmodel.get_unbalance_bl(bl)
+    rank_of_idx = np.empty(B, dtype=np.int64)
+    rank_of_idx[np.asarray(perm)] = np.arange(B)
+
+    tol = 1e-9 * max(1.0, abs(u_min), abs(su)) + 1e-12
+    in_window = tie_vals <= u_min + tol
+    k = len(tie_vals)
+    if bool(in_window.all()) and k < R * B * dp.replicas.shape[0]:
+        # the window may extend past the K candidates we fetched — the
+        # vectorized result is unreliable, use the exact scan
+        raise TieOverflow
+
+    cand = np.sort(tie_idx[in_window])
+    cu, best = su, None
+    for flat in cand:
+        p, rem = divmod(int(flat), R * B)
+        r, t_rank = divmod(rem, B)
+        s_dense = int(dp.replicas[p, r])
+        t_dense = int(perm[t_rank])
+        u = _exact_rescore(bl, rank_of_idx, float(dp.weights[p]), s_dense, t_dense)
+        if u < cu:
+            cu = u
+            best = (p, s_dense, t_dense)
+
+    if best is None or not (cu < su - cfg.min_unbalance):
+        return None
+    p, s_dense, t_dense = best
+    return p, int(dp.broker_ids[s_dense]), int(dp.broker_ids[t_dense])
+
+
+class TieOverflow(Exception):
+    """More than TIE_K near-minimal candidates: resolve with the exact scan."""
+
+
+def _tpu_move(
+    pl: PartitionList, cfg: RebalanceConfig, leaders: bool
+) -> Optional[PartitionList]:
+    dp = tensorize(pl, cfg)
+    try:
+        best = find_best_move(dp, cfg, leaders)
+    except TieOverflow:
+        return greedy_move(pl, cfg, leaders)
+    if best is None:
+        return None
+    p, s_id, t_id = best
+    return replace_replica(dp.partitions[p], s_id, t_id)
+
+
+def tpu_move_leaders(
+    pl: PartitionList, cfg: RebalanceConfig
+) -> Optional[PartitionList]:
+    """Leader moves, gated like the reference (steps.go:292-298)."""
+    if not cfg.allow_leader_rebalancing:
+        return None
+    return _tpu_move(pl, cfg, True)
+
+
+def tpu_move_non_leaders(
+    pl: PartitionList, cfg: RebalanceConfig
+) -> Optional[PartitionList]:
+    """Follower moves — always enabled (steps.go:286-288)."""
+    return _tpu_move(pl, cfg, False)

@@ -1,0 +1,125 @@
+"""TPU solver parity tests: the vectorized candidate scorer must produce
+byte-identical plans to the greedy oracle (which is itself pinned against
+the Go reference by the golden table tests).
+
+Covers the full golden table under ``solver=tpu``, plus randomized
+multi-move session parity across weighted/equal-weight instances, leader
+rebalancing, restricted broker sets, and configured empty brokers —
+equal-weight instances specifically exercise the host-exact tie-resolution
+window (see solvers/tpu.py module docstring)."""
+
+import copy
+import random
+
+import pytest
+
+from helpers import random_partition_list
+from test_balancer import CASES, P, wrap
+
+from kafkabalancer_tpu.balancer import BalanceError, balance
+from kafkabalancer_tpu.cli import apply_assignment
+from kafkabalancer_tpu.models import default_rebalance_config
+
+
+def tpu_cfg(cfg):
+    cfg = copy.deepcopy(cfg)
+    cfg.solver = "tpu"
+    return cfg
+
+
+@pytest.mark.parametrize("idx", range(len(CASES)))
+def test_golden_case_tpu(idx):
+    pl_parts, expected, err, cfg_factory = CASES[idx]
+    pl = wrap(pl_parts)
+    cfg = tpu_cfg(cfg_factory() if cfg_factory else default_rebalance_config())
+
+    if err is not None:
+        with pytest.raises(BalanceError, match=err):
+            balance(pl, cfg)
+        return
+
+    ppl = balance(pl, cfg)
+    if expected is None:
+        assert len(ppl) == 0
+    else:
+        assert ppl == wrap(expected)
+
+
+def run_session(pl, cfg, max_moves):
+    """Replicate the CLI main loop: balance + apply, collecting the plans."""
+    out = []
+    for _ in range(max_moves):
+        ppl = balance(pl, cfg)
+        if len(ppl) == 0:
+            break
+        for changed in ppl.partitions:
+            live = apply_assignment(pl, changed)
+            out.append((live.topic, live.partition, tuple(live.replicas)))
+    return out
+
+
+def assert_session_parity(pl, cfg, max_moves=6):
+    pl_g, pl_t = copy.deepcopy(pl), copy.deepcopy(pl)
+    cfg_g, cfg_t = copy.deepcopy(cfg), tpu_cfg(cfg)
+    got_g = run_session(pl_g, cfg_g, max_moves)
+    got_t = run_session(pl_t, cfg_t, max_moves)
+    assert got_g == got_t
+    assert pl_g == pl_t  # final assignments identical too
+
+
+@pytest.mark.parametrize("weighted", [False, True])
+@pytest.mark.parametrize("allow_leader", [False, True])
+def test_random_session_parity(weighted, allow_leader):
+    rng = random.Random(100 + weighted * 10 + allow_leader)
+    for _ in range(6):
+        pl = random_partition_list(
+            rng,
+            rng.randint(2, 25),
+            rng.randint(2, 8),
+            max_rf=3,
+            weighted=weighted,
+            with_consumers=True,
+            restrict_brokers=True,
+        )
+        cfg = default_rebalance_config()
+        cfg.allow_leader_rebalancing = allow_leader
+        assert_session_parity(pl, cfg)
+
+
+def test_session_parity_with_empty_configured_broker():
+    """Configured brokers with no replicas are zero-filled valid targets
+    (steps.go:150-155)."""
+    rng = random.Random(42)
+    for _ in range(4):
+        pl = random_partition_list(rng, 12, 4, weighted=True)
+        observed = sorted({b for p in pl.partitions for b in p.replicas})
+        cfg = default_rebalance_config()
+        cfg.brokers = observed + [max(observed) + 1, max(observed) + 2]
+        assert_session_parity(pl, cfg)
+
+
+def test_session_parity_equal_weights_many_ties():
+    """Uniform weights produce massive candidate ties; the tie window must
+    reproduce the oracle's accumulation-order tie-breaks exactly."""
+    rng = random.Random(7)
+    for _ in range(4):
+        pl = random_partition_list(rng, 30, 6, weighted=False, max_rf=3)
+        assert_session_parity(pl, default_rebalance_config(), max_moves=10)
+
+
+def test_tpu_rejects_below_min_unbalance():
+    pl = wrap(
+        [
+            P("a", 1, [1, 2], weight=1.0),
+            P("a", 2, [2, 1], weight=1.0),
+        ]
+    )
+    cfg = tpu_cfg(default_rebalance_config())
+    assert len(balance(pl, cfg)) == 0
+
+
+def test_tpu_single_partition_no_valid_target():
+    # every broker already holds a replica → no candidate at all
+    pl = wrap([P("a", 1, [1, 2, 3], weight=1.0, brokers=[1, 2, 3])])
+    cfg = tpu_cfg(default_rebalance_config())
+    assert len(balance(pl, cfg)) == 0
