@@ -1,0 +1,20 @@
+"""Multi-chip parallel layer.
+
+The reference has no compute parallelism (SURVEY.md §2.9) — its only
+concurrency is a log-flushing goroutine. The TPU-native framework scales on
+two orthogonal mesh axes instead:
+
+- ``sweep`` — scenario parallelism: independent what-if rebalances (broker
+  add/remove, config variants) run one-per-device-group via ``shard_map``
+  (:mod:`kafkabalancer_tpu.parallel.sweep`);
+- ``part`` — partition sharding: the ``[P, R, B]`` candidate tensor of a
+  single solve is split over devices, each scoring its partition shard,
+  with an ``all_gather`` argmin combine that preserves the solver's
+  candidate-order tie-break (:mod:`kafkabalancer_tpu.parallel.shard_move`).
+
+Collectives ride the ICI mesh; host code only dispatches and decodes.
+"""
+
+from kafkabalancer_tpu.parallel.mesh import make_mesh
+
+__all__ = ["make_mesh"]

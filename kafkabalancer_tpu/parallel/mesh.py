@@ -1,0 +1,54 @@
+"""Device-mesh construction helpers.
+
+All multi-chip code in this framework is written against a named
+:class:`jax.sharding.Mesh` with axes ``("sweep", "part")`` — scenario
+parallelism × partition sharding (see package docstring). On a single chip
+both axes are 1 and everything degenerates to the plain jitted path.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+SWEEP_AXIS = "sweep"
+PART_AXIS = "part"
+
+
+def balanced_factors(n: int) -> Tuple[int, int]:
+    """Factor ``n`` into ``(a, b)``, ``a*b == n``, as square as possible
+    (``a ≤ b``). Prime counts fall back to ``(1, n)``."""
+    a = int(math.isqrt(n))
+    while a > 1 and n % a:
+        a -= 1
+    return a, n // a
+
+
+def make_mesh(
+    n_devices: Optional[int] = None,
+    axis_names: Sequence[str] = (SWEEP_AXIS, PART_AXIS),
+    shape: Optional[Tuple[int, int]] = None,
+) -> Mesh:
+    """A 2D ``(sweep, part)`` mesh over the first ``n_devices`` devices.
+
+    ``shape`` overrides the default balanced factorization. With one device
+    this is a trivial 1×1 mesh, so single-chip and multi-chip callers share
+    one code path.
+    """
+    devices = jax.devices()
+    if n_devices is None:
+        n_devices = len(devices)
+    if n_devices > len(devices):
+        raise ValueError(
+            f"requested {n_devices} devices, only {len(devices)} available"
+        )
+    if shape is None:
+        shape = balanced_factors(n_devices)
+    if shape[0] * shape[1] != n_devices:
+        raise ValueError(f"mesh shape {shape} != {n_devices} devices")
+    grid = np.asarray(devices[:n_devices]).reshape(shape)
+    return Mesh(grid, tuple(axis_names))

@@ -1,0 +1,144 @@
+"""Partition-sharded candidate scoring.
+
+Splits the single-solve ``[P, R, B]`` candidate tensor across the ``part``
+mesh axis: every device scores the moves of its partition shard against the
+(replicated) broker-load table, then an ``all_gather`` over the axis
+combines the per-shard minima into the global winner. The combine is
+tie-break-exact: shard-local flat indices are rebased to global candidate
+indices (partition-major order), and ties on the objective value resolve to
+the smallest global index — identical to the unsharded
+``solvers.tpu.score_moves`` argmin.
+
+This is the scale-out path for partition counts whose candidate tensor
+exceeds one chip's HBM (P·R·B grows to ~10⁸ candidates at 100k partitions ×
+RF4 × 256 brokers in f32); the broker table is tiny and riding the ICI for
+one ``all_gather`` of three scalars per shard is negligible.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+from kafkabalancer_tpu.ops.runtime import ensure_x64
+
+ensure_x64()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+from jax.sharding import Mesh, NamedSharding  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from kafkabalancer_tpu.ops import cost  # noqa: E402
+from kafkabalancer_tpu.parallel.mesh import PART_AXIS  # noqa: E402
+
+
+def _local_best(
+    loads,
+    replicas,
+    allowed,
+    member,
+    weights,
+    nrep_cur,
+    nrep_tgt,
+    pvalid,
+    bvalid,
+    nb,
+    min_replicas,
+    leaders: bool,
+):
+    """Best candidate of one partition shard: ``(u, local flat idx)``."""
+    R = replicas.shape[1]
+    _, perm, rank_of = cost.rank_brokers(loads, bvalid)
+    u, su = cost.move_candidate_scores(
+        loads,
+        replicas,
+        allowed[:, perm],
+        member[:, perm],
+        bvalid,
+        bvalid[perm],
+        perm,
+        rank_of,
+        weights,
+        nrep_cur,
+        nrep_tgt,
+        pvalid,
+        nb,
+        min_replicas,
+    )
+    slot = jnp.arange(R)[None, :]
+    movable = (slot == 0) if leaders else (slot >= 1)
+    flat = jnp.where(movable[:, :, None], u, jnp.inf).reshape(-1)
+    idx = jnp.argmin(flat)
+    return flat[idx], idx, su, perm
+
+
+@partial(jax.jit, static_argnames=("leaders", "mesh"))
+def sharded_score_moves(
+    loads,
+    replicas,
+    allowed,
+    member,
+    weights,
+    nrep_cur,
+    nrep_tgt,
+    pvalid,
+    bvalid,
+    nb,
+    min_replicas,
+    *,
+    leaders: bool,
+    mesh: Mesh,
+):
+    """Global best move with the partition axis sharded over ``mesh``'s
+    ``part`` axis. Returns ``(u_min, global flat idx, su, perm)`` — the
+    same contract as ``solvers.tpu.score_moves`` without the tie window.
+
+    Per-partition arrays shard on axis 0; the broker table replicates.
+    The partition bucket must divide evenly by the ``part`` axis size
+    (tensorize with ``min_bucket ≥`` the axis size guarantees it).
+    """
+    axis = mesh.shape[PART_AXIS]
+    P_pad = replicas.shape[0]
+    if P_pad % axis:
+        raise ValueError(f"partition bucket {P_pad} not divisible by part={axis}")
+
+    rep = P()  # fully replicated (length-0 spec fits any rank)
+    pshard = P(PART_AXIS)
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            rep, pshard, pshard, pshard, pshard, pshard, pshard, pshard,
+            rep, rep, rep,
+        ),
+        out_specs=(rep, rep, rep, rep),
+        # the winner index derives from axis_index, so the varying-mode
+        # analysis can't see it is replicated after the all_gather+min
+        check_vma=False,
+    )
+    def run(loads, replicas, allowed, member, weights, nrep_cur, nrep_tgt,
+            pvalid, bvalid, nb, min_replicas):
+        u, idx, su, perm = _local_best(
+            loads, replicas, allowed, member, weights, nrep_cur, nrep_tgt,
+            pvalid, bvalid, nb, min_replicas, leaders,
+        )
+        # rebase the shard-local candidate index to the global
+        # partition-major order so cross-shard ties keep the solver's
+        # first-candidate semantics
+        shard_i = lax.axis_index(PART_AXIS)
+        local_p = replicas.shape[0]
+        gidx = idx + shard_i.astype(idx.dtype) * (
+            local_p * replicas.shape[1] * loads.shape[0]
+        )
+        u_all = lax.all_gather(u, PART_AXIS)  # [axis]
+        g_all = lax.all_gather(gidx, PART_AXIS)
+        u_min = jnp.min(u_all)
+        winner = jnp.min(jnp.where(u_all == u_min, g_all, jnp.iinfo(g_all.dtype).max))
+        return u_min, winner, su, perm
+
+    return run(
+        loads, replicas, allowed, member, weights, nrep_cur, nrep_tgt,
+        pvalid, bvalid, nb, min_replicas,
+    )

@@ -1,0 +1,277 @@
+"""What-if scenario sweeps: broker add/remove evaluated in parallel.
+
+The reference answers "what if I add/remove broker X?" by re-running the
+whole CLI once per scenario (README.md:109-137 walks such scenarios by
+hand). Here a batch of scenarios — each a candidate broker set — runs in
+one dispatch, sharded over the ``sweep`` mesh axis; every scenario
+evacuates replicas stranded on newly-disallowed brokers and then rebalances
+to convergence with the fused session loop, all on device.
+
+Per-scenario semantics mirror a CLI run with ``-broker-ids=<scenario>``:
+
+- partitions with an explicit per-partition broker list keep it; all
+  others adopt the scenario's broker set (``FillDefaults``,
+  steps.go:47-56);
+- stranded replicas move one at a time — first partition in list order,
+  first disallowed replica slot, target = most-loaded allowed non-member
+  broker *currently holding at least one replica* (the reference's
+  descending scan over the observed-only table, steps.go:117-143 — a
+  brand-new empty broker is never an evacuation target, SURVEY.md §2.5),
+  with loads recomputed between evacuations exactly as successive
+  ``Balance`` calls do. A scenario with no legal target is reported
+  infeasible (the CLI's exit-3 "unable to pick replica to replace");
+- optimization then runs the fused move session (solvers/scan.py) with the
+  scenario set as the configured zero-filled brokers, so empty *added*
+  brokers are valid move targets (steps.go:150-155).
+
+Results carry per-scenario feasibility, move counts, final unbalance, and
+the final assignment, plus the argmin scenario.
+
+Contract limits (explicit errors, never silent divergence): the input must
+be repair-settled (``num_replicas == len(replicas)`` everywhere — replica
+add/remove targets are scenario-dependent and host-side);
+``rebalance_leaders`` is unsupported (host-sequential by nature); budgets
+cap at 2^20 moves per scenario.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass
+from functools import partial
+from typing import List, Optional, Sequence
+
+from kafkabalancer_tpu.models import PartitionList, RebalanceConfig
+from kafkabalancer_tpu.ops.runtime import ensure_x64, next_bucket
+
+ensure_x64()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax import lax  # noqa: E402
+from jax.sharding import Mesh  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+from kafkabalancer_tpu.balancer import steps as _s  # noqa: E402
+from kafkabalancer_tpu.ops import cost, tensorize  # noqa: E402
+from kafkabalancer_tpu.parallel.mesh import SWEEP_AXIS, make_mesh  # noqa: E402
+from kafkabalancer_tpu.solvers.scan import session  # noqa: E402
+
+
+@dataclass
+class SweepResult:
+    """Outcome of one what-if scenario."""
+
+    brokers: List[int]  # the scenario's broker set
+    feasible: bool  # False: a stranded replica had no legal target
+    n_evacuations: int  # disallowed-replica moves applied
+    n_moves: int  # optimization moves applied
+    unbalance: float  # final objective value
+    replicas: List[List[int]]  # final assignment, row-aligned with input
+
+
+def _evacuate(replicas, member, allowed_s, weights, nrep_cur, ncons, pvalid,
+              universe_valid, max_evac: int):
+    """Drain disallowed replicas one at a time (module docstring)."""
+    Ppad, R = replicas.shape
+    B = universe_valid.shape[0]
+    flat_iota = jnp.arange(Ppad * R)
+    big = Ppad * R + 1
+
+    def cond(st):
+        replicas, member, n, feasible = st
+        stranded = _stranded_mask(replicas, allowed_s, nrep_cur, pvalid)
+        return stranded.any() & feasible & (n < max_evac)
+
+    def _stranded_mask(replicas, allowed_s, nrep_cur, pvalid):
+        slot = jnp.arange(R)[None, :]
+        valid = (slot < nrep_cur[:, None]) & pvalid[:, None]
+        target_ok = jnp.take_along_axis(
+            allowed_s, jnp.clip(replicas, 0), axis=1
+        )  # [P, R]: replica's broker allowed?
+        return valid & ~target_ok
+
+    def body(st):
+        replicas, member, n, feasible = st
+        stranded = _stranded_mask(replicas, allowed_s, nrep_cur, pvalid)
+        flat = jnp.where(stranded.reshape(-1), flat_iota, big)
+        first = jnp.min(flat)
+        p, slot = jnp.divmod(first, R)
+
+        loads = cost.broker_loads(replicas, weights, nrep_cur, ncons, B)
+        observed = jnp.any(member & pvalid[:, None], axis=0)
+        # target: most-loaded (then highest ID) allowed non-member broker
+        # present in the observed-only table (steps.go:122, :129-135)
+        elig = allowed_s[p] & ~member[p] & observed & universe_valid
+        _, _, rank_of = cost.rank_brokers(loads, observed & universe_valid)
+        t = jnp.argmax(jnp.where(elig, rank_of, -1))
+        ok = elig.any()
+
+        s = replicas[p, slot]
+
+        def apply(args):
+            replicas, member = args
+            replicas = replicas.at[p, slot].set(t.astype(replicas.dtype))
+            member = member.at[p, s].set(False).at[p, t].set(True)
+            return replicas, member
+
+        replicas, member = lax.cond(ok, apply, lambda a: a, (replicas, member))
+        return replicas, member, n + ok.astype(n.dtype), feasible & ok
+
+    state = (replicas, member, jnp.int32(0), jnp.bool_(True))
+    return lax.while_loop(cond, body, state)
+
+
+def _scenario_body(
+    replicas, member, allowed_base, has_explicit, scenario_mask, weights,
+    nrep_cur, nrep_tgt, ncons, pvalid, universe_valid, min_replicas,
+    min_unbalance, budget, *, max_moves: int, max_evac: int,
+    allow_leader: bool,
+):
+    """One scenario end-to-end on device: evacuation + move session."""
+    allowed_s = jnp.where(has_explicit[:, None], allowed_base, scenario_mask[None, :])
+
+    replicas, member, n_evac, feasible = _evacuate(
+        replicas, member, allowed_s, weights, nrep_cur, ncons, pvalid,
+        universe_valid, max_evac,
+    )
+
+    loads = cost.broker_loads(replicas, weights, nrep_cur, ncons,
+                              universe_valid.shape[0])
+    replicas, _loads, n_moves, _mp, _mslot, _msrc, _mtgt, su = session(
+        loads, replicas, member, allowed_s, weights, nrep_cur, nrep_tgt,
+        ncons, pvalid, scenario_mask & universe_valid, universe_valid,
+        min_replicas, min_unbalance, budget,
+        max_moves=max_moves, allow_leader=allow_leader,
+    )
+    return replicas, feasible, n_evac, n_moves, su
+
+
+def sweep(
+    pl: PartitionList,
+    cfg: RebalanceConfig,
+    scenarios: Sequence[Sequence[int]],
+    max_reassign: int = 1 << 16,
+    mesh: Optional[Mesh] = None,
+    dtype=None,
+) -> List[SweepResult]:
+    """Evaluate ``scenarios`` (broker-ID sets) in parallel; see module
+    docstring. ``pl`` is not mutated. The scenario axis shards over
+    ``mesh``'s ``sweep`` axis (default: a mesh over all devices)."""
+    if cfg.rebalance_leaders:
+        raise _s.BalanceError(
+            "sweep does not support rebalance_leaders (forced leadership "
+            "redistribution is host-sequential, steps.go:234-282); run "
+            "scenarios through the per-move pipeline instead"
+        )
+    if max_reassign > (1 << 20):
+        raise ValueError(
+            "sweep caps max_reassign at 2^20 per scenario (one fused device "
+            "session, no re-entry); use solvers.scan.plan for larger budgets"
+        )
+    if mesh is None:
+        mesh = make_mesh()
+    n_sweep = mesh.shape[SWEEP_AXIS]
+
+    pl = copy.deepcopy(pl)
+    cfg = copy.deepcopy(cfg)
+    has_explicit_l = [p.brokers is not None for p in pl.iter_partitions()]
+    from kafkabalancer_tpu.balancer.pipeline import _COMMON_HEAD
+
+    for name, step in _COMMON_HEAD[:3]:  # validations + FillDefaults
+        try:
+            step(pl, cfg)
+        except _s.BalanceError as exc:
+            raise _s.BalanceError(f"{name}: {exc}") from None
+    for p in pl.iter_partitions():
+        if p.num_replicas != len(p.replicas):
+            # replica add/remove repairs are scenario-dependent (target
+            # choice follows the scenario broker set, steps.go:70-113) and
+            # run host-side; require a repair-settled input instead of
+            # silently returning structurally wrong assignments
+            raise _s.BalanceError(
+                f"sweep requires a repair-settled assignment, but partition "
+                f"{p} has {len(p.replicas)} replicas and num_replicas="
+                f"{p.num_replicas}; run the pipeline (or solvers.scan.plan) "
+                f"first"
+            )
+
+    extra = sorted({int(b) for sc in scenarios for b in sc})
+    dp = tensorize(pl, cfg, extra_brokers=extra)
+    B = dp.bvalid.shape[0]
+
+    S = len(scenarios)
+    S_pad = next_bucket(S, max(1, n_sweep))  # always a multiple of n_sweep
+    scenario_mask = np.zeros((S_pad, B), dtype=bool)
+    for i, sc in enumerate(scenarios):
+        for bid in sc:
+            scenario_mask[i, dp.broker_index(int(bid))] = True
+
+    if dtype is None:
+        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+    has_explicit = np.asarray(has_explicit_l + [False] * (dp.pvalid.shape[0] - dp.np_))
+    max_evac = int(dp.replicas.shape[0] * dp.replicas.shape[1])
+    max_moves = next_bucket(min(max_reassign, 1 << 20), 64)
+
+    body = partial(
+        _scenario_body,
+        max_moves=max_moves,
+        max_evac=max_evac,
+        allow_leader=cfg.allow_leader_rebalancing,
+    )
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(SWEEP_AXIS),),
+        out_specs=(P(SWEEP_AXIS),) * 5,
+        # scenario state mixes sweep-varying values with replicated plan
+        # constants inside lax.cond branches; skip the varying-mode check
+        check_vma=False,
+    )
+    def run(scenario_mask_shard):
+        def one(mask):
+            return body(
+                jnp.asarray(dp.replicas), jnp.asarray(dp.member),
+                jnp.asarray(dp.allowed), jnp.asarray(has_explicit), mask,
+                jnp.asarray(dp.weights, dtype), jnp.asarray(dp.nrep_cur),
+                jnp.asarray(dp.nrep_tgt), jnp.asarray(dp.ncons, dtype),
+                jnp.asarray(dp.pvalid), jnp.asarray(dp.bvalid),
+                jnp.int32(cfg.min_replicas_for_rebalancing),
+                jnp.asarray(cfg.min_unbalance, dtype),
+                jnp.int32(min(max_reassign, 2**31 - 1)),
+            )
+
+        return lax.map(one, scenario_mask_shard)
+
+    replicas_s, feasible_s, n_evac_s, n_moves_s, su_s = run(
+        jnp.asarray(scenario_mask)
+    )
+
+    out: List[SweepResult] = []
+    replicas_s = np.asarray(replicas_s)
+    for i, sc in enumerate(scenarios):
+        out.append(
+            SweepResult(
+                brokers=sorted(int(b) for b in sc),
+                feasible=bool(np.asarray(feasible_s)[i]),
+                n_evacuations=int(np.asarray(n_evac_s)[i]),
+                n_moves=int(np.asarray(n_moves_s)[i]),
+                unbalance=float(np.asarray(su_s)[i]),
+                replicas=dp.decode_replicas(replicas_s[i], dp.nrep_cur),
+            )
+        )
+    return out
+
+
+def best_scenario(results: Sequence[SweepResult]) -> int:
+    """Index of the feasible scenario with the lowest final unbalance."""
+    best, best_u = -1, float("inf")
+    for i, r in enumerate(results):
+        if r.feasible and r.unbalance < best_u:
+            best, best_u = i, r.unbalance
+    if best < 0:
+        raise ValueError("no feasible scenario")
+    return best

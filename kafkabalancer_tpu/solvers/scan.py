@@ -71,8 +71,8 @@ def session(
     nrep_tgt,
     ncons,
     pvalid,
-    bvalid,
-    nb,
+    always_valid,
+    universe_valid,
     min_replicas,
     min_unbalance,
     budget,
@@ -84,10 +84,19 @@ def session(
 
     ``max_moves`` (static) sizes the move-log buffers and is bucketed by the
     caller so XLA compiles once per bucket; ``budget`` (dynamic) is the
-    actual reassignment budget. Returns ``(replicas, loads, n_moves,
-    move_p, move_slot, move_src, move_tgt, final_su)`` where the ``move_*``
-    arrays log the accepted moves in order (dense indices; entries past
-    ``n_moves`` are -1).
+    actual reassignment budget.
+
+    Broker-table membership is dynamic, like the reference: each iteration
+    the table is the brokers currently holding a replica plus the
+    ``always_valid`` configured set (``cfg.Brokers`` zero-fill,
+    steps.go:150-155) — a broker fully drained mid-session drops out of the
+    objective's average divisor exactly as it vanishes from
+    ``getBrokerLoad``'s map (utils.go:92-105) on the reference's next
+    ``Balance`` call. ``universe_valid`` masks padded broker columns.
+
+    Returns ``(replicas, loads, n_moves, move_p, move_slot, move_src,
+    move_tgt, final_su)`` where the ``move_*`` arrays log the accepted
+    moves in order (dense indices; entries past ``n_moves`` are -1).
     """
     P, R = replicas.shape
     B = loads.shape[0]
@@ -106,6 +115,10 @@ def session(
 
     def body(state):
         loads, replicas, member, n, done, mp, mslot, msrc, mtgt = state
+
+        observed = jnp.any(member & pvalid[:, None], axis=0)
+        bvalid = (always_valid | observed) & universe_valid
+        nb = jnp.sum(bvalid).astype(dtype)
 
         _, perm, rank_of = cost.rank_brokers(loads, bvalid)
         u, su = cost.move_candidate_scores(
@@ -178,8 +191,19 @@ def session(
     loads, replicas, member, n, _done, mp, mslot, msrc, mtgt = lax.while_loop(
         cond, body, state
     )
-    final_su = cost.unbalance(loads, bvalid, nb)
+    observed = jnp.any(member & pvalid[:, None], axis=0)
+    bvalid = (always_valid | observed) & universe_valid
+    final_su = cost.unbalance(loads, bvalid, jnp.sum(bvalid).astype(dtype))
     return replicas, loads, n, mp, mslot, msrc, mtgt, final_su
+
+
+def _cfg_broker_mask(dp, cfg: RebalanceConfig) -> "np.ndarray":
+    """Dense mask of the configured always-in-table brokers
+    (``cfg.Brokers`` zero-fill, steps.go:150-155)."""
+    mask = np.zeros(dp.bvalid.shape[0], dtype=bool)
+    for bid in cfg.brokers or []:
+        mask[dp.broker_index(bid)] = True
+    return mask
 
 
 def _settle_head(
@@ -266,8 +290,8 @@ def plan(
             jnp.asarray(dp.nrep_tgt),
             jnp.asarray(dp.ncons, dtype),
             jnp.asarray(dp.pvalid),
+            jnp.asarray(_cfg_broker_mask(dp, cfg)),
             jnp.asarray(dp.bvalid),
-            jnp.asarray(dp.nb, dtype),
             jnp.int32(cfg.min_replicas_for_rebalancing),
             jnp.asarray(cfg.min_unbalance, dtype),
             jnp.int32(chunk),

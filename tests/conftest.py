@@ -9,10 +9,18 @@ process.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# unconditional: the ambient environment may point JAX at a real TPU (a
+# sitecustomize can pre-register the plugin and pin JAX_PLATFORMS), but the
+# suite must run on the virtual 8-device CPU mesh — override both the env
+# var and the live jax config
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "1")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,251 @@
+"""Parallel-layer tests on the 8-virtual-device CPU mesh (conftest.py).
+
+- mesh construction/factorization
+- partition-sharded candidate scoring == unsharded scoring (incl. ties)
+- what-if sweeps vs per-scenario sequential host runs
+"""
+
+import copy
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from helpers import random_partition_list
+
+from kafkabalancer_tpu.balancer import balance
+from kafkabalancer_tpu.balancer.costmodel import (
+    get_bl,
+    get_broker_load,
+    get_unbalance_bl,
+)
+from kafkabalancer_tpu.cli import apply_assignment
+from kafkabalancer_tpu.models import default_rebalance_config
+from kafkabalancer_tpu.ops import cost, tensorize
+from kafkabalancer_tpu.parallel.mesh import balanced_factors, make_mesh
+from kafkabalancer_tpu.parallel.shard_move import sharded_score_moves
+from kafkabalancer_tpu.parallel.sweep import best_scenario, sweep
+from kafkabalancer_tpu.solvers.tpu import _oracle_loads, score_moves
+
+
+def test_balanced_factors():
+    assert balanced_factors(8) == (2, 4)
+    assert balanced_factors(16) == (4, 4)
+    assert balanced_factors(7) == (1, 7)
+    assert balanced_factors(1) == (1, 1)
+
+
+def test_make_mesh_shapes():
+    mesh = make_mesh(8)
+    assert mesh.shape["sweep"] == 2 and mesh.shape["part"] == 4
+    mesh = make_mesh(8, shape=(8, 1))
+    assert mesh.shape["sweep"] == 8
+    with pytest.raises(ValueError):
+        make_mesh(10**9)
+    with pytest.raises(ValueError):
+        make_mesh(8, shape=(3, 2))
+
+
+@pytest.mark.parametrize("leaders", [False, True])
+def test_sharded_score_matches_unsharded(leaders):
+    rng = random.Random(900 + leaders)
+    cfg = default_rebalance_config()
+    mesh = make_mesh(8, shape=(2, 4))
+    for _ in range(4):
+        pl = random_partition_list(
+            rng, rng.randint(4, 30), rng.randint(3, 9),
+            weighted=bool(rng.getrandbits(1)), with_consumers=True,
+            filled=True,
+        )
+        dp = tensorize(pl, cfg, min_bucket=8)
+        loads_map = _oracle_loads(pl, cfg)
+        loads = np.zeros(dp.bvalid.shape[0])
+        for bid, load in loads_map.items():
+            loads[dp.broker_index(bid)] = load
+
+        args = (
+            jnp.asarray(loads), jnp.asarray(dp.replicas),
+            jnp.asarray(dp.allowed), jnp.asarray(dp.member),
+            jnp.asarray(dp.weights), jnp.asarray(dp.nrep_cur),
+            jnp.asarray(dp.nrep_tgt), jnp.asarray(dp.pvalid),
+            jnp.asarray(dp.bvalid), float(dp.nb), 2,
+        )
+        u0, i0, su0, perm0 = score_moves(*args, leaders=leaders)
+        u1, i1, su1, perm1 = sharded_score_moves(*args, leaders=leaders, mesh=mesh)
+        assert bool(jnp.isinf(u0)) == bool(jnp.isinf(u1))
+        if not bool(jnp.isinf(u0)):
+            assert float(u0) == float(u1)
+            assert int(i0) == int(i1)
+        assert float(su0) == float(su1)
+        assert (np.asarray(perm0) == np.asarray(perm1)).all()
+
+
+def test_sharded_tie_break_across_shards():
+    """Mirror-image partitions in different shards produce exactly tied
+    candidates; the combine must keep the lowest global index."""
+    from test_balancer import P, wrap
+
+    cfg = default_rebalance_config()
+    # two identical heavy partitions far apart in the partition list
+    parts = [P("a", 1, [1, 2], weight=2.0)]
+    parts += [P("pad", i, [1, 2], weight=1.0) for i in range(2, 9)]
+    parts += [P("z", 1, [1, 2], weight=2.0)]
+    parts += [P("t", 1, [3, 4], weight=1.0), P("t", 2, [4, 3], weight=1.0)]
+    pl = wrap(parts)
+    from kafkabalancer_tpu.balancer.steps import fill_defaults
+
+    fill_defaults(pl, cfg)
+    dp = tensorize(pl, cfg, min_bucket=8)
+    loads_map = _oracle_loads(pl, cfg)
+    loads = np.zeros(dp.bvalid.shape[0])
+    for bid, load in loads_map.items():
+        loads[dp.broker_index(bid)] = load
+    args = (
+        jnp.asarray(loads), jnp.asarray(dp.replicas), jnp.asarray(dp.allowed),
+        jnp.asarray(dp.member), jnp.asarray(dp.weights),
+        jnp.asarray(dp.nrep_cur), jnp.asarray(dp.nrep_tgt),
+        jnp.asarray(dp.pvalid), jnp.asarray(dp.bvalid), float(dp.nb), 2,
+    )
+    mesh = make_mesh(8, shape=(1, 8))
+    u0, i0, _, _ = score_moves(*args, leaders=False)
+    u1, i1, _, _ = sharded_score_moves(*args, leaders=False, mesh=mesh)
+    assert float(u0) == float(u1)
+    assert int(i0) == int(i1)
+
+
+def unbalance_of(pl):
+    return get_unbalance_bl(get_bl(get_broker_load(pl)))
+
+
+def sequential_scenario(pl, cfg, brokers, max_moves=200):
+    """Host-pipeline reference for one sweep scenario."""
+    pl = copy.deepcopy(pl)
+    cfg = copy.deepcopy(cfg)
+    cfg.brokers = sorted(brokers)
+    n = 0
+    try:
+        while n < max_moves:
+            ppl = balance(pl, cfg)
+            if len(ppl) == 0:
+                break
+            for changed in ppl.partitions:
+                apply_assignment(pl, changed)
+            n += 1
+    except Exception:
+        return None, None, None
+    return pl, n, unbalance_of(pl)
+
+
+@pytest.mark.parametrize("weighted", [True, False])
+def test_sweep_matches_sequential(weighted):
+    rng = random.Random(1000 + weighted)
+    pl = random_partition_list(rng, 14, 5, weighted=weighted, max_rf=3)
+    observed = sorted({b for p in pl.partitions for b in p.replicas})
+    cfg = default_rebalance_config()
+
+    scenarios = [
+        observed,  # status quo
+        observed + [max(observed) + 1],  # add one broker
+        observed + [max(observed) + 1, max(observed) + 2],  # add two
+        observed[1:],  # remove the first broker (forces evacuation)
+    ]
+    results = sweep(pl, cfg, scenarios, max_reassign=200)
+
+    for sc, res in zip(scenarios, results):
+        seq_pl, seq_n, seq_u = sequential_scenario(pl, cfg, sc)
+        if seq_pl is None:
+            assert not res.feasible
+            continue
+        assert res.feasible
+        assert res.unbalance == pytest.approx(seq_u, rel=1e-9, abs=1e-12)
+        if weighted:
+            # no exact ties → identical final assignment
+            assert res.replicas == [p.replicas for p in seq_pl.partitions]
+
+
+def test_sweep_infeasible_scenario():
+    """Removing too many brokers leaves RF-2 partitions with nowhere to go."""
+    from test_balancer import P, wrap
+
+    pl = wrap(
+        [
+            P("a", 1, [1, 2], weight=1.0),
+            P("a", 2, [2, 1], weight=1.0),
+        ]
+    )
+    cfg = default_rebalance_config()
+    results = sweep(pl, cfg, [[1], [1, 2]], max_reassign=50)
+    assert not results[0].feasible
+    assert results[1].feasible
+    assert best_scenario(results) == 1
+
+
+def test_sweep_does_not_mutate_input():
+    rng = random.Random(1100)
+    pl = random_partition_list(rng, 8, 4)
+    before = copy.deepcopy(pl)
+    observed = sorted({b for p in pl.partitions for b in p.replicas})
+    sweep(pl, default_rebalance_config(), [observed], max_reassign=10)
+    assert pl == before
+
+
+def test_session_drained_broker_leaves_table():
+    """A leader move can drain a broker entirely; the reference's next
+    Balance call then drops it from the load table (it vanishes from
+    getBrokerLoad's map), shrinking the objective's average divisor. The
+    fused session must reproduce that (scan.py dynamic bvalid)."""
+    from test_balancer import P, wrap
+
+    from kafkabalancer_tpu.solvers.scan import plan
+
+    parts = [
+        # heavy leader alone on broker 5: score sees weight 6, the applied
+        # shift is 6*(2+3)=30 — moving it drains broker 5
+        P("big", 1, [5, 1], weight=6.0, num_consumers=3),
+        P("s", 1, [1, 2], weight=1.0),
+        P("s", 2, [2, 3], weight=1.0),
+        P("s", 3, [3, 4], weight=1.0),
+        P("s", 4, [4, 1], weight=1.0),
+    ]
+    cfg = default_rebalance_config()
+    cfg.allow_leader_rebalancing = True
+
+    pl_g = wrap([p for p in copy.deepcopy(parts)])
+    pl_s = wrap([p for p in copy.deepcopy(parts)])
+    moved_g = []
+    for _ in range(8):
+        ppl = balance(pl_g, copy.deepcopy(cfg))
+        if len(ppl) == 0:
+            break
+        for changed in ppl.partitions:
+            live = apply_assignment(pl_g, changed)
+            moved_g.append((live.topic, live.partition))
+    opl = plan(pl_s, copy.deepcopy(cfg), 8)
+    moved_s = [(p.topic, p.partition) for p in (opl.partitions or [])]
+    assert ("big", 1) in moved_g  # the drain actually happened
+    assert moved_s == moved_g
+    assert pl_s == pl_g
+
+
+def test_sweep_contract_errors():
+    """Unsupported configurations raise instead of silently diverging."""
+    from test_balancer import P, wrap
+
+    from kafkabalancer_tpu.balancer import BalanceError
+
+    pl = wrap([P("a", 1, [1, 2], weight=1.0), P("a", 2, [2, 1], weight=1.0)])
+    cfg = default_rebalance_config()
+
+    cfg_rl = copy.deepcopy(cfg)
+    cfg_rl.rebalance_leaders = True
+    with pytest.raises(BalanceError, match="rebalance_leaders"):
+        sweep(pl, cfg_rl, [[1, 2]])
+
+    with pytest.raises(ValueError, match="2\\^20"):
+        sweep(pl, cfg, [[1, 2]], max_reassign=(1 << 20) + 1)
+
+    bad = wrap([P("a", 1, [1, 2], weight=1.0, num_replicas=3, brokers=[1, 2, 3])])
+    with pytest.raises(BalanceError, match="repair-settled"):
+        sweep(bad, cfg, [[1, 2, 3]])
