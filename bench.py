@@ -1,0 +1,108 @@
+"""Benchmark: plan a 10k-partition / 100-broker rebalance to convergence.
+
+The north-star config from BASELINE.md — the reference publishes no numbers
+(no testing.B benchmarks anywhere in the repo), so the baseline is the
+reference-transcribed CPU greedy solver measured here: one full greedy move
+(O(P·R·B²), steps.go:145-232) timed at the same scale, extrapolated by the
+number of moves the fused TPU session needs to converge.
+
+Prints exactly ONE JSON line on stdout:
+    {"metric": ..., "value": ..., "unit": "s", "vs_baseline": ...}
+where value is the TPU wall-clock to convergence (second run, compile
+cached) and vs_baseline is the speedup over the extrapolated greedy time.
+Diagnostics go to stderr.
+
+Env knobs: BENCH_FAST=1 shrinks the instance for smoke-testing;
+BENCH_PARTITIONS / BENCH_BROKERS override sizes.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+import os
+import sys
+import time
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    fast = os.environ.get("BENCH_FAST") == "1"
+    n_parts = int(os.environ.get("BENCH_PARTITIONS", 1000 if fast else 10_000))
+    n_brokers = int(os.environ.get("BENCH_BROKERS", 20 if fast else 100))
+
+    import jax.numpy as jnp
+
+    from kafkabalancer_tpu.balancer import steps as S
+    from kafkabalancer_tpu.balancer.costmodel import (
+        get_bl,
+        get_broker_load,
+        get_unbalance_bl,
+    )
+    from kafkabalancer_tpu.models import default_rebalance_config
+    from kafkabalancer_tpu.solvers.scan import plan
+    from kafkabalancer_tpu.utils.synth import synth_cluster
+
+    import jax
+
+    log(f"devices: {jax.devices()}")
+    log(f"instance: {n_parts} partitions x {n_brokers} brokers, rf=3")
+
+    def fresh():
+        pl = synth_cluster(n_parts, n_brokers, rf=3, seed=42, weighted=True)
+        cfg = default_rebalance_config()
+        cfg.min_unbalance = 1e-5
+        return pl, cfg
+
+    # --- baseline: one reference-transcribed greedy move ------------------
+    pl, cfg = fresh()
+    S.validate_weights(pl, cfg)
+    S.fill_defaults(pl, cfg)
+    u0 = get_unbalance_bl(get_bl(get_broker_load(pl)))
+    log(f"initial unbalance: {u0:.6f}")
+
+    t0 = time.perf_counter()
+    move = S.greedy_move(pl, cfg, False)
+    t_greedy_move = time.perf_counter() - t0
+    assert move is not None
+    log(f"greedy single move: {t_greedy_move:.2f}s")
+
+    # --- TPU fused session: run twice, report the cached-compile run ------
+    budget = 1 << 19
+    t_tpu = n_moves = final_u = None
+    for attempt in range(2):
+        pl, cfg = fresh()
+        t0 = time.perf_counter()
+        opl = plan(pl, cfg, budget, dtype=jnp.float32)
+        t_tpu = time.perf_counter() - t0
+        n_moves = len(opl)
+        final_u = get_unbalance_bl(get_bl(get_broker_load(pl)))
+        log(
+            f"tpu session (run {attempt}): {t_tpu:.3f}s, {n_moves} moves, "
+            f"final unbalance {final_u:.3e}"
+        )
+
+    est_greedy_total = t_greedy_move * max(1, n_moves)
+    speedup = est_greedy_total / t_tpu
+    log(
+        f"extrapolated greedy convergence: {est_greedy_total:.1f}s "
+        f"({t_greedy_move:.2f}s/move x {n_moves} moves) -> {speedup:.1f}x"
+    )
+
+    print(
+        json.dumps(
+            {
+                "metric": f"converge_wall_s_{n_parts}parts_{n_brokers}brokers",
+                "value": round(t_tpu, 4),
+                "unit": "s",
+                "vs_baseline": round(speedup, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

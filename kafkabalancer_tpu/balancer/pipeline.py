@@ -54,6 +54,15 @@ def _tpu_move_non_leaders(pl, cfg):
     return tpu_move_non_leaders(pl, cfg)
 
 
+def _beam_move(pl, cfg):
+    try:
+        from kafkabalancer_tpu.solvers.beam import beam_move
+    except ImportError as exc:
+        raise _s.BalanceError(f"solver {cfg.solver!r} unavailable: {exc}") from None
+
+    return beam_move(pl, cfg)
+
+
 def _steps_for(cfg: RebalanceConfig) -> List[Tuple[str, StepFn]]:
     solver = getattr(cfg, "solver", "greedy") or "greedy"
     if solver == "greedy":
@@ -61,11 +70,15 @@ def _steps_for(cfg: RebalanceConfig) -> List[Tuple[str, StepFn]]:
             ("MoveLeaders", _s.move_leaders),
             ("MoveNonLeaders", _s.move_non_leaders),
         ]
-    elif solver in ("tpu", "beam"):
+    elif solver == "tpu":
         tail = [
             ("MoveLeaders", _tpu_move_leaders),
             ("MoveNonLeaders", _tpu_move_non_leaders),
         ]
+    elif solver == "beam":
+        # beam handles leader/follower candidates jointly in one lookahead
+        # search (solvers/beam.py); one tail step replaces both Move steps
+        tail = [("BeamSearch", _beam_move)]
     else:
         raise _s.BalanceError(f"unknown solver {solver!r}")
     return _COMMON_HEAD + tail

@@ -29,6 +29,13 @@ class RebalanceConfig:
 
     # --- extensions beyond the reference CLI (TPU backends) ---
     solver: str = "greedy"  # greedy | tpu | beam
+    beam_width: int = 8  # beam solver: states kept per depth
+    beam_depth: int = 4  # beam solver: lookahead moves per search
+    # same-topic anti-colocation penalty weight (0 = off, reference parity);
+    # adds λ·Σ_broker,topic max(0, replicas_of_topic_on_broker − 1) to the
+    # objective — the upstream's planned-but-never-built extension
+    # (README.md:94-100)
+    anti_colocation: float = 0.0
 
 
 def default_rebalance_config() -> RebalanceConfig:

@@ -49,6 +49,8 @@ class DensePlan:
     member: np.ndarray  # [P, B] bool — broker currently holds a replica
     pvalid: np.ndarray  # [P] bool
     bvalid: np.ndarray  # [B] bool
+    topic_id: np.ndarray  # [P] int32 — dense topic index (pad rows: 0)
+    topics: List[str]  # topic names, index-aligned with topic_id values
     partitions: List[Partition]  # originals, index-aligned with rows
 
     @property
@@ -138,7 +140,16 @@ def tensorize(
 
     idx_of = {int(b): j for j, b in enumerate(ids)}
 
+    topics: List[str] = []
+    topic_idx = {}
+    topic_id = np.zeros(P, dtype=np.int32)
+
     for i, p in enumerate(parts):
+        tid = topic_idx.get(p.topic)
+        if tid is None:
+            tid = topic_idx[p.topic] = len(topics)
+            topics.append(p.topic)
+        topic_id[i] = tid
         pvalid[i] = True
         weights[i] = p.weight
         nrep_cur[i] = len(p.replicas)
@@ -167,5 +178,7 @@ def tensorize(
         member=member,
         pvalid=pvalid,
         bvalid=bvalid,
+        topic_id=topic_id,
+        topics=topics,
         partitions=parts,
     )

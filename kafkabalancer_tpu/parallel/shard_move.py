@@ -26,51 +26,11 @@ ensure_x64()
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 from jax import lax  # noqa: E402
-from jax.sharding import Mesh, NamedSharding  # noqa: E402
+from jax.sharding import Mesh  # noqa: E402
 from jax.sharding import PartitionSpec as P  # noqa: E402
 
-from kafkabalancer_tpu.ops import cost  # noqa: E402
 from kafkabalancer_tpu.parallel.mesh import PART_AXIS  # noqa: E402
-
-
-def _local_best(
-    loads,
-    replicas,
-    allowed,
-    member,
-    weights,
-    nrep_cur,
-    nrep_tgt,
-    pvalid,
-    bvalid,
-    nb,
-    min_replicas,
-    leaders: bool,
-):
-    """Best candidate of one partition shard: ``(u, local flat idx)``."""
-    R = replicas.shape[1]
-    _, perm, rank_of = cost.rank_brokers(loads, bvalid)
-    u, su = cost.move_candidate_scores(
-        loads,
-        replicas,
-        allowed[:, perm],
-        member[:, perm],
-        bvalid,
-        bvalid[perm],
-        perm,
-        rank_of,
-        weights,
-        nrep_cur,
-        nrep_tgt,
-        pvalid,
-        nb,
-        min_replicas,
-    )
-    slot = jnp.arange(R)[None, :]
-    movable = (slot == 0) if leaders else (slot >= 1)
-    flat = jnp.where(movable[:, :, None], u, jnp.inf).reshape(-1)
-    idx = jnp.argmin(flat)
-    return flat[idx], idx, su, perm
+from kafkabalancer_tpu.solvers.tpu import score_moves  # noqa: E402
 
 
 @partial(jax.jit, static_argnames=("leaders", "mesh"))
@@ -95,13 +55,18 @@ def sharded_score_moves(
     same contract as ``solvers.tpu.score_moves`` without the tie window.
 
     Per-partition arrays shard on axis 0; the broker table replicates.
-    The partition bucket must divide evenly by the ``part`` axis size
-    (tensorize with ``min_bucket ≥`` the axis size guarantees it).
+    The partition bucket must divide evenly by the ``part`` axis size —
+    buckets are ``min_bucket·2^k``, so tensorize with a ``min_bucket`` that
+    is a *multiple* of the axis size (a non-power-of-two axis can never
+    divide the default bucket of 8).
     """
     axis = mesh.shape[PART_AXIS]
     P_pad = replicas.shape[0]
     if P_pad % axis:
-        raise ValueError(f"partition bucket {P_pad} not divisible by part={axis}")
+        raise ValueError(
+            f"partition bucket {P_pad} not divisible by part axis {axis}; "
+            f"tensorize with min_bucket a multiple of {axis}"
+        )
 
     rep = P()  # fully replicated (length-0 spec fits any rank)
     pshard = P(PART_AXIS)
@@ -120,9 +85,10 @@ def sharded_score_moves(
     )
     def run(loads, replicas, allowed, member, weights, nrep_cur, nrep_tgt,
             pvalid, bvalid, nb, min_replicas):
-        u, idx, su, perm = _local_best(
+        # the unsharded scorer, applied to this device's partition shard
+        u, idx, su, perm = score_moves(
             loads, replicas, allowed, member, weights, nrep_cur, nrep_tgt,
-            pvalid, bvalid, nb, min_replicas, leaders,
+            pvalid, bvalid, nb, min_replicas, leaders=leaders,
         )
         # rebase the shard-local candidate index to the global
         # partition-major order so cross-shard ties keep the solver's

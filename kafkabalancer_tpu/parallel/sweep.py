@@ -72,8 +72,12 @@ class SweepResult:
 
 
 def _evacuate(replicas, member, allowed_s, weights, nrep_cur, ncons, pvalid,
-              universe_valid, max_evac: int):
-    """Drain disallowed replicas one at a time (module docstring)."""
+              universe_valid, budget, max_evac: int):
+    """Drain disallowed replicas one at a time (module docstring).
+
+    Each evacuation consumes one unit of the reassignment ``budget``, like
+    a MoveDisallowedReplicas repair consuming one CLI loop iteration
+    (kafkabalancer.go:181-209)."""
     Ppad, R = replicas.shape
     B = universe_valid.shape[0]
     flat_iota = jnp.arange(Ppad * R)
@@ -82,7 +86,7 @@ def _evacuate(replicas, member, allowed_s, weights, nrep_cur, ncons, pvalid,
     def cond(st):
         replicas, member, n, feasible = st
         stranded = _stranded_mask(replicas, allowed_s, nrep_cur, pvalid)
-        return stranded.any() & feasible & (n < max_evac)
+        return stranded.any() & feasible & (n < budget) & (n < max_evac)
 
     def _stranded_mask(replicas, allowed_s, nrep_cur, pvalid):
         slot = jnp.arange(R)[None, :]
@@ -134,15 +138,17 @@ def _scenario_body(
 
     replicas, member, n_evac, feasible = _evacuate(
         replicas, member, allowed_s, weights, nrep_cur, ncons, pvalid,
-        universe_valid, max_evac,
+        universe_valid, budget, max_evac,
     )
 
     loads = cost.broker_loads(replicas, weights, nrep_cur, ncons,
                               universe_valid.shape[0])
+    # evacuations consumed part of the reassignment budget (reference CLI
+    # loop semantics: each repair is one -max-reassign iteration)
     replicas, _loads, n_moves, _mp, _mslot, _msrc, _mtgt, su = session(
         loads, replicas, member, allowed_s, weights, nrep_cur, nrep_tgt,
         ncons, pvalid, scenario_mask & universe_valid, universe_valid,
-        min_replicas, min_unbalance, budget,
+        min_replicas, min_unbalance, budget - n_evac,
         max_moves=max_moves, allow_leader=allow_leader,
     )
     return replicas, feasible, n_evac, n_moves, su
@@ -179,7 +185,12 @@ def sweep(
     has_explicit_l = [p.brokers is not None for p in pl.iter_partitions()]
     from kafkabalancer_tpu.balancer.pipeline import _COMMON_HEAD
 
-    for name, step in _COMMON_HEAD[:3]:  # validations + FillDefaults
+    prep = [
+        (name, step)
+        for name, step in _COMMON_HEAD
+        if name in ("ValidateWeights", "ValidateReplicas", "FillDefaults")
+    ]
+    for name, step in prep:
         try:
             step(pl, cfg)
         except _s.BalanceError as exc:
@@ -251,15 +262,17 @@ def sweep(
     )
 
     out: List[SweepResult] = []
-    replicas_s = np.asarray(replicas_s)
+    replicas_s, feasible_s, n_evac_s, n_moves_s, su_s = (
+        np.asarray(x) for x in (replicas_s, feasible_s, n_evac_s, n_moves_s, su_s)
+    )
     for i, sc in enumerate(scenarios):
         out.append(
             SweepResult(
                 brokers=sorted(int(b) for b in sc),
-                feasible=bool(np.asarray(feasible_s)[i]),
-                n_evacuations=int(np.asarray(n_evac_s)[i]),
-                n_moves=int(np.asarray(n_moves_s)[i]),
-                unbalance=float(np.asarray(su_s)[i]),
+                feasible=bool(feasible_s[i]),
+                n_evacuations=int(n_evac_s[i]),
+                n_moves=int(n_moves_s[i]),
+                unbalance=float(su_s[i]),
                 replicas=dp.decode_replicas(replicas_s[i], dp.nrep_cur),
             )
         )

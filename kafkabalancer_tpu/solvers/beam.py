@@ -1,0 +1,331 @@
+"""Beam search over move sequences.
+
+The upstream reference lists "N-way swaps" and a same-topic anti-colocation
+objective as planned-but-never-built features (README.md:94-100). This
+module ships both, TPU-style: a width-W beam explores D-move lookahead
+sequences entirely on device, so compound rebalances a single greedy move
+cannot see — e.g. an uphill move that unlocks a large improvement, or a
+2-way swap expressed as two moves — are found and applied atomically.
+
+Search semantics:
+
+- the objective is the reference unbalance (utils.go:119-147) plus, when
+  ``cfg.anti_colocation > 0``, λ·Σ_{topic,broker} max(0, c−1) where c
+  counts same-topic replicas sharing a broker;
+- each depth expands every live beam's full ``[P, R, B]`` candidate tensor
+  (rank-1 updates, ops/cost.py) — top-W of the W·W frontier survive.
+  Sequences may include uphill moves; acceptance is sequence-level: the
+  best state seen at any depth must beat the start by ``min_unbalance``
+  (the per-move threshold semantics of the greedy/tpu solvers do not apply
+  — beam is an extension, not a parity path);
+- leader moves are candidates whenever ``allow_leader_rebalancing`` is set
+  (slot 0 scored like any other movable slot — no leader-first precedence
+  inside a sequence); applying a leader move shifts the true premium load
+  (utils.go:96-101) while scoring uses the plain weight, exactly like the
+  fused session (solvers/scan.py);
+- two beams can reach the same state by permuted move orders; such
+  duplicates waste beam slots but are otherwise harmless.
+
+``beam_plan`` repeats search→apply rounds (receding horizon) until no
+sequence improves or the reassignment budget runs out.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional
+
+from kafkabalancer_tpu.models import PartitionList, RebalanceConfig
+from kafkabalancer_tpu.models.partition import empty_partition_list
+from kafkabalancer_tpu.ops.runtime import ensure_x64, next_bucket
+
+ensure_x64()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax import lax  # noqa: E402
+
+from kafkabalancer_tpu.ops import cost, tensorize  # noqa: E402
+from kafkabalancer_tpu.solvers.scan import _settle_head  # noqa: E402
+
+
+def _colocation_cost(member, topic_id, n_topics, lam):
+    """λ·Σ max(0, same-topic replicas per broker − 1)."""
+    counts = jnp.zeros((n_topics, member.shape[1]), member.dtype).at[
+        topic_id
+    ].add(member)
+    return lam * jnp.sum(jnp.maximum(counts - 1, 0))
+
+
+@partial(jax.jit, static_argnames=("width", "depth", "allow_leader", "n_topics"))
+def beam_search(
+    loads,
+    replicas,
+    member,
+    allowed,
+    weights,
+    nrep_cur,
+    nrep_tgt,
+    ncons,
+    pvalid,
+    always_valid,
+    universe_valid,
+    topic_id,
+    min_replicas,
+    lam,
+    *,
+    width: int,
+    depth: int,
+    allow_leader: bool,
+    n_topics: int,
+):
+    """One beam search from a single start state.
+
+    Returns ``(su0, best_u, best_depth, parents [D, W], move_p/slot/tgt
+    [D, W])`` — the move logs reconstruct the best sequence host-side.
+    Entries for dead/no-op expansions carry ``move_p == -1``.
+    """
+    P, R = replicas.shape
+    B = loads.shape[0]
+    dtype = loads.dtype
+    W, D = width, depth
+
+    slot_iota = jnp.arange(R)[None, :]
+    movable = (slot_iota >= 0) if allow_leader else (slot_iota >= 1)
+
+    def state_cost(loads, member):
+        observed = jnp.any(member & pvalid[:, None], axis=0)
+        bvalid = (always_valid | observed) & universe_valid
+        u = cost.unbalance(loads, bvalid, jnp.sum(bvalid).astype(dtype))
+        if n_topics:
+            u = u + _colocation_cost(
+                member.astype(dtype), topic_id, n_topics, lam
+            )
+        return u
+
+    def expand(args):
+        """Top-W candidates of one beam: (vals [W], p/slot/tgt [W])."""
+        loads, replicas, member, alive = args
+        observed = jnp.any(member & pvalid[:, None], axis=0)
+        bvalid = (always_valid | observed) & universe_valid
+        nb = jnp.sum(bvalid).astype(dtype)
+        _, perm, rank_of = cost.rank_brokers(loads, bvalid)
+        u, su = cost.move_candidate_scores(
+            loads, replicas, allowed[:, perm], member[:, perm], bvalid,
+            bvalid[perm], perm, rank_of, weights, nrep_cur, nrep_tgt,
+            pvalid, nb, min_replicas,
+        )
+        u = jnp.where(movable[:, :, None], u, jnp.inf)
+        if n_topics:
+            # rank-1 colocation delta: +λ if the target broker already has
+            # a same-topic replica, −λ if the source broker has ≥2
+            counts = jnp.zeros((n_topics, B), dtype).at[topic_id].add(
+                member.astype(dtype)
+            )
+            c_rows = counts[topic_id]  # [P, B]
+            s = jnp.clip(replicas, 0)
+            c_src = jnp.take_along_axis(c_rows, s, axis=1)  # [P, R]
+            add = jnp.where(c_rows[:, perm] >= 1, lam, 0.0)  # [P, B] rank
+            sub = jnp.where(c_src >= 2, lam, 0.0)  # [P, R]
+            u = u + add[:, None, :] - sub[:, :, None]
+        flat = jnp.where(alive, u, jnp.inf).reshape(-1)
+        neg, idx = lax.top_k(-flat, W)
+        p, rem = jnp.divmod(idx, R * B)
+        slot, t_rank = jnp.divmod(rem, B)
+        return -neg, p.astype(jnp.int32), slot.astype(jnp.int32), perm[
+            t_rank
+        ].astype(jnp.int32)
+
+    def apply_move(loads, replicas, member, p, slot, t):
+        s = replicas[p, slot]
+        delta = jnp.where(
+            slot == 0,
+            weights[p] * (nrep_cur[p].astype(dtype) + ncons[p]),
+            weights[p],
+        )
+        loads = loads.at[s].add(-delta).at[t].add(delta)
+        replicas = replicas.at[p, slot].set(t.astype(replicas.dtype))
+        member = member.at[p, s].set(False).at[p, t].set(True)
+        return loads, replicas, member
+
+    su0 = state_cost(loads, member)
+
+    # beam state: [W, ...] with beam 0 = the start, others dead
+    loads_b = jnp.broadcast_to(loads, (W, B))
+    replicas_b = jnp.broadcast_to(replicas, (W, P, R))
+    member_b = jnp.broadcast_to(member, (W, P, B))
+    alive = jnp.zeros(W, bool).at[0].set(True)
+    su_b = jnp.full(W, jnp.inf, dtype).at[0].set(su0)
+
+    def depth_step(carry, _):
+        loads_b, replicas_b, member_b, alive, su_b, best = carry
+
+        vals, cp, cslot, ct = lax.map(
+            expand, (loads_b, replicas_b, member_b, alive)
+        )  # each [W, W]
+
+        flat_vals = vals.reshape(-1)  # [W*W]
+        neg, pick = lax.top_k(-flat_vals, W)
+        new_u = -neg  # [W]
+        parent = (pick // W).astype(jnp.int32)
+        child = pick % W
+
+        ok = jnp.isfinite(new_u)
+        p_sel = jnp.where(ok, cp[parent, child], -1)
+        slot_sel = jnp.where(ok, cslot[parent, child], 0)
+        t_sel = jnp.where(ok, ct[parent, child], 0)
+
+        def build(i):
+            pl_, rp_, mb_ = (
+                loads_b[parent[i]],
+                replicas_b[parent[i]],
+                member_b[parent[i]],
+            )
+            return lax.cond(
+                ok[i],
+                lambda a: apply_move(*a, p_sel[i], slot_sel[i], t_sel[i]),
+                lambda a: a,
+                (pl_, rp_, mb_),
+            )
+
+        loads_b, replicas_b, member_b = lax.map(build, jnp.arange(W))
+        alive = ok
+        # re-evaluate the TRUE state cost: candidate scores under-model
+        # leader moves (plain weight scored, premium applied — the
+        # reference's steps.go:185/:207 quirk), so ranking/acceptance on
+        # the claimed values would accept sequences that are really worse
+        su_b = jnp.where(
+            ok,
+            lax.map(lambda i: state_cost(loads_b[i], member_b[i]), jnp.arange(W)),
+            jnp.inf,
+        )
+
+        best_u, best_beam, best_depth, d = best
+        m = jnp.min(su_b)
+        better = m < best_u
+        best = (
+            jnp.where(better, m, best_u),
+            jnp.where(better, jnp.argmin(su_b).astype(jnp.int32), best_beam),
+            jnp.where(better, d, best_depth),
+            d + 1,
+        )
+        carry = (loads_b, replicas_b, member_b, alive, su_b, best)
+        return carry, (parent, p_sel, slot_sel, t_sel)
+
+    best0 = (su0, jnp.int32(-1), jnp.int32(-1), jnp.int32(0))
+    carry0 = (loads_b, replicas_b, member_b, alive, su_b, best0)
+    (_, _, _, _, _, best), logs = lax.scan(
+        depth_step, carry0, None, length=D
+    )
+    best_u, best_beam, best_depth, _ = best
+    parents, mp, mslot, mtgt = logs  # each [D, W]
+    return su0, best_u, best_beam, best_depth, parents, mp, mslot, mtgt
+
+
+def _reconstruct(best_beam, best_depth, parents, mp, mslot, mtgt):
+    """Walk the parent pointers back to depth 0; returns [(p, slot, t_dense)]
+    in application order."""
+    seq = []
+    beam = int(best_beam)
+    for d in range(int(best_depth), -1, -1):
+        p = int(mp[d, beam])
+        if p >= 0:
+            seq.append((p, int(mslot[d, beam]), int(mtgt[d, beam])))
+        beam = int(parents[d, beam])
+    seq.reverse()
+    return seq
+
+
+def _search_once(pl: PartitionList, cfg: RebalanceConfig, depth: int):
+    """One beam search on the live list; returns the accepted move sequence
+    as ``[(partition row, slot, target broker id)]`` with its DensePlan, or
+    ``None`` when no sequence clears ``min_unbalance``."""
+    dp = tensorize(pl, cfg)
+    dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    loads = cost.broker_loads(
+        jnp.asarray(dp.replicas),
+        jnp.asarray(dp.weights, dtype),
+        jnp.asarray(dp.nrep_cur),
+        jnp.asarray(dp.ncons, dtype),
+        dp.bvalid.shape[0],
+    )
+    from kafkabalancer_tpu.solvers.scan import _cfg_broker_mask
+
+    lam = float(cfg.anti_colocation)
+    n_topics = next_bucket(len(dp.topics), 2) if lam > 0 else 0
+
+    su0, best_u, best_beam, best_depth, parents, mp, mslot, mtgt = beam_search(
+        loads,
+        jnp.asarray(dp.replicas),
+        jnp.asarray(dp.member),
+        jnp.asarray(dp.allowed),
+        jnp.asarray(dp.weights, dtype),
+        jnp.asarray(dp.nrep_cur),
+        jnp.asarray(dp.nrep_tgt),
+        jnp.asarray(dp.ncons, dtype),
+        jnp.asarray(dp.pvalid),
+        jnp.asarray(_cfg_broker_mask(dp, cfg)),
+        jnp.asarray(dp.bvalid),
+        jnp.asarray(dp.topic_id),
+        jnp.int32(cfg.min_replicas_for_rebalancing),
+        jnp.asarray(lam, dtype),
+        width=max(1, int(cfg.beam_width)),
+        depth=max(1, depth),
+        allow_leader=cfg.allow_leader_rebalancing,
+        n_topics=n_topics,
+    )
+    su0, best_u = float(su0), float(best_u)
+    if not (best_u < su0 - cfg.min_unbalance and best_u < su0):
+        return None
+    seq = _reconstruct(
+        best_beam, best_depth, np.asarray(parents), np.asarray(mp),
+        np.asarray(mslot), np.asarray(mtgt),
+    )
+    return dp, seq
+
+
+def beam_plan(
+    pl: PartitionList, cfg: RebalanceConfig, max_reassign: int
+) -> PartitionList:
+    """Receding-horizon beam planning: search a ``beam_depth`` lookahead,
+    apply the best sequence, repeat. Output/mutation contract matches
+    ``solvers.scan.plan`` (live partitions accumulated in move order)."""
+    opl = empty_partition_list()
+    if max_reassign <= 0:
+        return opl
+    repaired, budget = _settle_head(pl, cfg, max_reassign)
+    opl.append(*repaired)
+
+    while budget > 0:
+        found = _search_once(pl, cfg, depth=min(int(cfg.beam_depth), budget))
+        if found is None:
+            break
+        dp, seq = found
+        for p_row, slot, t_dense in seq[:budget]:
+            part = dp.partitions[p_row]
+            part.replicas[slot] = int(dp.broker_ids[t_dense])
+            opl.append(part)
+            budget -= 1
+    return opl
+
+
+def beam_move(
+    pl: PartitionList, cfg: RebalanceConfig
+) -> Optional[PartitionList]:
+    """Pipeline-step adapter (``-solver=beam``): the first move of the best
+    ``beam_depth``-lookahead sequence, emitted like any Move step so the
+    CLI loop, complete-partition logic, and logging all apply unchanged."""
+    from kafkabalancer_tpu.balancer.steps import replace_replica
+
+    found = _search_once(pl, cfg, depth=int(cfg.beam_depth))
+    if found is None:
+        return None
+    dp, seq = found
+    if not seq:
+        return None
+    p_row, slot, t_dense = seq[0]
+    part = dp.partitions[p_row]
+    return replace_replica(
+        part, part.replicas[slot], int(dp.broker_ids[t_dense])
+    )

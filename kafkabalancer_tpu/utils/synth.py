@@ -1,0 +1,53 @@
+"""Synthetic cluster generation for benchmarks and dry runs.
+
+Produces deliberately unbalanced assignments in the shape of the reference's
+fixture (test/test.json: a few brokers hot, most cold) scaled to arbitrary
+partition/broker counts. Deterministic per seed.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+from kafkabalancer_tpu.models import Partition, PartitionList
+
+
+def synth_cluster(
+    n_partitions: int,
+    n_brokers: int,
+    rf: int = 3,
+    seed: int = 0,
+    weighted: bool = True,
+    skew: float = 3.0,
+    num_consumers_max: int = 0,
+) -> PartitionList:
+    """An unbalanced ``n_partitions`` × ``n_brokers`` assignment.
+
+    Brokers are skewed: low-ID brokers are ``skew``× likelier to hold
+    replicas, mimicking a cluster that grew by adding brokers (the
+    README.md:109-124 scenario at scale).
+    """
+    rng = random.Random(seed)
+    brokers = list(range(1, n_brokers + 1))
+    # population weights: broker i gets weight skew..1 linearly
+    bw = [skew - (skew - 1.0) * i / max(1, n_brokers - 1) for i in range(n_brokers)]
+    parts = []
+    for i in range(n_partitions):
+        replicas: list = []
+        while len(replicas) < min(rf, n_brokers):
+            (b,) = rng.choices(brokers, weights=bw)
+            if b not in replicas:
+                replicas.append(b)
+        parts.append(
+            Partition(
+                topic=f"t{i % max(1, n_partitions // 50)}",
+                partition=i,
+                replicas=replicas,
+                weight=round(rng.uniform(0.5, 2.0), 3) if weighted else 0.0,
+                num_consumers=(
+                    rng.randint(0, num_consumers_max) if num_consumers_max else 0
+                ),
+            )
+        )
+    return PartitionList(version=1, partitions=parts)

@@ -249,3 +249,31 @@ def test_sweep_contract_errors():
     bad = wrap([P("a", 1, [1, 2], weight=1.0, num_replicas=3, brokers=[1, 2, 3])])
     with pytest.raises(BalanceError, match="repair-settled"):
         sweep(bad, cfg, [[1, 2, 3]])
+
+
+def test_sweep_evacuations_consume_budget():
+    """Each evacuation is one -max-reassign iteration in the reference CLI
+    loop; a binding budget limits evacuations and leaves no optimization."""
+    from test_balancer import P, wrap
+
+    # three partitions stranded on broker 9 once the scenario drops it
+    pl = wrap(
+        [
+            P("a", 1, [1, 9], weight=1.0),
+            P("a", 2, [2, 9], weight=1.0),
+            P("a", 3, [3, 9], weight=1.0),
+            P("b", 1, [1, 2], weight=1.0),
+            P("b", 2, [2, 3], weight=1.0),
+        ]
+    )
+    cfg = default_rebalance_config()
+    scenario = [1, 2, 3]  # drop broker 9
+    full = sweep(pl, cfg, [scenario], max_reassign=200)[0]
+    assert full.n_evacuations == 3
+
+    bounded = sweep(pl, cfg, [scenario], max_reassign=2)[0]
+    assert bounded.n_evacuations == 2
+    assert bounded.n_moves == 0
+    # two replicas moved off broker 9, one remains
+    stranded = sum(1 for reps in bounded.replicas if 9 in reps)
+    assert stranded == 1
