@@ -18,7 +18,6 @@ BENCH_PARTITIONS / BENCH_BROKERS override sizes.
 
 from __future__ import annotations
 
-import copy
 import json
 import os
 import sys
@@ -70,19 +69,21 @@ def main() -> None:
     assert move is not None
     log(f"greedy single move: {t_greedy_move:.2f}s")
 
-    # --- TPU fused session: run twice, report the cached-compile run ------
+    # --- TPU fused session (batched disjoint commits, see solvers/scan.py):
+    # run twice, report the cached-compile run ----------------------------
     budget = 1 << 19
+    batch = int(os.environ.get("BENCH_BATCH", "32"))
     t_tpu = n_moves = final_u = None
     for attempt in range(2):
         pl, cfg = fresh()
         t0 = time.perf_counter()
-        opl = plan(pl, cfg, budget, dtype=jnp.float32)
+        opl = plan(pl, cfg, budget, dtype=jnp.float32, batch=batch)
         t_tpu = time.perf_counter() - t0
         n_moves = len(opl)
         final_u = get_unbalance_bl(get_bl(get_broker_load(pl)))
         log(
-            f"tpu session (run {attempt}): {t_tpu:.3f}s, {n_moves} moves, "
-            f"final unbalance {final_u:.3e}"
+            f"tpu session (run {attempt}, batch={batch}): {t_tpu:.3f}s, "
+            f"{n_moves} moves, final unbalance {final_u:.3e}"
         )
 
     est_greedy_total = t_greedy_move * max(1, n_moves)

@@ -96,6 +96,7 @@ def run(i, o, e, args: List[str]) -> int:
     logger = Logger(be)
     log = logger.printf
     profiler = None
+    jaxprof = None
 
     try:
         defaults = default_rebalance_config()
@@ -149,6 +150,25 @@ def run(i, o, e, args: List[str]) -> int:
             "greedy",
             "Optimization backend: greedy (reference parity), tpu "
             "(vectorized JAX/XLA candidate scoring), beam (N-way beam search)",
+        )
+        f_fused = f.bool(
+            "fused",
+            False,
+            "Run the whole -max-reassign session as one fused device loop "
+            "(implies the tpu backend; trades per-move logging and "
+            "complete-partition handling for throughput)",
+        )
+        f_batch = f.int(
+            "fused-batch",
+            16,
+            "Fused mode: commit up to this many broker-disjoint moves per "
+            "device iteration (1 = strict one-move-at-a-time)",
+        )
+        f_jaxprof = f.string(
+            "jax-profile",
+            "",
+            "Write a JAX/XLA device trace to this directory (profiling "
+            "counterpart of -pprof for the TPU backends)",
         )
         f_help = f.bool("help", False, "Display usage")
 
@@ -238,11 +258,33 @@ def run(i, o, e, args: List[str]) -> int:
 
         log(f"rebalance config: {_fmt_cfg(cfg)}")
 
+        if f_jaxprof.value:
+            import jax
+
+            jax.profiler.start_trace(f_jaxprof.value)
+            jaxprof = jax
+
         # --- the main reassignment loop (kafkabalancer.go:177-221) -------
         opl = empty_partition_list()
         completing = False
         c_partition: Optional[Partition] = None
         r = f_max.value
+
+        if f_fused.value:
+            # extension: whole-session fused device planning
+            # (solvers/scan.py) instead of the per-move host loop; consumes
+            # the budget so the loop below is skipped and the shared output
+            # tail applies unchanged
+            try:
+                from kafkabalancer_tpu.solvers.scan import plan
+
+                opl = plan(pl, cfg, r, batch=max(1, f_batch.value))
+            except BalanceError as exc:
+                log(f"failed optimizing distribution: {exc}")
+                return 3
+            log(f"fused session: {len(opl)} reassignments")
+            r = 0
+
         while r > 0:
             try:
                 ppl = balance(pl, cfg, log=log)
@@ -285,6 +327,10 @@ def run(i, o, e, args: List[str]) -> int:
                     completing = True
                     log(f"Forcing complete of Partition: {c_partition}")
 
+        if jaxprof is not None:
+            jaxprof.profiler.stop_trace()
+            jaxprof = None
+
         be.flush(True)
 
         if f_full.value:
@@ -303,6 +349,11 @@ def run(i, o, e, args: List[str]) -> int:
 
         return 0
     finally:
+        if jaxprof is not None:  # early-return path with an active trace
+            try:
+                jaxprof.profiler.stop_trace()
+            except Exception:
+                pass
         if profiler is not None:
             profiler.disable()
             try:

@@ -65,6 +65,8 @@ class SweepResult:
 
     brokers: List[int]  # the scenario's broker set
     feasible: bool  # False: a stranded replica had no legal target
+    completed: bool  # False: the budget truncated the drain — replicas
+    # remain on disallowed brokers even though targets existed
     n_evacuations: int  # disallowed-replica moves applied
     n_moves: int  # optimization moves applied
     unbalance: float  # final objective value
@@ -140,6 +142,14 @@ def _scenario_body(
         replicas, member, allowed_s, weights, nrep_cur, ncons, pvalid,
         universe_valid, budget, max_evac,
     )
+    # did the budget truncate the drain? (distinct from infeasibility)
+    slot = jnp.arange(replicas.shape[1])[None, :]
+    still_stranded = (
+        (slot < nrep_cur[:, None])
+        & pvalid[:, None]
+        & ~jnp.take_along_axis(allowed_s, jnp.clip(replicas, 0), axis=1)
+    ).any()
+    completed = ~still_stranded
 
     loads = cost.broker_loads(replicas, weights, nrep_cur, ncons,
                               universe_valid.shape[0])
@@ -151,7 +161,7 @@ def _scenario_body(
         min_replicas, min_unbalance, budget - n_evac,
         max_moves=max_moves, allow_leader=allow_leader,
     )
-    return replicas, feasible, n_evac, n_moves, su
+    return replicas, feasible, completed, n_evac, n_moves, su
 
 
 def sweep(
@@ -237,7 +247,7 @@ def sweep(
         jax.shard_map,
         mesh=mesh,
         in_specs=(P(SWEEP_AXIS),),
-        out_specs=(P(SWEEP_AXIS),) * 5,
+        out_specs=(P(SWEEP_AXIS),) * 6,
         # scenario state mixes sweep-varying values with replicated plan
         # constants inside lax.cond branches; skip the varying-mode check
         check_vma=False,
@@ -257,19 +267,21 @@ def sweep(
 
         return lax.map(one, scenario_mask_shard)
 
-    replicas_s, feasible_s, n_evac_s, n_moves_s, su_s = run(
+    replicas_s, feasible_s, completed_s, n_evac_s, n_moves_s, su_s = run(
         jnp.asarray(scenario_mask)
     )
 
     out: List[SweepResult] = []
-    replicas_s, feasible_s, n_evac_s, n_moves_s, su_s = (
-        np.asarray(x) for x in (replicas_s, feasible_s, n_evac_s, n_moves_s, su_s)
+    replicas_s, feasible_s, completed_s, n_evac_s, n_moves_s, su_s = (
+        np.asarray(x)
+        for x in (replicas_s, feasible_s, completed_s, n_evac_s, n_moves_s, su_s)
     )
     for i, sc in enumerate(scenarios):
         out.append(
             SweepResult(
                 brokers=sorted(int(b) for b in sc),
                 feasible=bool(feasible_s[i]),
+                completed=bool(completed_s[i]),
                 n_evacuations=int(n_evac_s[i]),
                 n_moves=int(n_moves_s[i]),
                 unbalance=float(su_s[i]),
@@ -280,10 +292,11 @@ def sweep(
 
 
 def best_scenario(results: Sequence[SweepResult]) -> int:
-    """Index of the feasible scenario with the lowest final unbalance."""
+    """Index of the feasible, fully-drained scenario with the lowest final
+    unbalance."""
     best, best_u = -1, float("inf")
     for i, r in enumerate(results):
-        if r.feasible and r.unbalance < best_u:
+        if r.feasible and r.completed and r.unbalance < best_u:
             best, best_u = i, r.unbalance
     if best < 0:
         raise ValueError("no feasible scenario")

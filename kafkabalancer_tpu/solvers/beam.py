@@ -315,17 +315,39 @@ def beam_move(
 ) -> Optional[PartitionList]:
     """Pipeline-step adapter (``-solver=beam``): the first move of the best
     ``beam_depth``-lookahead sequence, emitted like any Move step so the
-    CLI loop, complete-partition logic, and logging all apply unchanged."""
+    CLI loop, complete-partition logic, and logging all apply unchanged.
+
+    The reference loop's invariant — every emitted reassignment improves
+    the objective by ``min_unbalance`` on its own (steps.go:227) — is
+    preserved: when the best sequence *starts* with an uphill move (legal
+    inside ``beam_plan``'s atomically-applied sequences, but not safe to
+    emit alone into a budget that may end here), the search retries at
+    depth 1, which can only yield an improving move or nothing."""
+    from kafkabalancer_tpu.balancer import costmodel
     from kafkabalancer_tpu.balancer.steps import replace_replica
 
-    found = _search_once(pl, cfg, depth=int(cfg.beam_depth))
-    if found is None:
-        return None
-    dp, seq = found
-    if not seq:
-        return None
-    p_row, slot, t_dense = seq[0]
-    part = dp.partitions[p_row]
-    return replace_replica(
-        part, part.replicas[slot], int(dp.broker_ids[t_dense])
-    )
+    for depth in (int(cfg.beam_depth), 1):
+        found = _search_once(pl, cfg, depth=depth)
+        if found is None:
+            return None
+        dp, seq = found
+        if not seq:
+            return None
+        p_row, slot, t_dense = seq[0]
+        part = dp.partitions[p_row]
+        t_id = int(dp.broker_ids[t_dense])
+        if depth == 1:
+            break
+        # exact host check that the first move improves on its own
+        loads = costmodel.get_broker_load(pl)
+        for bid in cfg.brokers or []:
+            loads.setdefault(bid, 0.0)
+        bl = costmodel.get_bl(loads)
+        su = costmodel.get_unbalance_bl(bl)
+        rank = {bid: i for i, (bid, _) in enumerate(bl)}
+        s_id = part.replicas[slot]
+        bl[rank[s_id]][1] -= part.weight
+        bl[rank[t_id]][1] += part.weight
+        if costmodel.get_unbalance_bl(bl) < su - cfg.min_unbalance:
+            break
+    return replace_replica(part, part.replicas[slot], t_id)

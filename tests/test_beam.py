@@ -137,3 +137,31 @@ def test_anti_colocation_penalty():
     after = colocations(pl)
     assert before == 4
     assert after < before
+
+
+def test_beam_move_emission_invariant():
+    """Every move emitted through the pipeline adapter improves the
+    objective on its own (reference loop invariant, steps.go:227) — even
+    though full sequences inside beam_plan may pass through uphill states."""
+    rng = random.Random(2300)
+    for _ in range(6):
+        pl = random_partition_list(
+            rng, rng.randint(5, 18), rng.randint(3, 6),
+            weighted=bool(rng.getrandbits(1)),
+        )
+        cfg = default_rebalance_config()
+        cfg.solver = "beam"
+        cfg.allow_leader_rebalancing = bool(rng.getrandbits(1))
+        for _move in range(4):
+            before = None
+            try:
+                before = unbalance_of(pl)
+            except ZeroDivisionError:
+                pass
+            ppl = balance(pl, cfg)
+            if len(ppl) == 0:
+                break
+            for changed in ppl.partitions:
+                apply_assignment(pl, changed)
+            if before is not None and before == before:  # skip NaN
+                assert unbalance_of(pl) < before - cfg.min_unbalance + 1e-12

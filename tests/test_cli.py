@@ -307,3 +307,71 @@ class TestEmptyReplicasEncoding:
             ["-input-json=x"], stdin=fixture_text()
         )
         assert 'invalid boolean value "x" for -input-json: parse error' in err
+
+
+def test_fused_session_cli(tmp_path):
+    """-fused runs the whole session on device; output is a valid converged
+    plan (trajectory may differ from greedy on ties, so no byte parity)."""
+    import json
+
+    out, err = io.StringIO(), io.StringIO()
+    code = run(
+        io.StringIO(), out, err,
+        ["kb", "-input-json", "-input", FIXTURE, "-max-reassign=16", "-fused"],
+    )
+    assert code == 0
+    plan = json.loads(out.getvalue())
+    assert plan["version"] == 1
+    assert plan["partitions"]
+    assert "fused session:" in err.getvalue()
+
+    # fused with a budget of 0 emits the empty plan
+    out2 = io.StringIO()
+    code = run(
+        io.StringIO(), out2, io.StringIO(),
+        ["kb", "-input-json", "-input", FIXTURE, "-max-reassign=0", "-fused"],
+    )
+    assert code == 0
+    assert out2.getvalue() == '{"version":1,"partitions":null}\n'
+
+
+def test_fused_reaches_greedy_quality(tmp_path):
+    """Fused convergence matches the greedy loop's final unbalance on the
+    fixture (same local optimum here)."""
+    import json
+
+    from kafkabalancer_tpu.balancer.costmodel import (
+        get_bl,
+        get_broker_load,
+        get_unbalance_bl,
+    )
+    from kafkabalancer_tpu.codecs import get_partition_list_from_reader
+
+    def final_unbalance(args):
+        out = io.StringIO()
+        assert run(io.StringIO(), out, io.StringIO(), args) == 0
+        pl = get_partition_list_from_reader(io.StringIO(out.getvalue()), True, [])
+        return get_unbalance_bl(get_bl(get_broker_load(pl)))
+
+    base = ["kb", "-input-json", "-input", FIXTURE, "-max-reassign=64",
+            "-full-output"]
+    u_greedy = final_unbalance(base)
+    u_fused = final_unbalance(base + ["-fused"])
+    assert u_fused <= u_greedy + 1e-9
+
+
+def test_jax_profile_flag(tmp_path):
+    trace_dir = str(tmp_path / "trace")
+    out, err = io.StringIO(), io.StringIO()
+    code = run(
+        io.StringIO(), out, err,
+        ["kb", "-input-json", "-input", FIXTURE, "-solver=tpu",
+         f"-jax-profile={trace_dir}"],
+    )
+    assert code == 0
+    import os
+
+    found = []
+    for root, _dirs, files in os.walk(trace_dir):
+        found.extend(files)
+    assert found  # a device trace was written

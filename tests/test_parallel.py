@@ -271,9 +271,12 @@ def test_sweep_evacuations_consume_budget():
     full = sweep(pl, cfg, [scenario], max_reassign=200)[0]
     assert full.n_evacuations == 3
 
+    assert full.completed
+
     bounded = sweep(pl, cfg, [scenario], max_reassign=2)[0]
     assert bounded.n_evacuations == 2
     assert bounded.n_moves == 0
+    assert bounded.feasible and not bounded.completed  # truncated drain
     # two replicas moved off broker 9, one remains
     stranded = sum(1 for reps in bounded.replicas if 9 in reps)
     assert stranded == 1
