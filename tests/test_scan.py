@@ -148,6 +148,11 @@ def test_plan_batched_quality(batch):
         for p in opl.partitions:
             assert len(set(p.replicas)) == len(p.replicas)
         assert len(balance(pl_b, copy.deepcopy(cfg))) == 0
+        # the churn gate keeps the emitted plan close to the one-at-a-time
+        # trajectory's length (each emitted move is real data movement)
+        pl_s = copy.deepcopy(pl)
+        n_single = len(plan(pl_s, copy.deepcopy(cfg), 200, batch=1))
+        assert len(opl) <= 2 * n_single + 5
 
 
 def test_plan_batched_respects_budget():
