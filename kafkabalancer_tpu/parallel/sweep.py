@@ -133,7 +133,7 @@ def _scenario_body(
     replicas, member, allowed_base, has_explicit, scenario_mask, weights,
     nrep_cur, nrep_tgt, ncons, pvalid, universe_valid, min_replicas,
     min_unbalance, budget, *, max_moves: int, max_evac: int,
-    allow_leader: bool,
+    allow_leader: bool, batch: int,
 ):
     """One scenario end-to-end on device: evacuation + move session."""
     allowed_s = jnp.where(has_explicit[:, None], allowed_base, scenario_mask[None, :])
@@ -159,7 +159,7 @@ def _scenario_body(
         loads, replicas, member, allowed_s, weights, nrep_cur, nrep_tgt,
         ncons, pvalid, scenario_mask & universe_valid, universe_valid,
         min_replicas, min_unbalance, budget - n_evac,
-        max_moves=max_moves, allow_leader=allow_leader,
+        max_moves=max_moves, allow_leader=allow_leader, batch=batch,
     )
     return replicas, feasible, completed, n_evac, n_moves, su
 
@@ -171,10 +171,17 @@ def sweep(
     max_reassign: int = 1 << 16,
     mesh: Optional[Mesh] = None,
     dtype=None,
+    batch: int = 1,
 ) -> List[SweepResult]:
     """Evaluate ``scenarios`` (broker-ID sets) in parallel; see module
     docstring. ``pl`` is not mutated. The scenario axis shards over
-    ``mesh``'s ``sweep`` axis (default: a mesh over all devices)."""
+    ``mesh``'s ``sweep`` axis (default: a mesh over all devices).
+
+    ``batch > 1`` runs each scenario's move session in the batched
+    disjoint-commit throughput mode (see ``solvers.scan.session``): faster
+    convergence per scenario, but trajectories (and thus per-scenario
+    ``n_moves``) no longer match the ``batch=1`` pipeline-parity mode —
+    final unbalance remains comparable for scenario ranking."""
     if cfg.rebalance_leaders:
         raise _s.BalanceError(
             "sweep does not support rebalance_leaders (forced leadership "
@@ -239,6 +246,7 @@ def sweep(
     body = partial(
         _scenario_body,
         max_moves=max_moves,
+        batch=max(1, batch),
         max_evac=max_evac,
         allow_leader=cfg.allow_leader_rebalancing,
     )

@@ -142,32 +142,20 @@ def session(
             weights[p],
         )
 
-    def _scored(loads, replicas, member, bcount, use_rank):
+    def _scored(loads, replicas, member, bcount):
+        # (load, ID) target ordering for reference-style tie-breaks
         bvalid = (always_valid | (bcount > 0)) & universe_valid
         nb = jnp.sum(bvalid).astype(dtype)
-        if use_rank:
-            # (load, ID) target ordering for reference-style tie-breaks
-            _, perm, rank_of = cost.rank_brokers(loads, bvalid)
-            allowed_t, member_t, bvalid_t = (
-                allowed[:, perm], member[:, perm], bvalid[perm],
-            )
-        else:
-            # throughput mode: tie-breaks by broker index; skips the sort
-            # and the two [P, B] gathers
-            perm = rank_of = jnp.arange(B, dtype=jnp.int32)
-            allowed_t, member_t, bvalid_t = allowed, member, bvalid
+        _, perm, rank_of = cost.rank_brokers(loads, bvalid)
         u, su = cost.move_candidate_scores(
-            loads, replicas, allowed_t, member_t, bvalid, bvalid_t, perm,
-            rank_of, weights, nrep_cur, nrep_tgt, pvalid, nb, min_replicas,
+            loads, replicas, allowed[:, perm], member[:, perm], bvalid,
+            bvalid[perm], perm, rank_of, weights, nrep_cur, nrep_tgt,
+            pvalid, nb, min_replicas,
         )
         return u, su, perm
 
     def body_batch(state):
         loads, replicas, member, bcount, n, done, mp, mslot, msrc, mtgt = state
-        u, su, _perm = _scored(loads, replicas, member, bcount, use_rank=False)
-
-        movable = (slot_iota[0] >= 0) if allow_leader else (slot_iota[0] >= 1)
-        u_m = jnp.where(movable[None, :, None], u, jnp.inf)
 
         # Per-TARGET candidate selection: the global top-K degenerates to one
         # commit per iteration because the best candidates all aim at the
@@ -175,11 +163,43 @@ def session(
         # then rejects everything but the first. Picking the best source for
         # each target broker instead yields up to B disjoint commits per
         # iteration — a bipartite matching of hot sources onto cold targets.
-        u2 = u_m.reshape(P * R, B)
-        cand = jnp.argmin(u2, axis=0).astype(jnp.int32)  # [B] best (p,slot)/target
-        vals = jnp.min(u2, axis=0)  # [B]
-        p, slot = jnp.divmod(cand, R)
+        #
+        # The rank-1 objective FACTORIZES over source and target:
+        #   u[p,r,t] = su + A[p,r] + C[p,t]
+        #   A[p,r] = f(load_s − w_p) − f(load_s)      (source term)
+        #   C[p,t] = f(load_t + w_p) − f(load_t)      (target term)
+        # so the per-target minimization needs only [P,R] + [P,B] work —
+        # the [P,R,B] candidate tensor never materializes:
+        #   best[t] = min_p [ min_r A[p,r] + C[p,t] ].
+        bvalid = (always_valid | (bcount > 0)) & universe_valid
+        nb = jnp.sum(bvalid).astype(dtype)
+        avg = jnp.sum(jnp.where(bvalid, loads, 0.0)) / nb
+        F = jnp.where(bvalid, cost.overload_penalty(loads, avg), 0.0)  # [B]
+        su = jnp.sum(F)
+
+        w = weights[:, None]  # [P, 1]
+        s_idx = jnp.clip(replicas, 0)  # [P, R]
+        movable = (slot_iota >= 0) if allow_leader else (slot_iota >= 1)
+        srcmask = (
+            movable
+            & (slot_iota < nrep_cur[:, None])
+            & pvalid[:, None]
+            & (nrep_tgt >= min_replicas)[:, None]
+        )  # [P, R]
+        A = cost.overload_penalty(loads[s_idx] - w, avg) - F[s_idx]  # [P, R]
+        A = jnp.where(srcmask, A, jnp.inf)
+        r_star = jnp.argmin(A, axis=1).astype(jnp.int32)  # [P]
+        A_star = jnp.min(A, axis=1)  # [P]
+
+        C = cost.overload_penalty(loads[None, :] + w, avg) - F[None, :]  # [P, B]
+        tmask = allowed & ~member & bvalid[None, :]  # [P, B]
+        V = jnp.where(
+            tmask & jnp.isfinite(A_star)[:, None], A_star[:, None] + C, jnp.inf
+        )
+        p = jnp.argmin(V, axis=0).astype(jnp.int32)  # [B] best source/target
         t = jnp.arange(B, dtype=jnp.int32)
+        vals = su + V[p, t]  # [B]
+        slot = r_star[p]
         s_ = replicas[p, slot].astype(jnp.int32)
 
         improving = jnp.isfinite(vals) & (vals < su - min_unbalance) & (vals < su)
@@ -235,7 +255,7 @@ def session(
 
     def body(state):
         loads, replicas, member, bcount, n, done, mp, mslot, msrc, mtgt = state
-        u, su, perm = _scored(loads, replicas, member, bcount, use_rank=True)
+        u, su, perm = _scored(loads, replicas, member, bcount)
 
         def best(mask_slots):
             flat = jnp.where(mask_slots[None, :, None], u, jnp.inf).reshape(-1)
