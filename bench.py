@@ -87,19 +87,32 @@ def main() -> None:
             f"unbalance {get_unbalance_bl(get_bl(get_broker_load(pl))):.3e}"
         )
 
-    # --- TPU fused session (batched disjoint commits, see solvers/scan.py):
-    # run twice, report the cached-compile run ----------------------------
+    # --- TPU fused session (batched disjoint commits via the whole-session
+    # Pallas kernel, XLA fallback): run twice, report the cached run ------
+    engine = os.environ.get("BENCH_ENGINE", "pallas")
     t_tpu = n_moves = final_u = None
     for attempt in range(2):
         pl, cfg = fresh()
         t0 = time.perf_counter()
-        opl = plan(pl, cfg, budget, dtype=jnp.float32, batch=batch)
+        try:
+            opl = plan(
+                pl, cfg, budget, dtype=jnp.float32, batch=batch, engine=engine
+            )
+        except Exception as exc:
+            if engine == "pallas":
+                log(f"pallas engine failed ({exc!r}); falling back to xla")
+                engine = "xla"
+                pl, cfg = fresh()
+                t0 = time.perf_counter()
+                opl = plan(pl, cfg, budget, dtype=jnp.float32, batch=batch)
+            else:
+                raise
         t_tpu = time.perf_counter() - t0
         n_moves = len(opl)
         final_u = get_unbalance_bl(get_bl(get_broker_load(pl)))
         log(
-            f"tpu session (run {attempt}, batch={batch}): {t_tpu:.3f}s, "
-            f"{n_moves} moves, final unbalance {final_u:.3e}"
+            f"tpu session (run {attempt}, batch={batch}, engine={engine}): "
+            f"{t_tpu:.3f}s, {n_moves} moves, final unbalance {final_u:.3e}"
         )
 
     est_greedy_total = t_greedy_move * max(1, n_ref)
@@ -117,6 +130,7 @@ def main() -> None:
                 "value": round(t_tpu, 4),
                 "unit": "s",
                 "vs_baseline": round(speedup, 2),
+                "engine": engine,
             }
         )
     )

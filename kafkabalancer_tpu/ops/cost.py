@@ -53,9 +53,16 @@ def broker_loads(replicas, weights, nrep_cur, ncons, num_brokers: int):
 
 def overload_penalty(loads, avg):
     """Per-broker objective term: ``rel²`` if overloaded else ``rel²/2``
-    (utils.go:134-143)."""
+    (utils.go:134-143).
+
+    Shared by the XLA solvers AND the Pallas session kernel — written
+    literal-free (``*_like`` instead of scalar constants) because weak
+    64-bit scalar literals cannot lower inside Mosaic kernels under global
+    x64."""
     rel = loads / avg - 1.0
-    return rel * rel * jnp.where(rel > 0, 1.0, 0.5)
+    return rel * rel * jnp.where(
+        rel > 0, jnp.ones_like(rel), jnp.full_like(rel, 0.5)
+    )
 
 
 def unbalance(loads, bvalid, nb):

@@ -106,6 +106,7 @@ def tensorize(
     cfg: Optional[RebalanceConfig] = None,
     extra_brokers: Sequence[int] = (),
     min_bucket: int = 8,
+    min_broker_bucket: int = 8,
 ) -> DensePlan:
     """Encode ``pl`` (post-``fill_defaults``: weights, brokers, num_replicas
     populated) into a :class:`DensePlan`.
@@ -125,7 +126,7 @@ def tensorize(
 
     P = next_bucket(np_real, min_bucket)
     R = next_bucket(rmax, 2)
-    B = next_bucket(nb, min_bucket)
+    B = next_bucket(nb, min_broker_bucket)
 
     weights = np.zeros(P, dtype=np.float64)
     replicas = np.full((P, R), -1, dtype=np.int32)
@@ -144,6 +145,25 @@ def tensorize(
     topic_idx = {}
     topic_id = np.zeros(P, dtype=np.int32)
 
+    # after FillDefaults most partitions share one brokers list object
+    # (steps.go:47-56 assigns the same slice) — cache dense rows by identity
+    allowed_rows: dict = {}
+
+    def allowed_row(brokers) -> np.ndarray:
+        key = id(brokers)
+        row = allowed_rows.get(key)
+        if row is None:
+            row = np.zeros(B, dtype=bool)
+            for bid in brokers:
+                j = idx_of.get(int(bid))
+                if j is not None:  # allowed-but-unobserved: see broker_universe
+                    row[j] = True
+            allowed_rows[key] = row
+        return row
+
+    full_row = np.zeros(B, dtype=bool)
+    full_row[:nb] = True
+
     for i, p in enumerate(parts):
         tid = topic_idx.get(p.topic)
         if tid is None:
@@ -156,16 +176,11 @@ def tensorize(
         nrep_tgt[i] = p.num_replicas
         ncons[i] = p.num_consumers
         for s, bid in enumerate(p.replicas):
-            bidx = idx_of[int(bid)]
-            replicas[i, s] = bidx
-            member[i, bidx] = True
-        if p.brokers is None:
-            allowed[i, :nb] = True
-        else:
-            for bid in p.brokers:
-                j = idx_of.get(int(bid))
-                if j is not None:  # allowed-but-unobserved: see broker_universe
-                    allowed[i, j] = True
+            replicas[i, s] = idx_of[int(bid)]
+        allowed[i] = full_row if p.brokers is None else allowed_row(p.brokers)
+
+    rows, cols = np.nonzero(replicas >= 0)
+    member[rows, replicas[rows, cols]] = True
 
     return DensePlan(
         broker_ids=ids,

@@ -1,0 +1,448 @@
+"""Whole-session Pallas TPU kernel for the batched fused move loop.
+
+The XLA version of the batched session (solvers/scan.py ``body_batch``)
+dispatches ~15 small kernels per iteration; on a remote-compiled TPU
+backend the per-kernel overhead (~0.1-0.3 ms each) dwarfs the arithmetic,
+capping convergence speed. This kernel runs the ENTIRE session — scoring,
+disjoint selection, application, move logging, convergence check — as one
+``pallas_call``: every state array stays resident in VMEM across all
+iterations and the device never returns to the dispatcher until the
+session converges or exhausts its budget.
+
+Same algorithm as ``scan.session`` with ``batch > 1`` (per-target
+candidate selection with the factorized rank-1 objective
+``u = su + A[p,r] + C[p,t]``, first-claimant disjointness, churn gate,
+dynamic broker-table membership), with kernel-friendly re-formulations:
+
+- the ``loads[s]`` gather becomes a one-hot contraction per P-tile (MXU);
+- claims/disjointness become pairwise ``[B, B]`` masks (no scatters);
+- cumsum becomes a lower-triangular ``[B, B]`` contraction;
+- member/replica updates are per-commit row read-modify-writes (the ≤B
+  commits per iteration are partition-disjoint, so rows are written once);
+- move logs live in ``[max_moves, 1]`` VMEM buffers written with dynamic
+  sublane indexing.
+
+The big ``allowed`` mask is int8 in VMEM (bool/int32 [P, B] arrays at the
+16k-partition bucket would not fit alongside the int32 member state);
+int8 values are widened before any comparison (int8 compares break the
+Mosaic lowering). Float32 only — this is the throughput path; parity
+modes stay on the XLA/host solvers. Under the Pallas interpreter the
+kernel is bit-identical to ``scan.session``'s batch path (pinned by
+tests/test_pallas.py); on hardware, float reduction order may resolve
+exact candidate ties differently — counts and final unbalance match.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+from kafkabalancer_tpu.ops.runtime import ensure_x64
+
+ensure_x64()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax import lax  # noqa: E402
+from jax.experimental import pallas as pl  # noqa: E402
+from jax.experimental.pallas import tpu as pltpu  # noqa: E402
+
+from kafkabalancer_tpu.ops.cost import overload_penalty as _pen  # noqa: E402
+
+BIG = 1e30  # inf stand-in (avoids inf−inf NaNs in masking)
+TILE_P = 256
+
+
+def _kernel(
+    # scalars (SMEM)
+    budget_ref,
+    batch_ref,
+    minrep_ref,
+    minunb_ref,
+    # arrays (VMEM)
+    loads0_ref,
+    replicas0_ref,
+    member_ref,
+    allowed_ref,
+    w_ref,
+    nrepc_ref,
+    nrept_ref,
+    ncons_ref,
+    pvalid_ref,
+    always_ref,
+    universe_ref,
+    # outputs
+    loads_ref,
+    replicas_ref,
+    n_ref,
+    mp_ref,
+    mslot_ref,
+    msrc_ref,
+    mtgt_ref,
+    member_out_ref,
+    # scratch
+    bcount_ref,
+    rstar_ref,
+    *,
+    P: int,
+    R: int,
+    B: int,
+    ML: int,
+    allow_leader: bool,
+):
+    f32 = jnp.float32
+
+    # ---- initialize mutable state from the inputs -----------------------
+    loads_ref[:] = loads0_ref[:]
+    replicas_ref[:] = replicas0_ref[:]
+    member_out_ref[:] = member_ref[:]
+    pv = pvalid_ref[:]  # [P, 1] int32
+    bcount_ref[:] = jnp.sum(
+        member_ref[:].astype(jnp.float32) * pv.astype(jnp.float32), axis=0,
+        keepdims=True,
+    ).astype(jnp.int32)
+    mp_ref[:] = jnp.full((ML, 1), -1, jnp.int32)
+    mslot_ref[:] = jnp.full((ML, 1), -1, jnp.int32)
+    msrc_ref[:] = jnp.full((ML, 1), -1, jnp.int32)
+    mtgt_ref[:] = jnp.full((ML, 1), -1, jnp.int32)
+
+    budget = budget_ref[0, 0]
+    batch = batch_ref[0, 0]
+    min_repl = minrep_ref[0, 0]
+    min_unb = minunb_ref[0, 0]
+
+    lane_b = lax.broadcasted_iota(jnp.int32, (1, B), 1)  # [1, B]
+    iota_r = lax.broadcasted_iota(jnp.int32, (1, R), 1)  # [1, R]
+
+    def iteration(carry):
+        n, _done = carry
+
+        loads = loads_ref[0, :]  # [B]
+        bvalid = (
+            ((always_ref[0, :] > 0) | (bcount_ref[0, :] > 0))
+            & (universe_ref[0, :] > 0)
+        )  # [B] bool
+        nb = jnp.sum(bvalid.astype(f32))
+        avg = jnp.sum(jnp.where(bvalid, loads, jnp.zeros_like(loads))) / nb
+        F = jnp.where(bvalid, _pen(loads, avg), jnp.zeros_like(loads))  # [B]
+        su = jnp.sum(F)
+
+        # ---- tile loop over partitions: best candidate per target -------
+        # carries: (bestv [1,B], bestp [1,B])
+        loadsF = jnp.concatenate(
+            [loads.reshape(B, 1), F.reshape(B, 1)], axis=1
+        )  # [B, 2]
+
+        def tile_body(ti, bc):
+            bestv, bestp = bc
+            off = ti * TILE_P
+            reps = replicas_ref[pl.ds(off, TILE_P), :]  # [T, R] i32
+            w_t = w_ref[pl.ds(off, TILE_P), :]  # [T, 1] f32
+            nrc = nrepc_ref[pl.ds(off, TILE_P), :]  # [T, 1]
+            nrt = nrept_ref[pl.ds(off, TILE_P), :]
+            pv_t = pvalid_ref[pl.ds(off, TILE_P), :]
+            # one-hot contraction replaces the loads/F gather
+            onehot = (
+                reps.reshape(TILE_P, R, 1)
+                == lane_b.reshape(1, 1, B)
+            ).astype(f32)  # [T, R, B]
+            g = jax.lax.dot_general(
+                onehot.reshape(TILE_P * R, B),
+                loadsF,
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=f32,
+                precision=jax.lax.Precision.HIGHEST,
+            ).reshape(TILE_P, R, 2)
+            loads_s = g[:, :, 0]
+            F_s = g[:, :, 1]
+
+            movable = iota_r >= (0 if allow_leader else 1)  # [1, R]
+            srcmask = (
+                movable
+                & (iota_r < nrc)
+                & (pv_t > 0)
+                & (nrt >= min_repl)
+            )  # [T, R]
+            A = jnp.where(srcmask, _pen(loads_s - w_t, avg) - F_s, jnp.full_like(loads_s, BIG))
+            astar = jnp.min(A, axis=1, keepdims=True)  # [T, 1]
+            rstar = lax.argmin(A, axis=1, index_dtype=jnp.int32)  # [T]
+            rstar_ref[pl.ds(off, TILE_P), :] = rstar.reshape(TILE_P, 1)
+
+            C = _pen(loads.reshape(1, B) + w_t, avg) - F.reshape(1, B)
+            memb = member_out_ref[pl.ds(off, TILE_P), :]  # [T, B] i32
+            # NOTE: int8 loads are fine but int8 *comparisons* break the
+            # Mosaic lowering — widen before comparing
+            alw = allowed_ref[pl.ds(off, TILE_P), :].astype(jnp.int32)
+            tmask = (alw > 0) & (memb == 0) & bvalid.reshape(1, B)
+            V = jnp.where(
+                tmask & (astar < BIG * 0.5), astar + C, jnp.full_like(C, BIG)
+            )  # [T, B]
+            vmin = jnp.min(V, axis=0, keepdims=True)  # [1, B]
+            varg = lax.argmin(V, axis=0, index_dtype=jnp.int32).reshape(1, B)
+            better = vmin < bestv
+            bestv = jnp.where(better, vmin, bestv)
+            bestp = jnp.where(better, off + varg, bestp)
+            return bestv, bestp
+
+        bestv0 = jnp.full((1, B), BIG, f32)
+        bestp0 = jnp.zeros((1, B), jnp.int32)
+        bestv, bestp = lax.fori_loop(
+            jnp.int32(0), jnp.int32(P // TILE_P), tile_body, (bestv0, bestp0)
+        )
+        vals = su + bestv[0, :]  # [B]
+        cp = bestp[0, :]  # [B] candidate partition per target
+
+        # ---- per-candidate scalar fetches (slot, source, weight terms) --
+        # scalar extraction from lane vectors via masked reduction (vector
+        # dynamic-slice along lanes is not portable Mosaic)
+        def ext_i(vec, i):
+            # exactly one lane matches and all extracted values are >= 0;
+            # max does not promote the accumulator dtype (integer sums
+            # would upcast to unsupported int64 under global x64)
+            return jnp.max(jnp.where(lane_b[0, :] == i, vec, jnp.zeros_like(vec)))
+
+        def fetch(i, acc):
+            cslot, cs, cdelta = acc
+            p_i = ext_i(cp, i)
+            slot_i = rstar_ref[p_i, 0]
+            rrow = replicas_ref[pl.ds(p_i, 1), :]  # [1, R]
+            s_i = jnp.max(jnp.where(iota_r == slot_i, rrow, jnp.zeros_like(rrow)))
+            w_i = w_ref[p_i, 0]
+            prem = w_i * (nrepc_ref[p_i, 0].astype(f32) + ncons_ref[p_i, 0])
+            d_i = jnp.where(slot_i == 0, prem, w_i)
+            sel = lane_b[0, :] == i
+            cslot = jnp.where(sel, slot_i, cslot)
+            cs = jnp.where(sel, s_i, cs)
+            cdelta = jnp.where(sel, d_i, cdelta)
+            return cslot, cs, cdelta
+
+        zi = jnp.zeros(B, jnp.int32)
+        cslot, cs, cdelta = lax.fori_loop(
+            jnp.int32(0), jnp.int32(B), fetch, (zi, zi, jnp.zeros(B, f32))
+        )
+
+        # ---- improvement + churn gate -----------------------------------
+        improving = (vals < su - min_unb) & (vals < su) & (bestv[0, :] < BIG * 0.5)
+        best_gain = su - jnp.min(vals)
+        improving &= (su - vals) * 4.0 >= best_gain
+
+        # ---- pairwise first-claimant disjointness [B, B] ----------------
+        # row j = earlier candidate, col i = later; t_j == j, t_i == i.
+        # Lane->sublane reshapes of vectors crash the Mosaic backend, so
+        # column versions are produced with an MXU transpose (eye @ row);
+        # values are exact in f32 (p < 2^24, brokers < 2^24)
+        iota2_r = lax.broadcasted_iota(jnp.int32, (B, B), 0)  # row index j
+        iota2_c = lax.broadcasted_iota(jnp.int32, (B, B), 1)  # col index i
+        eye = (iota2_r == iota2_c).astype(f32)
+
+        def to_col(vec_f32):  # [B] lanes -> [B, 1] sublanes
+            return jax.lax.dot_general(
+                eye,
+                vec_f32.reshape(1, B),
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=f32,
+                precision=jax.lax.Precision.HIGHEST,
+            )
+
+        cpf = cp.astype(f32)
+        csf = cs.astype(f32)
+        pj = to_col(cpf)  # [B, 1]
+        sj = to_col(csf)
+        pi = cpf.reshape(1, B)
+        si = csf.reshape(1, B)
+        tif = lane_b.astype(f32)  # [1, B]
+        tjf = iota2_r.astype(f32)[:, :1]  # [B, 1] row indices as f32
+        conflict = (pj == pi) | (sj == si) | (sj == tif) | (tjf == si)
+        earlier = iota2_r < iota2_c
+        imp_col = to_col(jnp.where(improving, jnp.ones(B, f32), jnp.zeros(B, f32))) > 0.5
+        blocked = (
+            jnp.max(
+                (earlier & imp_col & conflict).astype(f32), axis=0
+            )
+            > 0.5
+        )  # [B]
+        ok = improving & ~blocked
+
+        # ---- budget/batch cap via triangular cumsum ---------------------
+        tri = (iota2_r <= iota2_c).astype(f32)  # cols accumulate
+        csum = jax.lax.dot_general(
+            jnp.where(ok, jnp.ones(B, f32), jnp.zeros(B, f32)).reshape(1, B),
+            tri,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=f32,
+            precision=jax.lax.Precision.HIGHEST,
+        ).reshape(B).astype(jnp.int32)  # inclusive cumsum over candidates
+        pos = n + csum - 1
+        ok &= (pos < n + batch) & (pos < budget) & (pos < ML)
+        oki = jnp.where(ok, jnp.ones(B, jnp.int32), jnp.zeros(B, jnp.int32))
+        cnt = jnp.sum(oki.astype(f32)).astype(jnp.int32)
+
+        # ---- apply: loads and bcount (vectorized) -----------------------
+        okd = jnp.where(ok, cdelta, jnp.zeros_like(cdelta))  # [B]
+        s_onehot = (sj == tif).astype(f32)  # [B, B]: s_j one-hot rows
+        sub = jax.lax.dot_general(
+            okd.reshape(1, B),
+            s_onehot,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=f32,
+            precision=jax.lax.Precision.HIGHEST,
+        ).reshape(B)
+        loads_ref[0, :] = loads + okd - sub
+        subc = jax.lax.dot_general(
+            oki.astype(f32).reshape(1, B),
+            s_onehot,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=f32,
+            precision=jax.lax.Precision.HIGHEST,
+        ).reshape(B)
+        bcount_ref[0, :] = bcount_ref[0, :] + oki - subc.astype(jnp.int32)
+
+        # ---- apply: member/replica rows + move logs (per commit) --------
+        # commits are partition-disjoint, so each touched row is written by
+        # exactly one candidate
+        def commit(i, n_acc):
+            ok_i = ext_i(oki, i) > 0
+
+            @pl.when(ok_i)
+            def _():
+                p_i = ext_i(cp, i)
+                s_i = ext_i(cs, i)
+                slot_i = ext_i(cslot, i)
+                at = ext_i(jnp.where(ok, pos, jnp.zeros_like(pos)), i)
+                row = member_out_ref[pl.ds(p_i, 1), :]  # [1, B] i32
+                row = jnp.where(lane_b == s_i, jnp.zeros_like(row), row)
+                row = jnp.where(lane_b == i, jnp.ones_like(row), row)
+                member_out_ref[pl.ds(p_i, 1), :] = row
+                rrow = replicas_ref[pl.ds(p_i, 1), :]  # [1, R] i32
+                rrow = jnp.where(iota_r == slot_i, i, rrow)
+                replicas_ref[pl.ds(p_i, 1), :] = rrow
+                one = jnp.ones((1, 1), jnp.int32)
+                mp_ref[pl.ds(at, 1), :] = one * p_i
+                mslot_ref[pl.ds(at, 1), :] = one * slot_i
+                msrc_ref[pl.ds(at, 1), :] = one * s_i
+                mtgt_ref[pl.ds(at, 1), :] = one * i
+
+            return n_acc
+
+        lax.fori_loop(jnp.int32(0), jnp.int32(B), commit, jnp.int32(0))
+
+        return n + cnt, cnt == 0
+
+    def cond(carry):
+        n, done = carry
+        return (~done) & (n < budget) & (n < ML)
+
+    n, _ = lax.while_loop(cond, iteration, (jnp.int32(0), jnp.bool_(False)))
+    n_ref[0, 0] = n
+
+
+@partial(
+    jax.jit,
+    static_argnames=("max_moves", "allow_leader", "interpret"),
+)
+def pallas_session(
+    loads,
+    replicas,
+    member,
+    allowed,
+    weights,
+    nrep_cur,
+    nrep_tgt,
+    ncons,
+    pvalid,
+    always_valid,
+    universe_valid,
+    min_replicas,
+    min_unbalance,
+    budget,
+    batch,
+    *,
+    max_moves: int,
+    allow_leader: bool,
+    interpret: bool = False,
+):
+    """Device-resident batched session; same contract as ``scan.session``
+    restricted to the batch path: returns ``(replicas, loads, n, move_p,
+    move_slot, move_src, move_tgt)`` (no final objective — the caller
+    recomputes it host-side from the returned state).
+
+    Shape requirements: the partition bucket must be a multiple of
+    ``TILE_P`` (tensorize with ``min_bucket=TILE_P``); float32 only.
+    ``interpret=True`` runs the Pallas interpreter (CPU testing).
+    """
+    P, R = replicas.shape
+    B = loads.shape[0]
+    if P % TILE_P:
+        raise ValueError(f"partition bucket {P} not a multiple of {TILE_P}")
+    ML = max_moves
+
+    f32 = jnp.float32
+    i32 = jnp.int32
+    i8 = jnp.int8
+
+    def scalar(x, dt):
+        return jnp.asarray(x, dt).reshape(1, 1)
+
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+
+    # NOTE: the kernel is strictly 32-bit by construction (max-based lane
+    # extraction, f32-accumulated counts, lax.argmin with index_dtype) —
+    # Mosaic has no 64-bit types and the process may run with x64 enabled
+    out = _call(
+        partial(_kernel, P=P, R=R, B=B, ML=ML, allow_leader=allow_leader),
+        P, R, B, ML, smem, vmem, interpret,
+    )(
+        scalar(budget, i32),
+        scalar(batch, i32),
+        scalar(min_replicas, i32),
+        scalar(min_unbalance, f32),
+        jnp.asarray(loads, f32).reshape(1, B),
+        jnp.asarray(replicas, i32),
+        jnp.asarray(member, i32).reshape(P, B),
+        jnp.asarray(allowed, i8).reshape(P, B),
+        jnp.asarray(weights, f32).reshape(P, 1),
+        jnp.asarray(nrep_cur, i32).reshape(P, 1),
+        jnp.asarray(nrep_tgt, i32).reshape(P, 1),
+        jnp.asarray(ncons, f32).reshape(P, 1),
+        jnp.asarray(pvalid, i32).reshape(P, 1),
+        jnp.asarray(always_valid, i32).reshape(1, B),
+        jnp.asarray(universe_valid, i32).reshape(1, B),
+    )
+    loads_out, replicas_out, n, mp, mslot, msrc, mtgt, _member_out = out
+    return (
+        replicas_out,
+        loads_out.reshape(B),
+        n.reshape(()),
+        mp.reshape(ML),
+        mslot.reshape(ML),
+        msrc.reshape(ML),
+        mtgt.reshape(ML),
+    )
+
+
+def _call(kernel, P, R, B, ML, smem, vmem, interpret=False):
+    f32 = jnp.float32
+    i32 = jnp.int32
+    i8 = jnp.int8
+    return pl.pallas_call(
+        kernel,
+        interpret=interpret,
+        out_shape=(
+            jax.ShapeDtypeStruct((1, B), f32),  # loads
+            jax.ShapeDtypeStruct((P, R), i32),  # replicas
+            jax.ShapeDtypeStruct((1, 1), i32),  # n
+            jax.ShapeDtypeStruct((ML, 1), i32),  # move_p
+            jax.ShapeDtypeStruct((ML, 1), i32),  # move_slot
+            jax.ShapeDtypeStruct((ML, 1), i32),  # move_src
+            jax.ShapeDtypeStruct((ML, 1), i32),  # move_tgt
+            jax.ShapeDtypeStruct((P, B), i32),  # member (aliased state)
+        ),
+        in_specs=[smem] * 4 + [vmem] * 11,
+        out_specs=(vmem, vmem, smem, vmem, vmem, vmem, vmem, vmem),
+        input_output_aliases={6: 7},  # member input -> member output
+        scratch_shapes=[
+            pltpu.VMEM((1, B), i32),  # bcount
+            pltpu.VMEM((P, 1), i32),  # rstar
+        ],
+    )

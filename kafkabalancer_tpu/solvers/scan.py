@@ -365,6 +365,7 @@ def plan(
     dtype=None,
     batch: int = 1,
     chunk_moves: int = 8192,
+    engine: str = "xla",
 ) -> PartitionList:
     """Full multi-move planning session: host-side repairs, then a fused
     on-device move loop. The output accumulates live partitions in move
@@ -374,6 +375,13 @@ def plan(
 
     Falls back to the host per-move pipeline when ``rebalance_leaders`` is
     set (see module docstring).
+
+    ``engine="pallas"`` runs chunks through the whole-session Pallas kernel
+    (solvers/pallas_session.py): float32 only, always the pooled batched
+    selection (even at ``batch=1`` there is no leader-first precedence),
+    identical results to the XLA batch path at a fraction of the wall
+    clock. ``engine="pallas-interpret"`` uses the Pallas interpreter (CPU
+    testing).
     """
     opl = empty_partition_list()
     if max_reassign <= 0:
@@ -404,9 +412,21 @@ def plan(
     # until converged or exhausted; identical chunk buckets reuse one
     # compiled executable
     chunk_moves = max(1, min(chunk_moves, 1 << 20))
+    use_pallas = engine in ("pallas", "pallas-interpret")
+    if use_pallas:
+        from kafkabalancer_tpu.solvers.pallas_session import (
+            TILE_P,
+            pallas_session,
+        )
+
+        dtype = jnp.float32
+    elif engine != "xla":
+        raise ValueError(f"unknown engine {engine!r}")
+
     remaining = budget
     while remaining > 0:
-        dp = tensorize(pl, cfg)
+        # only the partition axis needs TILE_P alignment for the kernel
+        dp = tensorize(pl, cfg, min_bucket=TILE_P if use_pallas else 8)
         loads = cost.broker_loads(
             jnp.asarray(dp.replicas),
             jnp.asarray(dp.weights, dtype),
@@ -415,7 +435,7 @@ def plan(
             dp.bvalid.shape[0],
         )
         chunk = min(remaining, chunk_moves)
-        _replicas, _loads, n, mp, mslot, _msrc, mtgt, _su = session(
+        args = (
             loads,
             jnp.asarray(dp.replicas),
             jnp.asarray(dp.member),
@@ -430,10 +450,22 @@ def plan(
             jnp.int32(cfg.min_replicas_for_rebalancing),
             jnp.asarray(cfg.min_unbalance, dtype),
             jnp.int32(chunk),
-            max_moves=next_bucket(chunk, 64),
-            allow_leader=cfg.allow_leader_rebalancing,
-            batch=batch,
         )
+        if use_pallas:
+            _replicas, _loads, n, mp, mslot, _msrc, mtgt = pallas_session(
+                *args,
+                jnp.int32(max(1, batch)),
+                max_moves=next_bucket(chunk, 64),
+                allow_leader=cfg.allow_leader_rebalancing,
+                interpret=(engine == "pallas-interpret"),
+            )
+        else:
+            _replicas, _loads, n, mp, mslot, _msrc, mtgt, _su = session(
+                *args,
+                max_moves=next_bucket(chunk, 64),
+                allow_leader=cfg.allow_leader_rebalancing,
+                batch=batch,
+            )
 
         n = int(n)
         mp, mslot, mtgt = (np.asarray(x)[:n] for x in (mp, mslot, mtgt))

@@ -1,0 +1,80 @@
+"""Pallas whole-session kernel parity tests (interpreter mode on CPU).
+
+The kernel must reproduce the XLA batched session exactly: same moves in
+the same order, same final assignment and loads. Hardware-specific
+lowering concerns (Mosaic int8 comparisons, lane→sublane transposes,
+MXU matmul precision for integer payloads) are documented in
+solvers/pallas_session.py; these tests pin the algorithmic equivalence
+that the hardware path is then checked against by bench runs."""
+
+import copy
+import random
+
+import numpy as np
+import pytest
+
+from helpers import random_partition_list
+
+from kafkabalancer_tpu.balancer import balance
+from kafkabalancer_tpu.balancer.costmodel import (
+    get_bl,
+    get_broker_load,
+    get_unbalance_bl,
+)
+from kafkabalancer_tpu.models import default_rebalance_config
+from kafkabalancer_tpu.solvers.scan import plan
+
+
+def unbalance_of(pl):
+    return get_unbalance_bl(get_bl(get_broker_load(pl)))
+
+
+@pytest.mark.parametrize("allow_leader", [False, True])
+def test_pallas_session_matches_xla_batch(allow_leader):
+    import jax.numpy as jnp
+
+    rng = random.Random(3000 + allow_leader)
+    pl = random_partition_list(rng, 40, 8, weighted=True, with_consumers=True)
+    cfg = default_rebalance_config()
+    cfg.min_unbalance = 1e-6
+    cfg.allow_leader_rebalancing = allow_leader
+
+    pl_x, pl_p = copy.deepcopy(pl), copy.deepcopy(pl)
+    opl_x = plan(
+        pl_x, copy.deepcopy(cfg), 40, dtype=jnp.float32, batch=16,
+        engine="xla",
+    )
+    # NOTE: XLA batch mode with allow_leader pools leader+follower slots,
+    # exactly like the kernel
+    opl_p = plan(
+        pl_p, copy.deepcopy(cfg), 40, batch=16, engine="pallas-interpret",
+    )
+    moves_x = [(p.topic, p.partition, tuple(p.replicas)) for p in (opl_x.partitions or [])]
+    moves_p = [(p.topic, p.partition, tuple(p.replicas)) for p in (opl_p.partitions or [])]
+    assert moves_x == moves_p
+    assert pl_x == pl_p
+
+
+def test_pallas_session_respects_budget_and_converges():
+    rng = random.Random(3100)
+    pl = random_partition_list(rng, 30, 6, weighted=True)
+    cfg = default_rebalance_config()
+    cfg.min_unbalance = 1e-6
+    u0 = None
+    pl_b = copy.deepcopy(pl)
+    opl = plan(pl_b, copy.deepcopy(cfg), 5, batch=8, engine="pallas-interpret")
+    assert len(opl) <= 5
+    # converged run ends at a true local optimum
+    pl_c = copy.deepcopy(pl)
+    u0 = unbalance_of(pl_c) if pl_c.partitions[0].weight else None
+    plan(pl_c, copy.deepcopy(cfg), 500, batch=8, engine="pallas-interpret")
+    assert len(balance(pl_c, copy.deepcopy(cfg))) == 0
+    if u0 is not None:
+        assert unbalance_of(pl_c) < u0
+
+
+def test_plan_unknown_engine():
+    rng = random.Random(3200)
+    pl = random_partition_list(rng, 5, 3, weighted=True)
+    with pytest.raises(ValueError, match="unknown engine"):
+        plan(pl, default_rebalance_config(), 5, engine="cuda")
