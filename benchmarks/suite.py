@@ -1,0 +1,262 @@
+"""The five BASELINE.md measurement configs, end to end.
+
+``bench.py`` at the repo root is the driver's single-number benchmark
+(north-star config). This suite covers the full measurement plan — run it
+for the complete picture:
+
+    python benchmarks/suite.py            # on TPU
+    BENCH_FAST=1 python benchmarks/suite.py   # shrunk smoke run
+
+Configs (BASELINE.md):
+  1. test/test.json reassignment input, -max-reassign=1 (single-move latency)
+  2. kafka-topics.sh --describe dump, equal weights, 1k partitions / 12 brokers
+  3. weighted partitions with -allow-leader
+  4. beam search with the same-topic anti-colocation penalty (quality vs greedy)
+  5. broker add/remove what-if sweep vs sequential per-scenario runs
+
+Each row reports wall-clock and final unbalance for the CPU-greedy baseline
+and the TPU path. Output is a human-readable table on stdout; one JSON line
+per config on stderr for machines.
+"""
+
+from __future__ import annotations
+
+import copy
+import io
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kafkabalancer_tpu.balancer import balance  # noqa: E402
+from kafkabalancer_tpu.balancer.costmodel import (  # noqa: E402
+    get_bl,
+    get_broker_load,
+    get_unbalance_bl,
+)
+from kafkabalancer_tpu.cli import apply_assignment  # noqa: E402
+from kafkabalancer_tpu.codecs import get_partition_list_from_reader  # noqa: E402
+from kafkabalancer_tpu.models import default_rebalance_config  # noqa: E402
+from kafkabalancer_tpu.utils.synth import synth_cluster  # noqa: E402
+
+FAST = os.environ.get("BENCH_FAST") == "1"
+ROWS = []
+
+
+def unbalance_of(pl):
+    return get_unbalance_bl(get_bl(get_broker_load(pl)))
+
+
+def greedy_converge(pl, cfg, max_moves):
+    n = 0
+    while n < max_moves:
+        ppl = balance(pl, cfg)
+        if len(ppl) == 0:
+            break
+        for changed in ppl.partitions:
+            apply_assignment(pl, changed)
+        n += 1
+    return n
+
+
+def row(config, baseline_s, baseline_u, tpu_s, tpu_u, note=""):
+    ROWS.append((config, baseline_s, baseline_u, tpu_s, tpu_u, note))
+    print(
+        json.dumps(
+            {
+                "config": config,
+                "baseline_s": round(baseline_s, 4),
+                "baseline_unbalance": baseline_u,
+                "tpu_s": round(tpu_s, 4),
+                "tpu_unbalance": tpu_u,
+                "note": note,
+            }
+        ),
+        file=sys.stderr,
+        flush=True,
+    )
+
+
+def timed(fn, *args, **kw):
+    t0 = time.perf_counter()
+    out = fn(*args, **kw)
+    return time.perf_counter() - t0, out
+
+
+def config1_single_move():
+    """test.json, -max-reassign=1: greedy vs tpu solver (byte parity)."""
+    path = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tests", "data", "test.json",
+    )
+    with open(path) as f:
+        raw = f.read()
+
+    def run_once(solver):
+        pl = get_partition_list_from_reader(io.StringIO(raw), True, [])
+        cfg = default_rebalance_config()
+        cfg.solver = solver
+        return balance(pl, cfg)
+
+    run_once("tpu")  # warm the jit
+    tg, out_g = timed(run_once, "greedy")
+    tt, out_t = timed(run_once, "tpu")
+    assert out_g == out_t, "tpu plan must be byte-identical to greedy"
+    row("1: test.json single move", tg, None, tt, None, "plans identical")
+
+
+def config2_text_input():
+    """kafka-topics.sh text dump, equal weights, 1k partitions / 12 brokers."""
+    from kafkabalancer_tpu.solvers.scan import plan
+
+    n_parts = 100 if FAST else 1000
+    src = synth_cluster(n_parts, 12, rf=2, seed=7, weighted=False)
+    lines = []
+    for p in src.partitions:
+        reps = ",".join(str(b) for b in p.replicas)
+        lines.append(
+            f"\tTopic: {p.topic}\tPartition: {p.partition}\t"
+            f"Leader: {p.replicas[0]}\tReplicas: {reps}\tIsr: {reps}"
+        )
+    text = "\n".join(lines) + "\n"
+
+    budget = 2000
+
+    def parse():
+        return get_partition_list_from_reader(io.StringIO(text), False, [])
+
+    pl_g = parse()
+    cfg = default_rebalance_config()
+    cfg.min_unbalance = 1e-6  # unit weights are <1% of a broker's load here
+    tg, n_g = timed(greedy_converge, pl_g, copy.deepcopy(cfg), budget)
+
+    pl_t = parse()
+    plan(copy.deepcopy(pl_t), copy.deepcopy(cfg), 1)  # warm
+    pl_t = parse()
+    tt, opl = timed(plan, pl_t, copy.deepcopy(cfg), budget, batch=12)
+    row(
+        "2: text input 1k/12 equal wt", tg, unbalance_of(pl_g), tt,
+        unbalance_of(pl_t), f"{n_g} vs {len(opl)} moves",
+    )
+
+
+def config3_weighted_leader():
+    """Weighted partitions, -allow-leader."""
+    from kafkabalancer_tpu.solvers.scan import plan
+
+    n_parts = 200 if FAST else 2000
+    cfg = default_rebalance_config()
+    cfg.allow_leader_rebalancing = True
+    cfg.min_unbalance = 1e-5
+
+    def fresh():
+        return synth_cluster(n_parts, 24, rf=3, seed=11, weighted=True,
+                             num_consumers_max=3)
+
+    budget = 4000
+    # greedy here oscillates on leader moves (scored plain weight, applied
+    # with premium — the reference quirk) and can burn the full budget; cap
+    # its measurement so the suite stays bounded
+    greedy_cap = 200 if FAST else 400
+    pl_g = fresh()
+    tg, n_g = timed(greedy_converge, pl_g, copy.deepcopy(cfg), greedy_cap)
+    plan(fresh(), copy.deepcopy(cfg), budget, batch=24)  # warm
+    pl_t = fresh()
+    tt, opl = timed(plan, pl_t, copy.deepcopy(cfg), budget, batch=24)
+    row(
+        "3: weighted + allow-leader 2k/24", tg, unbalance_of(pl_g), tt,
+        unbalance_of(pl_t),
+        f"{n_g} (capped) vs {len(opl)} moves; batch mode scores leaders "
+        "with the true premium",
+    )
+
+
+def config4_beam_quality():
+    """Beam search + anti-colocation vs plain greedy (quality & time)."""
+    from kafkabalancer_tpu.solvers.beam import beam_plan
+
+    n_parts = 60 if FAST else 400
+    cfg = default_rebalance_config()
+    cfg.min_unbalance = 1e-6
+    cfg.beam_width = 8
+    cfg.beam_depth = 4
+    cfg.anti_colocation = 0.0
+
+    def fresh():
+        return synth_cluster(n_parts, 16, rf=3, seed=13, weighted=False)
+
+    budget = 1500
+    pl_g = fresh()
+    tg, n_g = timed(greedy_converge, pl_g, copy.deepcopy(cfg), budget)
+    beam_plan(fresh(), copy.deepcopy(cfg), 4)  # warm
+    pl_b = fresh()
+    tt, opl = timed(beam_plan, pl_b, copy.deepcopy(cfg), budget)
+    row(
+        "4: beam W8 D4 400/16", tg, unbalance_of(pl_g), tt,
+        unbalance_of(pl_b), f"{n_g} vs {len(opl)} moves",
+    )
+
+
+def config5_sweep():
+    """Broker add/remove what-if sweep vs sequential per-scenario runs."""
+    from kafkabalancer_tpu.parallel.sweep import sweep
+
+    n_parts = 80 if FAST else 500
+    pl = synth_cluster(n_parts, 12, rf=2, seed=17, weighted=True)
+    observed = sorted({b for p in pl.partitions for b in p.replicas})
+    cfg = default_rebalance_config()
+    cfg.min_unbalance = 1e-5
+    hi = max(observed)
+    scenarios = [
+        observed,
+        observed + [hi + 1],
+        observed + [hi + 1, hi + 2],
+        observed + [hi + 1, hi + 2, hi + 3, hi + 4],
+        observed[1:],
+        observed[2:],
+    ]
+
+    def sequential():
+        best = None
+        for sc in scenarios:
+            p2 = copy.deepcopy(pl)
+            c2 = copy.deepcopy(cfg)
+            c2.brokers = sorted(sc)
+            try:
+                greedy_converge(p2, c2, 2000)
+            except Exception:
+                continue
+            u = unbalance_of(p2)
+            best = u if best is None else min(best, u)
+        return best
+
+    tg, best_seq = timed(sequential)
+    sweep(pl, cfg, scenarios[:1], max_reassign=4)  # warm
+    tt, results = timed(sweep, pl, cfg, scenarios, max_reassign=2000)
+    best_sweep = min(r.unbalance for r in results if r.feasible and r.completed)
+    row(
+        f"5: what-if sweep {len(scenarios)} scenarios", tg, best_seq, tt,
+        best_sweep, "best-scenario unbalance",
+    )
+
+
+def main():
+    import jax
+
+    print(f"devices: {jax.devices()}", file=sys.stderr)
+    for fn in (config1_single_move, config2_text_input,
+               config3_weighted_leader, config4_beam_quality, config5_sweep):
+        fn()
+
+    w = max(len(r[0]) for r in ROWS) + 2
+    print(f"{'config':<{w}}{'cpu greedy':>14}{'tpu':>12}{'speedup':>9}  note")
+    for config, bs, bu, ts, tu, note in ROWS:
+        sp = f"{bs / ts:.1f}x" if ts > 0 else "-"
+        ub = "" if bu is None else f" (u={bu:.2e} vs {tu:.2e})"
+        print(f"{config:<{w}}{bs:>12.3f}s{ts:>11.3f}s{sp:>9}  {note}{ub}")
+
+
+if __name__ == "__main__":
+    main()

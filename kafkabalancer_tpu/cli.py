@@ -164,6 +164,12 @@ def run(i, o, e, args: List[str]) -> int:
             "Fused mode: commit up to this many broker-disjoint moves per "
             "device iteration (1 = strict one-move-at-a-time)",
         )
+        f_engine = f.string(
+            "fused-engine",
+            "xla",
+            "Fused mode: device engine (xla, or pallas for the "
+            "whole-session TPU kernel)",
+        )
         f_jaxprof = f.string(
             "jax-profile",
             "",
@@ -275,10 +281,18 @@ def run(i, o, e, args: List[str]) -> int:
             # (solvers/scan.py) instead of the per-move host loop; consumes
             # the budget so the loop below is skipped and the shared output
             # tail applies unchanged
+            if f_engine.value not in ("xla", "pallas", "pallas-interpret"):
+                log(f"unknown fused engine {f_engine.value!r}")
+                usage()
+                return 3
             try:
                 from kafkabalancer_tpu.solvers.scan import plan
 
-                opl = plan(pl, cfg, r, batch=max(1, f_batch.value))
+                opl = plan(
+                    pl, cfg, r,
+                    batch=max(1, f_batch.value),
+                    engine=f_engine.value,
+                )
             except BalanceError as exc:
                 log(f"failed optimizing distribution: {exc}")
                 return 3

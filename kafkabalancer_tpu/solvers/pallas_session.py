@@ -134,13 +134,14 @@ def _kernel(
         )  # [B, 2]
 
         def tile_body(ti, bc):
-            bestv, bestp = bc
+            bestv, bestp, bestv_l, bestp_l = bc
             off = ti * TILE_P
             reps = replicas_ref[pl.ds(off, TILE_P), :]  # [T, R] i32
             w_t = w_ref[pl.ds(off, TILE_P), :]  # [T, 1] f32
             nrc = nrepc_ref[pl.ds(off, TILE_P), :]  # [T, 1]
             nrt = nrept_ref[pl.ds(off, TILE_P), :]
             pv_t = pvalid_ref[pl.ds(off, TILE_P), :]
+            ncons_t = ncons_ref[pl.ds(off, TILE_P), :]  # [T, 1]
             # one-hot contraction replaces the loads/F gather
             onehot = (
                 reps.reshape(TILE_P, R, 1)
@@ -156,24 +157,20 @@ def _kernel(
             loads_s = g[:, :, 0]
             F_s = g[:, :, 1]
 
-            movable = iota_r >= (0 if allow_leader else 1)  # [1, R]
-            srcmask = (
-                movable
-                & (iota_r < nrc)
-                & (pv_t > 0)
-                & (nrt >= min_repl)
-            )  # [T, R]
-            A = jnp.where(srcmask, _pen(loads_s - w_t, avg) - F_s, jnp.full_like(loads_s, BIG))
-            astar = jnp.min(A, axis=1, keepdims=True)  # [T, 1]
-            rstar = lax.argmin(A, axis=1, index_dtype=jnp.int32)  # [T]
-            rstar_ref[pl.ds(off, TILE_P), :] = rstar.reshape(TILE_P, 1)
-
-            C = _pen(loads.reshape(1, B) + w_t, avg) - F.reshape(1, B)
+            elig = (pv_t > 0) & (nrt >= min_repl)  # [T, 1]
             memb = member_out_ref[pl.ds(off, TILE_P), :]  # [T, B] i32
             # NOTE: int8 loads are fine but int8 *comparisons* break the
             # Mosaic lowering — widen before comparing
             alw = allowed_ref[pl.ds(off, TILE_P), :].astype(jnp.int32)
             tmask = (alw > 0) & (memb == 0) & bvalid.reshape(1, B)
+
+            # follower pass: slots >= 1, delta = w
+            srcmask = (iota_r >= 1) & (iota_r < nrc) & elig  # [T, R]
+            A = jnp.where(srcmask, _pen(loads_s - w_t, avg) - F_s, jnp.full_like(loads_s, BIG))
+            astar = jnp.min(A, axis=1, keepdims=True)  # [T, 1]
+            rstar = lax.argmin(A, axis=1, index_dtype=jnp.int32)  # [T]
+            rstar_ref[pl.ds(off, TILE_P), :] = rstar.reshape(TILE_P, 1)
+            C = _pen(loads.reshape(1, B) + w_t, avg) - F.reshape(1, B)
             V = jnp.where(
                 tmask & (astar < BIG * 0.5), astar + C, jnp.full_like(C, BIG)
             )  # [T, B]
@@ -182,15 +179,48 @@ def _kernel(
             better = vmin < bestv
             bestv = jnp.where(better, vmin, bestv)
             bestp = jnp.where(better, off + varg, bestp)
-            return bestv, bestp
+
+            if allow_leader:
+                # leader pass: slot 0 scored with its TRUE applied delta
+                # w*(replicas+consumers) — see scan.py body_batch for why
+                # batch mode departs from the reference's plain-weight
+                # under-modelling here. Tracked separately from the
+                # follower best and merged globally AFTER the tile loop so
+                # follower-vs-leader ties resolve identically to scan.py
+                # (follower wins) regardless of which tile each lives in.
+                wl = w_t * (nrc.astype(f32) + ncons_t)  # [T, 1]
+                A_l = jnp.where(
+                    (nrc >= 1) & elig,
+                    _pen(loads_s[:, :1] - wl, avg) - F_s[:, :1],
+                    jnp.full_like(wl, BIG),
+                )  # [T, 1]
+                C_l = _pen(loads.reshape(1, B) + wl, avg) - F.reshape(1, B)
+                V_l = jnp.where(
+                    tmask & (A_l < BIG * 0.5), A_l + C_l, jnp.full_like(C_l, BIG)
+                )
+                vmin_l = jnp.min(V_l, axis=0, keepdims=True)
+                varg_l = lax.argmin(V_l, axis=0, index_dtype=jnp.int32).reshape(1, B)
+                better_l = vmin_l < bestv_l
+                bestv_l = jnp.where(better_l, vmin_l, bestv_l)
+                bestp_l = jnp.where(better_l, off + varg_l, bestp_l)
+
+            return bestv, bestp, bestv_l, bestp_l
 
         bestv0 = jnp.full((1, B), BIG, f32)
         bestp0 = jnp.zeros((1, B), jnp.int32)
-        bestv, bestp = lax.fori_loop(
-            jnp.int32(0), jnp.int32(P // TILE_P), tile_body, (bestv0, bestp0)
+        bestv, bestp, bestv_l, bestp_l = lax.fori_loop(
+            jnp.int32(0), jnp.int32(P // TILE_P), tile_body,
+            (bestv0, bestp0, bestv0, bestp0)
         )
+        # global leader-vs-follower merge, strict < (follower wins ties)
+        lead = bestv_l < bestv
+        bestv = jnp.where(lead, bestv_l, bestv)
+        bestp = jnp.where(lead, bestp_l, bestp)
         vals = su + bestv[0, :]  # [B]
         cp = bestp[0, :]  # [B] candidate partition per target
+        clead = jnp.where(
+            lead, jnp.ones((1, B), jnp.int32), jnp.zeros((1, B), jnp.int32)
+        )[0, :]  # [B] 1 = leader-pass winner (slot 0)
 
         # ---- per-candidate scalar fetches (slot, source, weight terms) --
         # scalar extraction from lane vectors via masked reduction (vector
@@ -204,7 +234,9 @@ def _kernel(
         def fetch(i, acc):
             cslot, cs, cdelta = acc
             p_i = ext_i(cp, i)
-            slot_i = rstar_ref[p_i, 0]
+            slot_i = jnp.where(
+                ext_i(clead, i) > 0, jnp.int32(0), rstar_ref[p_i, 0]
+            )
             rrow = replicas_ref[pl.ds(p_i, 1), :]  # [1, R]
             s_i = jnp.max(jnp.where(iota_r == slot_i, rrow, jnp.zeros_like(rrow)))
             w_i = w_ref[p_i, 0]

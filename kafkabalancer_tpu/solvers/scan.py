@@ -166,11 +166,20 @@ def session(
         #
         # The rank-1 objective FACTORIZES over source and target:
         #   u[p,r,t] = su + A[p,r] + C[p,t]
-        #   A[p,r] = f(load_s − w_p) − f(load_s)      (source term)
-        #   C[p,t] = f(load_t + w_p) − f(load_t)      (target term)
+        #   A[p,r] = f(load_s − d) − f(load_s)      (source term)
+        #   C[p,t] = f(load_t + d) − f(load_t)      (target term)
         # so the per-target minimization needs only [P,R] + [P,B] work —
         # the [P,R,B] candidate tensor never materializes:
         #   best[t] = min_p [ min_r A[p,r] + C[p,t] ].
+        #
+        # Unlike the per-move parity paths, batch mode scores leader moves
+        # with their TRUE applied delta d = w·(replicas+consumers) instead
+        # of the reference's plain-weight under-modelling (steps.go:185/
+        # :207, SURVEY.md §3.3 "fidelity knob"): committing many scored-vs-
+        # applied mismatches at once oscillates badly (one-at-a-time greedy
+        # self-corrects each overshoot). Followers and leaders therefore
+        # run as two factorized passes with their own deltas, merged per
+        # target.
         bvalid = (always_valid | (bcount > 0)) & universe_valid
         nb = jnp.sum(bvalid).astype(dtype)
         avg = jnp.sum(jnp.where(bvalid, loads, 0.0)) / nb
@@ -179,27 +188,48 @@ def session(
 
         w = weights[:, None]  # [P, 1]
         s_idx = jnp.clip(replicas, 0)  # [P, R]
-        movable = (slot_iota >= 0) if allow_leader else (slot_iota >= 1)
-        srcmask = (
-            movable
-            & (slot_iota < nrep_cur[:, None])
-            & pvalid[:, None]
-            & (nrep_tgt >= min_replicas)[:, None]
-        )  # [P, R]
-        A = cost.overload_penalty(loads[s_idx] - w, avg) - F[s_idx]  # [P, R]
-        A = jnp.where(srcmask, A, jnp.inf)
-        r_star = jnp.argmin(A, axis=1).astype(jnp.int32)  # [P]
-        A_star = jnp.min(A, axis=1)  # [P]
-
-        C = cost.overload_penalty(loads[None, :] + w, avg) - F[None, :]  # [P, B]
+        eligible = pvalid[:, None] & (nrep_tgt >= min_replicas)[:, None]
         tmask = allowed & ~member & bvalid[None, :]  # [P, B]
-        V = jnp.where(
-            tmask & jnp.isfinite(A_star)[:, None], A_star[:, None] + C, jnp.inf
-        )
-        p = jnp.argmin(V, axis=0).astype(jnp.int32)  # [B] best source/target
         t = jnp.arange(B, dtype=jnp.int32)
-        vals = su + V[p, t]  # [B]
+
+        # --- follower pass (slots ≥ 1, delta = w) ---
+        srcmask_f = (slot_iota >= 1) & (slot_iota < nrep_cur[:, None]) & eligible
+        A_f = cost.overload_penalty(loads[s_idx] - w, avg) - F[s_idx]
+        A_f = jnp.where(srcmask_f, A_f, jnp.inf)
+        r_star = jnp.argmin(A_f, axis=1).astype(jnp.int32)  # [P]
+        A_star = jnp.min(A_f, axis=1)  # [P]
+        C_f = cost.overload_penalty(loads[None, :] + w, avg) - F[None, :]
+        V = jnp.where(
+            tmask & jnp.isfinite(A_star)[:, None], A_star[:, None] + C_f,
+            jnp.inf,
+        )
+        p = jnp.argmin(V, axis=0).astype(jnp.int32)  # [B]
+        vals = V[p, t]
         slot = r_star[p]
+
+        if allow_leader:
+            # --- leader pass (slot 0, delta = w·(replicas+consumers)) ---
+            wl = weights * (nrep_cur.astype(dtype) + ncons)  # [P]
+            s0 = jnp.clip(replicas[:, 0], 0)
+            ok_l = (nrep_cur >= 1) & eligible[:, 0]
+            A_l = cost.overload_penalty(loads[s0] - wl, avg) - F[s0]
+            A_l = jnp.where(ok_l, A_l, jnp.inf)  # [P]
+            C_l = (
+                cost.overload_penalty(loads[None, :] + wl[:, None], avg)
+                - F[None, :]
+            )
+            V_l = jnp.where(
+                tmask & jnp.isfinite(A_l)[:, None], A_l[:, None] + C_l,
+                jnp.inf,
+            )
+            p_l = jnp.argmin(V_l, axis=0).astype(jnp.int32)
+            vals_l = V_l[p_l, t]
+            lead_better = vals_l < vals
+            vals = jnp.where(lead_better, vals_l, vals)
+            p = jnp.where(lead_better, p_l, p)
+            slot = jnp.where(lead_better, 0, slot)
+
+        vals = su + vals  # [B]
         s_ = replicas[p, slot].astype(jnp.int32)
 
         improving = jnp.isfinite(vals) & (vals < su - min_unbalance) & (vals < su)
@@ -383,6 +413,8 @@ def plan(
     clock. ``engine="pallas-interpret"`` uses the Pallas interpreter (CPU
     testing).
     """
+    if engine not in ("xla", "pallas", "pallas-interpret"):
+        raise ValueError(f"unknown engine {engine!r}")
     opl = empty_partition_list()
     if max_reassign <= 0:
         return opl
@@ -420,8 +452,6 @@ def plan(
         )
 
         dtype = jnp.float32
-    elif engine != "xla":
-        raise ValueError(f"unknown engine {engine!r}")
 
     remaining = budget
     while remaining > 0:

@@ -78,3 +78,46 @@ def test_plan_unknown_engine():
     pl = random_partition_list(rng, 5, 3, weighted=True)
     with pytest.raises(ValueError, match="unknown engine"):
         plan(pl, default_rebalance_config(), 5, engine="cuda")
+
+
+@pytest.mark.parametrize("allow_leader", [False, True])
+def test_pallas_multi_tile_parity(allow_leader):
+    """>TILE_P partitions forces multiple kernel tiles: pins cross-tile
+    offset arithmetic, the fori carry, and the global (not per-tile)
+    leader-vs-follower tie merge. Equal weights + consumers maximize exact
+    ties, the case where merge order is observable."""
+    import jax.numpy as jnp
+
+    from kafkabalancer_tpu.solvers.pallas_session import TILE_P
+
+    rng = random.Random(3300 + allow_leader)
+    pl = random_partition_list(
+        rng, TILE_P + 40, 10, weighted=False, with_consumers=True
+    )
+    cfg = default_rebalance_config()
+    cfg.min_unbalance = 1e-6
+    cfg.allow_leader_rebalancing = allow_leader
+
+    pl_x, pl_p = copy.deepcopy(pl), copy.deepcopy(pl)
+    opl_x = plan(
+        pl_x, copy.deepcopy(cfg), 25, dtype=jnp.float32, batch=10,
+        engine="xla",
+    )
+    opl_p = plan(
+        pl_p, copy.deepcopy(cfg), 25, batch=10, engine="pallas-interpret",
+    )
+    moves_x = [(p.topic, p.partition, tuple(p.replicas)) for p in (opl_x.partitions or [])]
+    moves_p = [(p.topic, p.partition, tuple(p.replicas)) for p in (opl_p.partitions or [])]
+    assert moves_x == moves_p
+    assert pl_x == pl_p
+
+
+def test_plan_unknown_engine_validates_before_mutating():
+    """Engine typos raise before any repair mutates the caller's list."""
+    from test_balancer import P, wrap
+
+    pl = wrap([P("a", 1, [1, 2, 3], weight=1.0, num_replicas=2)])
+    before = copy.deepcopy(pl)
+    with pytest.raises(ValueError, match="unknown engine"):
+        plan(pl, default_rebalance_config(), 5, engine="palas")
+    assert pl == before
