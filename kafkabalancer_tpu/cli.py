@@ -45,6 +45,7 @@ from kafkabalancer_tpu.models import (
     RebalanceConfig,
     default_rebalance_config,
 )
+from kafkabalancer_tpu.models.config import ENGINES
 from kafkabalancer_tpu.models.partition import empty_partition_list
 from kafkabalancer_tpu.utils import BufferingWriter, FlagSet, Logger
 from kafkabalancer_tpu.utils.flags import go_atoi
@@ -150,6 +151,19 @@ def run(i, o, e, args: List[str]) -> int:
             "greedy",
             "Optimization backend: greedy (reference parity), tpu "
             "(vectorized JAX/XLA candidate scoring), beam (N-way beam search)",
+        )
+        f_beam_width = f.int(
+            "beam-width", defaults.beam_width,
+            "Beam solver: candidate states kept per lookahead depth",
+        )
+        f_beam_depth = f.int(
+            "beam-depth", defaults.beam_depth,
+            "Beam solver: lookahead moves per search",
+        )
+        f_anti_coloc = f.float(
+            "anti-colocation", defaults.anti_colocation,
+            "Beam solver: penalty weight for same-topic replicas sharing a "
+            "broker (0 disables)",
         )
         f_fused = f.bool(
             "fused",
@@ -260,6 +274,9 @@ def run(i, o, e, args: List[str]) -> int:
             complete_partition=False,
             brokers=brokers,
             solver=f_solver.value,
+            beam_width=f_beam_width.value,
+            beam_depth=f_beam_depth.value,
+            anti_colocation=f_anti_coloc.value,
         )
 
         log(f"rebalance config: {_fmt_cfg(cfg)}")
@@ -281,7 +298,7 @@ def run(i, o, e, args: List[str]) -> int:
             # (solvers/scan.py) instead of the per-move host loop; consumes
             # the budget so the loop below is skipped and the shared output
             # tail applies unchanged
-            if f_engine.value not in ("xla", "pallas", "pallas-interpret"):
+            if f_engine.value not in ENGINES:
                 log(f"unknown fused engine {f_engine.value!r}")
                 usage()
                 return 3

@@ -17,6 +17,10 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import List, Optional
 
+# fused-session device engines (solvers/scan.py plan()); lives here so the
+# CLI can validate the flag without importing the jax-heavy solver stack
+ENGINES = ("xla", "pallas", "pallas-interpret")
+
 
 @dataclass
 class RebalanceConfig:

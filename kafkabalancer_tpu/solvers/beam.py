@@ -237,12 +237,14 @@ def _reconstruct(best_beam, best_depth, parents, mp, mslot, mtgt):
     return seq
 
 
-def _search_once(pl: PartitionList, cfg: RebalanceConfig, depth: int):
+def _search_once(pl: PartitionList, cfg: RebalanceConfig, depth: int,
+                 dtype=None):
     """One beam search on the live list; returns the accepted move sequence
     as ``[(partition row, slot, target broker id)]`` with its DensePlan, or
     ``None`` when no sequence clears ``min_unbalance``."""
     dp = tensorize(pl, cfg)
-    dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    if dtype is None:
+        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
     loads = cost.broker_loads(
         jnp.asarray(dp.replicas),
         jnp.asarray(dp.weights, dtype),
@@ -286,7 +288,7 @@ def _search_once(pl: PartitionList, cfg: RebalanceConfig, depth: int):
 
 
 def beam_plan(
-    pl: PartitionList, cfg: RebalanceConfig, max_reassign: int
+    pl: PartitionList, cfg: RebalanceConfig, max_reassign: int, dtype=None
 ) -> PartitionList:
     """Receding-horizon beam planning: search a ``beam_depth`` lookahead,
     apply the best sequence, repeat. Output/mutation contract matches
@@ -298,7 +300,7 @@ def beam_plan(
     opl.append(*repaired)
 
     while budget > 0:
-        found = _search_once(pl, cfg, depth=min(int(cfg.beam_depth), budget))
+        found = _search_once(pl, cfg, depth=min(int(cfg.beam_depth), budget), dtype=dtype)
         if found is None:
             break
         dp, seq = found

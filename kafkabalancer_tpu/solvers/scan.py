@@ -42,6 +42,7 @@ from functools import partial
 from typing import List, Tuple
 
 from kafkabalancer_tpu.models import Partition, PartitionList, RebalanceConfig
+from kafkabalancer_tpu.models.config import ENGINES
 from kafkabalancer_tpu.models.partition import empty_partition_list
 from kafkabalancer_tpu.ops.runtime import ensure_x64
 
@@ -413,7 +414,7 @@ def plan(
     clock. ``engine="pallas-interpret"`` uses the Pallas interpreter (CPU
     testing).
     """
-    if engine not in ("xla", "pallas", "pallas-interpret"):
+    if engine not in ENGINES:
         raise ValueError(f"unknown engine {engine!r}")
     opl = empty_partition_list()
     if max_reassign <= 0:

@@ -375,3 +375,16 @@ def test_jax_profile_flag(tmp_path):
     for root, _dirs, files in os.walk(trace_dir):
         found.extend(files)
     assert found  # a device trace was written
+
+
+def test_beam_cli_knobs():
+    """-beam-width/-beam-depth/-anti-colocation reach the solver config."""
+    out, err = io.StringIO(), io.StringIO()
+    code = run(
+        io.StringIO(), out, err,
+        ["kb", "-input-json", "-input", FIXTURE, "-solver=beam",
+         "-beam-width=4", "-beam-depth=2", "-anti-colocation=0.25",
+         "-max-reassign=2"],
+    )
+    assert code == 0
+    assert json.loads(out.getvalue())["version"] == 1
