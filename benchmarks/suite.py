@@ -132,7 +132,6 @@ def config2_text_input():
     cfg.min_unbalance = 1e-6  # unit weights are <1% of a broker's load here
     tg, n_g = timed(greedy_converge, pl_g, copy.deepcopy(cfg), budget)
 
-    pl_t = parse()
     # warm with the REAL budget so the timed run hits the compile cache
     plan(parse(), copy.deepcopy(cfg), budget, batch=12, engine='pallas')
     pl_t = parse()
@@ -250,7 +249,7 @@ def config5_sweep():
             try:
                 greedy_converge(p2, c2, 2000)
             except BalanceError as exc:  # expected: infeasible removal
-                print(f"scenario {sc} infeasible: {exc}", file=sys.stderr)
+                print(f"# scenario {sc} infeasible: {exc}")
                 continue
             u = unbalance_of(p2)
             best = u if best is None else min(best, u)

@@ -16,5 +16,6 @@ Collectives ride the ICI mesh; host code only dispatches and decodes.
 """
 
 from kafkabalancer_tpu.parallel.mesh import make_mesh
+from kafkabalancer_tpu.parallel.distributed import initialize, is_multi_host
 
-__all__ = ["make_mesh"]
+__all__ = ["make_mesh", "initialize", "is_multi_host"]

@@ -1,0 +1,53 @@
+"""Multi-host (DCN) initialization for the parallel layer.
+
+The reference's only networked component is its Zookeeper reader
+(codecs.go:95-135) — it has no inter-process compute communication
+(SURVEY.md §2.9). The TPU-native equivalent of a distributed backend is
+JAX's runtime itself: once every host calls :func:`initialize`, the global
+device list spans all hosts, :func:`kafkabalancer_tpu.parallel.mesh.make_mesh`
+builds meshes over it unchanged, and the same ``shard_map`` programs
+(sweeps over the ``sweep`` axis, partition-sharded solves over ``part``)
+run with XLA inserting ICI collectives within a slice and DCN transfers
+across slices. No solver code changes between one chip and a multi-host
+fleet — the mesh is the only contract.
+
+Host-side orchestration (codecs, CLI, repairs) stays single-process on
+process 0; results decode on process 0 via fully-replicated outputs, which
+is exactly how the single-chip paths already behave.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join this process to a multi-host JAX runtime.
+
+    Thin wrapper over :func:`jax.distributed.initialize` (args may be
+    omitted entirely on Cloud TPU pods, where the runtime discovers them).
+    Call before any other JAX usage on every host, then use
+    :func:`kafkabalancer_tpu.parallel.mesh.make_mesh` as usual — it will
+    see the global device set.
+    """
+    import jax
+
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+
+
+def is_multi_host() -> bool:
+    """True when the runtime spans more than one process."""
+    import jax
+
+    return jax.process_count() > 1
