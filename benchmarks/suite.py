@@ -94,6 +94,13 @@ def config1_single_move():
     with open(path) as f:
         raw = f.read()
 
+    # this row measures the DEVICE single-move path; disable the
+    # small-instance host fallback that would silently compare greedy to
+    # greedy on the 8-partition fixture
+    from kafkabalancer_tpu.solvers import tpu as tpu_solver
+
+    tpu_solver.MIN_DEVICE_CANDIDATES = 0
+
     def run_once(solver):
         pl = get_partition_list_from_reader(io.StringIO(raw), True, [])
         cfg = default_rebalance_config()
@@ -104,6 +111,7 @@ def config1_single_move():
     tg, out_g = timed(run_once, "greedy")
     tt, out_t = timed(run_once, "tpu")
     assert out_g == out_t, "tpu plan must be byte-identical to greedy"
+    tpu_solver.MIN_DEVICE_CANDIDATES = 20_000
     row("1: test.json single move", tg, None, tt, None, "plans identical")
 
 

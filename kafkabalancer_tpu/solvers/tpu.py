@@ -72,6 +72,11 @@ from kafkabalancer_tpu.ops.tensorize import DensePlan  # noqa: E402
 # (>TIE_K mathematically tied candidates) falls back to the greedy scan.
 TIE_K = 1024
 
+# Below this candidate count the greedy scan beats device dispatch latency;
+# since the tpu solver is byte-identical to greedy by contract, routing tiny
+# instances to the host scan changes nothing but wall-clock.
+MIN_DEVICE_CANDIDATES = 20_000
+
 
 def score_moves(
     loads,
@@ -242,6 +247,17 @@ class TieOverflow(Exception):
 def _tpu_move(
     pl: PartitionList, cfg: RebalanceConfig, leaders: bool
 ) -> Optional[PartitionList]:
+    # real (unpadded, movable-slot-aware) candidate count, computed without
+    # tensorizing so the fallback path pays no dense-encoding cost
+    n_parts = len(pl.partitions or ())
+    movable = 1 if leaders else max(
+        (len(p.replicas) - 1 for p in pl.iter_partitions()), default=0
+    )
+    from kafkabalancer_tpu.ops.tensorize import broker_universe
+
+    n_candidates = n_parts * movable * len(broker_universe(pl, cfg))
+    if n_candidates < MIN_DEVICE_CANDIDATES:
+        return greedy_move(pl, cfg, leaders)
     dp = tensorize(pl, cfg)
     try:
         best = find_best_move(dp, cfg, leaders)
