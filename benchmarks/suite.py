@@ -99,6 +99,7 @@ def config1_single_move():
     # greedy on the 8-partition fixture
     from kafkabalancer_tpu.solvers import tpu as tpu_solver
 
+    orig_threshold = tpu_solver.MIN_DEVICE_CANDIDATES
     tpu_solver.MIN_DEVICE_CANDIDATES = 0
 
     def run_once(solver):
@@ -107,11 +108,13 @@ def config1_single_move():
         cfg.solver = solver
         return balance(pl, cfg)
 
-    run_once("tpu")  # warm the jit
-    tg, out_g = timed(run_once, "greedy")
-    tt, out_t = timed(run_once, "tpu")
-    assert out_g == out_t, "tpu plan must be byte-identical to greedy"
-    tpu_solver.MIN_DEVICE_CANDIDATES = 20_000
+    try:
+        run_once("tpu")  # warm the jit
+        tg, out_g = timed(run_once, "greedy")
+        tt, out_t = timed(run_once, "tpu")
+        assert out_g == out_t, "tpu plan must be byte-identical to greedy"
+    finally:
+        tpu_solver.MIN_DEVICE_CANDIDATES = orig_threshold
     row("1: test.json single move", tg, None, tt, None, "plans identical")
 
 

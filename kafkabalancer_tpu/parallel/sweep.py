@@ -164,6 +164,67 @@ def _scenario_body(
     return replicas, feasible, completed, n_evac, n_moves, su
 
 
+@partial(
+    jax.jit,
+    static_argnames=("mesh", "max_moves", "max_evac", "allow_leader", "batch"),
+)
+def _sweep_exec(
+    scenario_mask,
+    replicas,
+    member,
+    allowed,
+    has_explicit,
+    weights,
+    nrep_cur,
+    nrep_tgt,
+    ncons,
+    pvalid,
+    universe_valid,
+    min_replicas,
+    min_unbalance,
+    budget,
+    *,
+    mesh: Mesh,
+    max_moves: int,
+    max_evac: int,
+    allow_leader: bool,
+    batch: int,
+):
+    """Module-level jitted sweep executor: repeat sweeps with the same shape
+    buckets and mesh reuse one compiled executable (a per-call shard_map
+    closure would retrace every invocation)."""
+    rep = P()
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(SWEEP_AXIS),) + (rep,) * 13,
+        out_specs=(P(SWEEP_AXIS),) * 6,
+        # scenario state mixes sweep-varying values with replicated plan
+        # inputs inside lax.cond branches; skip the varying-mode check
+        check_vma=False,
+    )
+    def run(mask_shard, replicas, member, allowed, has_explicit, weights,
+            nrep_cur, nrep_tgt, ncons, pvalid, universe_valid, min_replicas,
+            min_unbalance, budget):
+        def one(mask):
+            return _scenario_body(
+                replicas, member, allowed, has_explicit, mask, weights,
+                nrep_cur, nrep_tgt, ncons, pvalid, universe_valid,
+                min_replicas, min_unbalance, budget,
+                max_moves=max_moves, max_evac=max_evac,
+                allow_leader=allow_leader, batch=batch,
+            )
+
+        return lax.map(one, mask_shard)
+
+    return run(
+        scenario_mask, replicas, member, allowed, has_explicit, weights,
+        nrep_cur, nrep_tgt, ncons, pvalid, universe_valid, min_replicas,
+        min_unbalance, budget,
+    )
+
+
 def sweep(
     pl: PartitionList,
     cfg: RebalanceConfig,
@@ -243,41 +304,23 @@ def sweep(
     max_evac = int(dp.replicas.shape[0] * dp.replicas.shape[1])
     max_moves = next_bucket(min(max_reassign, 1 << 20), 64)
 
-    body = partial(
-        _scenario_body,
+    exec_out = _sweep_exec(
+        jnp.asarray(scenario_mask),
+        jnp.asarray(dp.replicas), jnp.asarray(dp.member),
+        jnp.asarray(dp.allowed), jnp.asarray(has_explicit),
+        jnp.asarray(dp.weights, dtype), jnp.asarray(dp.nrep_cur),
+        jnp.asarray(dp.nrep_tgt), jnp.asarray(dp.ncons, dtype),
+        jnp.asarray(dp.pvalid), jnp.asarray(dp.bvalid),
+        jnp.int32(cfg.min_replicas_for_rebalancing),
+        jnp.asarray(cfg.min_unbalance, dtype),
+        jnp.int32(min(max_reassign, 2**31 - 1)),
+        mesh=mesh,
         max_moves=max_moves,
-        batch=max(1, batch),
         max_evac=max_evac,
         allow_leader=cfg.allow_leader_rebalancing,
+        batch=max(1, batch),
     )
-
-    @partial(
-        jax.shard_map,
-        mesh=mesh,
-        in_specs=(P(SWEEP_AXIS),),
-        out_specs=(P(SWEEP_AXIS),) * 6,
-        # scenario state mixes sweep-varying values with replicated plan
-        # constants inside lax.cond branches; skip the varying-mode check
-        check_vma=False,
-    )
-    def run(scenario_mask_shard):
-        def one(mask):
-            return body(
-                jnp.asarray(dp.replicas), jnp.asarray(dp.member),
-                jnp.asarray(dp.allowed), jnp.asarray(has_explicit), mask,
-                jnp.asarray(dp.weights, dtype), jnp.asarray(dp.nrep_cur),
-                jnp.asarray(dp.nrep_tgt), jnp.asarray(dp.ncons, dtype),
-                jnp.asarray(dp.pvalid), jnp.asarray(dp.bvalid),
-                jnp.int32(cfg.min_replicas_for_rebalancing),
-                jnp.asarray(cfg.min_unbalance, dtype),
-                jnp.int32(min(max_reassign, 2**31 - 1)),
-            )
-
-        return lax.map(one, scenario_mask_shard)
-
-    replicas_s, feasible_s, completed_s, n_evac_s, n_moves_s, su_s = run(
-        jnp.asarray(scenario_mask)
-    )
+    replicas_s, feasible_s, completed_s, n_evac_s, n_moves_s, su_s = exec_out
 
     out: List[SweepResult] = []
     replicas_s, feasible_s, completed_s, n_evac_s, n_moves_s, su_s = (

@@ -249,14 +249,14 @@ def _tpu_move(
 ) -> Optional[PartitionList]:
     # real (unpadded, movable-slot-aware) candidate count, computed without
     # tensorizing so the fallback path pays no dense-encoding cost
-    n_parts = len(pl.partitions or ())
-    movable = 1 if leaders else max(
-        (len(p.replicas) - 1 for p in pl.iter_partitions()), default=0
+    movable = (
+        len(pl.partitions or ())
+        if leaders
+        else sum(max(0, len(p.replicas) - 1) for p in pl.iter_partitions())
     )
-    from kafkabalancer_tpu.ops.tensorize import broker_universe
-
-    n_candidates = n_parts * movable * len(broker_universe(pl, cfg))
-    if n_candidates < MIN_DEVICE_CANDIDATES:
+    n_brokers = len({b for p in pl.iter_partitions() for b in p.replicas}
+                    | set(cfg.brokers or ()))
+    if movable * n_brokers < MIN_DEVICE_CANDIDATES:
         return greedy_move(pl, cfg, leaders)
     dp = tensorize(pl, cfg)
     try:
