@@ -218,7 +218,8 @@ def config4_beam_quality():
     cfg_g = copy.deepcopy(cfg)
     cfg_g.anti_colocation = 0.0  # greedy has no colocation objective
     tg, n_g = timed(greedy_converge, pl_g, cfg_g, budget)
-    beam_plan(fresh(), copy.deepcopy(cfg), 4, dtype=jnp.float32)  # warm
+    # warm with the real budget (static move-log bucket)
+    beam_plan(fresh(), copy.deepcopy(cfg), budget, dtype=jnp.float32)
     pl_b = fresh()
     tt, opl = timed(beam_plan, pl_b, copy.deepcopy(cfg), budget,
                     dtype=jnp.float32)

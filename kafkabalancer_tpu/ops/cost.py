@@ -167,3 +167,98 @@ def rank_brokers(loads, bvalid):
     loads_rank = loads[perm]
     rank_of = jnp.zeros(B, dtype=jnp.int32).at[perm].set(iota)
     return loads_rank, perm, rank_of
+
+
+def factored_target_best(
+    loads,
+    replicas,
+    allowed,
+    member,
+    bvalid,
+    weights,
+    nrep_cur,
+    nrep_tgt,
+    ncons,
+    pvalid,
+    nb,
+    min_replicas,
+    *,
+    allow_leader: bool,
+    colo_sub=None,
+    colo_add=None,
+):
+    """Best candidate per TARGET broker via the factorized rank-1 objective.
+
+    The move objective factorizes as ``u = su + A[source] + C[target]``
+    (move_candidate_scores docstring), so per-target minimization needs
+    only [P, R] + [P, B] work — the [P, R, B] tensor never materializes.
+    Followers (slots ≥ 1) score with the plain weight; when
+    ``allow_leader``, slot 0 scores with its TRUE applied delta
+    ``w·(replicas+consumers)`` — the reference's plain-weight
+    under-modelling (steps.go:185/:207) oscillates when many moves commit
+    between load recomputations, so every batched/lookahead consumer uses
+    the true delta (the per-move parity paths keep the quirk).
+
+    ``colo_sub [P, R]`` / ``colo_add [P, B]`` are optional additive
+    objective offsets (the beam solver's anti-colocation deltas, which
+    also factorize over source/target).
+
+    Returns ``(su, vals [B], p [B], slot [B])`` with ``vals`` ABSOLUTE
+    (already ``su``-based) and ineligible targets at +inf. Shared by
+    ``solvers.scan`` (batched sessions), ``solvers.pallas_session``
+    (re-derived in kernel form), and ``solvers.beam``.
+    """
+    P, R = replicas.shape
+    B = loads.shape[0]
+    avg = jnp.sum(jnp.where(bvalid, loads, 0.0)) / nb
+    F = jnp.where(bvalid, overload_penalty(loads, avg), 0.0)  # [B]
+    su = jnp.sum(F)
+
+    w = weights[:, None]
+    s_idx = jnp.clip(replicas, 0)
+    slot_iota = jnp.arange(R)[None, :]
+    eligible = pvalid[:, None] & (nrep_tgt >= min_replicas)[:, None]
+    tmask = allowed & ~member & bvalid[None, :]
+    t = jnp.arange(B, dtype=jnp.int32)
+
+    # follower pass (slots >= 1, delta = w)
+    srcmask_f = (slot_iota >= 1) & (slot_iota < nrep_cur[:, None]) & eligible
+    A_f = overload_penalty(loads[s_idx] - w, avg) - F[s_idx]
+    if colo_sub is not None:
+        A_f = A_f - colo_sub
+    A_f = jnp.where(srcmask_f, A_f, jnp.inf)
+    r_star = jnp.argmin(A_f, axis=1).astype(jnp.int32)  # [P]
+    A_star = jnp.min(A_f, axis=1)
+    C_f = overload_penalty(loads[None, :] + w, avg) - F[None, :]
+    if colo_add is not None:
+        C_f = C_f + colo_add
+    V = jnp.where(
+        tmask & jnp.isfinite(A_star)[:, None], A_star[:, None] + C_f, jnp.inf
+    )
+    p = jnp.argmin(V, axis=0).astype(jnp.int32)  # [B]
+    vals = V[p, t]
+    slot = r_star[p]
+
+    if allow_leader:
+        # leader pass (slot 0, delta = w·(replicas+consumers))
+        wl = weights * (nrep_cur.astype(loads.dtype) + ncons)
+        s0 = jnp.clip(replicas[:, 0], 0)
+        ok_l = (nrep_cur >= 1) & eligible[:, 0]
+        A_l = overload_penalty(loads[s0] - wl, avg) - F[s0]
+        if colo_sub is not None:
+            A_l = A_l - colo_sub[:, 0]
+        A_l = jnp.where(ok_l, A_l, jnp.inf)
+        C_l = overload_penalty(loads[None, :] + wl[:, None], avg) - F[None, :]
+        if colo_add is not None:
+            C_l = C_l + colo_add
+        V_l = jnp.where(
+            tmask & jnp.isfinite(A_l)[:, None], A_l[:, None] + C_l, jnp.inf
+        )
+        p_l = jnp.argmin(V_l, axis=0).astype(jnp.int32)
+        vals_l = V_l[p_l, t]
+        lead_better = vals_l < vals
+        vals = jnp.where(lead_better, vals_l, vals)
+        p = jnp.where(lead_better, p_l, p)
+        slot = jnp.where(lead_better, 0, slot)
+
+    return su, su + vals, p, slot

@@ -19,10 +19,12 @@ Search semantics:
   (the per-move threshold semantics of the greedy/tpu solvers do not apply
   — beam is an extension, not a parity path);
 - leader moves are candidates whenever ``allow_leader_rebalancing`` is set
-  (slot 0 scored like any other movable slot — no leader-first precedence
-  inside a sequence); applying a leader move shifts the true premium load
-  (utils.go:96-101) while scoring uses the plain weight, exactly like the
-  fused session (solvers/scan.py);
+  (no leader-first precedence inside a sequence) and are scored with their
+  TRUE applied delta ``w·(replicas+consumers)`` like the batched session
+  (solvers/scan.py) — the reference's plain-weight under-modelling would
+  mis-rank whole sequences;
+- each beam contributes its best candidate per TARGET broker (factorized
+  rank-1 scoring), and the top-W of the W×B frontier survive;
 - two beams can reach the same state by permuted move orders; such
   duplicates waste beam slots but are otherwise harmless.
 
@@ -58,6 +60,175 @@ def _colocation_cost(member, topic_id, n_topics, lam):
     return lam * jnp.sum(jnp.maximum(counts - 1, 0))
 
 
+def _scan_factory(
+    allowed, weights, nrep_cur, nrep_tgt, ncons, pvalid, always_valid,
+    universe_valid, topic_id, min_replicas, lam, dtype, P, R, B,
+    *, width: int, depth: int, allow_leader: bool, n_topics: int,
+):
+    """Build the depth-scan ``run(loads, replicas, member, depth_cap)``
+    shared by :func:`beam_search` (one search) and :func:`beam_session`
+    (the device-fused receding-horizon loop).
+
+    ``depth_cap`` (traced) limits which depths may win the best-so-far
+    tracking, so a caller with a small remaining move budget never adopts a
+    sequence longer than it can afford. ``run`` returns ``(su0, best_u,
+    best_beam, best_depth, parents [D, W], move_p/slot/tgt [D, W],
+    best_loads [B], best_replicas [P, R], best_member [P, B])`` — the
+    snapshots are the winning beam's state at its winning depth.
+    """
+    W, D = width, depth
+
+    def state_cost(loads, member):
+        observed = jnp.any(member & pvalid[:, None], axis=0)
+        bvalid = (always_valid | observed) & universe_valid
+        u = cost.unbalance(loads, bvalid, jnp.sum(bvalid).astype(dtype))
+        if n_topics:
+            u = u + _colocation_cost(
+                member.astype(dtype), topic_id, n_topics, lam
+            )
+        return u
+
+    def expand(loads, replicas, member, alive):
+        """Per-TARGET best candidate of one beam via the shared factorized
+        scorer (ops/cost.py factored_target_best); the frontier takes the
+        top-W of the W×B per-target bests. Restricting to one candidate per
+        target per beam loses same-target siblings, but those collide
+        immediately at later depths anyway; the global best candidate is
+        always included. ``vals`` are ABSOLUTE objective values including
+        the beam's accumulated colocation cost, so cross-beam frontier
+        ranking is unbiased."""
+        observed = jnp.any(member & pvalid[:, None], axis=0)
+        bvalid = (always_valid | observed) & universe_valid
+        nb = jnp.sum(bvalid).astype(dtype)
+
+        if n_topics:
+            counts = jnp.zeros((n_topics, B), dtype).at[topic_id].add(
+                member.astype(dtype)
+            )
+            c_rows = counts[topic_id]  # [P, B]
+            c_src = jnp.take_along_axis(
+                c_rows, jnp.clip(replicas, 0), axis=1
+            )  # [P, R]
+            colo_sub = jnp.where(c_src >= 2, lam, 0.0)  # source term
+            colo_add = jnp.where(c_rows >= 1, lam, 0.0)  # target term
+            colo_now = lam * jnp.sum(jnp.maximum(counts - 1, 0))
+        else:
+            colo_sub = colo_add = None
+            colo_now = 0.0
+
+        _su, vals, p, slot = cost.factored_target_best(
+            loads, replicas, allowed, member, bvalid, weights, nrep_cur,
+            nrep_tgt, ncons, pvalid, nb, min_replicas,
+            allow_leader=allow_leader,
+            colo_sub=colo_sub, colo_add=colo_add,
+        )
+        vals = jnp.where(alive, vals + colo_now, jnp.inf)
+        return vals, p, slot
+
+    def apply_move(loads, replicas, member, p, slot, t):
+        s = replicas[p, slot]
+        delta = jnp.where(
+            slot == 0,
+            weights[p] * (nrep_cur[p].astype(dtype) + ncons[p]),
+            weights[p],
+        )
+        loads = loads.at[s].add(-delta).at[t].add(delta)
+        replicas = replicas.at[p, slot].set(t.astype(replicas.dtype))
+        member = member.at[p, s].set(False).at[p, t].set(True)
+        return loads, replicas, member
+
+    def run(loads, replicas, member, depth_cap):
+        su0 = state_cost(loads, member)
+
+        # beam state: [W, ...] with beam 0 = the start, others dead
+        loads_b = jnp.broadcast_to(loads, (W, B))
+        replicas_b = jnp.broadcast_to(replicas, (W, P, R))
+        member_b = jnp.broadcast_to(member, (W, P, B))
+        alive = jnp.zeros(W, bool).at[0].set(True)
+
+        def depth_step(carry, _):
+            loads_b, replicas_b, member_b, alive, best = carry
+
+            vals, cp, cslot = jax.vmap(expand)(
+                loads_b, replicas_b, member_b, alive
+            )  # each [W, B]
+
+            flat_vals = vals.reshape(-1)  # [W*B]
+            neg, pick = lax.top_k(-flat_vals, W)
+            new_u = -neg  # [W]
+            parent = (pick // B).astype(jnp.int32)
+            child = pick % B  # the target broker index
+
+            ok = jnp.isfinite(new_u)
+            p_sel = jnp.where(ok, cp[parent, child], -1)
+            slot_sel = jnp.where(ok, cslot[parent, child], 0)
+            t_sel = jnp.where(ok, child.astype(jnp.int32), 0)
+
+            def build(i):
+                pl_, rp_, mb_ = (
+                    loads_b[parent[i]],
+                    replicas_b[parent[i]],
+                    member_b[parent[i]],
+                )
+                return lax.cond(
+                    ok[i],
+                    lambda a: apply_move(*a, p_sel[i], slot_sel[i], t_sel[i]),
+                    lambda a: a,
+                    (pl_, rp_, mb_),
+                )
+
+            loads_b, replicas_b, member_b = lax.map(build, jnp.arange(W))
+            alive = ok
+            # re-evaluate the TRUE state cost: candidate scores are
+            # incremental estimates; ranking/acceptance must use real
+            # post-apply costs or whole sequences can be mis-accepted
+            su_b = jnp.where(
+                ok,
+                lax.map(
+                    lambda i: state_cost(loads_b[i], member_b[i]),
+                    jnp.arange(W),
+                ),
+                jnp.inf,
+            )
+
+            (best_u, best_beam, best_depth, d,
+             bs_loads, bs_replicas, bs_member) = best
+            m = jnp.min(su_b)
+            arg = jnp.argmin(su_b).astype(jnp.int32)
+            # the depth cap keeps sequences within the caller's remaining
+            # move budget
+            better = (m < best_u) & (d < depth_cap)
+            best = (
+                jnp.where(better, m, best_u),
+                jnp.where(better, arg, best_beam),
+                jnp.where(better, d, best_depth),
+                d + 1,
+                jnp.where(better, loads_b[arg], bs_loads),
+                jnp.where(better, replicas_b[arg], bs_replicas),
+                jnp.where(better, member_b[arg], bs_member),
+            )
+            carry = (loads_b, replicas_b, member_b, alive, best)
+            return carry, (parent, p_sel, slot_sel, t_sel)
+
+        best0 = (
+            su0, jnp.int32(-1), jnp.int32(-1), jnp.int32(0),
+            loads, replicas, member,
+        )
+        carry0 = (loads_b, replicas_b, member_b, alive, best0)
+        (_, _, _, _, best), logs = lax.scan(
+            depth_step, carry0, None, length=D
+        )
+        (best_u, best_beam, best_depth, _,
+         bs_loads, bs_replicas, bs_member) = best
+        parents, mp, mslot, mtgt = logs  # each [D, W]
+        return (
+            su0, best_u, best_beam, best_depth, parents, mp, mslot, mtgt,
+            bs_loads, bs_replicas, bs_member,
+        )
+
+    return run
+
+
 @partial(jax.jit, static_argnames=("width", "depth", "allow_leader", "n_topics"))
 def beam_search(
     loads,
@@ -82,145 +253,119 @@ def beam_search(
 ):
     """One beam search from a single start state.
 
-    Returns ``(su0, best_u, best_depth, parents [D, W], move_p/slot/tgt
-    [D, W])`` — the move logs reconstruct the best sequence host-side.
-    Entries for dead/no-op expansions carry ``move_p == -1``.
+    Returns ``(su0, best_u, best_beam, best_depth, parents [D, W],
+    move_p/slot/tgt [D, W])`` — the move logs reconstruct the best sequence
+    host-side. Entries for dead/no-op expansions carry ``move_p == -1``.
     """
     P, R = replicas.shape
     B = loads.shape[0]
-    dtype = loads.dtype
-    W, D = width, depth
-
-    slot_iota = jnp.arange(R)[None, :]
-    movable = (slot_iota >= 0) if allow_leader else (slot_iota >= 1)
-
-    def state_cost(loads, member):
-        observed = jnp.any(member & pvalid[:, None], axis=0)
-        bvalid = (always_valid | observed) & universe_valid
-        u = cost.unbalance(loads, bvalid, jnp.sum(bvalid).astype(dtype))
-        if n_topics:
-            u = u + _colocation_cost(
-                member.astype(dtype), topic_id, n_topics, lam
-            )
-        return u
-
-    def expand(args):
-        """Top-W candidates of one beam: (vals [W], p/slot/tgt [W])."""
-        loads, replicas, member, alive = args
-        observed = jnp.any(member & pvalid[:, None], axis=0)
-        bvalid = (always_valid | observed) & universe_valid
-        nb = jnp.sum(bvalid).astype(dtype)
-        _, perm, rank_of = cost.rank_brokers(loads, bvalid)
-        u, su = cost.move_candidate_scores(
-            loads, replicas, allowed[:, perm], member[:, perm], bvalid,
-            bvalid[perm], perm, rank_of, weights, nrep_cur, nrep_tgt,
-            pvalid, nb, min_replicas,
-        )
-        u = jnp.where(movable[:, :, None], u, jnp.inf)
-        if n_topics:
-            # rank-1 colocation delta: +λ if the target broker already has
-            # a same-topic replica, −λ if the source broker has ≥2
-            counts = jnp.zeros((n_topics, B), dtype).at[topic_id].add(
-                member.astype(dtype)
-            )
-            c_rows = counts[topic_id]  # [P, B]
-            s = jnp.clip(replicas, 0)
-            c_src = jnp.take_along_axis(c_rows, s, axis=1)  # [P, R]
-            add = jnp.where(c_rows[:, perm] >= 1, lam, 0.0)  # [P, B] rank
-            sub = jnp.where(c_src >= 2, lam, 0.0)  # [P, R]
-            u = u + add[:, None, :] - sub[:, :, None]
-        flat = jnp.where(alive, u, jnp.inf).reshape(-1)
-        neg, idx = lax.top_k(-flat, W)
-        p, rem = jnp.divmod(idx, R * B)
-        slot, t_rank = jnp.divmod(rem, B)
-        return -neg, p.astype(jnp.int32), slot.astype(jnp.int32), perm[
-            t_rank
-        ].astype(jnp.int32)
-
-    def apply_move(loads, replicas, member, p, slot, t):
-        s = replicas[p, slot]
-        delta = jnp.where(
-            slot == 0,
-            weights[p] * (nrep_cur[p].astype(dtype) + ncons[p]),
-            weights[p],
-        )
-        loads = loads.at[s].add(-delta).at[t].add(delta)
-        replicas = replicas.at[p, slot].set(t.astype(replicas.dtype))
-        member = member.at[p, s].set(False).at[p, t].set(True)
-        return loads, replicas, member
-
-    su0 = state_cost(loads, member)
-
-    # beam state: [W, ...] with beam 0 = the start, others dead
-    loads_b = jnp.broadcast_to(loads, (W, B))
-    replicas_b = jnp.broadcast_to(replicas, (W, P, R))
-    member_b = jnp.broadcast_to(member, (W, P, B))
-    alive = jnp.zeros(W, bool).at[0].set(True)
-    su_b = jnp.full(W, jnp.inf, dtype).at[0].set(su0)
-
-    def depth_step(carry, _):
-        loads_b, replicas_b, member_b, alive, su_b, best = carry
-
-        vals, cp, cslot, ct = lax.map(
-            expand, (loads_b, replicas_b, member_b, alive)
-        )  # each [W, W]
-
-        flat_vals = vals.reshape(-1)  # [W*W]
-        neg, pick = lax.top_k(-flat_vals, W)
-        new_u = -neg  # [W]
-        parent = (pick // W).astype(jnp.int32)
-        child = pick % W
-
-        ok = jnp.isfinite(new_u)
-        p_sel = jnp.where(ok, cp[parent, child], -1)
-        slot_sel = jnp.where(ok, cslot[parent, child], 0)
-        t_sel = jnp.where(ok, ct[parent, child], 0)
-
-        def build(i):
-            pl_, rp_, mb_ = (
-                loads_b[parent[i]],
-                replicas_b[parent[i]],
-                member_b[parent[i]],
-            )
-            return lax.cond(
-                ok[i],
-                lambda a: apply_move(*a, p_sel[i], slot_sel[i], t_sel[i]),
-                lambda a: a,
-                (pl_, rp_, mb_),
-            )
-
-        loads_b, replicas_b, member_b = lax.map(build, jnp.arange(W))
-        alive = ok
-        # re-evaluate the TRUE state cost: candidate scores under-model
-        # leader moves (plain weight scored, premium applied — the
-        # reference's steps.go:185/:207 quirk), so ranking/acceptance on
-        # the claimed values would accept sequences that are really worse
-        su_b = jnp.where(
-            ok,
-            lax.map(lambda i: state_cost(loads_b[i], member_b[i]), jnp.arange(W)),
-            jnp.inf,
-        )
-
-        best_u, best_beam, best_depth, d = best
-        m = jnp.min(su_b)
-        better = m < best_u
-        best = (
-            jnp.where(better, m, best_u),
-            jnp.where(better, jnp.argmin(su_b).astype(jnp.int32), best_beam),
-            jnp.where(better, d, best_depth),
-            d + 1,
-        )
-        carry = (loads_b, replicas_b, member_b, alive, su_b, best)
-        return carry, (parent, p_sel, slot_sel, t_sel)
-
-    best0 = (su0, jnp.int32(-1), jnp.int32(-1), jnp.int32(0))
-    carry0 = (loads_b, replicas_b, member_b, alive, su_b, best0)
-    (_, _, _, _, _, best), logs = lax.scan(
-        depth_step, carry0, None, length=D
+    run = _scan_factory(
+        allowed, weights, nrep_cur, nrep_tgt, ncons, pvalid, always_valid,
+        universe_valid, topic_id, min_replicas, lam, loads.dtype, P, R, B,
+        width=width, depth=depth, allow_leader=allow_leader,
+        n_topics=n_topics,
     )
-    best_u, best_beam, best_depth, _ = best
-    parents, mp, mslot, mtgt = logs  # each [D, W]
-    return su0, best_u, best_beam, best_depth, parents, mp, mslot, mtgt
+    out = run(loads, replicas, member, jnp.int32(depth))
+    return out[:8]
+
+@partial(
+    jax.jit,
+    static_argnames=("width", "depth", "allow_leader", "n_topics", "max_moves"),
+)
+def beam_session(
+    loads,
+    replicas,
+    member,
+    allowed,
+    weights,
+    nrep_cur,
+    nrep_tgt,
+    ncons,
+    pvalid,
+    always_valid,
+    universe_valid,
+    topic_id,
+    min_replicas,
+    lam,
+    min_unbalance,
+    budget,
+    *,
+    width: int,
+    depth: int,
+    allow_leader: bool,
+    n_topics: int,
+    max_moves: int,
+):
+    """Device-fused receding-horizon beam planning: rounds of depth-``depth``
+    beam search, each adopting the winning sequence's state, inside one
+    ``while_loop`` — one dispatch for the whole plan (per-search host round
+    trips dominate wall-clock on remote-attached TPUs).
+
+    Returns ``(replicas, loads, n, move_p, move_slot, move_tgt)`` with the
+    accepted moves logged in order (dense indices, -1 past ``n``). The
+    depth cap per round is ``min(depth, budget - n)``, so a sequence never
+    overruns the budget (a truncated prefix could end on an uphill move).
+    """
+    P, R = replicas.shape
+    B = loads.shape[0]
+    ML = max_moves
+    run = _scan_factory(
+        allowed, weights, nrep_cur, nrep_tgt, ncons, pvalid, always_valid,
+        universe_valid, topic_id, min_replicas, lam, loads.dtype, P, R, B,
+        width=width, depth=depth, allow_leader=allow_leader,
+        n_topics=n_topics,
+    )
+
+    mp0 = jnp.full(ML, -1, jnp.int32)
+
+    def cond(state):
+        n, done = state[3], state[4]
+        return (~done) & (n < budget)
+
+    def body(state):
+        loads, replicas, member, n, _done, mp, mslot, mtgt = state
+        depth_cap = jnp.minimum(jnp.int32(depth), budget - n)
+        (su0, best_u, best_beam, best_depth, parents, smp, sslot, smtgt,
+         bs_loads, bs_replicas, bs_member) = run(
+            loads, replicas, member, depth_cap
+        )
+        accept = (best_u < su0 - min_unbalance) & (best_u < su0)
+
+        # walk the parent chain from best_depth back to 0, writing the
+        # accepted prefix into the global logs at positions n..n+best_depth
+        def walk(k, carry):
+            beam, mp, mslot, mtgt = carry
+            idx = best_depth - k
+            valid = accept & (k <= best_depth)
+            i = jnp.clip(idx, 0)
+            pos = jnp.clip(n + i, 0, ML - 1)
+            mp = mp.at[pos].set(jnp.where(valid, smp[i, beam], mp[pos]))
+            mslot = mslot.at[pos].set(
+                jnp.where(valid, sslot[i, beam], mslot[pos])
+            )
+            mtgt = mtgt.at[pos].set(jnp.where(valid, smtgt[i, beam], mtgt[pos]))
+            beam = jnp.where(valid, parents[i, beam], beam)
+            return beam, mp, mslot, mtgt
+
+        _, mp, mslot, mtgt = lax.fori_loop(
+            jnp.int32(0), jnp.int32(depth), walk,
+            (best_beam, mp, mslot, mtgt),
+        )
+
+        loads = jnp.where(accept, bs_loads, loads)
+        replicas = jnp.where(accept, bs_replicas, replicas)
+        member = jnp.where(accept, bs_member, member)
+        n = n + jnp.where(accept, best_depth + 1, 0)
+        return loads, replicas, member, n, ~accept, mp, mslot, mtgt
+
+    state = (
+        loads, replicas, member, jnp.int32(0), jnp.bool_(False),
+        mp0, mp0, mp0,
+    )
+    loads, replicas, member, n, _done, mp, mslot, mtgt = lax.while_loop(
+        cond, body, state
+    )
+    return replicas, loads, n, mp, mslot, mtgt
 
 
 def _reconstruct(best_beam, best_depth, parents, mp, mslot, mtgt):
@@ -290,26 +435,80 @@ def _search_once(pl: PartitionList, cfg: RebalanceConfig, depth: int,
 def beam_plan(
     pl: PartitionList, cfg: RebalanceConfig, max_reassign: int, dtype=None
 ) -> PartitionList:
-    """Receding-horizon beam planning: search a ``beam_depth`` lookahead,
-    apply the best sequence, repeat. Output/mutation contract matches
-    ``solvers.scan.plan`` (live partitions accumulated in move order)."""
+    """Receding-horizon beam planning, fused on device: rounds of
+    ``beam_depth`` lookahead, each adopting the best sequence, inside one
+    dispatch (:func:`beam_session`). Output/mutation contract matches
+    ``solvers.scan.plan`` (live partitions accumulated in move order).
+    Sessions chunk at 2^16 moves per
+    dispatch and re-enter until converged or the budget is exhausted."""
     opl = empty_partition_list()
     if max_reassign <= 0:
         return opl
     repaired, budget = _settle_head(pl, cfg, max_reassign)
     opl.append(*repaired)
 
-    while budget > 0:
-        found = _search_once(pl, cfg, depth=min(int(cfg.beam_depth), budget), dtype=dtype)
-        if found is None:
+    from kafkabalancer_tpu.solvers.scan import _cfg_broker_mask
+
+    remaining = budget
+    while remaining > 0:
+        chunk_cap = min(remaining, 1 << 16)
+        n = _beam_round(pl, cfg, opl, remaining, dtype, _cfg_broker_mask)
+        remaining -= n
+        if n < chunk_cap:  # converged before exhausting the dispatch
             break
-        dp, seq = found
-        for p_row, slot, t_dense in seq[:budget]:
-            part = dp.partitions[p_row]
-            part.replicas[slot] = int(dp.broker_ids[t_dense])
-            opl.append(part)
-            budget -= 1
     return opl
+
+
+def _beam_round(pl, cfg, opl, budget, dtype, _cfg_broker_mask):
+    """One fused beam dispatch of up to 2^16 moves; applies the moves to the
+    live list and appends them to ``opl``; returns the move count."""
+    dp = tensorize(pl, cfg)
+    if dtype is None:
+        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    loads = jnp.asarray(
+        cost.broker_loads(
+            jnp.asarray(dp.replicas),
+            jnp.asarray(dp.weights, dtype),
+            jnp.asarray(dp.nrep_cur),
+            jnp.asarray(dp.ncons, dtype),
+            dp.bvalid.shape[0],
+        )
+    )
+    lam = float(cfg.anti_colocation)
+    n_topics = next_bucket(len(dp.topics), 2) if lam > 0 else 0
+    ML = next_bucket(min(budget, 1 << 16), 64)
+
+    replicas_out, _loads, n, mp, mslot, mtgt = beam_session(
+        loads,
+        jnp.asarray(dp.replicas),
+        jnp.asarray(dp.member),
+        jnp.asarray(dp.allowed),
+        jnp.asarray(dp.weights, dtype),
+        jnp.asarray(dp.nrep_cur),
+        jnp.asarray(dp.nrep_tgt),
+        jnp.asarray(dp.ncons, dtype),
+        jnp.asarray(dp.pvalid),
+        jnp.asarray(_cfg_broker_mask(dp, cfg)),
+        jnp.asarray(dp.bvalid),
+        jnp.asarray(dp.topic_id),
+        jnp.int32(cfg.min_replicas_for_rebalancing),
+        jnp.asarray(lam, dtype),
+        jnp.asarray(cfg.min_unbalance, dtype),
+        jnp.int32(min(budget, ML)),
+        width=max(1, int(cfg.beam_width)),
+        depth=max(1, int(cfg.beam_depth)),
+        allow_leader=cfg.allow_leader_rebalancing,
+        n_topics=n_topics,
+        max_moves=ML,
+    )
+
+    n = int(n)
+    mp, mslot, mtgt = (np.asarray(x)[:n] for x in (mp, mslot, mtgt))
+    for i in range(n):
+        part = dp.partitions[int(mp[i])]
+        part.replicas[int(mslot[i])] = int(dp.broker_ids[int(mtgt[i])])
+        opl.append(part)
+    return n
 
 
 def beam_move(

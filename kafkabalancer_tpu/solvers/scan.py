@@ -165,72 +165,18 @@ def session(
         # each target broker instead yields up to B disjoint commits per
         # iteration — a bipartite matching of hot sources onto cold targets.
         #
-        # The rank-1 objective FACTORIZES over source and target:
-        #   u[p,r,t] = su + A[p,r] + C[p,t]
-        #   A[p,r] = f(load_s − d) − f(load_s)      (source term)
-        #   C[p,t] = f(load_t + d) − f(load_t)      (target term)
-        # so the per-target minimization needs only [P,R] + [P,B] work —
-        # the [P,R,B] candidate tensor never materializes:
-        #   best[t] = min_p [ min_r A[p,r] + C[p,t] ].
-        #
-        # Unlike the per-move parity paths, batch mode scores leader moves
-        # with their TRUE applied delta d = w·(replicas+consumers) instead
-        # of the reference's plain-weight under-modelling (steps.go:185/
-        # :207, SURVEY.md §3.3 "fidelity knob"): committing many scored-vs-
-        # applied mismatches at once oscillates badly (one-at-a-time greedy
-        # self-corrects each overshoot). Followers and leaders therefore
-        # run as two factorized passes with their own deltas, merged per
-        # target.
+        # Per-target best candidates via the shared factorized scorer
+        # (ops/cost.py factored_target_best): [P,R] + [P,B] work, leader
+        # moves scored with their TRUE applied delta (the reference's
+        # plain-weight under-modelling oscillates under batched commits).
         bvalid = (always_valid | (bcount > 0)) & universe_valid
         nb = jnp.sum(bvalid).astype(dtype)
-        avg = jnp.sum(jnp.where(bvalid, loads, 0.0)) / nb
-        F = jnp.where(bvalid, cost.overload_penalty(loads, avg), 0.0)  # [B]
-        su = jnp.sum(F)
-
-        w = weights[:, None]  # [P, 1]
-        s_idx = jnp.clip(replicas, 0)  # [P, R]
-        eligible = pvalid[:, None] & (nrep_tgt >= min_replicas)[:, None]
-        tmask = allowed & ~member & bvalid[None, :]  # [P, B]
-        t = jnp.arange(B, dtype=jnp.int32)
-
-        # --- follower pass (slots ≥ 1, delta = w) ---
-        srcmask_f = (slot_iota >= 1) & (slot_iota < nrep_cur[:, None]) & eligible
-        A_f = cost.overload_penalty(loads[s_idx] - w, avg) - F[s_idx]
-        A_f = jnp.where(srcmask_f, A_f, jnp.inf)
-        r_star = jnp.argmin(A_f, axis=1).astype(jnp.int32)  # [P]
-        A_star = jnp.min(A_f, axis=1)  # [P]
-        C_f = cost.overload_penalty(loads[None, :] + w, avg) - F[None, :]
-        V = jnp.where(
-            tmask & jnp.isfinite(A_star)[:, None], A_star[:, None] + C_f,
-            jnp.inf,
+        su, vals, p, slot = cost.factored_target_best(
+            loads, replicas, allowed, member, bvalid, weights, nrep_cur,
+            nrep_tgt, ncons, pvalid, nb, min_replicas,
+            allow_leader=allow_leader,
         )
-        p = jnp.argmin(V, axis=0).astype(jnp.int32)  # [B]
-        vals = V[p, t]
-        slot = r_star[p]
-
-        if allow_leader:
-            # --- leader pass (slot 0, delta = w·(replicas+consumers)) ---
-            wl = weights * (nrep_cur.astype(dtype) + ncons)  # [P]
-            s0 = jnp.clip(replicas[:, 0], 0)
-            ok_l = (nrep_cur >= 1) & eligible[:, 0]
-            A_l = cost.overload_penalty(loads[s0] - wl, avg) - F[s0]
-            A_l = jnp.where(ok_l, A_l, jnp.inf)  # [P]
-            C_l = (
-                cost.overload_penalty(loads[None, :] + wl[:, None], avg)
-                - F[None, :]
-            )
-            V_l = jnp.where(
-                tmask & jnp.isfinite(A_l)[:, None], A_l[:, None] + C_l,
-                jnp.inf,
-            )
-            p_l = jnp.argmin(V_l, axis=0).astype(jnp.int32)
-            vals_l = V_l[p_l, t]
-            lead_better = vals_l < vals
-            vals = jnp.where(lead_better, vals_l, vals)
-            p = jnp.where(lead_better, p_l, p)
-            slot = jnp.where(lead_better, 0, slot)
-
-        vals = su + vals  # [B]
+        t = jnp.arange(B, dtype=jnp.int32)
         s_ = replicas[p, slot].astype(jnp.int32)
 
         improving = jnp.isfinite(vals) & (vals < su - min_unbalance) & (vals < su)

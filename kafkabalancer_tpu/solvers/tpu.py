@@ -254,9 +254,9 @@ def _tpu_move(
         if leaders
         else sum(max(0, len(p.replicas) - 1) for p in pl.iter_partitions())
     )
-    n_brokers = len({b for p in pl.iter_partitions() for b in p.replicas}
-                    | set(cfg.brokers or ()))
-    if movable * n_brokers < MIN_DEVICE_CANDIDATES:
+    from kafkabalancer_tpu.ops.tensorize import broker_universe
+
+    if movable * len(broker_universe(pl, cfg)) < MIN_DEVICE_CANDIDATES:
         return greedy_move(pl, cfg, leaders)
     dp = tensorize(pl, cfg)
     try:
