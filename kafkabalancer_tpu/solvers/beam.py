@@ -12,9 +12,9 @@ Search semantics:
 - the objective is the reference unbalance (utils.go:119-147) plus, when
   ``cfg.anti_colocation > 0``, λ·Σ_{topic,broker} max(0, c−1) where c
   counts same-topic replicas sharing a broker;
-- each depth expands every live beam's full ``[P, R, B]`` candidate tensor
-  (rank-1 updates, ops/cost.py) — top-W of the W·W frontier survive.
-  Sequences may include uphill moves; acceptance is sequence-level: the
+- each depth expands every live beam via the shared factorized per-target
+  scorer (ops/cost.py factored_target_best) — top-W of the W·B frontier
+  survive. Sequences may include uphill moves; acceptance is sequence-level: the
   best state seen at any depth must beat the start by ``min_unbalance``
   (the per-move threshold semantics of the greedy/tpu solvers do not apply
   — beam is an extension, not a parity path);
@@ -49,7 +49,10 @@ import numpy as np  # noqa: E402
 from jax import lax  # noqa: E402
 
 from kafkabalancer_tpu.ops import cost, tensorize  # noqa: E402
-from kafkabalancer_tpu.solvers.scan import _settle_head  # noqa: E402
+from kafkabalancer_tpu.solvers.scan import (  # noqa: E402
+    _cfg_broker_mask,
+    _settle_head,
+)
 
 
 def _colocation_cost(member, topic_id, n_topics, lam):
@@ -382,11 +385,10 @@ def _reconstruct(best_beam, best_depth, parents, mp, mslot, mtgt):
     return seq
 
 
-def _search_once(pl: PartitionList, cfg: RebalanceConfig, depth: int,
-                 dtype=None):
-    """One beam search on the live list; returns the accepted move sequence
-    as ``[(partition row, slot, target broker id)]`` with its DensePlan, or
-    ``None`` when no sequence clears ``min_unbalance``."""
+def _device_setup(pl, cfg, dtype):
+    """Shared device-setup for one search/round: dense plan, loads, dtype,
+    colocation config. Keeps beam_move (_search_once) and _beam_round from
+    drifting apart."""
     dp = tensorize(pl, cfg)
     if dtype is None:
         dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
@@ -397,10 +399,17 @@ def _search_once(pl: PartitionList, cfg: RebalanceConfig, depth: int,
         jnp.asarray(dp.ncons, dtype),
         dp.bvalid.shape[0],
     )
-    from kafkabalancer_tpu.solvers.scan import _cfg_broker_mask
-
     lam = float(cfg.anti_colocation)
     n_topics = next_bucket(len(dp.topics), 2) if lam > 0 else 0
+    return dp, dtype, loads, lam, n_topics
+
+
+def _search_once(pl: PartitionList, cfg: RebalanceConfig, depth: int,
+                 dtype=None):
+    """One beam search on the live list; returns the accepted move sequence
+    as ``[(partition row, slot, target broker id)]`` with its DensePlan, or
+    ``None`` when no sequence clears ``min_unbalance``."""
+    dp, dtype, loads, lam, n_topics = _device_setup(pl, cfg, dtype)
 
     su0, best_u, best_beam, best_depth, parents, mp, mslot, mtgt = beam_search(
         loads,
@@ -447,35 +456,20 @@ def beam_plan(
     repaired, budget = _settle_head(pl, cfg, max_reassign)
     opl.append(*repaired)
 
-    from kafkabalancer_tpu.solvers.scan import _cfg_broker_mask
-
     remaining = budget
     while remaining > 0:
         chunk_cap = min(remaining, 1 << 16)
-        n = _beam_round(pl, cfg, opl, remaining, dtype, _cfg_broker_mask)
+        n = _beam_round(pl, cfg, opl, remaining, dtype)
         remaining -= n
         if n < chunk_cap:  # converged before exhausting the dispatch
             break
     return opl
 
 
-def _beam_round(pl, cfg, opl, budget, dtype, _cfg_broker_mask):
+def _beam_round(pl, cfg, opl, budget, dtype):
     """One fused beam dispatch of up to 2^16 moves; applies the moves to the
     live list and appends them to ``opl``; returns the move count."""
-    dp = tensorize(pl, cfg)
-    if dtype is None:
-        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
-    loads = jnp.asarray(
-        cost.broker_loads(
-            jnp.asarray(dp.replicas),
-            jnp.asarray(dp.weights, dtype),
-            jnp.asarray(dp.nrep_cur),
-            jnp.asarray(dp.ncons, dtype),
-            dp.bvalid.shape[0],
-        )
-    )
-    lam = float(cfg.anti_colocation)
-    n_topics = next_bucket(len(dp.topics), 2) if lam > 0 else 0
+    dp, dtype, loads, lam, n_topics = _device_setup(pl, cfg, dtype)
     ML = next_bucket(min(budget, 1 << 16), 64)
 
     replicas_out, _loads, n, mp, mslot, mtgt = beam_session(

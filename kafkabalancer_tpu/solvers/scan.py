@@ -54,6 +54,7 @@ import numpy as np  # noqa: E402
 from jax import lax  # noqa: E402
 
 from kafkabalancer_tpu.balancer.pipeline import _COMMON_HEAD  # noqa: E402
+from kafkabalancer_tpu.balancer.steps import BalanceError  # noqa: E402
 from kafkabalancer_tpu.ops import cost, tensorize  # noqa: E402
 from kafkabalancer_tpu.ops.runtime import next_bucket  # noqa: E402
 
@@ -429,13 +430,23 @@ def plan(
             jnp.int32(chunk),
         )
         if use_pallas:
-            _replicas, _loads, n, mp, mslot, _msrc, mtgt = pallas_session(
-                *args,
-                jnp.int32(max(1, batch)),
-                max_moves=next_bucket(chunk, 64),
-                allow_leader=cfg.allow_leader_rebalancing,
-                interpret=(engine == "pallas-interpret"),
-            )
+            try:
+                _replicas, _loads, n, mp, mslot, _msrc, mtgt = pallas_session(
+                    *args,
+                    jnp.int32(max(1, batch)),
+                    max_moves=next_bucket(chunk, 64),
+                    allow_leader=cfg.allow_leader_rebalancing,
+                    interpret=(engine == "pallas-interpret"),
+                )
+            except BalanceError:
+                raise
+            except Exception as exc:
+                # compiled Mosaic kernels need a TPU backend; surface a
+                # planning failure (CLI exit 3) instead of a raw traceback
+                raise BalanceError(
+                    f"pallas engine failed ({exc!r}); use engine='xla' or "
+                    f"'pallas-interpret'"
+                ) from exc
         else:
             _replicas, _loads, n, mp, mslot, _msrc, mtgt, _su = session(
                 *args,

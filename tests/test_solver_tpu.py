@@ -189,3 +189,18 @@ def test_tiny_instance_host_fallback_still_identical(monkeypatch):
     assert calls, "fallback did not fire"
     ppl_g = balance(copy.deepcopy(pl), default_rebalance_config())
     assert ppl == ppl_g
+
+
+def test_duplicate_topic_partition_parity():
+    """Duplicate topic+partition entries are legal input (that is what
+    -unique exists for); apply_assignment matches by object identity, so
+    sessions must stay in lockstep across solvers even with ambiguous keys."""
+    pl = wrap(
+        [
+            P("a", 1, [1, 2], weight=2.0),
+            P("a", 1, [1, 3], weight=1.0),  # duplicate key, different replicas
+            P("a", 2, [1, 4], weight=1.5),
+            P("b", 1, [2, 1], weight=1.0),
+        ]
+    )
+    assert_session_parity(pl, default_rebalance_config(), max_moves=6)
