@@ -17,15 +17,17 @@ dynamic broker-table membership), with kernel-friendly re-formulations:
 - the ``loads[s]`` gather becomes a one-hot contraction per P-tile (MXU);
 - claims/disjointness become pairwise ``[B, B]`` masks (no scatters);
 - cumsum becomes a lower-triangular ``[B, B]`` contraction;
-- member/replica updates are per-commit row read-modify-writes (the ≤B
-  commits per iteration are partition-disjoint, so rows are written once);
+- replica updates are per-commit row read-modify-writes (the ≤B commits
+  per iteration are partition-disjoint, so rows are written once);
+  replica-set membership is never stored — it is derived per tile from
+  the replica matrix (the [P, B] matrix would be both the largest
+  transfer and the largest VMEM resident);
 - move logs live in ``[max_moves, 1]`` VMEM buffers written with dynamic
   sublane indexing.
 
-The big ``allowed`` mask is int8 in VMEM (bool/int32 [P, B] arrays at the
-16k-partition bucket would not fit alongside the int32 member state);
-int8 values are widened before any comparison (int8 compares break the
-Mosaic lowering). Float32 only — this is the throughput path; parity
+The ``allowed`` mask is int8 in VMEM (the kernel's VMEM budget is tight
+at the 16k-partition bucket); int8 values are widened before any
+comparison (int8 compares break the Mosaic lowering). Float32 only — this is the throughput path; parity
 modes stay on the XLA/host solvers. Under the Pallas interpreter the
 kernel is bit-identical to ``scan.session``'s batch path (pinned by
 tests/test_pallas.py); on hardware, float reduction order may resolve
@@ -62,7 +64,6 @@ def _kernel(
     # arrays (VMEM)
     loads0_ref,
     replicas0_ref,
-    member_ref,
     allowed_ref,
     w_ref,
     nrepc_ref,
@@ -79,7 +80,6 @@ def _kernel(
     mslot_ref,
     msrc_ref,
     mtgt_ref,
-    member_out_ref,
     # scratch
     bcount_ref,
     rstar_ref,
@@ -93,14 +93,35 @@ def _kernel(
     f32 = jnp.float32
 
     # ---- initialize mutable state from the inputs -----------------------
+    # replica-set membership is DERIVED from the replica matrix per tile,
+    # never stored or transferred: the [P, B] matrix would be both the
+    # largest session input (host->device transfer is on the critical
+    # path) and the largest VMEM resident (8 MB at the 16k bucket, which
+    # overflows the kernel's VMEM budget)
     loads_ref[:] = loads0_ref[:]
     replicas_ref[:] = replicas0_ref[:]
-    member_out_ref[:] = member_ref[:]
-    pv = pvalid_ref[:]  # [P, 1] int32
-    bcount_ref[:] = jnp.sum(
-        member_ref[:].astype(jnp.float32) * pv.astype(jnp.float32), axis=0,
-        keepdims=True,
-    ).astype(jnp.int32)
+    lane_b0 = lax.broadcasted_iota(jnp.int32, (1, B), 1)
+    bcount_ref[:] = jnp.zeros((1, B), jnp.int32)
+
+    def _member_tile(off):
+        reps = replicas_ref[pl.ds(off, TILE_P), :]
+        nrc = nrepc_ref[pl.ds(off, TILE_P), :]
+        pv_t = pvalid_ref[pl.ds(off, TILE_P), :]
+        m = jnp.zeros((TILE_P, B), jnp.int32)
+        for r in range(R):
+            col = reps[:, r].reshape(TILE_P, 1)
+            valid = (nrc > r) & (pv_t > 0)
+            m = jnp.where((col == lane_b0) & valid, jnp.ones_like(m), m)
+        return m
+
+    def init_tile(ti, _):
+        bcount_ref[:] = bcount_ref[:] + jnp.sum(
+            _member_tile(ti * TILE_P).astype(jnp.float32), axis=0,
+            keepdims=True,
+        ).astype(jnp.int32)
+        return _
+
+    lax.fori_loop(jnp.int32(0), jnp.int32(P // TILE_P), init_tile, jnp.int32(0))
     mp_ref[:] = jnp.full((ML, 1), -1, jnp.int32)
     mslot_ref[:] = jnp.full((ML, 1), -1, jnp.int32)
     msrc_ref[:] = jnp.full((ML, 1), -1, jnp.int32)
@@ -158,11 +179,18 @@ def _kernel(
             F_s = g[:, :, 1]
 
             elig = (pv_t > 0) & (nrt >= min_repl)  # [T, 1]
-            memb = member_out_ref[pl.ds(off, TILE_P), :]  # [T, B] i32
+            # membership from the already-materialized onehot: max over
+            # valid slots (pad slots hold -1 and never match a lane)
+            # f32 mask: minor-dim insertion on sub-32-bit types fails to
+            # lower in Mosaic at some shapes
+            valid_slots = ((iota_r < nrc) & (pv_t > 0)).astype(f32)  # [T, R]
+            memb = jnp.max(
+                onehot * valid_slots[:, :, None], axis=1
+            )  # [T, B] f32 0/1
             # NOTE: int8 loads are fine but int8 *comparisons* break the
             # Mosaic lowering — widen before comparing
             alw = allowed_ref[pl.ds(off, TILE_P), :].astype(jnp.int32)
-            tmask = (alw > 0) & (memb == 0) & bvalid.reshape(1, B)
+            tmask = (alw > 0) & (memb < 0.5) & bvalid.reshape(1, B)
 
             # follower pass: slots >= 1, delta = w
             srcmask = (iota_r >= 1) & (iota_r < nrc) & elig  # [T, R]
@@ -341,10 +369,6 @@ def _kernel(
                 s_i = ext_i(cs, i)
                 slot_i = ext_i(cslot, i)
                 at = ext_i(jnp.where(ok, pos, jnp.zeros_like(pos)), i)
-                row = member_out_ref[pl.ds(p_i, 1), :]  # [1, B] i32
-                row = jnp.where(lane_b == s_i, jnp.zeros_like(row), row)
-                row = jnp.where(lane_b == i, jnp.ones_like(row), row)
-                member_out_ref[pl.ds(p_i, 1), :] = row
                 rrow = replicas_ref[pl.ds(p_i, 1), :]  # [1, R] i32
                 rrow = jnp.where(iota_r == slot_i, i, rrow)
                 replicas_ref[pl.ds(p_i, 1), :] = rrow
@@ -375,8 +399,8 @@ def _kernel(
 def pallas_session(
     loads,
     replicas,
-    member,
-    allowed,
+    member,  # ignored (None accepted): membership is derived in-kernel
+    allowed,  # from the replica matrix and never stored or transferred
     weights,
     nrep_cur,
     nrep_tgt,
@@ -431,7 +455,6 @@ def pallas_session(
         scalar(min_unbalance, f32),
         jnp.asarray(loads, f32).reshape(1, B),
         jnp.asarray(replicas, i32),
-        jnp.asarray(member, i32).reshape(P, B),
         jnp.asarray(allowed, i8).reshape(P, B),
         jnp.asarray(weights, f32).reshape(P, 1),
         jnp.asarray(nrep_cur, i32).reshape(P, 1),
@@ -441,7 +464,7 @@ def pallas_session(
         jnp.asarray(always_valid, i32).reshape(1, B),
         jnp.asarray(universe_valid, i32).reshape(1, B),
     )
-    loads_out, replicas_out, n, mp, mslot, msrc, mtgt, _member_out = out
+    loads_out, replicas_out, n, mp, mslot, msrc, mtgt = out
     return (
         replicas_out,
         loads_out.reshape(B),
@@ -468,11 +491,9 @@ def _call(kernel, P, R, B, ML, smem, vmem, interpret=False):
             jax.ShapeDtypeStruct((ML, 1), i32),  # move_slot
             jax.ShapeDtypeStruct((ML, 1), i32),  # move_src
             jax.ShapeDtypeStruct((ML, 1), i32),  # move_tgt
-            jax.ShapeDtypeStruct((P, B), i32),  # member (aliased state)
         ),
-        in_specs=[smem] * 4 + [vmem] * 11,
-        out_specs=(vmem, vmem, smem, vmem, vmem, vmem, vmem, vmem),
-        input_output_aliases={6: 7},  # member input -> member output
+        in_specs=[smem] * 4 + [vmem] * 10,
+        out_specs=(vmem, vmem, smem, vmem, vmem, vmem, vmem),
         scratch_shapes=[
             pltpu.VMEM((1, B), i32),  # bcount
             pltpu.VMEM((P, 1), i32),  # rstar

@@ -416,7 +416,9 @@ def plan(
         args = (
             loads,
             jnp.asarray(dp.replicas),
-            jnp.asarray(dp.member),
+            # the pallas kernel derives membership from the replica matrix;
+            # skip the [P, B] transfer (the largest session input) there
+            None if use_pallas else jnp.asarray(dp.member),
             jnp.asarray(dp.allowed),
             jnp.asarray(dp.weights, dtype),
             jnp.asarray(dp.nrep_cur),
