@@ -19,7 +19,7 @@ part of the contract.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 from kafkabalancer_tpu.models import PartitionList
 

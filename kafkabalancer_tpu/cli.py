@@ -169,8 +169,9 @@ def run(i, o, e, args: List[str]) -> int:
             "fused",
             False,
             "Run the whole -max-reassign session as one fused device loop "
-            "(implies the tpu backend; trades per-move logging and "
-            "complete-partition handling for throughput)",
+            "(implies the tpu backend; trades per-move logging for "
+            "throughput; complete-partition still applies at budget "
+            "exhaustion)",
         )
         f_batch = f.int(
             "fused-batch",
@@ -315,6 +316,19 @@ def run(i, o, e, args: List[str]) -> int:
                 return 3
             log(f"fused session: {len(opl)} reassignments")
             r = 0
+            # complete-partition extension (kafkabalancer.go:212-220): when
+            # the budget was exhausted mid-stream, keep granting one extra
+            # move while it still targets the same topic+partition as the
+            # last budgeted one
+            if (
+                f_complete.value
+                and len(opl) >= f_max.value
+                and opl.partitions
+            ):
+                c_partition = opl.partitions[-1]
+                completing = True
+                log(f"Forcing complete of Partition: {c_partition}")
+                r = 1
 
         while r > 0:
             try:

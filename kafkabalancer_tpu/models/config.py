@@ -14,7 +14,7 @@ not by any balancing step (kafkabalancer.go:212-220).
 
 from __future__ import annotations
 
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import List, Optional
 
 # fused-session device engines (solvers/scan.py plan()); lives here so the

@@ -27,8 +27,8 @@ dynamic broker-table membership), with kernel-friendly re-formulations:
 
 The ``allowed`` mask is int8 in VMEM (the kernel's VMEM budget is tight
 at the 16k-partition bucket); int8 values are widened before any
-comparison (int8 compares break the Mosaic lowering). Float32 only — this is the throughput path; parity
-modes stay on the XLA/host solvers. Under the Pallas interpreter the
+comparison (int8 compares break the Mosaic lowering). Float32 only —
+this is the throughput path; parity modes stay on the XLA/host solvers. Under the Pallas interpreter the
 kernel is bit-identical to ``scan.session``'s batch path (pinned by
 tests/test_pallas.py); on hardware, float reduction order may resolve
 exact candidate ties differently — counts and final unbalance match.

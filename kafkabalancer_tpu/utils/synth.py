@@ -8,7 +8,7 @@ partition/broker counts. Deterministic per seed.
 from __future__ import annotations
 
 import random
-from typing import Optional
+
 
 from kafkabalancer_tpu.models import Partition, PartitionList
 

@@ -388,3 +388,37 @@ def test_beam_cli_knobs():
     )
     assert code == 0
     assert json.loads(out.getvalue())["version"] == 1
+
+
+def test_fused_complete_partition():
+    """-fused honors the complete-partition extension: when the budget cuts
+    mid-stream, extra moves are granted while they keep targeting the same
+    topic+partition (kafkabalancer.go:212-220). The single-replica fillers
+    are below the min-replicas gate, so every move targets partition h/1 —
+    guaranteeing the grant path actually fires."""
+    data = {"version": 1, "partitions": [
+        {"topic": "h", "partition": 1, "replicas": [1, 2, 3], "weight": 5},
+        {"topic": "f", "partition": 1, "replicas": [1], "weight": 4},
+        {"topic": "f", "partition": 2, "replicas": [2], "weight": 4},
+        {"topic": "f", "partition": 3, "replicas": [3], "weight": 4},
+    ]}
+    raw = json.dumps(data)
+    base = ["kb", "-input-json", "-max-reassign=1", "-broker-ids=1,2,3,4,5"]
+
+    for extra in (["-fused"], []):
+        out, err = io.StringIO(), io.StringIO()
+        code = run(io.StringIO(raw), out, err,
+                   base + ["-complete-partition"] + extra)
+        assert code == 0
+        plan = json.loads(out.getvalue())["partitions"]
+        # two granted moves on the same partition, entries alias the final
+        # replica set (reference state-threading semantics, SURVEY.md §2.2)
+        assert [(p["topic"], p["partition"]) for p in plan] == [("h", 1)] * 2
+        assert plan[0]["replicas"] == plan[1]["replicas"] == [1, 4, 5]
+        assert "Forcing complete of Partition" in err.getvalue()
+
+        out2 = io.StringIO()
+        code = run(io.StringIO(raw), out2, io.StringIO(),
+                   base + ["-complete-partition=false"] + extra)
+        assert code == 0
+        assert len(json.loads(out2.getvalue())["partitions"]) == 1
