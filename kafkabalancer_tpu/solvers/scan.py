@@ -313,6 +313,32 @@ def _cfg_broker_mask(dp, cfg: RebalanceConfig) -> "np.ndarray":
     return mask
 
 
+def _repairs_possible(pl: PartitionList, cfg: RebalanceConfig) -> bool:
+    """Cheap O(P·R) prescreen: can any repair step (remove-extra,
+    add-missing, move-disallowed — steps.go:70-143) fire at all?
+
+    The full repair steps cost O(P·B) host work per pass (per-partition
+    sorted broker scans); on an already-feasible 10k-partition input that
+    is ~0.8 s of pure Python for zero fired steps. After ``fill_defaults``
+    most partitions share one brokers-list *object*, so the allowed-set
+    check caches by identity exactly like ``tensorize`` does.
+    """
+    observed = set()
+    for p in pl.iter_partitions():
+        observed.update(p.replicas)
+    full_ok: dict = {}
+    for p in pl.iter_partitions():
+        if p.num_replicas != len(p.replicas):
+            return True
+        key = id(p.brokers)
+        bset = full_ok.get(key)
+        if bset is None:
+            bset = full_ok[key] = set(p.brokers)
+        if not bset.issuperset(p.replicas):
+            return True
+    return False
+
+
 def _settle_head(
     pl: PartitionList, cfg: RebalanceConfig, budget: int
 ) -> Tuple[List[Partition], int]:
@@ -320,6 +346,13 @@ def _settle_head(
     fires, applying each repair like the CLI loop does. Returns the applied
     live partitions (each counts against the reassignment budget)."""
     from kafkabalancer_tpu.cli import apply_assignment
+
+    # validations + defaults always run once (exact error behavior);
+    # the repair loop is skipped entirely when no repair can fire
+    for _name, step in _COMMON_HEAD[:3]:
+        step(pl, cfg)
+    if not cfg.rebalance_leaders and not _repairs_possible(pl, cfg):
+        return [], budget
 
     out: List[Partition] = []
     while budget > 0:
@@ -457,8 +490,19 @@ def plan(
                 batch=batch,
             )
 
-        n = int(n)
-        mp, mslot, mtgt = (np.asarray(x)[:n] for x in (mp, mslot, mtgt))
+        # one device->host transfer for everything the decode needs: on a
+        # remote-attached TPU each fetch pays a full relay round trip
+        # (~0.15 s), so n + the three log arrays are packed device-side
+        packed = np.asarray(
+            jnp.concatenate(
+                [mp, mslot, mtgt, n.astype(jnp.int32).reshape(1)]
+            )
+        )
+        n = int(packed[-1])
+        ml = (packed.shape[0] - 1) // 3
+        mp, mslot, mtgt = (
+            packed[:n], packed[ml : ml + n], packed[2 * ml : 2 * ml + n]
+        )
         for i in range(n):
             part = dp.partitions[int(mp[i])]
             part.replicas[int(mslot[i])] = int(dp.broker_ids[int(mtgt[i])])
