@@ -1,19 +1,30 @@
 """Benchmark: plan a 10k-partition / 100-broker rebalance to convergence.
 
 The north-star config from BASELINE.md — the reference publishes no numbers
-(no testing.B benchmarks anywhere in the repo), so the baseline is the
-reference-transcribed CPU greedy solver measured here: one full greedy move
-(O(P·R·B²), steps.go:145-232) timed at the same scale, extrapolated by the
-number of moves the fused TPU session needs to converge.
+(no testing.B benchmarks anywhere in its repo), so the baseline is the
+reference-transcribed CPU greedy solver measured here: single greedy moves
+(O(P*R*B^2), steps.go:145-232) timed at the same scale (median of three,
+min/max band reported), extrapolated by the number of moves a batch=1
+device session needs to fully converge the same follower-only
+neighborhood.
+
+The flagship run adds the reference's own ``-allow-leader`` flag plus the
+pair-swap polish (solvers/polish.py): follower-only rebalancing floors at
+the hottest all-leader broker (~9e-5 at this scale), while leader moves +
+swap polish converge to ~1e-8 — three orders of magnitude below the 1e-5
+north-star target. The greedy extrapolation keeps the reference's cheaper
+default task (follower-only, to its own local optimum), so the reported
+multiplier is conservative.
 
 Prints exactly ONE JSON line on stdout:
-    {"metric": ..., "value": ..., "unit": "s", "vs_baseline": ...}
-where value is the TPU wall-clock to convergence (second run, compile
-cached) and vs_baseline is the speedup over the extrapolated greedy time.
-Diagnostics go to stderr.
+    {"metric": ..., "value": ..., "unit": "s", "vs_baseline": ...,
+     "final_unbalance": ..., "n_moves": ..., "vs_baseline_band": [lo, hi],
+     "engine": ...}
+where value is the flagship wall-clock to convergence (second run, compile
+cached). Diagnostics go to stderr.
 
 Env knobs: BENCH_FAST=1 shrinks the instance for smoke-testing;
-BENCH_PARTITIONS / BENCH_BROKERS override sizes.
+BENCH_PARTITIONS / BENCH_BROKERS / BENCH_BATCH / BENCH_ENGINE override.
 """
 
 from __future__ import annotations
@@ -33,6 +44,7 @@ def main() -> None:
     n_parts = int(os.environ.get("BENCH_PARTITIONS", 1000 if fast else 10_000))
     n_brokers = int(os.environ.get("BENCH_BROKERS", 20 if fast else 100))
 
+    import jax
     import jax.numpy as jnp
 
     from kafkabalancer_tpu.balancer import steps as S
@@ -44,8 +56,6 @@ def main() -> None:
     from kafkabalancer_tpu.models import default_rebalance_config
     from kafkabalancer_tpu.solvers.scan import plan
     from kafkabalancer_tpu.utils.synth import synth_cluster
-
-    import jax
 
     # persistent compilation cache: repeat bench invocations skip the
     # one-time XLA/Mosaic compiles (the reported value is warm either way)
@@ -60,31 +70,42 @@ def main() -> None:
     log(f"devices: {jax.devices()}")
     log(f"instance: {n_parts} partitions x {n_brokers} brokers, rf=3")
 
-    def fresh():
+    def fresh(allow_leader=False):
         pl = synth_cluster(n_parts, n_brokers, rf=3, seed=42, weighted=True)
         cfg = default_rebalance_config()
-        cfg.min_unbalance = 1e-5
+        cfg.min_unbalance = 0.0
+        cfg.allow_leader_rebalancing = allow_leader
         return pl, cfg
 
-    # --- baseline: one reference-transcribed greedy move ------------------
+    # --- baseline: reference-transcribed greedy moves, median of 3 --------
     pl, cfg = fresh()
     S.validate_weights(pl, cfg)
     S.fill_defaults(pl, cfg)
     u0 = get_unbalance_bl(get_bl(get_broker_load(pl)))
     log(f"initial unbalance: {u0:.6f}")
 
-    t0 = time.perf_counter()
-    move = S.greedy_move(pl, cfg, False)
-    t_greedy_move = time.perf_counter() - t0
-    assert move is not None
-    log(f"greedy single move: {t_greedy_move:.2f}s")
+    greedy_times = []
+    for _ in range(1 if fast else 3):
+        t0 = time.perf_counter()
+        move = S.greedy_move(pl, cfg, False)
+        greedy_times.append(time.perf_counter() - t0)
+        assert move is not None
+    greedy_times.sort()
+    t_move = greedy_times[len(greedy_times) // 2]
+    log(
+        f"greedy single move: median {t_move:.2f}s "
+        f"(min {greedy_times[0]:.2f}, max {greedy_times[-1]:.2f}, "
+        f"n={len(greedy_times)})"
+    )
 
     budget = 1 << 19
     batch = int(os.environ.get("BENCH_BATCH", "100"))
+    engine = os.environ.get("BENCH_ENGINE", "pallas")
 
     # --- reference-trajectory move count: a batch=1 session walks the same
-    # one-move-at-a-time trajectory the greedy solver would, so its move
-    # count is the honest multiplier for the greedy extrapolation ----------
+    # one-move-at-a-time trajectory the greedy solver would (follower-only,
+    # the reference's default config), so its converged move count is the
+    # honest multiplier for the greedy extrapolation ----------------------
     n_ref = None
     for attempt in range(2):  # run twice: report the compile-cached run
         pl, cfg = fresh()
@@ -97,40 +118,48 @@ def main() -> None:
             f"unbalance {get_unbalance_bl(get_bl(get_broker_load(pl))):.3e}"
         )
 
-    # --- TPU fused session (batched disjoint commits via the whole-session
-    # Pallas kernel, XLA fallback): run twice, report the cached run ------
-    engine = os.environ.get("BENCH_ENGINE", "pallas")
+    # --- flagship: -allow-leader + batched session + pair-swap polish ----
     t_tpu = n_moves = final_u = None
     for attempt in range(2):
-        pl, cfg = fresh()
+        pl, cfg = fresh(allow_leader=True)
         t0 = time.perf_counter()
         try:
             opl = plan(
-                pl, cfg, budget, dtype=jnp.float32, batch=batch, engine=engine
+                pl, cfg, budget, dtype=jnp.float32, batch=batch,
+                engine=engine, polish=True,
             )
         except Exception as exc:
             if engine == "pallas":
                 log(f"pallas engine failed ({exc!r}); falling back to xla")
                 engine = "xla"
-                pl, cfg = fresh()
+                pl, cfg = fresh(allow_leader=True)
                 t0 = time.perf_counter()
-                opl = plan(pl, cfg, budget, dtype=jnp.float32, batch=batch)
+                opl = plan(
+                    pl, cfg, budget, dtype=jnp.float32, batch=batch,
+                    polish=True,
+                )
             else:
                 raise
         t_tpu = time.perf_counter() - t0
         n_moves = len(opl)
         final_u = get_unbalance_bl(get_bl(get_broker_load(pl)))
         log(
-            f"tpu session (run {attempt}, batch={batch}, engine={engine}): "
-            f"{t_tpu:.3f}s, {n_moves} moves, final unbalance {final_u:.3e}"
+            f"tpu flagship (run {attempt}, allow-leader, batch={batch}, "
+            f"engine={engine}, polish): {t_tpu:.3f}s, {n_moves} moves, "
+            f"final unbalance {final_u:.3e}"
         )
 
-    est_greedy_total = t_greedy_move * max(1, n_ref)
-    speedup = est_greedy_total / t_tpu
+    est_mid = t_move * max(1, n_ref)
+    est_lo = greedy_times[0] * max(1, n_ref)
+    est_hi = greedy_times[-1] * max(1, n_ref)
+    speedup = est_mid / t_tpu
     log(
-        f"extrapolated greedy convergence: {est_greedy_total:.1f}s "
-        f"({t_greedy_move:.2f}s/move x {n_ref} reference-trajectory moves) "
-        f"-> {speedup:.1f}x"
+        f"extrapolated greedy convergence: {est_mid:.1f}s "
+        f"[{est_lo:.1f}, {est_hi:.1f}] ({t_move:.2f}s/move x {n_ref} "
+        f"reference-trajectory moves) -> {speedup:.1f}x "
+        f"[{est_lo / t_tpu:.1f}, {est_hi / t_tpu:.1f}] "
+        f"(conservative: greedy's follower-only task floors at ~9e-5 "
+        f"unbalance; the flagship reaches {final_u:.1e})"
     )
 
     print(
@@ -140,6 +169,12 @@ def main() -> None:
                 "value": round(t_tpu, 4),
                 "unit": "s",
                 "vs_baseline": round(speedup, 2),
+                "final_unbalance": float(f"{final_u:.3e}"),
+                "n_moves": n_moves,
+                "vs_baseline_band": [
+                    round(est_lo / t_tpu, 2),
+                    round(est_hi / t_tpu, 2),
+                ],
                 "engine": engine,
             }
         )

@@ -25,15 +25,20 @@ from kafkabalancer_tpu.models.partition import empty_partition_list
 StepFn = Callable[[PartitionList, RebalanceConfig], Optional[PartitionList]]
 
 # Go-style step names preserved for log/error prefixes (balancer.go:51-52).
-_COMMON_HEAD: List[Tuple[str, StepFn]] = [
+# The validate/repair split is load-bearing: solvers/scan.py runs the
+# validations+defaults unconditionally but prescreens the repair steps.
+_HEAD_VALIDATE: List[Tuple[str, StepFn]] = [
     ("ValidateWeights", _s.validate_weights),
     ("ValidateReplicas", _s.validate_replicas),
     ("FillDefaults", _s.fill_defaults),
+]
+_HEAD_REPAIR: List[Tuple[str, StepFn]] = [
     ("RemoveExtraReplicas", _s.remove_extra_replicas),
     ("AddMissingReplicas", _s.add_missing_replicas),
     ("MoveDisallowedReplicas", _s.move_disallowed_replicas),
     ("ReassignLeaders", _s.reassign_leaders),
 ]
+_COMMON_HEAD: List[Tuple[str, StepFn]] = _HEAD_VALIDATE + _HEAD_REPAIR
 
 
 def _tpu_move_leaders(pl, cfg):

@@ -22,8 +22,12 @@ dynamic broker-table membership), with kernel-friendly re-formulations:
   replica-set membership is never stored — it is derived per tile from
   the replica matrix (the [P, B] matrix would be both the largest
   transfer and the largest VMEM resident);
-- move logs live in ``[max_moves, 1]`` VMEM buffers written with dynamic
-  sublane indexing.
+- move logs live in ``[max_moves/128, 128]`` VMEM buffers (exact (8,128)
+  tiles) written with dynamic-sublane row selection + masked-lane
+  blending; a ``[max_moves, 1]`` layout would tile-pad its lane dimension
+  128-fold and blow the scoped-VMEM budget whenever the outputs stay on
+  device (e.g. embedded in solvers/polish.py ``converge_session``). The
+  replicas output aliases the replicas input for the same reason.
 
 The ``allowed`` mask is int8 in VMEM (the kernel's VMEM budget is tight
 at the 16k-partition bucket); int8 values are widened before any
@@ -122,10 +126,10 @@ def _kernel(
         return _
 
     lax.fori_loop(jnp.int32(0), jnp.int32(P // TILE_P), init_tile, jnp.int32(0))
-    mp_ref[:] = jnp.full((ML, 1), -1, jnp.int32)
-    mslot_ref[:] = jnp.full((ML, 1), -1, jnp.int32)
-    msrc_ref[:] = jnp.full((ML, 1), -1, jnp.int32)
-    mtgt_ref[:] = jnp.full((ML, 1), -1, jnp.int32)
+    mp_ref[:] = jnp.full((ML // 128, 128), -1, jnp.int32)
+    mslot_ref[:] = jnp.full((ML // 128, 128), -1, jnp.int32)
+    msrc_ref[:] = jnp.full((ML // 128, 128), -1, jnp.int32)
+    mtgt_ref[:] = jnp.full((ML // 128, 128), -1, jnp.int32)
 
     budget = budget_ref[0, 0]
     batch = batch_ref[0, 0]
@@ -372,11 +376,21 @@ def _kernel(
                 rrow = replicas_ref[pl.ds(p_i, 1), :]  # [1, R] i32
                 rrow = jnp.where(iota_r == slot_i, i, rrow)
                 replicas_ref[pl.ds(p_i, 1), :] = rrow
-                one = jnp.ones((1, 1), jnp.int32)
-                mp_ref[pl.ds(at, 1), :] = one * p_i
-                mslot_ref[pl.ds(at, 1), :] = one * slot_i
-                msrc_ref[pl.ds(at, 1), :] = one * s_i
-                mtgt_ref[pl.ds(at, 1), :] = one * i
+                # packed log write: dynamic row + masked-lane blend (the
+                # buffers are [ML/128, 128] — see module docstring)
+                at_row = lax.div(at, jnp.int32(128))
+                at_ln = lax.rem(at, jnp.int32(128))
+                lane128 = lax.broadcasted_iota(jnp.int32, (1, 128), 1)
+                hit = lane128 == at_ln
+
+                def logw(ref, val):
+                    row = ref[pl.ds(at_row, 1), :]
+                    ref[pl.ds(at_row, 1), :] = jnp.where(hit, val, row)
+
+                logw(mp_ref, p_i)
+                logw(mslot_ref, slot_i)
+                logw(msrc_ref, s_i)
+                logw(mtgt_ref, i)
 
             return n_acc
 
@@ -430,6 +444,8 @@ def pallas_session(
     B = loads.shape[0]
     if P % TILE_P:
         raise ValueError(f"partition bucket {P} not a multiple of {TILE_P}")
+    if max_moves % 128:
+        raise ValueError(f"max_moves {max_moves} not a multiple of 128")
     ML = max_moves
 
     f32 = jnp.float32
@@ -465,6 +481,7 @@ def pallas_session(
         jnp.asarray(universe_valid, i32).reshape(1, B),
     )
     loads_out, replicas_out, n, mp, mslot, msrc, mtgt = out
+    # packed [ML/128, 128] row-major == flat move order
     return (
         replicas_out,
         loads_out.reshape(B),
@@ -487,13 +504,17 @@ def _call(kernel, P, R, B, ML, smem, vmem, interpret=False):
             jax.ShapeDtypeStruct((1, B), f32),  # loads
             jax.ShapeDtypeStruct((P, R), i32),  # replicas
             jax.ShapeDtypeStruct((1, 1), i32),  # n
-            jax.ShapeDtypeStruct((ML, 1), i32),  # move_p
-            jax.ShapeDtypeStruct((ML, 1), i32),  # move_slot
-            jax.ShapeDtypeStruct((ML, 1), i32),  # move_src
-            jax.ShapeDtypeStruct((ML, 1), i32),  # move_tgt
+            jax.ShapeDtypeStruct((ML // 128, 128), i32),  # move_p
+            jax.ShapeDtypeStruct((ML // 128, 128), i32),  # move_slot
+            jax.ShapeDtypeStruct((ML // 128, 128), i32),  # move_src
+            jax.ShapeDtypeStruct((ML // 128, 128), i32),  # move_tgt
         ),
         in_specs=[smem] * 4 + [vmem] * 10,
         out_specs=(vmem, vmem, smem, vmem, vmem, vmem, vmem),
+        # the replicas output aliases the replicas input (operand 5 of the
+        # flattened inputs): without the alias a second lane-padded [P, R]
+        # VMEM buffer doubles the largest resident
+        input_output_aliases={5: 1},
         scratch_shapes=[
             pltpu.VMEM((1, B), i32),  # bcount
             pltpu.VMEM((P, 1), i32),  # rstar

@@ -1,0 +1,431 @@
+"""Pair-swap polish: escaping single-move local optima on device.
+
+The greedy neighborhood (one replica, one target — steps.go:145-232) stalls
+when every single move overshoots: at 10k x 100 scale the move session
+converges to ~9e-5 unbalance while the north-star target is < 1e-5
+(BASELINE.md). The exit is a *pair swap* — partition p1 moves a replica
+from broker a to broker b while p2 moves one from b to a. The objective
+only sees the net transfer ``d = w1 - w2`` between the two brokers, and
+
+    g(d) = pen(L_a - d) + pen(L_b + d)
+
+is convex in ``d`` (both terms are convex piecewise quadratics of the
+asymmetric penalty, utils.go:134-143), so per broker pair the ideal
+transfer has the closed form
+
+    d* = (c_a (L_a - avg) - c_b (L_b - avg)) / (c_a + c_b)
+
+with the current over/under coefficients, and the best achievable swap
+uses the replica weights whose difference brackets ``d*``.
+
+The search is sort-free and fully fused on device:
+
+- follower replica entries are compacted host-side ONCE, sorted by weight
+  (weights never change during a session) — the static *weight rank*;
+- per iteration, the ``nb`` valid brokers are ranked by load and the
+  hottest half is paired with a rotation of the coldest half (the
+  rotation cycles so different pairings are tried before declaring
+  convergence);
+- per entry held by a hot broker: query ``w1 - d*`` in the static weight
+  order (one ``searchsorted`` against the immutable sorted weights), then
+  map to the nearest entries actually held by the paired cold broker with
+  next/prev occupied-rank tables ([pairs, Nc] cummin/cummax scans — no
+  per-iteration sort);
+- the two bracketing candidates are evaluated EXACTLY (true penalty at
+  the actual ``d``, so coefficient crossings cost nothing), feasibility-
+  masked (allowed/member both directions, eligibility), reduced to the
+  best swap per pair, partition-claimed (pairs are broker-disjoint by
+  construction), and committed batched — every accepted swap improves the
+  objective by exactly its scored delta.
+
+``converge_session`` alternates fused move phases (solvers/scan.py
+``session`` or the whole-session Pallas kernel) with swap phases inside
+one dispatch until neither commits — a single host round trip for the
+whole plan-to-convergence.
+
+This is an extension beyond the reference (its greedy loop cannot express
+compound moves; the upstream README lists "N-way swaps" as planned but
+never built, README.md:94-100); swaps only exchange follower slots, so
+leader premiums (utils.go:96-101) never enter the swap delta.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+from kafkabalancer_tpu.ops.runtime import ensure_x64
+
+ensure_x64()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax import lax  # noqa: E402
+
+from kafkabalancer_tpu.ops import cost  # noqa: E402
+
+# swap-phase convergence: shift rotations tried without progress before
+# declaring the pairing exhausted
+N_SHIFTS = 4
+# adaptive acceptance floor: gains below su * SWAP_REL_EPS are noise-level
+# churn, not progress
+SWAP_REL_EPS = 1e-4
+
+
+def entry_table(
+    dp, min_replicas: int, min_bucket: int = 256
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Static weight-sorted follower-entry table for the swap search.
+
+    Returns ``(ew, ep, er, evalid)`` — weights ascending (+inf padding),
+    partition row, replica slot, validity. Only follower slots (slot >= 1;
+    leader premiums never enter swap deltas) of eligible partitions
+    (steps.go:168-170 min-replicas gate) participate. Weights are
+    immutable during a session, so the table is built once per plan.
+    """
+    from kafkabalancer_tpu.ops.runtime import next_bucket
+
+    P, R = dp.replicas.shape
+    slot = np.arange(R)[None, :]
+    mask = (
+        (slot >= 1)
+        & (slot < dp.nrep_cur[:, None])
+        & dp.pvalid[:, None]
+        & (dp.nrep_tgt >= min_replicas)[:, None]
+    )
+    p_idx, r_idx = np.nonzero(mask)
+    w = dp.weights[p_idx]
+    order = np.argsort(w, kind="stable")
+    n = len(order)
+    Nc = next_bucket(max(n, 1), min_bucket)
+    ew = np.full(Nc, np.inf)
+    ep = np.zeros(Nc, np.int32)
+    er = np.zeros(Nc, np.int32)
+    evalid = np.zeros(Nc, bool)
+    ew[:n] = w[order]
+    ep[:n] = p_idx[order]
+    er[:n] = r_idx[order]
+    evalid[:n] = True
+    return ew, ep, er, evalid
+
+
+def _swap_loop(
+    loads,
+    replicas,
+    member,
+    n,
+    mp,
+    mslot,
+    mtgt,
+    *,
+    ew,
+    ep,
+    er,
+    evalid,
+    allowed,
+    pvalid,
+    always_valid,
+    universe_valid,
+    min_unbalance,
+    budget,
+    ML: int,
+):
+    """Fused pair-swap loop (see module docstring). Mutates the carried
+    state/logs; logs each swap as its two constituent moves. Returns the
+    updated ``(loads, replicas, member, n, mp, mslot, mtgt)``."""
+    P, R = replicas.shape
+    B = loads.shape[0]
+    Nc = ew.shape[0]
+    dtype = loads.dtype
+    nh = B // 2
+    iota_e = jnp.arange(Nc, dtype=jnp.int32)
+    i_pair = jnp.arange(nh, dtype=jnp.int32)
+    BIGI = jnp.int32(Nc + 1)
+
+    def cond(st):
+        n, streak = st[3], st[4]
+        return (streak < N_SHIFTS) & (n + 2 <= budget) & (n + 2 <= ML)
+
+    def body(st):
+        loads, replicas, member, n, streak, it, mp, mslot, mtgt = st
+
+        bcount = jnp.sum(
+            (member & pvalid[:, None]).astype(jnp.int32), axis=0,
+            dtype=jnp.int32,
+        )
+        bvalid = (always_valid | (bcount > 0)) & universe_valid
+        nb = jnp.sum(bvalid.astype(jnp.int32), dtype=jnp.int32)
+        avg = jnp.sum(jnp.where(bvalid, loads, 0.0)) / nb.astype(dtype)
+        F = jnp.where(bvalid, cost.overload_penalty(loads, avg), 0.0)
+        su = jnp.sum(F)
+        eps = jnp.maximum(min_unbalance, su * SWAP_REL_EPS)
+
+        # hottest half paired with a rotation of the coldest half; the
+        # halves are disjoint rank ranges, so pairs are broker-disjoint
+        # by construction (no broker claims needed)
+        _, perm, _ = cost.rank_brokers(loads, bvalid)
+        npair = nb // 2
+        s = it % N_SHIFTS
+        cold_rank = (i_pair + s) % jnp.maximum(npair, 1)
+        hot_rank = nb - 1 - i_pair
+        src_b = perm[jnp.clip(hot_rank, 0, B - 1)]
+        tgt_b = perm[jnp.clip(cold_rank, 0, B - 1)]
+        pair_live = i_pair < npair
+
+        La = loads[src_b]
+        Lb = loads[tgt_b]
+        ca = jnp.where(La > avg, 1.0, 0.5).astype(dtype)
+        cb = jnp.where(Lb > avg, 1.0, 0.5).astype(dtype)
+        dstar = (ca * (La - avg) - cb * (Lb - avg)) / (ca + cb)  # [nh]
+
+        # entry -> its holder's pair (via a trash slot at broker index B)
+        pair_of_src = (
+            jnp.full(B + 1, -1, jnp.int32)
+            .at[jnp.where(pair_live, src_b, B)]
+            .set(jnp.where(pair_live, i_pair, -1))
+        )
+        holder = jnp.where(
+            evalid, replicas[ep, er].astype(jnp.int32), jnp.int32(B)
+        )
+        pe = pair_of_src[holder]  # [Nc] pair index or -1
+        pe_c = jnp.clip(pe, 0)
+        live_e = pe >= 0
+        t_e = tgt_b[pe_c]
+
+        feas1 = live_e & allowed[ep, t_e] & ~member[ep, t_e]
+
+        # nearest cold-broker entries by weight around w1 - d*: one
+        # searchsorted into the STATIC weight order, then next/prev
+        # occupied-rank tables per pair
+        wq = ew - dstar[pe_c]
+        rq = jnp.searchsorted(ew, wq).astype(jnp.int32)  # [Nc]
+        occ = (holder[None, :] == tgt_b[:, None]) & pair_live[:, None]
+        nxt = lax.cummin(
+            jnp.where(occ, iota_e[None, :], BIGI), axis=1, reverse=True
+        )
+        prv = lax.cummax(jnp.where(occ, iota_e[None, :], -1), axis=1)
+        j_above = nxt[pe_c, jnp.clip(rq, 0, Nc - 1)]
+        j_below = prv[pe_c, jnp.clip(rq - 1, 0, Nc - 1)]
+        va = (rq < Nc) & (j_above < BIGI)
+        vb = (rq > 0) & (j_below >= 0)
+
+        def cand_score(j2, ok2):
+            j2c = jnp.clip(j2, 0, Nc - 1)
+            w2 = ew[j2c]
+            p2 = ep[j2c]
+            feas2 = ok2 & allowed[p2, holder % B] & ~member[p2, holder % B]
+            d = ew - w2
+            delta = (
+                cost.overload_penalty(La[pe_c] - d, avg)
+                + cost.overload_penalty(Lb[pe_c] + d, avg)
+                - F[holder % B]
+                - F[t_e]
+            )
+            return jnp.where(feas1 & feas2, delta, jnp.inf), j2c
+
+        sa, ja = cand_score(j_above, va)
+        sb, jb = cand_score(j_below, vb)
+        score = jnp.minimum(sa, sb)
+        jsel = jnp.where(sa <= sb, ja, jb)
+
+        # best entry per pair: scatter-min, then lowest-index winner
+        improving = score < -eps
+        pe_t = jnp.where(improving, pe_c, nh)  # trash pair nh
+        best = jnp.full(nh + 1, jnp.inf, dtype).at[pe_t].min(score)
+        is_win = improving & (score <= best[pe_c])
+        win_e = (
+            jnp.full(nh + 1, BIGI, jnp.int32)
+            .at[jnp.where(is_win, pe_c, nh)]
+            .min(jnp.where(is_win, iota_e, BIGI))
+        )[:nh]
+        ok = (win_e < BIGI) & pair_live  # [nh]
+        e1 = jnp.clip(win_e, 0, Nc - 1)
+        e2 = jsel[e1]
+        p1w, r1w = ep[e1], er[e1]
+        p2w, r2w = ep[e2], er[e2]
+        dw = ew[e1] - ew[e2]
+
+        # partition claims: the same partition may hold replicas in two
+        # different pairs; first claimant (lowest pair index) wins
+        bigp = jnp.int32(nh + 1)
+        prio = jnp.where(ok, i_pair, bigp)
+        first_p = (
+            jnp.full(P + 1, bigp, jnp.int32)
+            .at[jnp.where(ok, p1w, P)]
+            .min(prio)
+            .at[jnp.where(ok, p2w, P)]
+            .min(prio)
+        )
+        ok &= (first_p[p1w] == i_pair) & (first_p[p2w] == i_pair)
+
+        # budget cap (2 log slots per swap)
+        rank = jnp.cumsum(ok.astype(jnp.int32), dtype=jnp.int32) - 1
+        ok &= (n + 2 * rank + 2 <= budget) & (n + 2 * rank + 2 <= ML)
+        oki = ok.astype(jnp.int32)
+        okf = oki.astype(dtype)
+        cnt = jnp.sum(oki, dtype=jnp.int32)
+
+        # apply: pairs are broker-disjoint, partitions claimed — rejected
+        # candidates contribute zero-adds, so scatters cannot race
+        loads = loads.at[src_b].add(-dw * okf).at[tgt_b].add(dw * okf)
+        replicas = (
+            replicas.at[p1w, r1w]
+            .add(((tgt_b - src_b) * oki).astype(replicas.dtype))
+            .at[p2w, r2w]
+            .add(((src_b - tgt_b) * oki).astype(replicas.dtype))
+        )
+        toggles = (
+            jnp.zeros((P, B), jnp.int32)
+            .at[p1w, src_b]
+            .add(oki)
+            .at[p1w, tgt_b]
+            .add(oki)
+            .at[p2w, tgt_b]
+            .add(oki)
+            .at[p2w, src_b]
+            .add(oki)
+        )
+        member = member ^ (toggles > 0)
+
+        pos1 = jnp.where(ok, n + 2 * rank, ML)
+        pos2 = jnp.where(ok, n + 2 * rank + 1, ML)
+        mp = mp.at[pos1].set(jnp.where(ok, p1w, -1)).at[pos2].set(
+            jnp.where(ok, p2w, -1)
+        )
+        mslot = mslot.at[pos1].set(jnp.where(ok, r1w, -1)).at[pos2].set(
+            jnp.where(ok, r2w, -1)
+        )
+        mtgt = mtgt.at[pos1].set(jnp.where(ok, tgt_b, -1)).at[pos2].set(
+            jnp.where(ok, src_b, -1)
+        )
+
+        n = n + 2 * cnt
+        streak = jnp.where(cnt == 0, streak + 1, 0)
+        return loads, replicas, member, n, streak, it + 1, mp, mslot, mtgt
+
+    st = (loads, replicas, member, n, jnp.int32(0), jnp.int32(0), mp, mslot, mtgt)
+    loads, replicas, member, n, _s, _i, mp, mslot, mtgt = lax.while_loop(
+        cond, body, st
+    )
+    return loads, replicas, member, n, mp, mslot, mtgt
+
+
+def _member_from(replicas, nrep_cur, pvalid, B: int):
+    """Recompute the [P, B] membership mask from the replica matrix."""
+    R = replicas.shape[1]
+    slot = jnp.arange(R)[None, :]
+    valid = (slot < nrep_cur[:, None]) & pvalid[:, None]
+    onehot = replicas[:, :, None] == jnp.arange(B, dtype=replicas.dtype)
+    return jnp.any(onehot & valid[:, :, None], axis=1)
+
+
+@partial(
+    jax.jit,
+    static_argnames=("max_moves", "allow_leader", "batch", "engine"),
+)
+def converge_session(
+    loads,
+    replicas,
+    allowed,
+    weights,
+    nrep_cur,
+    nrep_tgt,
+    ncons,
+    pvalid,
+    always_valid,
+    universe_valid,
+    min_replicas,
+    min_unbalance,
+    budget,
+    ew,
+    ep,
+    er,
+    evalid,
+    *,
+    max_moves: int,
+    allow_leader: bool,
+    batch: int,
+    engine: str = "xla",
+):
+    """Move phases and swap phases alternated on device until neither
+    commits — one dispatch for the whole plan-to-convergence.
+
+    With a Pallas engine the whole-session kernel runs ONCE up front (it
+    fully converges the single-move neighborhood; embedding the kernel in
+    the alternation ``while_loop`` would pin its buffers in scoped VMEM
+    and overflow the 16 MB budget at the 16k-partition bucket), then the
+    alternation loop interleaves XLA move phases (solvers/scan.py
+    ``session`` — after a swap phase only a handful of single moves
+    reopen) with swap phases until neither commits. Returns ``packed`` —
+    the int32 concatenation ``[move_p | move_slot | move_tgt | n]`` sized
+    ``3 * (2 * max_moves) + 1`` (one device->host transfer decodes the
+    whole plan).
+    """
+    from kafkabalancer_tpu.solvers.scan import session
+
+    B = loads.shape[0]
+    ML = 2 * max_moves  # phase buffers merge into double-size global logs
+    mp0 = jnp.full(ML + 1, -1, jnp.int32)
+    use_pallas = engine in ("pallas", "pallas-interpret")
+
+    n = jnp.int32(0)
+    mp, mslot, mtgt = mp0, mp0, mp0
+    if use_pallas:
+        from kafkabalancer_tpu.solvers.pallas_session import pallas_session
+
+        replicas, loads, n, pmp, pmslot, _pmsrc, pmtgt = pallas_session(
+            loads, replicas, None, allowed, weights, nrep_cur, nrep_tgt,
+            ncons, pvalid, always_valid, universe_valid, min_replicas,
+            min_unbalance, budget, jnp.int32(max(1, batch)),
+            max_moves=max_moves, allow_leader=allow_leader,
+            interpret=(engine == "pallas-interpret"),
+        )
+        mp = lax.dynamic_update_slice(mp, pmp, (0,))
+        mslot = lax.dynamic_update_slice(mslot, pmslot, (0,))
+        mtgt = lax.dynamic_update_slice(mtgt, pmtgt, (0,))
+
+    def outer_cond(st):
+        n, done = st[3], st[4]
+        return (~done) & (n + 1 <= budget)
+
+    def outer_body(st):
+        loads, replicas, member, n, _done, mp, mslot, mtgt = st
+        n0 = n
+
+        # --- move phase (no-op pass after the pallas pre-phase) ----------
+        replicas, loads, nm, pmp, pmslot, _pmsrc, pmtgt, _su = session(
+            loads, replicas, member, allowed, weights, nrep_cur,
+            nrep_tgt, ncons, pvalid, always_valid, universe_valid,
+            min_replicas, min_unbalance, budget - n,
+            max_moves=max_moves, allow_leader=allow_leader, batch=batch,
+        )
+        # merge the phase logs at offset n; entries past nm are -1 and get
+        # overwritten by the next merge or ignored by the [:n] decode
+        mp = lax.dynamic_update_slice(mp, pmp, (n,))
+        mslot = lax.dynamic_update_slice(mslot, pmslot, (n,))
+        mtgt = lax.dynamic_update_slice(mtgt, pmtgt, (n,))
+        n = n + nm
+        member = _member_from(replicas, nrep_cur, pvalid, B)
+
+        # --- swap phase -------------------------------------------------
+        loads, replicas, member, n, mp, mslot, mtgt = _swap_loop(
+            loads, replicas, member, n, mp, mslot, mtgt,
+            ew=ew, ep=ep, er=er, evalid=evalid, allowed=allowed,
+            pvalid=pvalid, always_valid=always_valid,
+            universe_valid=universe_valid, min_unbalance=min_unbalance,
+            budget=budget, ML=ML,
+        )
+
+        return loads, replicas, member, n, n == n0, mp, mslot, mtgt
+
+    member = _member_from(replicas, nrep_cur, pvalid, B)
+    # with a non-pallas engine the first move phase runs inside the loop
+    # (swap phase on an unconverged state commits little and is cheap)
+    st = (loads, replicas, member, n, jnp.bool_(False), mp, mslot, mtgt)
+    loads, replicas, member, n, _done, mp, mslot, mtgt = lax.while_loop(
+        outer_cond, outer_body, st
+    )
+    return jnp.concatenate(
+        [mp[:ML], mslot[:ML], mtgt[:ML], n.astype(jnp.int32).reshape(1)]
+    )

@@ -313,6 +313,23 @@ def _cfg_broker_mask(dp, cfg: RebalanceConfig) -> "np.ndarray":
     return mask
 
 
+def _decode_packed(packed: "np.ndarray", dp, opl: PartitionList) -> int:
+    """Replay a packed ``[move_p | move_slot | move_tgt | n]`` move log
+    onto the live partitions, appending each to ``opl`` in move order
+    (the CLI main-loop output contract, kafkabalancer.go:177-221).
+    Returns the move count."""
+    n = int(packed[-1])
+    ml = (packed.shape[0] - 1) // 3
+    mp = packed[:n]
+    mslot = packed[ml : ml + n]
+    mtgt = packed[2 * ml : 2 * ml + n]
+    for i in range(n):
+        part = dp.partitions[int(mp[i])]
+        part.replicas[int(mslot[i])] = int(dp.broker_ids[int(mtgt[i])])
+        opl.append(part)
+    return n
+
+
 def _repairs_possible(pl: PartitionList, cfg: RebalanceConfig) -> bool:
     """Cheap O(P·R) prescreen: can any repair step (remove-extra,
     add-missing, move-disallowed — steps.go:70-143) fire at all?
@@ -323,9 +340,6 @@ def _repairs_possible(pl: PartitionList, cfg: RebalanceConfig) -> bool:
     most partitions share one brokers-list *object*, so the allowed-set
     check caches by identity exactly like ``tensorize`` does.
     """
-    observed = set()
-    for p in pl.iter_partitions():
-        observed.update(p.replicas)
     full_ok: dict = {}
     for p in pl.iter_partitions():
         if p.num_replicas != len(p.replicas):
@@ -349,7 +363,9 @@ def _settle_head(
 
     # validations + defaults always run once (exact error behavior);
     # the repair loop is skipped entirely when no repair can fire
-    for _name, step in _COMMON_HEAD[:3]:
+    from kafkabalancer_tpu.balancer.pipeline import _HEAD_VALIDATE
+
+    for _name, step in _HEAD_VALIDATE:
         step(pl, cfg)
     if not cfg.rebalance_leaders and not _repairs_possible(pl, cfg):
         return [], budget
@@ -377,6 +393,7 @@ def plan(
     batch: int = 1,
     chunk_moves: int = 8192,
     engine: str = "xla",
+    polish: bool = False,
 ) -> PartitionList:
     """Full multi-move planning session: host-side repairs, then a fused
     on-device move loop. The output accumulates live partitions in move
@@ -393,6 +410,12 @@ def plan(
     identical results to the XLA batch path at a fraction of the wall
     clock. ``engine="pallas-interpret"`` uses the Pallas interpreter (CPU
     testing).
+
+    ``polish=True`` alternates the move session with fused pair-swap
+    phases on device (solvers/polish.py) — compound two-move exchanges
+    escape the single-move local optimum the reference's greedy
+    neighborhood cannot (its upstream lists N-way swaps as planned but
+    never built, README.md:94-100).
     """
     if engine not in ENGINES:
         raise ValueError(f"unknown engine {engine!r}")
@@ -464,12 +487,53 @@ def plan(
             jnp.asarray(cfg.min_unbalance, dtype),
             jnp.int32(chunk),
         )
+        if polish:
+            from kafkabalancer_tpu.solvers.polish import (
+                converge_session,
+                entry_table,
+            )
+
+            ew, ep_, er_, evalid = entry_table(
+                dp, cfg.min_replicas_for_rebalancing
+            )
+            # drop only the member slot (index 2 — recomputed on device);
+            # the trailing chunk scalar stays and binds converge_session's
+            # ``budget`` parameter
+            sargs = args[:2] + args[3:]
+            try:
+                packed = np.asarray(
+                    converge_session(
+                        *sargs,
+                        jnp.asarray(ew, dtype),
+                        jnp.asarray(ep_),
+                        jnp.asarray(er_),
+                        jnp.asarray(evalid),
+                        max_moves=next_bucket(chunk, 128),
+                        allow_leader=cfg.allow_leader_rebalancing,
+                        batch=max(1, batch),
+                        engine=engine,
+                    )
+                )
+            except BalanceError:
+                raise
+            except Exception as exc:
+                if engine in ("pallas", "pallas-interpret"):
+                    raise BalanceError(
+                        f"pallas engine failed ({exc!r}); use engine='xla' "
+                        f"or 'pallas-interpret'"
+                    ) from exc
+                raise
+            n = _decode_packed(packed, dp, opl)
+            remaining -= n
+            if n < chunk:
+                break
+            continue
         if use_pallas:
             try:
                 _replicas, _loads, n, mp, mslot, _msrc, mtgt = pallas_session(
                     *args,
                     jnp.int32(max(1, batch)),
-                    max_moves=next_bucket(chunk, 64),
+                    max_moves=next_bucket(chunk, 128),
                     allow_leader=cfg.allow_leader_rebalancing,
                     interpret=(engine == "pallas-interpret"),
                 )
@@ -485,7 +549,7 @@ def plan(
         else:
             _replicas, _loads, n, mp, mslot, _msrc, mtgt, _su = session(
                 *args,
-                max_moves=next_bucket(chunk, 64),
+                max_moves=next_bucket(chunk, 128),
                 allow_leader=cfg.allow_leader_rebalancing,
                 batch=batch,
             )
@@ -498,15 +562,7 @@ def plan(
                 [mp, mslot, mtgt, n.astype(jnp.int32).reshape(1)]
             )
         )
-        n = int(packed[-1])
-        ml = (packed.shape[0] - 1) // 3
-        mp, mslot, mtgt = (
-            packed[:n], packed[ml : ml + n], packed[2 * ml : 2 * ml + n]
-        )
-        for i in range(n):
-            part = dp.partitions[int(mp[i])]
-            part.replicas[int(mslot[i])] = int(dp.broker_ids[int(mtgt[i])])
-            opl.append(part)
+        n = _decode_packed(packed, dp, opl)
         remaining -= n
         if n < chunk:
             break

@@ -1,0 +1,147 @@
+"""Pair-swap polish (solvers/polish.py): quality, invariants, budget.
+
+The swap neighborhood is an extension beyond the reference (upstream lists
+N-way swaps as planned but never built, README.md:94-100), so there is no
+oracle to match; these tests pin the safety invariants (valid replica
+sets, budget, monotone improvement) and the quality gain over the
+single-move session on instances where the local optimum is strict.
+"""
+
+import jax.numpy as jnp
+import pytest
+
+from kafkabalancer_tpu.balancer.costmodel import (
+    get_bl,
+    get_broker_load,
+    get_unbalance_bl,
+)
+from kafkabalancer_tpu.models import default_rebalance_config
+from kafkabalancer_tpu.solvers.polish import entry_table
+from kafkabalancer_tpu.solvers.scan import plan
+from kafkabalancer_tpu.utils.synth import synth_cluster
+
+
+def u_of(pl):
+    return get_unbalance_bl(get_bl(get_broker_load(pl)))
+
+
+def fresh(n_parts=200, n_brokers=12, seed=7):
+    pl = synth_cluster(n_parts, n_brokers, rf=3, seed=seed, weighted=True)
+    cfg = default_rebalance_config()
+    cfg.min_unbalance = 0.0
+    return pl, cfg
+
+
+@pytest.mark.parametrize("engine", ["xla", "pallas-interpret"])
+def test_polish_beats_single_move_optimum(engine):
+    pl_plain, cfg = fresh()
+    plan(pl_plain, cfg, 100_000, batch=8, engine="xla", polish=False)
+    u_plain = u_of(pl_plain)
+
+    pl, cfg = fresh()
+    plan(pl, cfg, 100_000, batch=8, engine=engine, polish=True)
+    u_pol = u_of(pl)
+
+    # the 200x12 instance has a strict single-move local optimum; swaps
+    # must escape it (observed ~6x; assert a conservative margin)
+    assert u_pol < u_plain
+    assert u_pol < u_plain * 0.8
+
+
+def test_polish_preserves_replica_set_validity():
+    pl, cfg = fresh(seed=11)
+    before = {
+        (p.topic, p.partition): len(p.replicas) for p in pl.iter_partitions()
+    }
+    plan(pl, cfg, 100_000, batch=8, engine="xla", polish=True)
+    for p in pl.iter_partitions():
+        # no duplicate brokers within a partition (ValidateReplicas
+        # invariant, steps.go:27-36)
+        assert len(set(p.replicas)) == len(p.replicas), p
+        # swaps/moves never change replica counts
+        assert len(p.replicas) == before[(p.topic, p.partition)]
+        # every replica stays on an allowed broker
+        assert set(p.replicas).issubset(set(p.brokers))
+
+
+def test_polish_move_log_replays_to_final_state():
+    pl, cfg = fresh(seed=13)
+    initial = {
+        (p.topic, p.partition): list(p.replicas) for p in pl.iter_partitions()
+    }
+    opl = plan(pl, cfg, 100_000, batch=8, engine="xla", polish=True)
+    # opl entries alias the live partitions (CLI main-loop contract,
+    # kafkabalancer.go:177-221): every emitted entry reflects the final
+    # assignment of its partition
+    for entry in opl.partitions:
+        key = (entry.topic, entry.partition)
+        live = next(
+            p
+            for p in pl.iter_partitions()
+            if (p.topic, p.partition) == key
+        )
+        assert entry.replicas == live.replicas
+    # something actually changed relative to the initial assignment
+    assert any(
+        list(p.replicas) != initial[(p.topic, p.partition)]
+        for p in pl.iter_partitions()
+    )
+
+
+def test_polish_respects_budget():
+    pl, cfg = fresh(seed=17)
+    opl = plan(pl, cfg, 7, batch=4, engine="xla", polish=True)
+    assert len(opl) <= 7
+
+    pl, cfg = fresh(seed=17)
+    opl = plan(pl, cfg, 0, batch=4, engine="xla", polish=True)
+    assert len(opl) == 0
+
+
+def test_polish_with_allow_leader_reaches_deep_balance():
+    # follower-only balancing floors at the hottest all-leader broker;
+    # with leader moves the polished state should be orders of magnitude
+    # below the single-move optimum
+    pl_plain, cfg = fresh(400, 16, seed=23)
+    cfg.allow_leader_rebalancing = True
+    plan(pl_plain, cfg, 100_000, batch=8, engine="xla", polish=False)
+    u_plain = u_of(pl_plain)
+
+    pl, cfg = fresh(400, 16, seed=23)
+    cfg.allow_leader_rebalancing = True
+    plan(pl, cfg, 100_000, batch=8, engine="xla", polish=True)
+    assert u_of(pl) < u_plain
+
+
+def test_polish_min_unbalance_gates_swaps():
+    # a large threshold suppresses the swap tail entirely: polish output
+    # must match the plain session's
+    pl_a, cfg = fresh(seed=29)
+    cfg.min_unbalance = 10.0
+    opl_a = plan(pl_a, cfg, 100_000, batch=8, engine="xla", polish=False)
+
+    pl_b, cfg = fresh(seed=29)
+    cfg.min_unbalance = 10.0
+    opl_b = plan(pl_b, cfg, 100_000, batch=8, engine="xla", polish=True)
+    assert len(opl_a) == len(opl_b) == 0
+
+
+def test_entry_table_static_structure():
+    from kafkabalancer_tpu.balancer import steps as S
+    from kafkabalancer_tpu.ops import tensorize
+
+    pl, cfg = fresh(50, 8, seed=31)
+    S.validate_weights(pl, cfg)
+    S.fill_defaults(pl, cfg)
+    dp = tensorize(pl, cfg)
+    ew, ep, er, evalid = entry_table(dp, min_replicas=2)
+    n = int(evalid.sum())
+    # weights ascending over the valid prefix, +inf padding after
+    assert (ew[: n - 1] <= ew[1:n]).all()
+    assert (ew[n:] == float("inf")).all()
+    # follower slots only, within each partition's replica count
+    assert (er[:n] >= 1).all()
+    for i in range(n):
+        assert er[i] < dp.nrep_cur[ep[i]]
+    # min-replicas gate (steps.go:168-170)
+    assert (dp.nrep_tgt[ep[:n]] >= 2).all()
