@@ -65,6 +65,7 @@ def _kernel(
     batch_ref,
     minrep_ref,
     minunb_ref,
+    churn_ref,
     # arrays (VMEM)
     loads0_ref,
     replicas0_ref,
@@ -135,6 +136,7 @@ def _kernel(
     batch = batch_ref[0, 0]
     min_repl = minrep_ref[0, 0]
     min_unb = minunb_ref[0, 0]
+    churn = churn_ref[0, 0]
 
     lane_b = lax.broadcasted_iota(jnp.int32, (1, B), 1)  # [1, B]
     iota_r = lax.broadcasted_iota(jnp.int32, (1, R), 1)  # [1, R]
@@ -288,7 +290,7 @@ def _kernel(
         # ---- improvement + churn gate -----------------------------------
         improving = (vals < su - min_unb) & (vals < su) & (bestv[0, :] < BIG * 0.5)
         best_gain = su - jnp.min(vals)
-        improving &= (su - vals) * 4.0 >= best_gain
+        improving &= (su - vals) * churn >= best_gain
 
         # ---- pairwise first-claimant disjointness [B, B] ----------------
         # row j = earlier candidate, col i = later; t_j == j, t_i == i.
@@ -426,6 +428,7 @@ def pallas_session(
     min_unbalance,
     budget,
     batch,
+    churn_gate=1.5,  # see scan.DEFAULT_CHURN_GATE
     *,
     max_moves: int,
     allow_leader: bool,
@@ -469,6 +472,7 @@ def pallas_session(
         scalar(batch, i32),
         scalar(min_replicas, i32),
         scalar(min_unbalance, f32),
+        scalar(churn_gate, f32),
         jnp.asarray(loads, f32).reshape(1, B),
         jnp.asarray(replicas, i32),
         jnp.asarray(allowed, i8).reshape(P, B),
@@ -509,12 +513,12 @@ def _call(kernel, P, R, B, ML, smem, vmem, interpret=False):
             jax.ShapeDtypeStruct((ML // 128, 128), i32),  # move_src
             jax.ShapeDtypeStruct((ML // 128, 128), i32),  # move_tgt
         ),
-        in_specs=[smem] * 4 + [vmem] * 10,
+        in_specs=[smem] * 5 + [vmem] * 10,
         out_specs=(vmem, vmem, smem, vmem, vmem, vmem, vmem),
-        # the replicas output aliases the replicas input (operand 5 of the
+        # the replicas output aliases the replicas input (operand 6 of the
         # flattened inputs): without the alias a second lane-padded [P, R]
         # VMEM buffer doubles the largest resident
-        input_output_aliases={5: 1},
+        input_output_aliases={6: 1},
         scratch_shapes=[
             pltpu.VMEM((1, B), i32),  # bcount
             pltpu.VMEM((P, 1), i32),  # rstar

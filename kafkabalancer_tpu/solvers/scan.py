@@ -58,6 +58,12 @@ from kafkabalancer_tpu.balancer.steps import BalanceError  # noqa: E402
 from kafkabalancer_tpu.ops import cost, tensorize  # noqa: E402
 from kafkabalancer_tpu.ops.runtime import next_bucket  # noqa: E402
 
+# batched-commit churn gate default: only commit moves whose gain is within
+# this factor of the iteration's best. Swept at the 10k x 100 scale
+# (mu=1e-5): 4.0 -> +26% commits vs the batch=1 trajectory; 1.5 -> +0.14%
+# commits at BETTER final unbalance and equal wall-clock.
+DEFAULT_CHURN_GATE = 1.5
+
 
 @partial(
     jax.jit,
@@ -78,6 +84,7 @@ def session(
     min_replicas,
     min_unbalance,
     budget,
+    churn_gate=DEFAULT_CHURN_GATE,
     *,
     max_moves: int,
     allow_leader: bool,
@@ -181,14 +188,14 @@ def session(
         s_ = replicas[p, slot].astype(jnp.int32)
 
         improving = jnp.isfinite(vals) & (vals < su - min_unbalance) & (vals < su)
-        # churn gate: only commit targets whose improvement is within 4x of
-        # this iteration's best. Without it the per-target matching floods
-        # marginal moves that later iterations re-move, inflating the
-        # emitted plan (= real Kafka data movement) ~2.5x for the same
-        # final unbalance. The best candidate always passes, so the
-        # convergence criterion is unchanged.
+        # churn gate: only commit targets whose improvement is within
+        # ``churn_gate``x of this iteration's best. Without it the
+        # per-target matching floods marginal moves that later iterations
+        # re-move, inflating the emitted plan (= real Kafka data movement)
+        # ~2.5x for the same final unbalance. The best candidate always
+        # passes, so the convergence criterion is unchanged.
         best_gain = su - jnp.min(vals)
-        improving &= (su - vals) * 4.0 >= best_gain
+        improving &= (su - vals) * churn_gate >= best_gain
 
         # disjointness via first-claimant scatter-min, priority = target
         # index: each committed move must own its partition and both its
@@ -394,6 +401,7 @@ def plan(
     chunk_moves: int = 8192,
     engine: str = "xla",
     polish: bool = False,
+    churn_gate: float = DEFAULT_CHURN_GATE,
 ) -> PartitionList:
     """Full multi-move planning session: host-side repairs, then a fused
     on-device move loop. The output accumulates live partitions in move
@@ -533,6 +541,7 @@ def plan(
                 _replicas, _loads, n, mp, mslot, _msrc, mtgt = pallas_session(
                     *args,
                     jnp.int32(max(1, batch)),
+                    jnp.asarray(churn_gate, jnp.float32),
                     max_moves=next_bucket(chunk, 128),
                     allow_leader=cfg.allow_leader_rebalancing,
                     interpret=(engine == "pallas-interpret"),
@@ -549,6 +558,7 @@ def plan(
         else:
             _replicas, _loads, n, mp, mslot, _msrc, mtgt, _su = session(
                 *args,
+                jnp.asarray(churn_gate, dtype),
                 max_moves=next_bucket(chunk, 128),
                 allow_leader=cfg.allow_leader_rebalancing,
                 batch=batch,
