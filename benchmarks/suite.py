@@ -282,12 +282,45 @@ def config5_sweep():
     )
 
 
+def config6_rebalance_leader():
+    """-rebalance-leader at the north-star scale: the fused device Balance
+    loop (solvers/leader.py — leader redistribution interleaved with
+    greedy moves, exact step precedence) vs the host per-move pipeline."""
+    import jax.numpy as jnp
+
+    from kafkabalancer_tpu.solvers.scan import plan
+
+    n_parts = 1000 if FAST else 10_000
+    n_brokers = 20 if FAST else 100
+    cfg = default_rebalance_config()  # min_unbalance = 0.01 (reference)
+    cfg.rebalance_leaders = True
+
+    def fresh():
+        return synth_cluster(n_parts, n_brokers, rf=3, seed=42, weighted=True)
+
+    budget = 1024
+    # the host pipeline pays O(P) per leader move and O(P*R*B^2) per
+    # greedy move; cap its measurement so the suite stays bounded
+    host_cap = 16 if FAST else 64
+    pl_g = fresh()
+    tg, n_g = timed(greedy_converge, pl_g, copy.deepcopy(cfg), host_cap)
+    plan(fresh(), copy.deepcopy(cfg), budget, dtype=jnp.float32)  # warm
+    pl_t = fresh()
+    tt, opl = timed(plan, pl_t, copy.deepcopy(cfg), budget, dtype=jnp.float32)
+    row(
+        f"6: rebalance-leader {n_parts // 1000}k/{n_brokers}", tg,
+        unbalance_of(pl_g), tt, unbalance_of(pl_t),
+        f"{n_g} (capped) vs {len(opl)} moves",
+    )
+
+
 def main():
     import jax
 
     print(f"devices: {jax.devices()}", file=sys.stderr)
     for fn in (config1_single_move, config2_text_input,
-               config3_weighted_leader, config4_beam_quality, config5_sweep):
+               config3_weighted_leader, config4_beam_quality, config5_sweep,
+               config6_rebalance_leader):
         fn()
 
     w = max(len(r[0]) for r in ROWS) + 2

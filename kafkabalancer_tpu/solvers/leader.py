@@ -1,0 +1,231 @@
+"""Fused ``-rebalance-leader`` session: the full Balance loop on device.
+
+With ``rebalance_leaders`` set, every reference ``Balance()`` call tries
+``distributeLeaders`` FIRST (steps.go:301-307 -> 234-282) and only falls
+through to the Move steps when it does not fire; the CLI loop repeats
+this per reassignment (kafkabalancer.go:177-221). Round 1 ran that loop
+host-side per move — minutes at 10k-partition scale. This module fuses
+the whole loop into one ``lax.while_loop``: each device iteration
+replays one ``Balance()`` call with exact step precedence:
+
+1. **distributeLeaders** (steps.go:234-282): gate on TOTAL unbalance >=
+   ``min_unbalance`` (steps.go:249-253 — a threshold on the state, not
+   on the gain); take the most-loaded broker (ascending (load, ID)
+   table, so ties resolve to the highest ID, utils.go:14-28), find the
+   first partition IN LIST ORDER it leads with ``num_replicas >=
+   min_replicas_for_rebalancing`` (steps.go:258-266), and hand its
+   leadership to the least-loaded broker. If the target is already a
+   follower the slots are exchanged in place — a leadership transfer
+   with no data movement (``replacepl`` swap branch, utils.go:181-188)
+   — logged with ``move_slot == -1`` (see :data:`SWAP_SLOT`); otherwise
+   slot 0 is overwritten, moving the full leader load
+   ``weight * (replicas + consumers)`` (utils.go:96-101).
+2. **MoveLeaders / MoveNonLeaders** (steps.go:286-298): when the leader
+   step does not fire, one greedy move exactly like
+   ``scan.session``'s batch=1 body: leader candidates first when
+   ``allow_leader`` (scored with the reference's plain follower weight,
+   steps.go:185/:207), follower candidates otherwise; accept iff the
+   best improves by more than ``min_unbalance``.
+
+The session ends when neither step fires or the budget is exhausted —
+identical to the CLI loop hitting "no candidate changes".
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+from kafkabalancer_tpu.ops.runtime import ensure_x64
+
+ensure_x64()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+
+from kafkabalancer_tpu.ops import cost  # noqa: E402
+
+# move_slot sentinel: leadership handed to a broker already in the replica
+# set — decode as an in-place position swap, not a slot overwrite
+SWAP_SLOT = -2
+
+
+@partial(jax.jit, static_argnames=("max_moves", "allow_leader"))
+def leader_session(
+    loads,
+    replicas,
+    member,
+    allowed,
+    weights,
+    nrep_cur,
+    nrep_tgt,
+    ncons,
+    pvalid,
+    always_valid,
+    universe_valid,
+    min_replicas,
+    min_unbalance,
+    budget,
+    *,
+    max_moves: int,
+    allow_leader: bool,
+):
+    """Fused rebalance-leaders Balance loop (see module docstring).
+
+    Returns ``(replicas, loads, n, move_p, move_slot, move_tgt)``; log
+    entries with ``move_slot == SWAP_SLOT`` are leadership swaps toward
+    ``move_tgt`` (decode: exchange the positions of ``move_tgt`` and the
+    current leader), all others are plain slot overwrites.
+    """
+    P, R = replicas.shape
+    B = loads.shape[0]
+    dtype = loads.dtype
+    iota_p = jnp.arange(P, dtype=jnp.int32)
+    iota_r = jnp.arange(R, dtype=jnp.int32)
+    slot_iota = iota_r[None, :]
+
+    mp0 = jnp.full(max_moves + 1, -1, jnp.int32)
+
+    bcount0 = jnp.sum(
+        (member & pvalid[:, None]).astype(jnp.int32), axis=0, dtype=jnp.int32
+    )
+
+    def cond(st):
+        n, done = st[4], st[5]
+        return (~done) & (n < budget) & (n < max_moves)
+
+    def body(st):
+        loads, replicas, member, bcount, n, _done, mp, mslot, mtgt = st
+        bvalid = (always_valid | (bcount > 0)) & universe_valid
+        nb = jnp.sum(bvalid, dtype=jnp.int32)
+        nbf = nb.astype(dtype)
+        su = cost.unbalance(loads, bvalid, nbf)
+        _, perm, rank_of = cost.rank_brokers(loads, bvalid)
+        heavy = perm[jnp.clip(nb - 1, 0, B - 1)]
+        light = perm[0]
+
+        lead_mask = (
+            (replicas[:, 0].astype(jnp.int32) == heavy)
+            & pvalid
+            & (nrep_tgt >= min_replicas)
+            & (nrep_cur >= 1)
+        )
+        leader_fire = (su >= min_unbalance) & jnp.any(lead_mask)
+
+        def leader_branch(args):
+            loads, replicas, member, bcount, mp, mslot, mtgt = args
+            p = jnp.min(jnp.where(lead_mask, iota_p, P))
+            p = jnp.clip(p, 0, P - 1)
+            w = weights[p]
+            full = w * (nrep_cur[p].astype(dtype) + ncons[p])  # leader load
+            extra = full - w  # leader premium over a follower
+
+            eqj = (replicas[p, :].astype(jnp.int32) == light) & (
+                iota_r < nrep_cur[p]
+            )
+            has = jnp.any(eqj)
+            j = jnp.argmax(eqj).astype(jnp.int32)
+
+            # swap branch: positions exchange, membership unchanged, only
+            # the premium moves; set branch: slot 0 overwritten, the full
+            # leader load moves and membership updates
+            old_leader = replicas[p, 0].astype(jnp.int32)  # == heavy
+            new_row = jnp.where(
+                iota_r == 0,
+                light,
+                jnp.where(has & (iota_r == j), old_leader, replicas[p, :]),
+            ).astype(replicas.dtype)
+            replicas = replicas.at[p, :].set(new_row)
+            delta = jnp.where(has, extra, full)
+            loads = loads.at[old_leader].add(-delta).at[light].add(delta)
+            member = member.at[p, old_leader].set(
+                jnp.where(has, member[p, old_leader], False)
+            ).at[p, light].set(True)
+            one = jnp.where(has, 0, 1).astype(jnp.int32)
+            bcount = bcount.at[old_leader].add(-one).at[light].add(one)
+
+            mp = mp.at[n].set(p)
+            mslot = mslot.at[n].set(jnp.where(has, SWAP_SLOT, 0))
+            mtgt = mtgt.at[n].set(light)
+            return loads, replicas, member, bcount, mp, mslot, mtgt, True
+
+        def move_branch(args):
+            loads, replicas, member, bcount, mp, mslot, mtgt = args
+            # one greedy move, batch=1 parity semantics (mirror of
+            # scan.session's non-batch body; the [P, R, B] scoring core is
+            # shared via ops/cost.py)
+            u, su2 = cost.move_candidate_scores(
+                loads, replicas, allowed[:, perm], member[:, perm], bvalid,
+                bvalid[perm], perm, rank_of, weights, nrep_cur, nrep_tgt,
+                pvalid, nbf, min_replicas,
+            )
+
+            def best(mask_slots):
+                flat = jnp.where(
+                    mask_slots[None, :, None], u, jnp.inf
+                ).reshape(-1)
+                i = jnp.argmin(flat)
+                return flat[i], i
+
+            fol_u, fol_i = best(slot_iota[0] >= 1)
+            if allow_leader:
+                lead_u, lead_i = best(slot_iota[0] == 0)
+                accept_lead = (lead_u < su2 - min_unbalance) & (lead_u < su2)
+            else:
+                lead_i = jnp.zeros_like(fol_i)
+                accept_lead = jnp.bool_(False)
+            accept_fol = (fol_u < su2 - min_unbalance) & (fol_u < su2)
+            accept = accept_lead | accept_fol
+            chosen = jnp.where(accept_lead, lead_i, fol_i)
+
+            p, rem = jnp.divmod(chosen, R * B)
+            slot, t_rank = jnp.divmod(rem, B)
+            t_dense = perm[t_rank]
+            s_dense = replicas[p, slot]
+            delta = jnp.where(
+                slot == 0,
+                weights[p] * (nrep_cur[p].astype(dtype) + ncons[p]),
+                weights[p],
+            )
+
+            def apply(a):
+                loads, replicas, member, bcount, mp, mslot, mtgt = a
+                loads = loads.at[s_dense].add(-delta).at[t_dense].add(delta)
+                replicas = replicas.at[p, slot].set(
+                    t_dense.astype(replicas.dtype)
+                )
+                member = member.at[p, s_dense].set(False).at[
+                    p, t_dense
+                ].set(True)
+                bcount = bcount.at[s_dense].add(-1).at[t_dense].add(1)
+                mp = mp.at[n].set(p.astype(jnp.int32))
+                mslot = mslot.at[n].set(slot.astype(jnp.int32))
+                mtgt = mtgt.at[n].set(t_dense.astype(jnp.int32))
+                return loads, replicas, member, bcount, mp, mslot, mtgt
+
+            loads, replicas, member, bcount, mp, mslot, mtgt = lax.cond(
+                accept, apply, lambda a: a,
+                (loads, replicas, member, bcount, mp, mslot, mtgt),
+            )
+            return loads, replicas, member, bcount, mp, mslot, mtgt, accept
+
+        loads, replicas, member, bcount, mp, mslot, mtgt, fired = lax.cond(
+            leader_fire,
+            leader_branch,
+            move_branch,
+            (loads, replicas, member, bcount, mp, mslot, mtgt),
+        )
+        n = n + fired.astype(n.dtype)
+        return loads, replicas, member, bcount, n, ~fired, mp, mslot, mtgt
+
+    st = (
+        loads, replicas, member, bcount0, jnp.int32(0), jnp.bool_(False),
+        mp0, mp0, mp0,
+    )
+    loads, replicas, member, _bc, n, _done, mp, mslot, mtgt = lax.while_loop(
+        cond, body, st
+    )
+    return (
+        replicas, loads, n,
+        mp[:max_moves], mslot[:max_moves], mtgt[:max_moves],
+    )

@@ -324,7 +324,13 @@ def _decode_packed(packed: "np.ndarray", dp, opl: PartitionList) -> int:
     """Replay a packed ``[move_p | move_slot | move_tgt | n]`` move log
     onto the live partitions, appending each to ``opl`` in move order
     (the CLI main-loop output contract, kafkabalancer.go:177-221).
-    Returns the move count."""
+
+    A slot of ``leader.SWAP_SLOT`` is a leadership exchange (``replacepl``
+    swap branch, utils.go:181-188): the target broker — already a
+    follower — trades positions with the leader. Returns the move count.
+    """
+    from kafkabalancer_tpu.solvers.leader import SWAP_SLOT
+
     n = int(packed[-1])
     ml = (packed.shape[0] - 1) // 3
     mp = packed[:n]
@@ -332,7 +338,14 @@ def _decode_packed(packed: "np.ndarray", dp, opl: PartitionList) -> int:
     mtgt = packed[2 * ml : 2 * ml + n]
     for i in range(n):
         part = dp.partitions[int(mp[i])]
-        part.replicas[int(mslot[i])] = int(dp.broker_ids[int(mtgt[i])])
+        slot = int(mslot[i])
+        tgt = int(dp.broker_ids[int(mtgt[i])])
+        if slot == SWAP_SLOT:
+            j = part.replicas.index(tgt)
+            part.replicas[j] = part.replicas[0]
+            part.replicas[0] = tgt
+        else:
+            part.replicas[slot] = tgt
         opl.append(part)
     return n
 
@@ -361,26 +374,41 @@ def _repairs_possible(pl: PartitionList, cfg: RebalanceConfig) -> bool:
 
 
 def _settle_head(
-    pl: PartitionList, cfg: RebalanceConfig, budget: int
+    pl: PartitionList,
+    cfg: RebalanceConfig,
+    budget: int,
+    include_reassign_leaders: bool = True,
 ) -> Tuple[List[Partition], int]:
     """Run the pipeline head (validations, defaults, repairs) until no step
     fires, applying each repair like the CLI loop does. Returns the applied
-    live partitions (each counts against the reassignment budget)."""
+    live partitions (each counts against the reassignment budget).
+
+    ``include_reassign_leaders=False`` settles only the repair steps that
+    precede ``ReassignLeaders`` in the pipeline order — used by the fused
+    leader session (solvers/leader.py), which replays the leader step on
+    device. Repairs strictly precede it (balancer.go:34-44), so settling
+    them first preserves the reference's step precedence exactly.
+    """
+    from kafkabalancer_tpu.balancer.pipeline import _HEAD_VALIDATE
     from kafkabalancer_tpu.cli import apply_assignment
 
     # validations + defaults always run once (exact error behavior);
     # the repair loop is skipped entirely when no repair can fire
-    from kafkabalancer_tpu.balancer.pipeline import _HEAD_VALIDATE
-
     for _name, step in _HEAD_VALIDATE:
         step(pl, cfg)
-    if not cfg.rebalance_leaders and not _repairs_possible(pl, cfg):
+    leaders_live = include_reassign_leaders and cfg.rebalance_leaders
+    if not leaders_live and not _repairs_possible(pl, cfg):
         return [], budget
 
+    head = (
+        _COMMON_HEAD
+        if include_reassign_leaders
+        else [s for s in _COMMON_HEAD if s[0] != "ReassignLeaders"]
+    )
     out: List[Partition] = []
     while budget > 0:
         fired = None
-        for _name, step in _COMMON_HEAD:
+        for _name, step in head:
             fired = step(pl, cfg)
             if fired is not None:
                 break
@@ -390,6 +418,68 @@ def _settle_head(
             out.append(apply_assignment(pl, changed))
         budget -= 1
     return out, budget
+
+
+def _leader_plan(
+    pl: PartitionList,
+    cfg: RebalanceConfig,
+    max_reassign: int,
+    dtype,
+    chunk_moves: int,
+    opl: PartitionList,
+) -> PartitionList:
+    """Fused ``rebalance_leaders`` planning: host repairs (strictly before
+    ReassignLeaders in the pipeline order), then the device Balance loop
+    of solvers/leader.py, chunked and decoded like the move sessions."""
+    from kafkabalancer_tpu.solvers.leader import leader_session
+
+    repaired, budget = _settle_head(
+        pl, cfg, max_reassign, include_reassign_leaders=False
+    )
+    opl.append(*repaired)
+    if dtype is None:
+        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    chunk_moves = max(1, min(chunk_moves, 1 << 20))
+
+    remaining = budget
+    while remaining > 0:
+        dp = tensorize(pl, cfg)
+        loads = cost.broker_loads(
+            jnp.asarray(dp.replicas),
+            jnp.asarray(dp.weights, dtype),
+            jnp.asarray(dp.nrep_cur),
+            jnp.asarray(dp.ncons, dtype),
+            dp.bvalid.shape[0],
+        )
+        chunk = min(remaining, chunk_moves)
+        _replicas, _loads, n, mp, mslot, mtgt = leader_session(
+            loads,
+            jnp.asarray(dp.replicas),
+            jnp.asarray(dp.member),
+            jnp.asarray(dp.allowed),
+            jnp.asarray(dp.weights, dtype),
+            jnp.asarray(dp.nrep_cur),
+            jnp.asarray(dp.nrep_tgt),
+            jnp.asarray(dp.ncons, dtype),
+            jnp.asarray(dp.pvalid),
+            jnp.asarray(_cfg_broker_mask(dp, cfg)),
+            jnp.asarray(dp.bvalid),
+            jnp.int32(cfg.min_replicas_for_rebalancing),
+            jnp.asarray(cfg.min_unbalance, dtype),
+            jnp.int32(chunk),
+            max_moves=next_bucket(chunk, 128),
+            allow_leader=cfg.allow_leader_rebalancing,
+        )
+        packed = np.asarray(
+            jnp.concatenate(
+                [mp, mslot, mtgt, n.astype(jnp.int32).reshape(1)]
+            )
+        )
+        n = _decode_packed(packed, dp, opl)
+        remaining -= n
+        if n < chunk:
+            break
+    return opl
 
 
 def plan(
@@ -409,8 +499,10 @@ def plan(
     final assignment, kafkabalancer.go:177-221 + SURVEY.md §2.2); ``pl`` is
     mutated in place like the reference's aliasing does.
 
-    Falls back to the host per-move pipeline when ``rebalance_leaders`` is
-    set (see module docstring).
+    With ``rebalance_leaders`` set, the whole Balance loop (leader
+    redistribution interleaved with greedy moves, exact step precedence)
+    runs as one fused device session (solvers/leader.py) — round 1 ran it
+    host-side per move, minutes at 10k-partition scale.
 
     ``engine="pallas"`` runs chunks through the whole-session Pallas kernel
     (solvers/pallas_session.py): float32 only, always the pooled batched
@@ -432,18 +524,7 @@ def plan(
         return opl
 
     if cfg.rebalance_leaders:
-        from kafkabalancer_tpu.balancer.pipeline import balance
-        from kafkabalancer_tpu.cli import apply_assignment
-
-        budget = max_reassign
-        while budget > 0:
-            ppl = balance(pl, cfg)
-            if len(ppl) == 0:
-                break
-            for changed in ppl.partitions:
-                opl.append(apply_assignment(pl, changed))
-            budget -= 1
-        return opl
+        return _leader_plan(pl, cfg, max_reassign, dtype, chunk_moves, opl)
 
     repaired, budget = _settle_head(pl, cfg, max_reassign)
     opl.append(*repaired)

@@ -186,3 +186,51 @@ def test_batched_move_inflation_bounded():
         # comparable quality: the batched run converges at least as deep
         # up to a small tolerance (different local optima are legal)
         assert ub <= max(u1 * 2.5, u1 + 2e-5), (seed, u1, ub)
+
+
+@pytest.mark.parametrize("seed", [601, 602, 603, 604])
+@pytest.mark.parametrize("allow_leader", [False, True])
+def test_leader_session_parity(seed, allow_leader):
+    """The fused rebalance-leaders session (solvers/leader.py) replays the
+    host Balance loop move for move: leader redistribution first each
+    iteration (total-unbalance gate, heaviest broker's first eligible led
+    partition -> lightest broker, swap-on-conflict, steps.go:234-282),
+    greedy moves otherwise."""
+    rng = random.Random(seed)
+    pl = random_partition_list(rng, 16, 5, weighted=True)
+    cfg = default_rebalance_config()
+    cfg.rebalance_leaders = True
+    cfg.allow_leader_rebalancing = allow_leader
+    cfg.min_unbalance = 1e-6
+    pl_g, pl_s = copy.deepcopy(pl), copy.deepcopy(pl)
+    moved_g = greedy_session(pl_g, copy.deepcopy(cfg), 24)
+    opl = plan(pl_s, copy.deepcopy(cfg), 24)
+    moved_s = [(p.topic, p.partition) for p in (opl.partitions or [])]
+    assert moved_s == moved_g
+    assert pl_s == pl_g
+
+
+def test_leader_session_swap_branch():
+    """Leadership handed to a broker already in the replica set must swap
+    positions in place (replacepl swap branch, utils.go:181-188), not
+    duplicate the broker."""
+    from test_balancer import P, wrap
+
+    # broker 1 leads everything (heavy); broker 2 follows everywhere
+    # (light) -> redistribution must swap leadership in place
+    pl = wrap(
+        [
+            P("t", 0, [1, 2], weight=5.0),
+            P("t", 1, [1, 2], weight=1.0),
+            P("t", 2, [1, 3], weight=1.0),
+        ]
+    )
+    cfg = default_rebalance_config()
+    cfg.rebalance_leaders = True
+    cfg.min_unbalance = 1e-9
+    pl_g, pl_s = copy.deepcopy(pl), copy.deepcopy(pl)
+    greedy_session(pl_g, copy.deepcopy(cfg), 4)
+    plan(pl_s, copy.deepcopy(cfg), 4)
+    assert pl_s == pl_g
+    for p in pl_s.iter_partitions():
+        assert len(set(p.replicas)) == len(p.replicas)
