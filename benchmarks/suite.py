@@ -272,9 +272,10 @@ def config5_sweep():
 
     # warm with the real scenario count and budget (static shapes) so the
     # timed run hits the compile cache
-    sweep(pl, cfg, scenarios, max_reassign=2000, dtype=jnp.float32, batch=12)
+    sweep(pl, cfg, scenarios, max_reassign=2000, dtype=jnp.float32, batch=12,
+          engine="pallas")
     tt, results = timed(sweep, pl, cfg, scenarios, max_reassign=2000,
-                        dtype=jnp.float32, batch=12)
+                        dtype=jnp.float32, batch=12, engine="pallas")
     best_sweep = min(r.unbalance for r in results if r.feasible and r.completed)
     row(
         f"5: what-if sweep {len(scenarios)} scenarios", tg, best_seq, tt,

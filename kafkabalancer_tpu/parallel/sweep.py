@@ -133,9 +133,12 @@ def _scenario_body(
     replicas, member, allowed_base, has_explicit, scenario_mask, weights,
     nrep_cur, nrep_tgt, ncons, pvalid, universe_valid, min_replicas,
     min_unbalance, budget, *, max_moves: int, max_evac: int,
-    allow_leader: bool, batch: int,
+    allow_leader: bool, batch: int, engine: str = "xla",
 ):
-    """One scenario end-to-end on device: evacuation + move session."""
+    """One scenario end-to-end on device: evacuation + move session
+    (``engine`` selects the XLA while_loop or the whole-session Pallas
+    kernel — the kernel cuts per-iteration launch overhead ~5x on the
+    remote-attached TPU, see solvers/pallas_session.py)."""
     allowed_s = jnp.where(has_explicit[:, None], allowed_base, scenario_mask[None, :])
 
     replicas, member, n_evac, feasible = _evacuate(
@@ -153,20 +156,51 @@ def _scenario_body(
 
     loads = cost.broker_loads(replicas, weights, nrep_cur, ncons,
                               universe_valid.shape[0])
+    always_valid = scenario_mask & universe_valid
     # evacuations consumed part of the reassignment budget (reference CLI
     # loop semantics: each repair is one -max-reassign iteration)
-    replicas, _loads, n_moves, _mp, _mslot, _msrc, _mtgt, su = session(
-        loads, replicas, member, allowed_s, weights, nrep_cur, nrep_tgt,
-        ncons, pvalid, scenario_mask & universe_valid, universe_valid,
-        min_replicas, min_unbalance, budget - n_evac,
-        max_moves=max_moves, allow_leader=allow_leader, batch=batch,
-    )
+    if engine in ("pallas", "pallas-interpret"):
+        from kafkabalancer_tpu.solvers.pallas_session import pallas_session
+
+        replicas, loads_f, n_moves, _mp, _mslot, _msrc, _mtgt = (
+            pallas_session(
+                loads, replicas, None, allowed_s, weights, nrep_cur,
+                nrep_tgt, ncons, pvalid, always_valid, universe_valid,
+                min_replicas, min_unbalance, budget - n_evac,
+                jnp.int32(max(1, batch)),
+                max_moves=max_moves, allow_leader=allow_leader,
+                interpret=(engine == "pallas-interpret"),
+            )
+        )
+        # the kernel returns no objective; recompute over the final
+        # broker table (observed ∪ scenario zero-fill, steps.go:150-155)
+        member_f = jnp.any(
+            (replicas[:, :, None] == jnp.arange(
+                universe_valid.shape[0], dtype=replicas.dtype
+            ))
+            & ((slot < nrep_cur[:, None]) & pvalid[:, None])[:, :, None],
+            axis=1,
+        )
+        observed = jnp.any(member_f & pvalid[:, None], axis=0)
+        bvalid = (always_valid | observed) & universe_valid
+        su = cost.unbalance(
+            loads_f, bvalid, jnp.sum(bvalid).astype(loads_f.dtype)
+        )
+    else:
+        replicas, _loads, n_moves, _mp, _mslot, _msrc, _mtgt, su = session(
+            loads, replicas, member, allowed_s, weights, nrep_cur, nrep_tgt,
+            ncons, pvalid, always_valid, universe_valid,
+            min_replicas, min_unbalance, budget - n_evac,
+            max_moves=max_moves, allow_leader=allow_leader, batch=batch,
+        )
     return replicas, feasible, completed, n_evac, n_moves, su
 
 
 @partial(
     jax.jit,
-    static_argnames=("mesh", "max_moves", "max_evac", "allow_leader", "batch"),
+    static_argnames=(
+        "mesh", "max_moves", "max_evac", "allow_leader", "batch", "engine",
+    ),
 )
 def _sweep_exec(
     scenario_mask,
@@ -189,6 +223,7 @@ def _sweep_exec(
     max_evac: int,
     allow_leader: bool,
     batch: int,
+    engine: str = "xla",
 ):
     """Module-level jitted sweep executor: repeat sweeps with the same shape
     buckets and mesh reuse one compiled executable (a per-call shard_map
@@ -213,15 +248,31 @@ def _sweep_exec(
                 nrep_cur, nrep_tgt, ncons, pvalid, universe_valid,
                 min_replicas, min_unbalance, budget,
                 max_moves=max_moves, max_evac=max_evac,
-                allow_leader=allow_leader, batch=batch,
+                allow_leader=allow_leader, batch=batch, engine=engine,
             )
 
         return lax.map(one, mask_shard)
 
-    return run(
+    out = run(
         scenario_mask, replicas, member, allowed, has_explicit, weights,
         nrep_cur, nrep_tgt, ncons, pvalid, universe_valid, min_replicas,
         min_unbalance, budget,
+    )
+    replicas_s, feasible_s, completed_s, n_evac_s, n_moves_s, su_s = out
+    # pack every output into ONE int32 array (f32 objective bitcast): on a
+    # remote-attached TPU each separate device->host fetch pays a full
+    # relay round trip (~0.1 s), which dominated the warm sweep wall-clock
+    return jnp.concatenate(
+        [
+            replicas_s.astype(jnp.int32).reshape(-1),
+            feasible_s.astype(jnp.int32),
+            completed_s.astype(jnp.int32),
+            n_evac_s.astype(jnp.int32),
+            n_moves_s.astype(jnp.int32),
+            # objective packed at its native precision (1 int32 word for
+            # f32, 2 for f64 — the CPU parity tests compare f64 exactly)
+            lax.bitcast_convert_type(su_s, jnp.int32).reshape(-1),
+        ]
     )
 
 
@@ -233,6 +284,7 @@ def sweep(
     mesh: Optional[Mesh] = None,
     dtype=None,
     batch: int = 1,
+    engine: str = "xla",
 ) -> List[SweepResult]:
     """Evaluate ``scenarios`` (broker-ID sets) in parallel; see module
     docstring. ``pl`` is not mutated. The scenario axis shards over
@@ -242,7 +294,11 @@ def sweep(
     disjoint-commit throughput mode (see ``solvers.scan.session``): faster
     convergence per scenario, but trajectories (and thus per-scenario
     ``n_moves``) no longer match the ``batch=1`` pipeline-parity mode —
-    final unbalance remains comparable for scenario ranking."""
+    final unbalance remains comparable for scenario ranking.
+
+    ``engine="pallas"`` routes each scenario's move session through the
+    whole-session Pallas kernel (float32, batched selection) —
+    ``"pallas-interpret"`` for CPU testing."""
     if cfg.rebalance_leaders:
         raise _s.BalanceError(
             "sweep does not support rebalance_leaders (forced leadership "
@@ -286,8 +342,15 @@ def sweep(
                 f"first"
             )
 
+    use_pallas = engine in ("pallas", "pallas-interpret")
+    if use_pallas:
+        from kafkabalancer_tpu.solvers.pallas_session import TILE_P
+
     extra = sorted({int(b) for sc in scenarios for b in sc})
-    dp = tensorize(pl, cfg, extra_brokers=extra)
+    dp = tensorize(
+        pl, cfg, extra_brokers=extra,
+        min_bucket=TILE_P if use_pallas else 8,
+    )
     B = dp.bvalid.shape[0]
 
     S = len(scenarios)
@@ -299,34 +362,42 @@ def sweep(
 
     if dtype is None:
         dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    if use_pallas:
+        dtype = jnp.float32  # the kernel is float32-only
 
     has_explicit = np.asarray(has_explicit_l + [False] * (dp.pvalid.shape[0] - dp.np_))
     max_evac = int(dp.replicas.shape[0] * dp.replicas.shape[1])
-    max_moves = next_bucket(min(max_reassign, 1 << 20), 64)
+    max_moves = next_bucket(min(max_reassign, 1 << 20), 128)
 
-    exec_out = _sweep_exec(
-        jnp.asarray(scenario_mask),
-        jnp.asarray(dp.replicas), jnp.asarray(dp.member),
-        jnp.asarray(dp.allowed), jnp.asarray(has_explicit),
-        jnp.asarray(dp.weights, dtype), jnp.asarray(dp.nrep_cur),
-        jnp.asarray(dp.nrep_tgt), jnp.asarray(dp.ncons, dtype),
-        jnp.asarray(dp.pvalid), jnp.asarray(dp.bvalid),
-        jnp.int32(cfg.min_replicas_for_rebalancing),
-        jnp.asarray(cfg.min_unbalance, dtype),
-        jnp.int32(min(max_reassign, 2**31 - 1)),
-        mesh=mesh,
-        max_moves=max_moves,
-        max_evac=max_evac,
-        allow_leader=cfg.allow_leader_rebalancing,
-        batch=max(1, batch),
+    packed = np.asarray(
+        _sweep_exec(
+            jnp.asarray(scenario_mask),
+            jnp.asarray(dp.replicas), jnp.asarray(dp.member),
+            jnp.asarray(dp.allowed), jnp.asarray(has_explicit),
+            jnp.asarray(dp.weights, dtype), jnp.asarray(dp.nrep_cur),
+            jnp.asarray(dp.nrep_tgt), jnp.asarray(dp.ncons, dtype),
+            jnp.asarray(dp.pvalid), jnp.asarray(dp.bvalid),
+            jnp.int32(cfg.min_replicas_for_rebalancing),
+            jnp.asarray(cfg.min_unbalance, dtype),
+            jnp.int32(min(max_reassign, 2**31 - 1)),
+            mesh=mesh,
+            max_moves=max_moves,
+            max_evac=max_evac,
+            allow_leader=cfg.allow_leader_rebalancing,
+            batch=max(1, batch),
+            engine=engine,
+        )
     )
-    replicas_s, feasible_s, completed_s, n_evac_s, n_moves_s, su_s = exec_out
+    P_pad, R_pad = dp.replicas.shape
+    nrep = S_pad * P_pad * R_pad
+    replicas_s = packed[:nrep].reshape(S_pad, P_pad, R_pad)
+    scalars = packed[nrep : nrep + 4 * S_pad].reshape(4, S_pad)
+    feasible_s, completed_s, n_evac_s, n_moves_s = scalars
+    su_s = np.ascontiguousarray(packed[nrep + 4 * S_pad :]).view(
+        np.dtype(dtype)
+    )
 
     out: List[SweepResult] = []
-    replicas_s, feasible_s, completed_s, n_evac_s, n_moves_s, su_s = (
-        np.asarray(x)
-        for x in (replicas_s, feasible_s, completed_s, n_evac_s, n_moves_s, su_s)
-    )
     for i, sc in enumerate(scenarios):
         out.append(
             SweepResult(

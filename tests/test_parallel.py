@@ -288,3 +288,32 @@ def test_distributed_helper_surface():
 
     assert callable(initialize)
     assert is_multi_host() is False
+
+
+def test_sweep_pallas_engine_matches_xla():
+    """The pallas-engine scenario bodies reach the same per-scenario
+    quality as the XLA session (interpreter on CPU; float32, batched
+    selection — trajectories may differ, final unbalance must agree to
+    f32 noise)."""
+    rng = random.Random(1600)
+    pl = random_partition_list(rng, 14, 5, weighted=True, max_rf=3)
+    observed = sorted({b for p in pl.partitions for b in p.replicas})
+    cfg = default_rebalance_config()
+    scenarios = [
+        observed,
+        observed + [max(observed) + 1],
+        observed[1:],
+    ]
+    res_x = sweep(pl, cfg, scenarios, max_reassign=200, batch=4)
+    res_p = sweep(
+        pl, cfg, scenarios, max_reassign=200, batch=4,
+        engine="pallas-interpret",
+    )
+    for rx, rp in zip(res_x, res_p):
+        assert rx.feasible == rp.feasible
+        assert rx.completed == rp.completed
+        assert rx.n_evacuations == rp.n_evacuations
+        if rx.feasible:
+            assert rp.unbalance == pytest.approx(
+                rx.unbalance, rel=1e-4, abs=1e-6
+            )
