@@ -165,12 +165,20 @@ def run(i, o, e, args: List[str]) -> int:
             "Beam solver: penalty weight for same-topic replicas sharing a "
             "broker (0 disables)",
         )
+        f_beam_siblings = f.bool(
+            "beam-siblings", defaults.beam_siblings,
+            "Beam solver: also expand the second-best candidate per target "
+            "broker (wider plateau coverage, ~10% slower searches)",
+        )
         f_fused = f.bool(
             "fused",
             False,
             "Run the whole -max-reassign session as one fused device loop "
-            "(implies the tpu backend; trades per-move logging for "
-            "throughput; complete-partition still applies at budget "
+            "(implies the tpu backend, overriding -solver; trades per-move "
+            "logging for throughput; with the default -fused-batch>1 the "
+            "plan trajectory differs from the per-move pipeline at equal "
+            "quality — use -fused-batch=1 for the pipeline-parity "
+            "trajectory; complete-partition still applies at budget "
             "exhaustion)",
         )
         f_batch = f.int(
@@ -277,6 +285,7 @@ def run(i, o, e, args: List[str]) -> int:
             solver=f_solver.value,
             beam_width=f_beam_width.value,
             beam_depth=f_beam_depth.value,
+            beam_siblings=f_beam_siblings.value,
             anti_colocation=f_anti_coloc.value,
         )
 
@@ -299,6 +308,11 @@ def run(i, o, e, args: List[str]) -> int:
             # (solvers/scan.py) instead of the per-move host loop; consumes
             # the budget so the loop below is skipped and the shared output
             # tail applies unchanged
+            if f_solver.value != defaults.solver:
+                log(
+                    f"-fused implies the tpu session backend; ignoring "
+                    f"-solver={f_solver.value}"
+                )
             if f_engine.value not in ENGINES:
                 log(f"unknown fused engine {f_engine.value!r}")
                 usage()

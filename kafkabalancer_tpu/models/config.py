@@ -35,6 +35,7 @@ class RebalanceConfig:
     solver: str = "greedy"  # greedy | tpu | beam
     beam_width: int = 8  # beam solver: states kept per depth
     beam_depth: int = 4  # beam solver: lookahead moves per search
+    beam_siblings: bool = False  # beam: also expand 2nd-best per target
     # same-topic anti-colocation penalty weight (0 = off, reference parity);
     # adds λ·Σ_broker,topic max(0, replicas_of_topic_on_broker − 1) to the
     # objective — the upstream's planned-but-never-built extension

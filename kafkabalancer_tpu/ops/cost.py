@@ -186,8 +186,13 @@ def factored_target_best(
     allow_leader: bool,
     colo_sub=None,
     colo_add=None,
+    exclude_p=None,
 ):
     """Best candidate per TARGET broker via the factorized rank-1 objective.
+
+    ``exclude_p [B]`` (optional) bars one partition row per target — used
+    by the beam solver's sibling expansion to fetch the SECOND-best
+    candidate per target (the best one's partition is excluded).
 
     The move objective factorizes as ``u = su + A[source] + C[target]``
     (move_candidate_scores docstring), so per-target minimization needs
@@ -219,6 +224,10 @@ def factored_target_best(
     slot_iota = jnp.arange(R)[None, :]
     eligible = pvalid[:, None] & (nrep_tgt >= min_replicas)[:, None]
     tmask = allowed & ~member & bvalid[None, :]
+    if exclude_p is not None:
+        tmask = tmask & (
+            jnp.arange(P, dtype=jnp.int32)[:, None] != exclude_p[None, :]
+        )
     t = jnp.arange(B, dtype=jnp.int32)
 
     # follower pass (slots >= 1, delta = w)

@@ -6,8 +6,16 @@
 - :mod:`kafkabalancer_tpu.solvers.scan` — multi-move sessions fused
   on-device with ``lax.while_loop`` (replaces the host-side
   ``-max-reassign`` outer loop, kafkabalancer.go:177-221).
-- :mod:`kafkabalancer_tpu.solvers.beam` (planned, not yet shipped) — N-way
-  beam search over move sequences (the upstream's planned-but-never-built
-  feature, README.md:94-100). Until it lands, ``-solver=beam`` runs the
-  tpu backend.
+- :mod:`kafkabalancer_tpu.solvers.beam` — receding-horizon N-way beam
+  search over move sequences with the same-topic anti-colocation
+  objective (the upstream's planned-but-never-built feature,
+  README.md:94-100); ``-solver=beam`` with ``-beam-width``/``-beam-depth``
+  /``-beam-siblings``/``-anti-colocation`` knobs.
+- :mod:`kafkabalancer_tpu.solvers.leader` — the fused ``-rebalance-leader``
+  Balance loop (leader redistribution interleaved with greedy moves,
+  steps.go:234-282 precedence).
+- :mod:`kafkabalancer_tpu.solvers.pallas_session` — the whole-session TPU
+  kernel behind ``-fused-engine=pallas``.
+- :mod:`kafkabalancer_tpu.solvers.polish` — fused pair-swap polish
+  (compound exchanges past the single-move local optimum).
 """

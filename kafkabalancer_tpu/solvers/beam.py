@@ -67,6 +67,7 @@ def _scan_factory(
     allowed, weights, nrep_cur, nrep_tgt, ncons, pvalid, always_valid,
     universe_valid, topic_id, min_replicas, lam, dtype, P, R, B,
     *, width: int, depth: int, allow_leader: bool, n_topics: int,
+    siblings: bool = False,
 ):
     """Build the depth-scan ``run(loads, replicas, member, depth_cap)``
     shared by :func:`beam_search` (one search) and :func:`beam_session`
@@ -125,6 +126,25 @@ def _scan_factory(
             allow_leader=allow_leader,
             colo_sub=colo_sub, colo_add=colo_add,
         )
+        if siblings:
+            # sibling expansion: the SECOND-best candidate per target (the
+            # best one's partition excluded) joins the frontier — on
+            # plateaus the per-target-best restriction loses compound
+            # sequences whose later moves need a different source for the
+            # same cold target (VERDICT r1 weak #9)
+            _su2, vals2, p2, slot2 = cost.factored_target_best(
+                loads, replicas, allowed, member, bvalid, weights,
+                nrep_cur, nrep_tgt, ncons, pvalid, nb, min_replicas,
+                allow_leader=allow_leader,
+                colo_sub=colo_sub, colo_add=colo_add, exclude_p=p,
+            )
+            vals = jnp.stack([vals, vals2])  # [C=2, B]
+            p = jnp.stack([p, p2])
+            slot = jnp.stack([slot, slot2])
+        else:
+            vals = vals[None, :]  # [C=1, B]
+            p = p[None, :]
+            slot = slot[None, :]
         vals = jnp.where(alive, vals + colo_now, jnp.inf)
         return vals, p, slot
 
@@ -154,17 +174,20 @@ def _scan_factory(
 
             vals, cp, cslot = jax.vmap(expand)(
                 loads_b, replicas_b, member_b, alive
-            )  # each [W, B]
+            )  # each [W, C, B] (C = 2 with sibling expansion)
 
-            flat_vals = vals.reshape(-1)  # [W*B]
+            C = vals.shape[1]
+            flat_vals = vals.reshape(-1)  # [W*C*B]
             neg, pick = lax.top_k(-flat_vals, W)
             new_u = -neg  # [W]
-            parent = (pick // B).astype(jnp.int32)
-            child = pick % B  # the target broker index
+            parent = (pick // (C * B)).astype(jnp.int32)
+            rem = pick % (C * B)
+            which = (rem // B).astype(jnp.int32)
+            child = rem % B  # the target broker index
 
             ok = jnp.isfinite(new_u)
-            p_sel = jnp.where(ok, cp[parent, child], -1)
-            slot_sel = jnp.where(ok, cslot[parent, child], 0)
+            p_sel = jnp.where(ok, cp[parent, which, child], -1)
+            slot_sel = jnp.where(ok, cslot[parent, which, child], 0)
             t_sel = jnp.where(ok, child.astype(jnp.int32), 0)
 
             def build(i):
@@ -232,7 +255,10 @@ def _scan_factory(
     return run
 
 
-@partial(jax.jit, static_argnames=("width", "depth", "allow_leader", "n_topics"))
+@partial(
+    jax.jit,
+    static_argnames=("width", "depth", "allow_leader", "n_topics", "siblings"),
+)
 def beam_search(
     loads,
     replicas,
@@ -253,6 +279,7 @@ def beam_search(
     depth: int,
     allow_leader: bool,
     n_topics: int,
+    siblings: bool = False,
 ):
     """One beam search from a single start state.
 
@@ -266,14 +293,16 @@ def beam_search(
         allowed, weights, nrep_cur, nrep_tgt, ncons, pvalid, always_valid,
         universe_valid, topic_id, min_replicas, lam, loads.dtype, P, R, B,
         width=width, depth=depth, allow_leader=allow_leader,
-        n_topics=n_topics,
+        n_topics=n_topics, siblings=siblings,
     )
     out = run(loads, replicas, member, jnp.int32(depth))
     return out[:8]
 
 @partial(
     jax.jit,
-    static_argnames=("width", "depth", "allow_leader", "n_topics", "max_moves"),
+    static_argnames=(
+        "width", "depth", "allow_leader", "n_topics", "max_moves", "siblings",
+    ),
 )
 def beam_session(
     loads,
@@ -298,14 +327,16 @@ def beam_session(
     allow_leader: bool,
     n_topics: int,
     max_moves: int,
+    siblings: bool = False,
 ):
     """Device-fused receding-horizon beam planning: rounds of depth-``depth``
     beam search, each adopting the winning sequence's state, inside one
     ``while_loop`` — one dispatch for the whole plan (per-search host round
     trips dominate wall-clock on remote-attached TPUs).
 
-    Returns ``(replicas, loads, n, move_p, move_slot, move_tgt)`` with the
-    accepted moves logged in order (dense indices, -1 past ``n``). The
+    Returns the packed int32 concatenation ``[move_p | move_slot |
+    move_tgt | n]`` with the accepted moves logged in order (dense
+    indices, -1 past ``n``) — one array, one device->host transfer. The
     depth cap per round is ``min(depth, budget - n)``, so a sequence never
     overruns the budget (a truncated prefix could end on an uphill move).
     """
@@ -316,7 +347,7 @@ def beam_session(
         allowed, weights, nrep_cur, nrep_tgt, ncons, pvalid, always_valid,
         universe_valid, topic_id, min_replicas, lam, loads.dtype, P, R, B,
         width=width, depth=depth, allow_leader=allow_leader,
-        n_topics=n_topics,
+        n_topics=n_topics, siblings=siblings,
     )
 
     mp0 = jnp.full(ML, -1, jnp.int32)
@@ -368,7 +399,11 @@ def beam_session(
     loads, replicas, member, n, _done, mp, mslot, mtgt = lax.while_loop(
         cond, body, state
     )
-    return replicas, loads, n, mp, mslot, mtgt
+    # one packed int32 output: each separate device->host fetch pays a
+    # full relay round trip on a remote-attached TPU (see scan.plan)
+    return jnp.concatenate(
+        [mp, mslot, mtgt, n.astype(jnp.int32).reshape(1)]
+    )
 
 
 def _reconstruct(best_beam, best_depth, parents, mp, mslot, mtgt):
@@ -430,6 +465,7 @@ def _search_once(pl: PartitionList, cfg: RebalanceConfig, depth: int,
         depth=max(1, depth),
         allow_leader=cfg.allow_leader_rebalancing,
         n_topics=n_topics,
+        siblings=bool(getattr(cfg, "beam_siblings", False)),
     )
     su0, best_u = float(su0), float(best_u)
     if not (best_u < su0 - cfg.min_unbalance and best_u < su0):
@@ -472,7 +508,7 @@ def _beam_round(pl, cfg, opl, budget, dtype):
     dp, dtype, loads, lam, n_topics = _device_setup(pl, cfg, dtype)
     ML = next_bucket(min(budget, 1 << 16), 64)
 
-    replicas_out, _loads, n, mp, mslot, mtgt = beam_session(
+    packed = np.asarray(beam_session(
         loads,
         jnp.asarray(dp.replicas),
         jnp.asarray(dp.member),
@@ -494,15 +530,12 @@ def _beam_round(pl, cfg, opl, budget, dtype):
         allow_leader=cfg.allow_leader_rebalancing,
         n_topics=n_topics,
         max_moves=ML,
-    )
+        siblings=bool(getattr(cfg, "beam_siblings", False)),
+    ))
 
-    n = int(n)
-    mp, mslot, mtgt = (np.asarray(x)[:n] for x in (mp, mslot, mtgt))
-    for i in range(n):
-        part = dp.partitions[int(mp[i])]
-        part.replicas[int(mslot[i])] = int(dp.broker_ids[int(mtgt[i])])
-        opl.append(part)
-    return n
+    from kafkabalancer_tpu.solvers.scan import _decode_packed
+
+    return _decode_packed(packed, dp, opl)
 
 
 def beam_move(
