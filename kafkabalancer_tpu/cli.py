@@ -29,6 +29,7 @@ sessions is *not* reproduced; repairs here update state cleanly.)
 from __future__ import annotations
 
 import sys
+import time
 from typing import List, Optional
 
 from kafkabalancer_tpu.balancer import BalanceError, balance
@@ -215,6 +216,7 @@ def run(i, o, e, args: List[str]) -> int:
             import cProfile
 
             profiler = cProfile.Profile()
+            prof_t0 = time.perf_counter_ns()
             profiler.enable()
 
         if f_help.value:
@@ -415,8 +417,15 @@ def run(i, o, e, args: List[str]) -> int:
                 pass
         if profiler is not None:
             profiler.disable()
+            # pprof-format output like the reference's pkg/profile
+            # (kafkabalancer.go:100-102): go tool pprof cpu.pprof works
+            from kafkabalancer_tpu.utils.pprof import write_pprof
+
             try:
-                profiler.dump_stats("cpu.pprof")
+                write_pprof(
+                    profiler, "cpu.pprof",
+                    duration_ns=time.perf_counter_ns() - prof_t0,
+                )
             except OSError:
                 pass
         be.close()

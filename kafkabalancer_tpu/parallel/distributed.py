@@ -14,6 +14,12 @@ fleet — the mesh is the only contract.
 Host-side orchestration (codecs, CLI, repairs) stays single-process on
 process 0; results decode on process 0 via fully-replicated outputs, which
 is exactly how the single-chip paths already behave.
+
+Exercised for real by tests/test_distributed.py: two worker processes
+join one runtime through :func:`initialize`, build a global mesh with
+``make_mesh``, and run the partition-sharded scorer over a mesh spanning
+both processes — the ``all_gather`` combine rides the cross-process
+transport and matches the single-process result exactly.
 """
 
 from __future__ import annotations

@@ -1,0 +1,87 @@
+"""Worker process for the 2-process jax.distributed test.
+
+Usage: python distributed_worker.py <process_id> <coordinator_port>
+
+Each worker joins a 2-process CPU runtime (2 virtual XLA devices per
+process -> 4 global devices), builds a global (sweep, part) mesh with the
+framework's make_mesh, and runs the partition-sharded candidate scorer
+over a mesh that SPANS BOTH PROCESSES — the all_gather combine rides the
+cross-process transport. The result is checked against the unsharded
+single-process scorer on the same (deterministic) instance.
+
+Must be launched with JAX_PLATFORMS=cpu and
+--xla_force_host_platform_device_count=2 in XLA_FLAGS set at interpreter
+startup (the test harness does this).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+process_id = int(sys.argv[1])
+port = sys.argv[2]
+
+from kafkabalancer_tpu.parallel.distributed import (  # noqa: E402
+    initialize,
+    is_multi_host,
+)
+
+initialize(f"127.0.0.1:{port}", num_processes=2, process_id=process_id)
+
+import jax  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.sharding import NamedSharding  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+assert jax.process_count() == 2, jax.process_count()
+assert len(jax.devices()) == 4, jax.devices()
+assert len(jax.local_devices()) == 2
+assert is_multi_host()
+
+from __graft_entry__ import _example_dense  # noqa: E402
+from kafkabalancer_tpu.parallel.mesh import PART_AXIS, make_mesh  # noqa: E402
+from kafkabalancer_tpu.parallel.shard_move import (  # noqa: E402
+    sharded_score_moves,
+)
+from kafkabalancer_tpu.solvers.tpu import score_moves  # noqa: E402
+
+mesh = make_mesh(4)  # (sweep=2, part=2) over both processes
+assert mesh.devices.size == 4
+assert {d.process_index for d in mesh.devices.flat} == {0, 1}
+
+part = mesh.shape[PART_AXIS]
+_pl, _cfg, _dp, args = _example_dense(n_parts=64, n_brokers=8, min_bucket=8 * part)
+
+# promote the host-local (identical-on-both-processes) inputs to global
+# arrays: per-partition tensors shard on the part axis, the rest replicate
+pshard = NamedSharding(mesh, P(PART_AXIS))
+rep = NamedSharding(mesh, P())
+(loads, replicas, allowed, member, weights, nrep_cur, nrep_tgt, pvalid,
+ bvalid, nb, min_replicas) = args
+gargs = (
+    jax.device_put(loads, rep),
+    jax.device_put(replicas, pshard),
+    jax.device_put(allowed, pshard),
+    jax.device_put(member, pshard),
+    jax.device_put(weights, pshard),
+    jax.device_put(nrep_cur, pshard),
+    jax.device_put(nrep_tgt, pshard),
+    jax.device_put(pvalid, pshard),
+    jax.device_put(bvalid, rep),
+    nb,
+    min_replicas,
+)
+
+u0, i0, su0, perm0 = score_moves(*args, leaders=False, tie_k=0)
+u1, i1, su1, perm1 = sharded_score_moves(*gargs, leaders=False, mesh=mesh)
+assert float(u0) == float(u1), (float(u0), float(u1))
+assert int(i0) == int(i1), (int(i0), int(i1))
+assert float(su0) == float(su1)
+assert (np.asarray(perm0) == np.asarray(perm1)).all()
+
+print(
+    f"DIST_OK proc={process_id} processes={jax.process_count()} "
+    f"global_devices={len(jax.devices())} best_u={float(u1):.12e}",
+    flush=True,
+)

@@ -1,0 +1,122 @@
+"""Zookeeper codec: happy path against a fake kazoo client.
+
+The reference leaves its ZK happy path untested (only the bad-connection-
+string error path, kafkabalancer_test.go:145-154); round 1 matched that.
+These tests close the gap with an in-memory kazoo stand-in covering the
+topics -> partitions -> replicas walk, ordering, topic filtering, and
+mid-walk failure mapping (codecs.go:95-135).
+"""
+
+import io
+import json
+import sys
+import types
+
+import pytest
+
+from kafkabalancer_tpu.codecs.readers import CodecError
+from kafkabalancer_tpu.codecs.zookeeper import (
+    get_partition_list_from_zookeeper,
+)
+
+
+class FakeKazooClient:
+    """Minimal kazoo.client.KazooClient: /brokers/topics tree reads."""
+
+    tree = {}
+    fail_topic = None
+    started = []
+
+    def __init__(self, hosts, read_only=False):
+        self.hosts = hosts
+        type(self).started.append(hosts)
+
+    def start(self, timeout=None):
+        pass
+
+    def get_children(self, path):
+        assert path == "/brokers/topics"
+        return list(self.tree)  # deliberately unsorted
+
+    def get(self, path):
+        topic = path.rsplit("/", 1)[1]
+        if topic == self.fail_topic:
+            raise RuntimeError("zk read boom")
+        state = {"version": 3, "partitions": self.tree[topic]}
+        return json.dumps(state).encode("utf-8"), object()
+
+    def stop(self):
+        pass
+
+    def close(self):
+        pass
+
+
+@pytest.fixture
+def fake_kazoo(monkeypatch):
+    mod = types.ModuleType("kazoo")
+    client_mod = types.ModuleType("kazoo.client")
+    client_mod.KazooClient = FakeKazooClient
+    mod.client = client_mod
+    monkeypatch.setitem(sys.modules, "kazoo", mod)
+    monkeypatch.setitem(sys.modules, "kazoo.client", client_mod)
+    FakeKazooClient.tree = {
+        "zebra": {"0": [3, 1], "1": [1, 2]},
+        "alpha": {"0": [1, 2], "10": [2, 3], "9": [3, 2]},
+    }
+    FakeKazooClient.fail_topic = None
+    FakeKazooClient.started = []
+    return FakeKazooClient
+
+
+def test_zk_happy_path_walk_and_ordering(fake_kazoo):
+    pl = get_partition_list_from_zookeeper("zk1:2181,zk2:2181/kafka")
+    # chroot rides the hosts string (kazoo-go semantics)
+    assert fake_kazoo.started == ["zk1:2181,zk2:2181/kafka"]
+    got = [(p.topic, p.partition, p.replicas) for p in pl.iter_partitions()]
+    # topics sorted lexically; partitions sorted NUMERICALLY (9 before 10)
+    assert got == [
+        ("alpha", 0, [1, 2]),
+        ("alpha", 9, [3, 2]),
+        ("alpha", 10, [2, 3]),
+        ("zebra", 0, [3, 1]),
+        ("zebra", 1, [1, 2]),
+    ]
+    # enrichment left unset like the reference's TODO (codecs.go:128-129)
+    for p in pl.iter_partitions():
+        assert p.weight == 0.0 and p.num_consumers == 0.0
+
+
+def test_zk_topic_filter(fake_kazoo):
+    pl = get_partition_list_from_zookeeper("zk1:2181", topics=["zebra"])
+    assert {p.topic for p in pl.iter_partitions()} == {"zebra"}
+    assert len(pl) == 2
+
+
+def test_zk_midwalk_failure_maps_to_codec_error(fake_kazoo):
+    fake_kazoo.fail_topic = "zebra"
+    with pytest.raises(CodecError, match="topic zebra"):
+        get_partition_list_from_zookeeper("zk1:2181")
+
+
+def test_zk_cli_end_to_end(fake_kazoo):
+    """-from-zk through run(): full pipeline on the fake cluster."""
+    from kafkabalancer_tpu.cli import run
+
+    out, err = io.StringIO(), io.StringIO()
+    rv = run(
+        io.StringIO(""), out, err,
+        ["kafkabalancer", "-from-zk=zk1:2181", "-max-reassign=1"],
+    )
+    assert rv == 0, err.getvalue()
+    plan = json.loads(out.getvalue())
+    assert plan["version"] == 1
+
+
+def test_zk_cli_error_paths_unchanged(fake_kazoo):
+    from kafkabalancer_tpu.cli import run
+
+    out, err = io.StringIO(), io.StringIO()
+    rv = run(io.StringIO(""), out, err, ["kafkabalancer", "-from-zk=."])
+    assert rv == 2
+    assert "failed parsing zk connection string" in err.getvalue()
