@@ -1,4 +1,4 @@
-"""The five BASELINE.md measurement configs, end to end.
+"""The BASELINE.md measurement configs (plus rebalance-leader), end to end.
 
 ``bench.py`` at the repo root is the driver's single-number benchmark
 (north-star config). This suite covers the full measurement plan — run it
@@ -13,6 +13,7 @@ Configs (BASELINE.md):
   3. weighted partitions with -allow-leader
   4. beam search with the same-topic anti-colocation penalty (quality vs greedy)
   5. broker add/remove what-if sweep vs sequential per-scenario runs
+  6. -rebalance-leader at the north-star scale (fused device Balance loop)
 
 Each row reports wall-clock and final unbalance for the CPU-greedy baseline
 and the TPU path. Output is a human-readable table on stdout; one JSON line

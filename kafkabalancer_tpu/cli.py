@@ -194,6 +194,13 @@ def run(i, o, e, args: List[str]) -> int:
             "Fused mode: device engine (xla, or pallas for the "
             "whole-session TPU kernel)",
         )
+        f_polish = f.bool(
+            "fused-polish",
+            False,
+            "Fused mode: alternate pair-swap polish phases with the move "
+            "session (compound two-replica exchanges escape single-move "
+            "local optima; an extension beyond the reference)",
+        )
         f_jaxprof = f.string(
             "jax-profile",
             "",
@@ -315,6 +322,12 @@ def run(i, o, e, args: List[str]) -> int:
                     f"-fused implies the tpu session backend; ignoring "
                     f"-solver={f_solver.value}"
                 )
+            if f_polish.value and f_rebalance_leader.value:
+                log(
+                    "-fused-polish does not apply to the -rebalance-leader "
+                    "session (leadership redistribution has no swap "
+                    "neighborhood); ignoring it"
+                )
             if f_engine.value not in ENGINES:
                 log(f"unknown fused engine {f_engine.value!r}")
                 usage()
@@ -326,6 +339,7 @@ def run(i, o, e, args: List[str]) -> int:
                     pl, cfg, r,
                     batch=max(1, f_batch.value),
                     engine=f_engine.value,
+                    polish=f_polish.value,
                 )
             except BalanceError as exc:
                 log(f"failed optimizing distribution: {exc}")

@@ -473,3 +473,50 @@ def test_pprof_writes_valid_pprof_protobuf(tmp_path, monkeypatch):
         assert needed in strings
     # profiled frames include this package's own functions
     assert any("kafkabalancer_tpu" in t for t in strings)
+
+
+def test_fused_polish_flag():
+    """-fused -fused-polish runs the swap-polish session end to end and
+    converges at least as deep as the plain fused session."""
+    from kafkabalancer_tpu.balancer.costmodel import (
+        get_bl,
+        get_broker_load,
+        get_unbalance_bl,
+    )
+    from kafkabalancer_tpu.codecs import get_partition_list_from_reader
+
+    def final_unbalance(stdout):
+        pl = get_partition_list_from_reader(io.StringIO(stdout), True, [])
+        return get_unbalance_bl(get_bl(get_broker_load(pl)))
+
+    base = [
+        "-input-json", "-input", FIXTURE, "-fused", "-fused-batch=4",
+        "-max-reassign=64", "-unique", "-min-unbalance=0", "-full-output",
+    ]
+    rv_p, out_p, err_p = run_cli(base + ["-fused-polish"])
+    assert rv_p == 0, err_p
+    assert "fused session:" in err_p
+    rv_f, out_f, err_f = run_cli(base)
+    assert rv_f == 0, err_f
+    assert final_unbalance(out_p) <= final_unbalance(out_f) + 1e-12
+
+
+def test_fused_rebalance_leader():
+    """-fused with -rebalance-leader routes through the fused leader
+    session (round 1 fell back to the host per-move pipeline)."""
+    rv_f, out_f, err_f = run_cli(
+        [
+            "-input-json", "-input", FIXTURE, "-fused",
+            "-rebalance-leader", "-max-reassign=4", "-unique",
+        ]
+    )
+    assert rv_f == 0, err_f
+    # same plan as the host pipeline (parity pinned in test_scan too)
+    rv_h, out_h, err_h = run_cli(
+        [
+            "-input-json", "-input", FIXTURE,
+            "-rebalance-leader", "-max-reassign=4", "-unique",
+        ]
+    )
+    assert rv_h == 0, err_h
+    assert json.loads(out_f) == json.loads(out_h)
