@@ -64,6 +64,11 @@ from kafkabalancer_tpu.ops.runtime import next_bucket  # noqa: E402
 # commits at BETTER final unbalance and equal wall-clock.
 DEFAULT_CHURN_GATE = 1.5
 
+# whole-session kernel capacity: partition-bucket x broker-bucket cells that
+# still fit the v5e scoped-VMEM budget (16k x 128 verified on hardware;
+# 32k x 128 OOMs in Mosaic compilation)
+PALLAS_VMEM_CELLS = 16384 * 128
+
 
 @partial(
     jax.jit,
@@ -550,6 +555,17 @@ def plan(
     while remaining > 0:
         # only the partition axis needs TILE_P alignment for the kernel
         dp = tensorize(pl, cfg, min_bucket=TILE_P if use_pallas else 8)
+        if engine == "pallas" and (
+            dp.replicas.shape[0] * max(dp.bvalid.shape[0], 128)
+            > PALLAS_VMEM_CELLS
+        ):
+            # the whole-session kernel keeps its state VMEM-resident; past
+            # the empirical scoped-VMEM ceiling (16k partitions x 128
+            # brokers on v5e) Mosaic compilation OOMs, so fall back to the
+            # XLA while_loop session — same algorithm, HBM-resident state
+            engine = "xla"
+            use_pallas = False
+            dp = tensorize(pl, cfg)
         loads = cost.broker_loads(
             jnp.asarray(dp.replicas),
             jnp.asarray(dp.weights, dtype),

@@ -234,3 +234,21 @@ def test_leader_session_swap_branch():
     assert pl_s == pl_g
     for p in pl_s.iter_partitions():
         assert len(set(p.replicas)) == len(p.replicas)
+
+
+def test_pallas_vmem_gate_falls_back_to_xla():
+    """Past the whole-session kernel's scoped-VMEM ceiling, plan() must
+    fall back to the XLA session instead of OOMing Mosaic compilation.
+    On CPU this is observable directly: engine='pallas' normally fails
+    without a TPU backend, but above the gate the fallback engages first
+    and the plan succeeds."""
+    from kafkabalancer_tpu.solvers.scan import PALLAS_VMEM_CELLS
+    from kafkabalancer_tpu.utils.synth import synth_cluster
+
+    n_parts = 17_000  # buckets to 32768 x 128 cells > PALLAS_VMEM_CELLS
+    assert 32768 * 128 > PALLAS_VMEM_CELLS
+    pl = synth_cluster(n_parts, 100, rf=2, seed=3, weighted=True)
+    cfg = default_rebalance_config()
+    cfg.min_unbalance = 0.0
+    opl = plan(pl, cfg, 3, batch=8, engine="pallas")
+    assert len(opl) == 3
