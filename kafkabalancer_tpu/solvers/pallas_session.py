@@ -94,6 +94,7 @@ def _kernel(
     B: int,
     ML: int,
     allow_leader: bool,
+    all_allowed: bool,
 ):
     f32 = jnp.float32
 
@@ -193,10 +194,16 @@ def _kernel(
             memb = jnp.max(
                 onehot * valid_slots[:, :, None], axis=1
             )  # [T, B] f32 0/1
-            # NOTE: int8 loads are fine but int8 *comparisons* break the
-            # Mosaic lowering — widen before comparing
-            alw = allowed_ref[pl.ds(off, TILE_P), :].astype(jnp.int32)
-            tmask = (alw > 0) & (memb < 0.5) & bvalid.reshape(1, B)
+            if all_allowed:
+                # every partition allows the whole universe (the default
+                # FillDefaults outcome): the [P, B] allowed matrix is
+                # neither transferred nor stored
+                tmask = (memb < 0.5) & bvalid.reshape(1, B)
+            else:
+                # NOTE: int8 loads are fine but int8 *comparisons* break
+                # the Mosaic lowering — widen before comparing
+                alw = allowed_ref[pl.ds(off, TILE_P), :].astype(jnp.int32)
+                tmask = (alw > 0) & (memb < 0.5) & bvalid.reshape(1, B)
 
             # follower pass: slots >= 1, delta = w
             srcmask = (iota_r >= 1) & (iota_r < nrc) & elig  # [T, R]
@@ -410,7 +417,7 @@ def _kernel(
 
 @partial(
     jax.jit,
-    static_argnames=("max_moves", "allow_leader", "interpret"),
+    static_argnames=("max_moves", "allow_leader", "interpret", "all_allowed"),
 )
 def pallas_session(
     loads,
@@ -433,6 +440,7 @@ def pallas_session(
     max_moves: int,
     allow_leader: bool,
     interpret: bool = False,
+    all_allowed: bool = False,
 ):
     """Device-resident batched session; same contract as ``scan.session``
     restricted to the batch path: returns ``(replicas, loads, n, move_p,
@@ -465,7 +473,10 @@ def pallas_session(
     # extraction, f32-accumulated counts, lax.argmin with index_dtype) —
     # Mosaic has no 64-bit types and the process may run with x64 enabled
     out = _call(
-        partial(_kernel, P=P, R=R, B=B, ML=ML, allow_leader=allow_leader),
+        partial(
+            _kernel, P=P, R=R, B=B, ML=ML, allow_leader=allow_leader,
+            all_allowed=all_allowed,
+        ),
         P, R, B, ML, smem, vmem, interpret,
     )(
         scalar(budget, i32),
@@ -475,7 +486,11 @@ def pallas_session(
         scalar(churn_gate, f32),
         jnp.asarray(loads, f32).reshape(1, B),
         jnp.asarray(replicas, i32),
-        jnp.asarray(allowed, i8).reshape(P, B),
+        # all_allowed: a [1, B] placeholder replaces the [P, B] matrix —
+        # the largest kernel input both as transfer and as VMEM resident
+        jnp.zeros((1, B), i8)
+        if all_allowed
+        else jnp.asarray(allowed, i8).reshape(P, B),
         jnp.asarray(weights, f32).reshape(P, 1),
         jnp.asarray(nrep_cur, i32).reshape(P, 1),
         jnp.asarray(nrep_tgt, i32).reshape(P, 1),

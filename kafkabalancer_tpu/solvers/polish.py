@@ -322,7 +322,9 @@ def _member_from(replicas, nrep_cur, pvalid, B: int):
 
 @partial(
     jax.jit,
-    static_argnames=("max_moves", "allow_leader", "batch", "engine"),
+    static_argnames=(
+        "max_moves", "allow_leader", "batch", "engine", "all_allowed",
+    ),
 )
 def converge_session(
     loads,
@@ -347,6 +349,7 @@ def converge_session(
     allow_leader: bool,
     batch: int,
     engine: str = "xla",
+    all_allowed: bool = False,
 ):
     """Move phases and swap phases alternated on device until neither
     commits — one dispatch for the whole plan-to-convergence.
@@ -380,6 +383,7 @@ def converge_session(
             min_unbalance, budget, jnp.int32(max(1, batch)),
             max_moves=max_moves, allow_leader=allow_leader,
             interpret=(engine == "pallas-interpret"),
+            all_allowed=all_allowed,
         )
         mp = lax.dynamic_update_slice(mp, pmp, (0,))
         mslot = lax.dynamic_update_slice(mslot, pmslot, (0,))

@@ -574,13 +574,25 @@ def plan(
             dp.bvalid.shape[0],
         )
         chunk = min(remaining, chunk_moves)
+        # the default FillDefaults outcome allows every broker everywhere;
+        # then the [P, B] allowed matrix is just the broker-validity row
+        # broadcast — build it ON DEVICE from the [B] mask instead of
+        # transferring 2 MB per session (and let the kernel skip storing
+        # it entirely)
+        all_allowed = bool(dp.allowed[:, : dp.nb].all(axis=1)[: dp.np_].all())
+        if all_allowed:
+            allowed_dev = jnp.broadcast_to(
+                jnp.asarray(dp.bvalid)[None, :], dp.allowed.shape
+            )
+        else:
+            allowed_dev = jnp.asarray(dp.allowed)
         args = (
             loads,
             jnp.asarray(dp.replicas),
             # the pallas kernel derives membership from the replica matrix;
             # skip the [P, B] transfer (the largest session input) there
             None if use_pallas else jnp.asarray(dp.member),
-            jnp.asarray(dp.allowed),
+            allowed_dev,
             jnp.asarray(dp.weights, dtype),
             jnp.asarray(dp.nrep_cur),
             jnp.asarray(dp.nrep_tgt),
@@ -617,6 +629,7 @@ def plan(
                         allow_leader=cfg.allow_leader_rebalancing,
                         batch=max(1, batch),
                         engine=engine,
+                        all_allowed=all_allowed,
                     )
                 )
             except BalanceError:
@@ -642,6 +655,7 @@ def plan(
                     max_moves=next_bucket(chunk, 128),
                     allow_leader=cfg.allow_leader_rebalancing,
                     interpret=(engine == "pallas-interpret"),
+                    all_allowed=all_allowed,
                 )
             except BalanceError:
                 raise
