@@ -262,7 +262,7 @@ def _sweep_exec(
     # pack every output into ONE int32 array (f32 objective bitcast): on a
     # remote-attached TPU each separate device->host fetch pays a full
     # relay round trip (~0.1 s), which dominated the warm sweep wall-clock
-    return jnp.concatenate(
+    packed = jnp.concatenate(
         [
             replicas_s.astype(jnp.int32).reshape(-1),
             feasible_s.astype(jnp.int32),
@@ -273,6 +273,12 @@ def _sweep_exec(
             # f32, 2 for f64 — the CPU parity tests compare f64 exactly)
             lax.bitcast_convert_type(su_s, jnp.int32).reshape(-1),
         ]
+    )
+    # replicate across the mesh so every process of a multi-host runtime
+    # holds the full result (scenario shards live on their owning process
+    # otherwise, and a host-side fetch of a non-addressable array raises)
+    return jax.lax.with_sharding_constraint(
+        packed, jax.sharding.NamedSharding(mesh, P())
     )
 
 

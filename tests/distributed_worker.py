@@ -85,3 +85,26 @@ print(
     f"global_devices={len(jax.devices())} best_u={float(u1):.12e}",
     flush=True,
 )
+
+# --- what-if sweep sharded over a cross-process mesh ----------------------
+from kafkabalancer_tpu.models import default_rebalance_config  # noqa: E402
+from kafkabalancer_tpu.parallel.sweep import sweep  # noqa: E402
+from kafkabalancer_tpu.utils.synth import synth_cluster  # noqa: E402
+
+pl = synth_cluster(24, 6, rf=2, seed=11, weighted=True)
+cfg = default_rebalance_config()
+observed = sorted({b for p in pl.partitions for b in p.replicas})
+scenarios = [
+    observed,
+    observed + [max(observed) + 1],
+    observed + [max(observed) + 1, max(observed) + 2],
+    observed[1:],
+]
+results = sweep(pl, cfg, scenarios, max_reassign=64, mesh=mesh)
+assert len(results) == len(scenarios)
+assert any(r.feasible for r in results)
+summary = ";".join(
+    f"{int(r.feasible)}:{int(r.completed)}:{r.n_moves}:{r.unbalance:.9e}"
+    for r in results
+)
+print(f"SWEEP_OK proc={process_id} {summary}", flush=True)

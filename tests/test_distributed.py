@@ -57,3 +57,35 @@ def test_two_process_distributed_scoring():
         if "DIST_OK" in line
     ]
     assert len(best) == 2 and best[0] == best[1]
+
+    # the what-if sweep ran sharded over the cross-process mesh, with
+    # replicated results identical on both processes AND identical to a
+    # single-process run of the same scenarios (this test process runs on
+    # the 8-virtual-device conftest mesh)
+    sweeps = [
+        line.split(" ", 2)[2]
+        for out in outs
+        for line in out.splitlines()
+        if "SWEEP_OK" in line
+    ]
+    assert len(sweeps) == 2 and sweeps[0] == sweeps[1]
+
+    from kafkabalancer_tpu.models import default_rebalance_config
+    from kafkabalancer_tpu.parallel.sweep import sweep
+    from kafkabalancer_tpu.utils.synth import synth_cluster
+
+    pl = synth_cluster(24, 6, rf=2, seed=11, weighted=True)
+    cfg = default_rebalance_config()
+    observed = sorted({b for p in pl.partitions for b in p.replicas})
+    scenarios = [
+        observed,
+        observed + [max(observed) + 1],
+        observed + [max(observed) + 1, max(observed) + 2],
+        observed[1:],
+    ]
+    results = sweep(pl, cfg, scenarios, max_reassign=64)
+    expected = ";".join(
+        f"{int(r.feasible)}:{int(r.completed)}:{r.n_moves}:{r.unbalance:.9e}"
+        for r in results
+    )
+    assert sweeps[0] == expected

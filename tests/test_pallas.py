@@ -121,3 +121,39 @@ def test_plan_unknown_engine_validates_before_mutating():
     with pytest.raises(ValueError, match="unknown engine"):
         plan(pl, default_rebalance_config(), 5, engine="palas")
     assert pl == before
+
+
+def test_pallas_session_restricted_brokers_parity():
+    """Per-partition broker restrictions exercise the kernel's allowed-
+    matrix branch (the default all-allowed instances take the matrix-free
+    fast path since the all_allowed optimization)."""
+    import jax.numpy as jnp
+
+    rng = random.Random(3100)
+    pl = random_partition_list(
+        rng, 40, 8, weighted=True, restrict_brokers=True
+    )
+    cfg = default_rebalance_config()
+    cfg.min_unbalance = 1e-6
+
+    pl_x, pl_p = copy.deepcopy(pl), copy.deepcopy(pl)
+    opl_x = plan(
+        pl_x, copy.deepcopy(cfg), 40, dtype=jnp.float32, batch=16,
+        engine="xla",
+    )
+    opl_p = plan(
+        pl_p, copy.deepcopy(cfg), 40, batch=16, engine="pallas-interpret",
+    )
+    moves_x = [
+        (p.topic, p.partition, tuple(p.replicas))
+        for p in (opl_x.partitions or [])
+    ]
+    moves_p = [
+        (p.topic, p.partition, tuple(p.replicas))
+        for p in (opl_p.partitions or [])
+    ]
+    assert moves_x == moves_p
+    assert pl_x == pl_p
+    # restrictions actually bound the plan: every replica stays allowed
+    for p in pl_p.iter_partitions():
+        assert set(p.replicas).issubset(set(p.brokers))
