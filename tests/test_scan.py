@@ -252,3 +252,48 @@ def test_pallas_vmem_gate_falls_back_to_xla():
     cfg.min_unbalance = 0.0
     opl = plan(pl, cfg, 3, batch=8, engine="pallas")
     assert len(opl) == 3
+
+
+@pytest.mark.parametrize("polish", [False, True])
+def test_plan_chunk_reentry_equivalent_quality(polish):
+    """Sessions that exhaust a device chunk re-enter with the mutated
+    assignment (re-tensorize + fresh dispatch). Chunking is not
+    bit-stable — a fresh chunk recomputes loads from scratch while a
+    running session updates them incrementally, so near-ties can resolve
+    differently (the documented fused-session caveat) and batch>1 chunk
+    boundaries truncate an iteration's disjoint commit set. What IS
+    promised: a valid final assignment of equivalent quality, with every
+    emitted entry reflecting the live partition's final state."""
+    from kafkabalancer_tpu.utils.synth import synth_cluster
+
+    us = {}
+    for chunk in (4, 8192):
+        pl = synth_cluster(60, 8, rf=2, seed=5, weighted=True)
+        cfg = default_rebalance_config()
+        cfg.min_unbalance = 1e-9
+        opl = plan(pl, cfg, 40, batch=4, chunk_moves=chunk, polish=polish)
+        live = {
+            (p.topic, p.partition): tuple(p.replicas)
+            for p in pl.iter_partitions()
+        }
+        for entry in opl.partitions or []:
+            assert tuple(entry.replicas) == live[(entry.topic, entry.partition)]
+            assert len(set(entry.replicas)) == len(entry.replicas)
+        us[chunk] = unbalance_of(pl)
+    assert us[4] <= us[8192] * 2 + 1e-9 and us[8192] <= us[4] * 2 + 1e-9
+
+
+def test_leader_plan_chunk_reentry():
+    from kafkabalancer_tpu.utils.synth import synth_cluster
+
+    res = {}
+    for chunk in (2, 8192):
+        pl = synth_cluster(40, 6, rf=2, seed=9, weighted=True)
+        cfg = default_rebalance_config()
+        cfg.rebalance_leaders = True
+        opl = plan(pl, cfg, 10, chunk_moves=chunk)
+        res[chunk] = (
+            len(opl),
+            [(p.topic, p.partition, tuple(p.replicas)) for p in pl.iter_partitions()],
+        )
+    assert res[2] == res[8192]
