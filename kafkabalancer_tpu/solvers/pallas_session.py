@@ -77,6 +77,8 @@ def _kernel(
     pvalid_ref,
     always_ref,
     universe_ref,
+    lanef_ref,  # [1, B] f32 broker indices (tpu.iota is int-only and
+    slotf_ref,  # [1, R] f32 slot indices    sitofp fails to legalize)
     # outputs
     loads_ref,
     replicas_ref,
@@ -87,7 +89,6 @@ def _kernel(
     mtgt_ref,
     # scratch
     bcount_ref,
-    rstar_ref,
     *,
     P: int,
     R: int,
@@ -142,6 +143,23 @@ def _kernel(
     lane_b = lax.broadcasted_iota(jnp.int32, (1, B), 1)  # [1, B]
     iota_r = lax.broadcasted_iota(jnp.int32, (1, R), 1)  # [1, R]
 
+    # [T, T] identity for MXU transposes of per-tile payload columns
+    # (lane<->sublane reshapes are not portable Mosaic; a dot with the
+    # identity is)
+    eye_t = (
+        lax.broadcasted_iota(jnp.int32, (TILE_P, TILE_P), 0)
+        == lax.broadcasted_iota(jnp.int32, (TILE_P, TILE_P), 1)
+    ).astype(f32)
+    iota_sub_t = lax.broadcasted_iota(jnp.int32, (TILE_P, 1), 0)
+
+    def _dot(a, b, ca, cb):
+        return jax.lax.dot_general(
+            a, b,
+            dimension_numbers=(((ca,), (cb,)), ((), ())),
+            preferred_element_type=f32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+
     def iteration(carry):
         n, _done = carry
 
@@ -162,7 +180,7 @@ def _kernel(
         )  # [B, 2]
 
         def tile_body(ti, bc):
-            bestv, bestp, bestv_l, bestp_l = bc
+            bestv, bestp, bestpay, bestv_l, bestp_l, bestpay_l = bc
             off = ti * TILE_P
             reps = replicas_ref[pl.ds(off, TILE_P), :]  # [T, R] i32
             w_t = w_ref[pl.ds(off, TILE_P), :]  # [T, 1] f32
@@ -210,7 +228,6 @@ def _kernel(
             A = jnp.where(srcmask, _pen(loads_s - w_t, avg) - F_s, jnp.full_like(loads_s, BIG))
             astar = jnp.min(A, axis=1, keepdims=True)  # [T, 1]
             rstar = lax.argmin(A, axis=1, index_dtype=jnp.int32)  # [T]
-            rstar_ref[pl.ds(off, TILE_P), :] = rstar.reshape(TILE_P, 1)
             C = _pen(loads.reshape(1, B) + w_t, avg) - F.reshape(1, B)
             V = jnp.where(
                 tmask & (astar < BIG * 0.5), astar + C, jnp.full_like(C, BIG)
@@ -220,6 +237,30 @@ def _kernel(
             better = vmin < bestv
             bestv = jnp.where(better, vmin, bestv)
             bestp = jnp.where(better, off + varg, bestp)
+
+            # payload capture for the winning rows: (rstar, source broker
+            # at rstar, weight) as [T, 3], transposed on the MXU and
+            # contracted with the winner one-hot — all winner attributes
+            # travel with the selection, replacing a B-length scalar
+            # fetch loop per iteration. Values < 2^24, exact in f32, and
+            # produced by masked sums against FLOAT iotas: int->float
+            # vector conversions (arith.sitofp) fail to legalize in
+            # Mosaic at these layouts
+            rstar_c = rstar.reshape(TILE_P, 1)
+            sel_r = (iota_r == rstar_c).astype(f32)  # [T, R]
+            lane_f = lanef_ref[:]  # [1, B]
+            iota_rf = slotf_ref[:]  # [1, R]
+            s_fol = jnp.sum(
+                jnp.sum(onehot * sel_r[:, :, None], axis=1) * lane_f,
+                axis=1, keepdims=True,
+            )  # [T, 1] source broker id at slot rstar
+            rstar_f = jnp.sum(iota_rf * sel_r, axis=1, keepdims=True)
+            paymat = jnp.concatenate(
+                [rstar_f, s_fol, w_t], axis=1
+            )  # [T, 3]
+            onehot_win = (iota_sub_t == varg).astype(f32)  # [T, B]
+            paysel = _dot(_dot(paymat, eye_t, 0, 0), onehot_win, 1, 0)
+            bestpay = jnp.where(better, paysel, bestpay)  # [3, B]
 
             if allow_leader:
                 # leader pass: slot 0 scored with its TRUE applied delta
@@ -245,13 +286,25 @@ def _kernel(
                 bestv_l = jnp.where(better_l, vmin_l, bestv_l)
                 bestp_l = jnp.where(better_l, off + varg_l, bestp_l)
 
-            return bestv, bestp, bestv_l, bestp_l
+                # leader payloads: (source broker at slot 0, true applied
+                # premium w*(replicas+consumers))
+                s0 = jnp.sum(
+                    onehot[:, 0, :] * lane_f, axis=1, keepdims=True
+                )  # [T, 1]
+                paymat_l = jnp.concatenate([s0, wl], axis=1)
+                onehot_l = (iota_sub_t == varg_l).astype(f32)
+                paysel_l = _dot(_dot(paymat_l, eye_t, 0, 0), onehot_l, 1, 0)
+                bestpay_l = jnp.where(better_l, paysel_l, bestpay_l)
+
+            return bestv, bestp, bestpay, bestv_l, bestp_l, bestpay_l
 
         bestv0 = jnp.full((1, B), BIG, f32)
         bestp0 = jnp.zeros((1, B), jnp.int32)
-        bestv, bestp, bestv_l, bestp_l = lax.fori_loop(
+        pay0 = jnp.zeros((3, B), f32)
+        pay0_l = jnp.zeros((2, B), f32)
+        bestv, bestp, bestpay, bestv_l, bestp_l, bestpay_l = lax.fori_loop(
             jnp.int32(0), jnp.int32(P // TILE_P), tile_body,
-            (bestv0, bestp0, bestv0, bestp0)
+            (bestv0, bestp0, pay0, bestv0, bestp0, pay0_l)
         )
         # global leader-vs-follower merge, strict < (follower wins ties)
         lead = bestv_l < bestv
@@ -259,11 +312,25 @@ def _kernel(
         bestp = jnp.where(lead, bestp_l, bestp)
         vals = su + bestv[0, :]  # [B]
         cp = bestp[0, :]  # [B] candidate partition per target
-        clead = jnp.where(
-            lead, jnp.ones((1, B), jnp.int32), jnp.zeros((1, B), jnp.int32)
-        )[0, :]  # [B] 1 = leader-pass winner (slot 0)
+        lead_lane = lead[0, :]
 
-        # ---- per-candidate scalar fetches (slot, source, weight terms) --
+        # winner attributes straight from the captured payload rows (all
+        # exact small integers or weights in f32)
+        if allow_leader:
+            cslot = jnp.where(
+                lead_lane, jnp.int32(0), bestpay[0, :].astype(jnp.int32)
+            )
+            cs = jnp.where(
+                lead_lane,
+                bestpay_l[0, :].astype(jnp.int32),
+                bestpay[1, :].astype(jnp.int32),
+            )
+            cdelta = jnp.where(lead_lane, bestpay_l[1, :], bestpay[2, :])
+        else:
+            cslot = bestpay[0, :].astype(jnp.int32)
+            cs = bestpay[1, :].astype(jnp.int32)
+            cdelta = bestpay[2, :]
+
         # scalar extraction from lane vectors via masked reduction (vector
         # dynamic-slice along lanes is not portable Mosaic)
         def ext_i(vec, i):
@@ -271,28 +338,6 @@ def _kernel(
             # max does not promote the accumulator dtype (integer sums
             # would upcast to unsupported int64 under global x64)
             return jnp.max(jnp.where(lane_b[0, :] == i, vec, jnp.zeros_like(vec)))
-
-        def fetch(i, acc):
-            cslot, cs, cdelta = acc
-            p_i = ext_i(cp, i)
-            slot_i = jnp.where(
-                ext_i(clead, i) > 0, jnp.int32(0), rstar_ref[p_i, 0]
-            )
-            rrow = replicas_ref[pl.ds(p_i, 1), :]  # [1, R]
-            s_i = jnp.max(jnp.where(iota_r == slot_i, rrow, jnp.zeros_like(rrow)))
-            w_i = w_ref[p_i, 0]
-            prem = w_i * (nrepc_ref[p_i, 0].astype(f32) + ncons_ref[p_i, 0])
-            d_i = jnp.where(slot_i == 0, prem, w_i)
-            sel = lane_b[0, :] == i
-            cslot = jnp.where(sel, slot_i, cslot)
-            cs = jnp.where(sel, s_i, cs)
-            cdelta = jnp.where(sel, d_i, cdelta)
-            return cslot, cs, cdelta
-
-        zi = jnp.zeros(B, jnp.int32)
-        cslot, cs, cdelta = lax.fori_loop(
-            jnp.int32(0), jnp.int32(B), fetch, (zi, zi, jnp.zeros(B, f32))
-        )
 
         # ---- improvement + churn gate -----------------------------------
         improving = (vals < su - min_unb) & (vals < su) & (bestv[0, :] < BIG * 0.5)
@@ -498,6 +543,8 @@ def pallas_session(
         jnp.asarray(pvalid, i32).reshape(P, 1),
         jnp.asarray(always_valid, i32).reshape(1, B),
         jnp.asarray(universe_valid, i32).reshape(1, B),
+        jnp.arange(B, dtype=f32).reshape(1, B),
+        jnp.arange(R, dtype=f32).reshape(1, R),
     )
     loads_out, replicas_out, n, mp, mslot, msrc, mtgt = out
     # packed [ML/128, 128] row-major == flat move order
@@ -528,7 +575,7 @@ def _call(kernel, P, R, B, ML, smem, vmem, interpret=False):
             jax.ShapeDtypeStruct((ML // 128, 128), i32),  # move_src
             jax.ShapeDtypeStruct((ML // 128, 128), i32),  # move_tgt
         ),
-        in_specs=[smem] * 5 + [vmem] * 10,
+        in_specs=[smem] * 5 + [vmem] * 12,
         out_specs=(vmem, vmem, smem, vmem, vmem, vmem, vmem),
         # the replicas output aliases the replicas input (operand 6 of the
         # flattened inputs): without the alias a second lane-padded [P, R]
@@ -536,6 +583,5 @@ def _call(kernel, P, R, B, ML, smem, vmem, interpret=False):
         input_output_aliases={6: 1},
         scratch_shapes=[
             pltpu.VMEM((1, B), i32),  # bcount
-            pltpu.VMEM((P, 1), i32),  # rstar
         ],
     )
