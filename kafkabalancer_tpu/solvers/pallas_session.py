@@ -68,13 +68,10 @@ def _kernel(
     churn_ref,
     # arrays (VMEM)
     loads0_ref,
-    replicas0_ref,
-    allowed_ref,
-    w_ref,
-    nrepc_ref,
-    nrept_ref,
-    ncons_ref,
-    pvalid_ref,
+    replicas0_ref,  # [R, P] f32 TRANSPOSED (broker idx as exact floats)
+    allowed_ref,  # [P, B] i8 (placeholder [1, B] when all_allowed)
+    cols_ref,  # [5, P] f32 packed per-partition columns:
+    #            [weight, nrep_cur, nrep_tgt, num_consumers, pvalid]
     always_ref,
     universe_ref,
     lanef_ref,  # [1, B] f32 broker indices (tpu.iota is int-only and
@@ -100,25 +97,52 @@ def _kernel(
     f32 = jnp.float32
 
     # ---- initialize mutable state from the inputs -----------------------
-    # replica-set membership is DERIVED from the replica matrix per tile,
-    # never stored or transferred: the [P, B] matrix would be both the
-    # largest session input (host->device transfer is on the critical
-    # path) and the largest VMEM resident (8 MB at the 16k bucket, which
-    # overflows the kernel's VMEM budget)
+    # State lives TRANSPOSED ([R, P] replicas, [5, P] columns): the
+    # partition axis on LANES keeps physical VMEM equal to logical size,
+    # while the natural [P, small] orientation tile-pads its lane
+    # dimension up to 128x — the single reason the previous layout capped
+    # the kernel at a 16k-partition bucket. Replica entries are broker
+    # indices carried as exact f32 (< 2^24); per-tile compute transposes
+    # slices back to [T, R] on the MXU. Replica-set membership is DERIVED
+    # per tile, never stored or transferred.
     loads_ref[:] = loads0_ref[:]
     replicas_ref[:] = replicas0_ref[:]
-    lane_b0 = lax.broadcasted_iota(jnp.int32, (1, B), 1)
     bcount_ref[:] = jnp.zeros((1, B), jnp.int32)
 
+    # [T, T] identity for MXU transposes of lane-sliced tiles and payload
+    # columns (lane<->sublane reshapes are not portable Mosaic; a dot
+    # with the identity is)
+    eye_t = (
+        lax.broadcasted_iota(jnp.int32, (TILE_P, TILE_P), 0)
+        == lax.broadcasted_iota(jnp.int32, (TILE_P, TILE_P), 1)
+    ).astype(f32)
+
+    def _dot(a, b, ca, cb):
+        return jax.lax.dot_general(
+            a, b,
+            dimension_numbers=(((ca,), (cb,)), ((), ())),
+            preferred_element_type=f32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+
+    def read_tile(off):
+        """One partition tile in compute orientation: replicas [T, R] f32
+        and per-partition columns w/nrc/nrt/ncons/pvalid (each [T, 1])."""
+        reps = _dot(eye_t, replicas_ref[:, pl.ds(off, TILE_P)], 1, 1)
+        colst = _dot(eye_t, cols_ref[:, pl.ds(off, TILE_P)], 1, 1)  # [T, 5]
+        return (
+            reps, colst[:, 0:1], colst[:, 1:2], colst[:, 2:3],
+            colst[:, 3:4], colst[:, 4:5],
+        )
+
     def _member_tile(off):
-        reps = replicas_ref[pl.ds(off, TILE_P), :]
-        nrc = nrepc_ref[pl.ds(off, TILE_P), :]
-        pv_t = pvalid_ref[pl.ds(off, TILE_P), :]
+        reps, _w, nrc, _nrt, _nc, pv_t = read_tile(off)
+        lanef0 = lanef_ref[:]
         m = jnp.zeros((TILE_P, B), jnp.int32)
         for r in range(R):
             col = reps[:, r].reshape(TILE_P, 1)
-            valid = (nrc > r) & (pv_t > 0)
-            m = jnp.where((col == lane_b0) & valid, jnp.ones_like(m), m)
+            valid = (nrc > r + 0.5) & (pv_t > 0.5)
+            m = jnp.where((col == lanef0) & valid, jnp.ones_like(m), m)
         return m
 
     def init_tile(ti, _):
@@ -136,29 +160,14 @@ def _kernel(
 
     budget = budget_ref[0, 0]
     batch = batch_ref[0, 0]
-    min_repl = minrep_ref[0, 0]
+    min_repl = minrep_ref[0, 0]  # f32 (compared against f32 columns)
     min_unb = minunb_ref[0, 0]
     churn = churn_ref[0, 0]
 
     lane_b = lax.broadcasted_iota(jnp.int32, (1, B), 1)  # [1, B]
     iota_r = lax.broadcasted_iota(jnp.int32, (1, R), 1)  # [1, R]
 
-    # [T, T] identity for MXU transposes of per-tile payload columns
-    # (lane<->sublane reshapes are not portable Mosaic; a dot with the
-    # identity is)
-    eye_t = (
-        lax.broadcasted_iota(jnp.int32, (TILE_P, TILE_P), 0)
-        == lax.broadcasted_iota(jnp.int32, (TILE_P, TILE_P), 1)
-    ).astype(f32)
     iota_sub_t = lax.broadcasted_iota(jnp.int32, (TILE_P, 1), 0)
-
-    def _dot(a, b, ca, cb):
-        return jax.lax.dot_general(
-            a, b,
-            dimension_numbers=(((ca,), (cb,)), ((), ())),
-            preferred_element_type=f32,
-            precision=jax.lax.Precision.HIGHEST,
-        )
 
     def iteration(carry):
         n, _done = carry
@@ -182,16 +191,13 @@ def _kernel(
         def tile_body(ti, bc):
             bestv, bestp, bestpay, bestv_l, bestp_l, bestpay_l = bc
             off = ti * TILE_P
-            reps = replicas_ref[pl.ds(off, TILE_P), :]  # [T, R] i32
-            w_t = w_ref[pl.ds(off, TILE_P), :]  # [T, 1] f32
-            nrc = nrepc_ref[pl.ds(off, TILE_P), :]  # [T, 1]
-            nrt = nrept_ref[pl.ds(off, TILE_P), :]
-            pv_t = pvalid_ref[pl.ds(off, TILE_P), :]
-            ncons_t = ncons_ref[pl.ds(off, TILE_P), :]  # [T, 1]
-            # one-hot contraction replaces the loads/F gather
+            reps, w_t, nrc, nrt, ncons_t, pv_t = read_tile(off)
+            # one-hot contraction replaces the loads/F gather (replica
+            # entries are exact f32 broker indices; pads are -1 and never
+            # match a lane)
             onehot = (
                 reps.reshape(TILE_P, R, 1)
-                == lane_b.reshape(1, 1, B)
+                == lanef_ref[:].reshape(1, 1, B)
             ).astype(f32)  # [T, R, B]
             g = jax.lax.dot_general(
                 onehot.reshape(TILE_P * R, B),
@@ -203,12 +209,14 @@ def _kernel(
             loads_s = g[:, :, 0]
             F_s = g[:, :, 1]
 
-            elig = (pv_t > 0) & (nrt >= min_repl)  # [T, 1]
+            elig = (pv_t > 0.5) & (nrt >= min_repl)  # [T, 1]
             # membership from the already-materialized onehot: max over
             # valid slots (pad slots hold -1 and never match a lane)
             # f32 mask: minor-dim insertion on sub-32-bit types fails to
             # lower in Mosaic at some shapes
-            valid_slots = ((iota_r < nrc) & (pv_t > 0)).astype(f32)  # [T, R]
+            valid_slots = (
+                (slotf_ref[:] < nrc) & (pv_t > 0.5)
+            ).astype(f32)  # [T, R]
             memb = jnp.max(
                 onehot * valid_slots[:, :, None], axis=1
             )  # [T, B] f32 0/1
@@ -224,7 +232,9 @@ def _kernel(
                 tmask = (alw > 0) & (memb < 0.5) & bvalid.reshape(1, B)
 
             # follower pass: slots >= 1, delta = w
-            srcmask = (iota_r >= 1) & (iota_r < nrc) & elig  # [T, R]
+            srcmask = (
+                (slotf_ref[:] >= 0.5) & (slotf_ref[:] < nrc) & elig
+            )  # [T, R]
             A = jnp.where(srcmask, _pen(loads_s - w_t, avg) - F_s, jnp.full_like(loads_s, BIG))
             astar = jnp.min(A, axis=1, keepdims=True)  # [T, 1]
             rstar = lax.argmin(A, axis=1, index_dtype=jnp.int32)  # [T]
@@ -250,6 +260,7 @@ def _kernel(
             sel_r = (iota_r == rstar_c).astype(f32)  # [T, R]
             lane_f = lanef_ref[:]  # [1, B]
             iota_rf = slotf_ref[:]  # [1, R]
+            # (int iota_r vs int rstar: comparisons stay integer-legal)
             s_fol = jnp.sum(
                 jnp.sum(onehot * sel_r[:, :, None], axis=1) * lane_f,
                 axis=1, keepdims=True,
@@ -270,7 +281,7 @@ def _kernel(
                 # follower best and merged globally AFTER the tile loop so
                 # follower-vs-leader ties resolve identically to scan.py
                 # (follower wins) regardless of which tile each lives in.
-                wl = w_t * (nrc.astype(f32) + ncons_t)  # [T, 1]
+                wl = w_t * (nrc + ncons_t)  # [T, 1]
                 A_l = jnp.where(
                     (nrc >= 1) & elig,
                     _pen(loads_s[:, :1] - wl, avg) - F_s[:, :1],
@@ -418,6 +429,9 @@ def _kernel(
         # ---- apply: member/replica rows + move logs (per commit) --------
         # commits are partition-disjoint, so each touched row is written by
         # exactly one candidate
+        lane_t = lax.broadcasted_iota(jnp.int32, (1, TILE_P), 1)
+        sub_r = lax.broadcasted_iota(jnp.int32, (R, 1), 0)
+
         def commit(i, n_acc):
             ok_i = ext_i(oki, i) > 0
 
@@ -427,9 +441,25 @@ def _kernel(
                 s_i = ext_i(cs, i)
                 slot_i = ext_i(cslot, i)
                 at = ext_i(jnp.where(ok, pos, jnp.zeros_like(pos)), i)
-                rrow = replicas_ref[pl.ds(p_i, 1), :]  # [1, R] i32
-                rrow = jnp.where(iota_r == slot_i, i, rrow)
-                replicas_ref[pl.ds(p_i, 1), :] = rrow
+                # transposed replica write: blend one (slot, partition)
+                # cell inside the 256-aligned lane tile holding p_i; the
+                # new entry is the target broker index as exact f32
+                base = lax.mul(
+                    lax.div(p_i, jnp.int32(TILE_P)), jnp.int32(TILE_P)
+                )
+                p_loc = lax.rem(p_i, jnp.int32(TILE_P))
+                i_f = jnp.max(
+                    jnp.where(
+                        lane_b[0, :] == i,
+                        lanef_ref[0, :],
+                        jnp.zeros((B,), f32),
+                    )
+                )
+                tile = replicas_ref[:, pl.ds(base, TILE_P)]  # [R, T]
+                tile = jnp.where(
+                    (lane_t == p_loc) & (sub_r == slot_i), i_f, tile
+                )
+                replicas_ref[:, pl.ds(base, TILE_P)] = tile
                 # packed log write: dynamic row + masked-lane blend (the
                 # buffers are [ML/128, 128] — see module docstring)
                 at_row = lax.div(at, jnp.int32(128))
@@ -517,6 +547,18 @@ def pallas_session(
     # NOTE: the kernel is strictly 32-bit by construction (max-based lane
     # extraction, f32-accumulated counts, lax.argmin with index_dtype) —
     # Mosaic has no 64-bit types and the process may run with x64 enabled
+    # transposed device layout: replicas [R, P] as exact-integer f32,
+    # per-partition columns packed [5, P] — see the kernel docstring
+    replicas_t = jnp.asarray(replicas, i32).astype(f32).T
+    cols_t = jnp.stack(
+        [
+            jnp.asarray(weights, f32).reshape(P),
+            jnp.asarray(nrep_cur, i32).astype(f32).reshape(P),
+            jnp.asarray(nrep_tgt, i32).astype(f32).reshape(P),
+            jnp.asarray(ncons, f32).reshape(P),
+            jnp.asarray(pvalid, i32).astype(f32).reshape(P),
+        ]
+    )  # [5, P]
     out = _call(
         partial(
             _kernel, P=P, R=R, B=B, ML=ML, allow_leader=allow_leader,
@@ -526,30 +568,26 @@ def pallas_session(
     )(
         scalar(budget, i32),
         scalar(batch, i32),
-        scalar(min_replicas, i32),
+        scalar(min_replicas, f32),
         scalar(min_unbalance, f32),
         scalar(churn_gate, f32),
         jnp.asarray(loads, f32).reshape(1, B),
-        jnp.asarray(replicas, i32),
+        replicas_t,
         # all_allowed: a [1, B] placeholder replaces the [P, B] matrix —
         # the largest kernel input both as transfer and as VMEM resident
         jnp.zeros((1, B), i8)
         if all_allowed
         else jnp.asarray(allowed, i8).reshape(P, B),
-        jnp.asarray(weights, f32).reshape(P, 1),
-        jnp.asarray(nrep_cur, i32).reshape(P, 1),
-        jnp.asarray(nrep_tgt, i32).reshape(P, 1),
-        jnp.asarray(ncons, f32).reshape(P, 1),
-        jnp.asarray(pvalid, i32).reshape(P, 1),
+        cols_t,
         jnp.asarray(always_valid, i32).reshape(1, B),
         jnp.asarray(universe_valid, i32).reshape(1, B),
         jnp.arange(B, dtype=f32).reshape(1, B),
         jnp.arange(R, dtype=f32).reshape(1, R),
     )
-    loads_out, replicas_out, n, mp, mslot, msrc, mtgt = out
+    loads_out, replicas_t_out, n, mp, mslot, msrc, mtgt = out
     # packed [ML/128, 128] row-major == flat move order
     return (
-        replicas_out,
+        replicas_t_out.T.astype(i32),
         loads_out.reshape(B),
         n.reshape(()),
         mp.reshape(ML),
@@ -568,14 +606,14 @@ def _call(kernel, P, R, B, ML, smem, vmem, interpret=False):
         interpret=interpret,
         out_shape=(
             jax.ShapeDtypeStruct((1, B), f32),  # loads
-            jax.ShapeDtypeStruct((P, R), i32),  # replicas
+            jax.ShapeDtypeStruct((R, P), f32),  # replicas (transposed)
             jax.ShapeDtypeStruct((1, 1), i32),  # n
             jax.ShapeDtypeStruct((ML // 128, 128), i32),  # move_p
             jax.ShapeDtypeStruct((ML // 128, 128), i32),  # move_slot
             jax.ShapeDtypeStruct((ML // 128, 128), i32),  # move_src
             jax.ShapeDtypeStruct((ML // 128, 128), i32),  # move_tgt
         ),
-        in_specs=[smem] * 5 + [vmem] * 12,
+        in_specs=[smem] * 5 + [vmem] * 8,
         out_specs=(vmem, vmem, smem, vmem, vmem, vmem, vmem),
         # the replicas output aliases the replicas input (operand 6 of the
         # flattened inputs): without the alias a second lane-padded [P, R]

@@ -64,10 +64,13 @@ from kafkabalancer_tpu.ops.runtime import next_bucket  # noqa: E402
 # commits at BETTER final unbalance and equal wall-clock.
 DEFAULT_CHURN_GATE = 1.5
 
-# whole-session kernel capacity: partition-bucket x broker-bucket cells that
-# still fit the v5e scoped-VMEM budget (16k x 128 verified on hardware;
-# 32k x 128 OOMs in Mosaic compilation)
-PALLAS_VMEM_CELLS = 16384 * 128
+# whole-session kernel capacity: partition-bucket x broker-bucket cells
+# that still fit the v5e scoped-VMEM budget with the transposed compact
+# layout. All-allowed sessions carry no [P, B] matrix at all (128k x 256
+# verified on hardware); restricted sessions keep the int8 allowed matrix
+# resident (64k x 128 verified).
+PALLAS_VMEM_CELLS = 131072 * 256
+PALLAS_VMEM_CELLS_RESTRICTED = 65536 * 128
 
 
 @partial(
@@ -555,14 +558,17 @@ def plan(
     while remaining > 0:
         # only the partition axis needs TILE_P alignment for the kernel
         dp = tensorize(pl, cfg, min_bucket=TILE_P if use_pallas else 8)
+        # the default FillDefaults outcome allows every broker everywhere
+        # (detected by value, before the capacity gate — the all-allowed
+        # kernel mode stores no [P, B] matrix and has a far higher ceiling)
+        all_allowed = bool(dp.allowed[:, : dp.nb].all(axis=1)[: dp.np_].all())
         if engine == "pallas" and (
             dp.replicas.shape[0] * max(dp.bvalid.shape[0], 128)
-            > PALLAS_VMEM_CELLS
+            > (PALLAS_VMEM_CELLS if all_allowed else PALLAS_VMEM_CELLS_RESTRICTED)
         ):
-            # the whole-session kernel keeps its state VMEM-resident; past
-            # the empirical scoped-VMEM ceiling (16k partitions x 128
-            # brokers on v5e) Mosaic compilation OOMs, so fall back to the
-            # XLA while_loop session — same algorithm, HBM-resident state
+            # past the empirical scoped-VMEM ceiling Mosaic compilation
+            # OOMs, so fall back to the XLA while_loop session — same
+            # algorithm, HBM-resident state
             engine = "xla"
             use_pallas = False
             dp = tensorize(pl, cfg)
@@ -574,12 +580,9 @@ def plan(
             dp.bvalid.shape[0],
         )
         chunk = min(remaining, chunk_moves)
-        # the default FillDefaults outcome allows every broker everywhere;
-        # then the [P, B] allowed matrix is just the broker-validity row
-        # broadcast — build it ON DEVICE from the [B] mask instead of
-        # transferring 2 MB per session (and let the kernel skip storing
-        # it entirely)
-        all_allowed = bool(dp.allowed[:, : dp.nb].all(axis=1)[: dp.np_].all())
+        # all-allowed: the [P, B] allowed matrix is just the broker
+        # validity row broadcast — build it ON DEVICE from the [B] mask
+        # instead of transferring it (and the kernel skips storing it)
         if all_allowed:
             allowed_dev = jnp.broadcast_to(
                 jnp.asarray(dp.bvalid)[None, :], dp.allowed.shape

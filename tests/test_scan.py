@@ -241,13 +241,20 @@ def test_pallas_vmem_gate_falls_back_to_xla():
     fall back to the XLA session instead of OOMing Mosaic compilation.
     On CPU this is observable directly: engine='pallas' normally fails
     without a TPU backend, but above the gate the fallback engages first
-    and the plan succeeds."""
-    from kafkabalancer_tpu.solvers.scan import PALLAS_VMEM_CELLS
+    and the plan succeeds. The restricted mode (an explicit per-partition
+    broker list keeps the [P, B] allowed matrix resident) has the lower
+    ceiling, so a 17k x 200 instance with one restricted partition trips
+    it."""
+    from kafkabalancer_tpu.solvers.scan import (
+        PALLAS_VMEM_CELLS_RESTRICTED,
+    )
     from kafkabalancer_tpu.utils.synth import synth_cluster
 
-    n_parts = 17_000  # buckets to 32768 x 128 cells > PALLAS_VMEM_CELLS
-    assert 32768 * 128 > PALLAS_VMEM_CELLS
-    pl = synth_cluster(n_parts, 100, rf=2, seed=3, weighted=True)
+    n_parts = 17_000  # buckets to 32768 x 512 cells
+    assert 32768 * 512 > PALLAS_VMEM_CELLS_RESTRICTED
+    pl = synth_cluster(n_parts, 300, rf=2, seed=3, weighted=True)
+    p0 = pl.partitions[0]
+    p0.brokers = sorted(set(p0.replicas) | {1, 2})
     cfg = default_rebalance_config()
     cfg.min_unbalance = 0.0
     opl = plan(pl, cfg, 3, batch=8, engine="pallas")
