@@ -14,26 +14,34 @@ candidate selection with the factorized rank-1 objective
 ``u = su + A[p,r] + C[p,t]``, first-claimant disjointness, churn gate,
 dynamic broker-table membership), with kernel-friendly re-formulations:
 
+- ALL state lives TRANSPOSED with the partition axis on lanes
+  (replicas ``[R, P]`` as exact-integer f32, per-partition columns
+  packed ``[5, P]`` f32): VMEM tiles pad the lane dimension to 128, so
+  the natural ``[P, small]`` orientation costs 128x its logical size
+  and capped the kernel at a 16k-partition bucket — transposed, the
+  verified ceiling is a 128k x 256 bucket (64k x 128 when an explicit
+  per-partition broker list keeps the int8 ``[P, B]`` allowed matrix
+  resident; scan.plan gates and falls back to the XLA session beyond);
+- per-tile compute transposes lane slices back to ``[T, R]``/``[T, 5]``
+  with one MXU identity-dot each (dynamic lane slicing at 256-aligned
+  offsets); commit writes blend one (slot, partition) cell inside the
+  aligned lane tile holding the partition;
+- no int<->float vector conversion exists anywhere: ``arith.sitofp``
+  fails to legalize in Mosaic, so integers ride f32 exactly (< 2^24)
+  and float iotas arrive as constant inputs (``tpu.iota`` is int-only);
 - the ``loads[s]`` gather becomes a one-hot contraction per P-tile (MXU);
+- the per-target winner's attributes (slot, source, delta) are captured
+  IN the tile loop as payload columns contracted with the winner
+  one-hot — no post-selection re-reads;
 - claims/disjointness become pairwise ``[B, B]`` masks (no scatters);
 - cumsum becomes a lower-triangular ``[B, B]`` contraction;
-- replica updates are per-commit row read-modify-writes (the ≤B commits
-  per iteration are partition-disjoint, so rows are written once);
-  replica-set membership is never stored — it is derived per tile from
-  the replica matrix (the [P, B] matrix would be both the largest
-  transfer and the largest VMEM resident);
 - move logs live in ``[max_moves/128, 128]`` VMEM buffers (exact (8,128)
   tiles) written with dynamic-sublane row selection + masked-lane
-  blending; a ``[max_moves, 1]`` layout would tile-pad its lane dimension
-  128-fold and blow the scoped-VMEM budget whenever the outputs stay on
-  device (e.g. embedded in solvers/polish.py ``converge_session``). The
-  replicas output aliases the replicas input for the same reason.
+  blending. The replicas output aliases the replicas input.
 
-The ``allowed`` mask is int8 in VMEM (the kernel's VMEM budget is tight
-at the 16k-partition bucket); int8 values are widened before any
-comparison (int8 compares break the Mosaic lowering). Float32 only —
-this is the throughput path; parity modes stay on the XLA/host solvers. Under the Pallas interpreter the
-kernel is bit-identical to ``scan.session``'s batch path (pinned by
+Float32 only — this is the throughput path; parity modes stay on the
+XLA/host solvers. Under the Pallas interpreter the kernel is
+bit-identical to ``scan.session``'s batch path (pinned by
 tests/test_pallas.py); on hardware, float reduction order may resolve
 exact candidate ties differently — counts and final unbalance match.
 """
