@@ -157,3 +157,38 @@ def test_pallas_session_restricted_brokers_parity():
     # restrictions actually bound the plan: every replica stays allowed
     for p in pl_p.iter_partitions():
         assert set(p.replicas).issubset(set(p.brokers))
+
+
+def test_pallas_session_high_rf_parity():
+    """R bucket of 8 (replication factors up to 6): the transposed-layout
+    kernel's per-tile transposes, membership derivation, and payload
+    capture must stay bit-identical to the XLA batch path across the
+    wider slot axis."""
+    import jax.numpy as jnp
+
+    rng = random.Random(3200)
+    pl = random_partition_list(
+        rng, 48, 10, max_rf=6, weighted=True, with_consumers=True
+    )
+    cfg = default_rebalance_config()
+    cfg.min_unbalance = 1e-6
+    cfg.allow_leader_rebalancing = True
+
+    pl_x, pl_p = copy.deepcopy(pl), copy.deepcopy(pl)
+    opl_x = plan(
+        pl_x, copy.deepcopy(cfg), 40, dtype=jnp.float32, batch=16,
+        engine="xla",
+    )
+    opl_p = plan(
+        pl_p, copy.deepcopy(cfg), 40, batch=16, engine="pallas-interpret",
+    )
+    moves_x = [
+        (p.topic, p.partition, tuple(p.replicas))
+        for p in (opl_x.partitions or [])
+    ]
+    moves_p = [
+        (p.topic, p.partition, tuple(p.replicas))
+        for p in (opl_p.partitions or [])
+    ]
+    assert moves_x == moves_p
+    assert pl_x == pl_p
