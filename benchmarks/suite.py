@@ -14,10 +14,14 @@ Configs (BASELINE.md):
   4. beam search with the same-topic anti-colocation penalty (quality vs greedy)
   5. broker add/remove what-if sweep vs sequential per-scenario runs
   6. -rebalance-leader at the north-star scale (fused device Balance loop)
+  7. 3x north-star scale through the whole-session kernel (no greedy
+     baseline — one greedy move alone costs ~100 s there; the baseline
+     column renders '-')
 
 Each row reports wall-clock and final unbalance for the CPU-greedy baseline
-and the TPU path. Output is a human-readable table on stdout; one JSON line
-per config on stderr for machines.
+(where one is measurable) and the TPU path. Output is a human-readable
+table on stdout; one JSON line per config on stderr for machines
+(baseline fields are null for baseline-less rows).
 """
 
 from __future__ import annotations
@@ -68,7 +72,7 @@ def row(config, baseline_s, baseline_u, tpu_s, tpu_u, note=""):
         json.dumps(
             {
                 "config": config,
-                "baseline_s": round(baseline_s, 4),
+                "baseline_s": None if baseline_s is None else round(baseline_s, 4),
                 "baseline_unbalance": baseline_u,
                 "tpu_s": round(tpu_s, 4),
                 "tpu_unbalance": tpu_u,
@@ -320,9 +324,8 @@ def config7_scale():
     """3x the north-star scale through the whole-session kernel: the
     transposed compact layout keeps 30k x 100 VMEM-resident (the
     previous [P, small] orientation capped the kernel at a 16k bucket).
-    No greedy baseline — a single greedy move alone takes ~100 s here;
-    the baseline column reuses config 6's capped host measurement scale
-    via extrapolation and is omitted as '-'."""
+    No greedy baseline — a single greedy move alone takes ~100 s here,
+    so the baseline cell renders '-' and the JSON carries null."""
     import jax.numpy as jnp
 
     from kafkabalancer_tpu.solvers.scan import plan
@@ -342,8 +345,9 @@ def config7_scale():
                     dtype=jnp.float32, batch=128, engine="pallas",
                     polish=True)
     row(
-        f"7: scale {n_parts // 1000}k/100 allow-leader+polish", 0.0, None,
-        tt, unbalance_of(pl_t), f"{len(opl)} moves to convergence",
+        f"7: scale {n_parts // 1000}k/100 allow-leader+polish", None, None,
+        tt, unbalance_of(pl_t),
+        f"{len(opl)} moves to convergence (u={unbalance_of(pl_t):.2e})",
     )
 
 
@@ -359,9 +363,10 @@ def main():
     w = max(len(r[0]) for r in ROWS) + 2
     print(f"{'config':<{w}}{'cpu greedy':>14}{'tpu':>12}{'speedup':>9}  note")
     for config, bs, bu, ts, tu, note in ROWS:
-        sp = f"{bs / ts:.1f}x" if ts > 0 else "-"
+        sp = f"{bs / ts:.1f}x" if bs is not None and ts > 0 else "-"
+        bs_s = "-" if bs is None else f"{bs:.3f}s"
         ub = "" if bu is None else f" (u={bu:.2e} vs {tu:.2e})"
-        print(f"{config:<{w}}{bs:>12.3f}s{ts:>11.3f}s{sp:>9}  {note}{ub}")
+        print(f"{config:<{w}}{bs_s:>13}{ts:>11.3f}s{sp:>9}  {note}{ub}")
 
 
 if __name__ == "__main__":
