@@ -244,7 +244,11 @@ def _swap_loop(
         e2 = jsel[e1]
         p1w, r1w = ep[e1], er[e1]
         p2w, r2w = ep[e2], er[e2]
-        dw = ew[e1] - ew[e2]
+        # dead/rejected pairs index the +inf weight padding; their transfer
+        # must be EXACTLY zero before the masked scatter-add below — the
+        # usual zero-mask trick fails on inf payloads (inf * 0 = NaN, and
+        # one NaN added to a broker load poisons every later phase)
+        dw = jnp.where(ok, ew[e1] - ew[e2], 0.0)
 
         # partition claims: the same partition may hold replicas in two
         # different pairs; first claimant (lowest pair index) wins
@@ -306,6 +310,111 @@ def _swap_loop(
 
     st = (loads, replicas, member, n, jnp.int32(0), jnp.int32(0), mp, mslot, mtgt)
     loads, replicas, member, n, _s, _i, mp, mslot, mtgt = lax.while_loop(
+        cond, body, st
+    )
+    return loads, replicas, member, n, mp, mslot, mtgt
+
+
+def _leader_shuffle_loop(
+    loads,
+    replicas,
+    member,
+    n,
+    mp,
+    mslot,
+    mtgt,
+    *,
+    weights,
+    nrep_cur,
+    nrep_tgt,
+    ncons,
+    pvalid,
+    always_valid,
+    universe_valid,
+    min_replicas,
+    min_unbalance,
+    budget,
+    ML: int,
+):
+    """Intra-partition leadership transfers: hand the leader role to one
+    of the partition's OWN followers. This shifts exactly the leader
+    premium ``w*(replicas+consumers) - w`` between two member brokers
+    with no data movement and no membership change — a neighborhood
+    neither the reference's ``move()`` (targets must be non-members,
+    steps.go:199-201) nor the swap phase (followers only) can express,
+    yet it is what closes the final gap when the residual unbalance is
+    premium-granular. Logged with ``leader.SWAP_SLOT`` (decoded as the
+    ``replacepl`` in-place position exchange, utils.go:181-188)."""
+    from kafkabalancer_tpu.solvers.leader import SWAP_SLOT
+
+    P, R = replicas.shape
+    dtype = loads.dtype
+    slot_iota = jnp.arange(R)[None, :]
+
+    def cond(st):
+        n, done = st[3], st[4]
+        return (~done) & (n + 1 <= budget) & (n + 1 <= ML)
+
+    def body(st):
+        loads, replicas, member, n, _done, mp, mslot, mtgt = st
+        bcount = jnp.sum(
+            (member & pvalid[:, None]).astype(jnp.int32), axis=0,
+            dtype=jnp.int32,
+        )
+        bvalid = (always_valid | (bcount > 0)) & universe_valid
+        nb = jnp.sum(bvalid.astype(jnp.int32), dtype=jnp.int32)
+        avg = jnp.sum(jnp.where(bvalid, loads, 0.0)) / nb.astype(dtype)
+        F = cost.overload_penalty(loads, avg)
+        su_terms = jnp.where(bvalid, F, 0.0)
+        su = jnp.sum(su_terms)
+        eps = jnp.maximum(min_unbalance, su * SWAP_REL_EPS)
+
+        lead = jnp.clip(replicas[:, 0], 0)  # [P]
+        extra = weights * (nrep_cur.astype(dtype) + ncons) - weights  # [P]
+        fol = jnp.clip(replicas, 0)  # [P, R]
+        valid = (
+            (slot_iota >= 1)
+            & (slot_iota < nrep_cur[:, None])
+            & pvalid[:, None]
+            & (nrep_tgt >= min_replicas)[:, None]
+            & (extra > 0)[:, None]
+        )
+        Ls = loads[lead][:, None]
+        Lf = loads[fol]
+        ex = extra[:, None]
+        delta = (
+            cost.overload_penalty(Ls - ex, avg)
+            + cost.overload_penalty(Lf + ex, avg)
+            - F[lead][:, None]
+            - F[fol]
+        )
+        delta = jnp.where(valid, delta, jnp.inf)
+        flat = delta.reshape(-1)
+        i = jnp.argmin(flat)
+        accept = flat[i] < -eps
+        p, r = jnp.divmod(i, R)
+        l_b = lead[p]
+        f_b = replicas[p, r]
+
+        def apply(a):
+            loads, replicas, mp, mslot, mtgt = a
+            loads = loads.at[l_b].add(-extra[p]).at[f_b].add(extra[p])
+            replicas = replicas.at[p, 0].set(f_b).at[p, r].set(
+                l_b.astype(replicas.dtype)
+            )
+            mp = mp.at[n].set(p.astype(jnp.int32))
+            mslot = mslot.at[n].set(jnp.int32(SWAP_SLOT))
+            mtgt = mtgt.at[n].set(f_b.astype(jnp.int32))
+            return loads, replicas, mp, mslot, mtgt
+
+        loads, replicas, mp, mslot, mtgt = lax.cond(
+            accept, apply, lambda a: a, (loads, replicas, mp, mslot, mtgt)
+        )
+        n = n + accept.astype(n.dtype)
+        return loads, replicas, member, n, ~accept, mp, mslot, mtgt
+
+    st = (loads, replicas, member, n, jnp.bool_(False), mp, mslot, mtgt)
+    loads, replicas, member, n, _d, mp, mslot, mtgt = lax.while_loop(
         cond, body, st
     )
     return loads, replicas, member, n, mp, mslot, mtgt
@@ -420,6 +529,19 @@ def converge_session(
             universe_valid=universe_valid, min_unbalance=min_unbalance,
             budget=budget, ML=ML,
         )
+
+        # --- leadership-shuffle phase (allow_leader only) ---------------
+        if allow_leader:
+            loads, replicas, member, n, mp, mslot, mtgt = (
+                _leader_shuffle_loop(
+                    loads, replicas, member, n, mp, mslot, mtgt,
+                    weights=weights, nrep_cur=nrep_cur, nrep_tgt=nrep_tgt,
+                    ncons=ncons, pvalid=pvalid, always_valid=always_valid,
+                    universe_valid=universe_valid,
+                    min_replicas=min_replicas,
+                    min_unbalance=min_unbalance, budget=budget, ML=ML,
+                )
+            )
 
         return loads, replicas, member, n, n == n0, mp, mslot, mtgt
 

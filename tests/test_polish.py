@@ -145,3 +145,59 @@ def test_entry_table_static_structure():
         assert er[i] < dp.nrep_cur[ep[i]]
     # min-replicas gate (steps.go:168-170)
     assert (dp.nrep_tgt[ep[:n]] >= 2).all()
+
+
+def test_polish_near_global_optimum_tiny():
+    """Exhaustively enumerate every assignment on tiny instances: the
+    allow-leader polish pipeline must land within a small factor of the
+    true global optimum (greedy+swaps is still local search; the bound
+    documents how close it provably gets on these instances)."""
+    import itertools
+
+    from kafkabalancer_tpu.models import Partition, PartitionList
+
+    def pen_total(loads, brokers):
+        avg = sum(loads[b] for b in brokers) / len(brokers)
+        tot = 0.0
+        for b in brokers:
+            rel = loads[b] / avg - 1.0
+            tot += rel * rel * (1.0 if rel > 0 else 0.5)
+        return tot
+
+    rng_specs = [
+        # (weights per partition, rf), 3 brokers
+        ([2.0, 1.1, 0.7, 1.6, 0.9], 1),
+        ([1.5, 0.5, 1.2, 0.8], 2),
+    ]
+    brokers = [1, 2, 3]
+    for weights, rf in rng_specs:
+        # exhaustive optimum over ordered replica tuples (leader = first)
+        choices = [
+            list(itertools.permutations(brokers, rf)) for _ in weights
+        ]
+        best = float("inf")
+        for combo in itertools.product(*choices):
+            loads = {b: 0.0 for b in brokers}
+            for w, reps in zip(weights, combo):
+                loads[reps[0]] += w * len(reps)  # leader premium (ncons=0)
+                for b in reps[1:]:
+                    loads[b] += w
+            best = min(best, pen_total(loads, brokers))
+
+        pl = PartitionList(
+            version=1,
+            partitions=[
+                Partition(
+                    topic="t", partition=i, replicas=list(brokers[:rf]),
+                    weight=w,
+                )
+                for i, w in enumerate(weights)
+            ],
+        )
+        cfg = default_rebalance_config()
+        cfg.min_unbalance = 0.0
+        cfg.allow_leader_rebalancing = True
+        cfg.brokers = list(brokers)  # full universe incl. unobserved
+        plan(pl, cfg, 10_000, batch=2, engine="xla", polish=True)
+        got = u_of(pl)
+        assert got <= max(best * 3.0, best + 1e-9), (weights, rf, got, best)
