@@ -290,8 +290,11 @@ def config5_sweep():
 
 def config6_rebalance_leader():
     """-rebalance-leader at the north-star scale: the fused device Balance
-    loop (solvers/leader.py — leader redistribution interleaved with
-    greedy moves, exact step precedence) vs the host per-move pipeline."""
+    loop (solvers/leader.py) in its batched-transfer mode — K heaviest
+    brokers paired with K lightest per device iteration, best-gain led
+    partition per pair — run UNCAPPED to the reference gate
+    (su < min_unbalance, steps.go:249-253) vs the host per-move
+    pipeline."""
     import jax.numpy as jnp
 
     from kafkabalancer_tpu.solvers.scan import plan
@@ -304,19 +307,25 @@ def config6_rebalance_leader():
     def fresh():
         return synth_cluster(n_parts, n_brokers, rf=3, seed=42, weighted=True)
 
-    budget = 1024
+    budget = 1 << 17  # effectively uncapped: the gate ends the session
+    batch = n_brokers // 2
     # the host pipeline pays O(P) per leader move and O(P*R*B^2) per
     # greedy move; cap its measurement so the suite stays bounded
     host_cap = 16 if FAST else 64
     pl_g = fresh()
     tg, n_g = timed(greedy_converge, pl_g, copy.deepcopy(cfg), host_cap)
-    plan(fresh(), copy.deepcopy(cfg), budget, dtype=jnp.float32)  # warm
+    plan(fresh(), copy.deepcopy(cfg), budget, dtype=jnp.float32,
+         batch=batch)  # warm
     pl_t = fresh()
-    tt, opl = timed(plan, pl_t, copy.deepcopy(cfg), budget, dtype=jnp.float32)
+    tt, opl = timed(plan, pl_t, copy.deepcopy(cfg), budget,
+                    dtype=jnp.float32, batch=batch)
+    u_t = unbalance_of(pl_t)
+    gate = "converged" if u_t < cfg.min_unbalance else "NOT converged"
     row(
         f"6: rebalance-leader {n_parts // 1000}k/{n_brokers}", tg,
-        unbalance_of(pl_g), tt, unbalance_of(pl_t),
-        f"{n_g} (capped) vs {len(opl)} moves",
+        unbalance_of(pl_g), tt, u_t,
+        f"{n_g} (capped) vs {len(opl)} moves ({gate} at gate "
+        f"su<{cfg.min_unbalance})",
     )
 
 

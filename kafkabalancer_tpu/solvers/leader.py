@@ -29,6 +29,24 @@ replays one ``Balance()`` call with exact step precedence:
 
 The session ends when neither step fires or the budget is exhausted —
 identical to the CLI loop hitting "no candidate changes".
+
+``batch > 1`` enables the convergent batched extension: per device
+iteration the K heaviest brokers pair with the K lightest (the same
+hot/cold pairing the polish swap phase uses, solvers/polish.py), and
+each pair hands over the led partition whose transfer maximizes the
+exact pair objective gain. Disjoint broker pairs make the deltas
+exactly additive (the objective is a sum of per-broker penalties with a
+transfer-invariant average), so a round of K transfers lands precisely
+the sum of its scored gains. Two deliberate deviations from the
+reference trajectory (which ``batch=1`` replays exactly):
+
+- the transferred partition is chosen by gain, not first-in-list-order
+  (steps.go:258-266 is weight-blind, which plateaus at coarse
+  granularity and can oscillate);
+- only strictly improving transfers fire, so the session terminates at
+  ``su < min_unbalance`` (the reference gate, steps.go:249-253) or at
+  the improving-action fixed point instead of replaying worsening
+  transfers forever.
 """
 
 from __future__ import annotations
@@ -50,7 +68,7 @@ from kafkabalancer_tpu.ops import cost  # noqa: E402
 SWAP_SLOT = -2
 
 
-@partial(jax.jit, static_argnames=("max_moves", "allow_leader"))
+@partial(jax.jit, static_argnames=("max_moves", "allow_leader", "batch"))
 def leader_session(
     loads,
     replicas,
@@ -69,13 +87,16 @@ def leader_session(
     *,
     max_moves: int,
     allow_leader: bool,
+    batch: int = 1,
 ):
     """Fused rebalance-leaders Balance loop (see module docstring).
 
     Returns ``(replicas, loads, n, move_p, move_slot, move_tgt)``; log
     entries with ``move_slot == SWAP_SLOT`` are leadership swaps toward
     ``move_tgt`` (decode: exchange the positions of ``move_tgt`` and the
-    current leader), all others are plain slot overwrites.
+    current leader), all others are plain slot overwrites. ``batch=1``
+    replays the reference trajectory exactly; ``batch>1`` runs the
+    convergent batched extension (module docstring).
     """
     P, R = replicas.shape
     B = loads.shape[0]
@@ -83,6 +104,8 @@ def leader_session(
     iota_p = jnp.arange(P, dtype=jnp.int32)
     iota_r = jnp.arange(R, dtype=jnp.int32)
     slot_iota = iota_r[None, :]
+    K = max(1, min(batch, B))
+    batched = batch > 1
 
     mp0 = jnp.full(max_moves + 1, -1, jnp.int32)
 
@@ -104,18 +127,64 @@ def leader_session(
         heavy = perm[jnp.clip(nb - 1, 0, B - 1)]
         light = perm[0]
 
-        lead_mask = (
-            (replicas[:, 0].astype(jnp.int32) == heavy)
-            & pvalid
-            & (nrep_tgt >= min_replicas)
-            & (nrep_cur >= 1)
-        )
-        leader_fire = (su >= min_unbalance) & jnp.any(lead_mask)
+        eligible_p = pvalid & (nrep_tgt >= min_replicas) & (nrep_cur >= 1)
+        if batched:
+            # pair the K heaviest with the K lightest valid brokers; pick
+            # each pair's best-gain led partition; fire improving pairs only
+            ii = jnp.arange(K, dtype=jnp.int32)
+            hk = perm[jnp.clip(nb - 1 - ii, 0, B - 1)]
+            lk = perm[jnp.clip(ii, 0, B - 1)]
+            valid_pair = (nb - 1 - ii) > ii
+            leaders_of = replicas[:, 0].astype(jnp.int32)
+            fullw = weights * (nrep_cur.astype(dtype) + ncons)  # leader load
+            extraw = fullw - weights  # premium over a follower
+            elig = (leaders_of[None, :] == hk[:, None]) & eligible_p[None, :]
+            is_fol = member.T[lk]  # [K, P]: light already a follower -> swap
+            delta = jnp.where(is_fol, extraw[None, :], fullw[None, :])
+            avg = jnp.sum(jnp.where(bvalid, loads, 0.0)) / nbf
+            lh = loads[hk][:, None]
+            ll = loads[lk][:, None]
+            pen = cost.overload_penalty
+            # exact pair gain: transfers conserve total load, so only the
+            # two brokers' penalty terms change (avg is invariant)
+            gain = (pen(lh, avg) + pen(ll, avg)) - (
+                pen(lh - delta, avg) + pen(ll + delta, avg)
+            )
+            gain = jnp.where(elig, gain, -jnp.inf)
+            p_star = jnp.argmax(gain, axis=1).astype(jnp.int32)
+            g_star = jnp.max(gain, axis=1)
+            fire0 = (
+                valid_pair
+                & (g_star > 0)
+                & (hk != lk)
+                & (su >= min_unbalance)
+            )
+            # replay the reference gate (steps.go:249-253) WITHIN the
+            # round: a pair only fires while the objective, net of the
+            # exactly-additive gains of the pairs before it, is still >=
+            # min_unbalance. The exclusive cumsum over fire0 may overcount
+            # gains of pairs this same gate trims, which only blocks
+            # conservatively (fewer transfers); pair 0 sees su itself, so
+            # rounds always progress.
+            g_cum = jnp.cumsum(jnp.where(fire0, g_star, 0.0))
+            su_before = su - (g_cum - jnp.where(fire0, g_star, 0.0))
+            fire1 = fire0 & (su_before >= min_unbalance)
+            cap = jnp.minimum(budget, jnp.int32(max_moves))
+            fire = fire1 & (n + jnp.cumsum(fire1.astype(jnp.int32)) <= cap)
+            leader_fire = jnp.any(fire)
+        else:
+            lead_mask = (
+                replicas[:, 0].astype(jnp.int32) == heavy
+            ) & eligible_p
+            leader_fire = (su >= min_unbalance) & jnp.any(lead_mask)
 
-        def leader_branch(args):
-            loads, replicas, member, bcount, mp, mslot, mtgt = args
-            p = jnp.min(jnp.where(lead_mask, iota_p, P))
-            p = jnp.clip(p, 0, P - 1)
+        def _transfer(state, p, light, log_idx):
+            """Hand leadership of partition ``p`` to broker ``light`` —
+            the shared replacepl analog (utils.go:166-197): swap branch
+            when ``light`` is already a follower (positions exchange, only
+            the premium moves), set branch otherwise (slot 0 overwritten,
+            the full leader load moves, membership updates)."""
+            loads, replicas, member, bcount, mp, mslot, mtgt = state
             w = weights[p]
             full = w * (nrep_cur[p].astype(dtype) + ncons[p])  # leader load
             extra = full - w  # leader premium over a follower
@@ -126,10 +195,7 @@ def leader_session(
             has = jnp.any(eqj)
             j = jnp.argmax(eqj).astype(jnp.int32)
 
-            # swap branch: positions exchange, membership unchanged, only
-            # the premium moves; set branch: slot 0 overwritten, the full
-            # leader load moves and membership updates
-            old_leader = replicas[p, 0].astype(jnp.int32)  # == heavy
+            old_leader = replicas[p, 0].astype(jnp.int32)
             new_row = jnp.where(
                 iota_r == 0,
                 light,
@@ -144,10 +210,35 @@ def leader_session(
             one = jnp.where(has, 0, 1).astype(jnp.int32)
             bcount = bcount.at[old_leader].add(-one).at[light].add(one)
 
-            mp = mp.at[n].set(p)
-            mslot = mslot.at[n].set(jnp.where(has, SWAP_SLOT, 0))
-            mtgt = mtgt.at[n].set(light)
-            return loads, replicas, member, bcount, mp, mslot, mtgt, True
+            mp = mp.at[log_idx].set(p)
+            mslot = mslot.at[log_idx].set(jnp.where(has, SWAP_SLOT, 0))
+            mtgt = mtgt.at[log_idx].set(light)
+            return loads, replicas, member, bcount, mp, mslot, mtgt
+
+        if batched:
+
+            def leader_branch(args):
+                def apply_k(k, carry):
+                    state, cnt = carry
+
+                    def do(c):
+                        state, cnt = c
+                        state = _transfer(state, p_star[k], lk[k], n + cnt)
+                        return state, cnt + 1
+
+                    return lax.cond(fire[k], do, lambda c: c, (state, cnt))
+
+                state, cnt = lax.fori_loop(
+                    0, K, apply_k, (args, jnp.int32(0))
+                )
+                return (*state, cnt)
+
+        else:
+
+            def leader_branch(args):
+                p = jnp.min(jnp.where(lead_mask, iota_p, P))
+                p = jnp.clip(p, 0, P - 1)
+                return (*_transfer(args, p, light, n), jnp.int32(1))
 
         def move_branch(args):
             loads, replicas, member, bcount, mp, mslot, mtgt = args
@@ -207,7 +298,10 @@ def leader_session(
                 accept, apply, lambda a: a,
                 (loads, replicas, member, bcount, mp, mslot, mtgt),
             )
-            return loads, replicas, member, bcount, mp, mslot, mtgt, accept
+            return (
+                loads, replicas, member, bcount, mp, mslot, mtgt,
+                accept.astype(jnp.int32),
+            )
 
         loads, replicas, member, bcount, mp, mslot, mtgt, fired = lax.cond(
             leader_fire,
@@ -215,8 +309,10 @@ def leader_session(
             move_branch,
             (loads, replicas, member, bcount, mp, mslot, mtgt),
         )
-        n = n + fired.astype(n.dtype)
-        return loads, replicas, member, bcount, n, ~fired, mp, mslot, mtgt
+        n = n + fired
+        return (
+            loads, replicas, member, bcount, n, fired == 0, mp, mslot, mtgt
+        )
 
     st = (
         loads, replicas, member, bcount0, jnp.int32(0), jnp.bool_(False),

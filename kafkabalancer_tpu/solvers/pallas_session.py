@@ -62,6 +62,7 @@ from jax.experimental import pallas as pl  # noqa: E402
 from jax.experimental.pallas import tpu as pltpu  # noqa: E402
 
 from kafkabalancer_tpu.ops.cost import overload_penalty as _pen  # noqa: E402
+from kafkabalancer_tpu.solvers.scan import DEFAULT_CHURN_GATE  # noqa: E402
 
 BIG = 1e30  # inf stand-in (avoids inf−inf NaNs in masking)
 TILE_P = 256
@@ -518,7 +519,7 @@ def pallas_session(
     min_unbalance,
     budget,
     batch,
-    churn_gate=1.5,  # see scan.DEFAULT_CHURN_GATE
+    churn_gate=DEFAULT_CHURN_GATE,
     *,
     max_moves: int,
     allow_leader: bool,

@@ -64,6 +64,7 @@ import numpy as np  # noqa: E402
 from jax import lax  # noqa: E402
 
 from kafkabalancer_tpu.ops import cost  # noqa: E402
+from kafkabalancer_tpu.solvers.scan import DEFAULT_CHURN_GATE  # noqa: E402
 
 # swap-phase convergence: shift rotations tried without progress before
 # declaring the pairing exhausted
@@ -453,6 +454,7 @@ def converge_session(
     ep,
     er,
     evalid,
+    churn_gate=DEFAULT_CHURN_GATE,
     *,
     max_moves: int,
     allow_leader: bool,
@@ -478,6 +480,11 @@ def converge_session(
 
     B = loads.shape[0]
     ML = 2 * max_moves  # phase buffers merge into double-size global logs
+    # the dynamic_update_slice merges at offset n are in-bounds only while
+    # n <= budget <= max_moves (phase logs are max_moves+1 long and land in
+    # the (ML+1)-sized global log); clamp so a caller passing budget >
+    # max_moves degrades to a capped session instead of corrupting the log
+    budget = jnp.minimum(budget, jnp.int32(max_moves))
     mp0 = jnp.full(ML + 1, -1, jnp.int32)
     use_pallas = engine in ("pallas", "pallas-interpret")
 
@@ -489,7 +496,7 @@ def converge_session(
         replicas, loads, n, pmp, pmslot, _pmsrc, pmtgt = pallas_session(
             loads, replicas, None, allowed, weights, nrep_cur, nrep_tgt,
             ncons, pvalid, always_valid, universe_valid, min_replicas,
-            min_unbalance, budget, jnp.int32(max(1, batch)),
+            min_unbalance, budget, jnp.int32(max(1, batch)), churn_gate,
             max_moves=max_moves, allow_leader=allow_leader,
             interpret=(engine == "pallas-interpret"),
             all_allowed=all_allowed,
@@ -510,7 +517,7 @@ def converge_session(
         replicas, loads, nm, pmp, pmslot, _pmsrc, pmtgt, _su = session(
             loads, replicas, member, allowed, weights, nrep_cur,
             nrep_tgt, ncons, pvalid, always_valid, universe_valid,
-            min_replicas, min_unbalance, budget - n,
+            min_replicas, min_unbalance, budget - n, churn_gate,
             max_moves=max_moves, allow_leader=allow_leader, batch=batch,
         )
         # merge the phase logs at offset n; entries past nm are -1 and get

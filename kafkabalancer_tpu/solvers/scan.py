@@ -435,10 +435,14 @@ def _leader_plan(
     dtype,
     chunk_moves: int,
     opl: PartitionList,
+    batch: int = 1,
 ) -> PartitionList:
     """Fused ``rebalance_leaders`` planning: host repairs (strictly before
     ReassignLeaders in the pipeline order), then the device Balance loop
-    of solvers/leader.py, chunked and decoded like the move sessions."""
+    of solvers/leader.py, chunked and decoded like the move sessions.
+    ``batch > 1`` selects the convergent batched-transfer extension
+    (solvers/leader.py module docstring); ``batch=1`` replays the
+    reference trajectory."""
     from kafkabalancer_tpu.solvers.leader import leader_session
 
     repaired, budget = _settle_head(
@@ -477,6 +481,7 @@ def _leader_plan(
             jnp.int32(chunk),
             max_moves=next_bucket(chunk, 128),
             allow_leader=cfg.allow_leader_rebalancing,
+            batch=max(1, batch),
         )
         packed = np.asarray(
             jnp.concatenate(
@@ -532,7 +537,9 @@ def plan(
         return opl
 
     if cfg.rebalance_leaders:
-        return _leader_plan(pl, cfg, max_reassign, dtype, chunk_moves, opl)
+        return _leader_plan(
+            pl, cfg, max_reassign, dtype, chunk_moves, opl, batch=batch
+        )
 
     repaired, budget = _settle_head(pl, cfg, max_reassign)
     opl.append(*repaired)
@@ -628,6 +635,7 @@ def plan(
                         jnp.asarray(ep_),
                         jnp.asarray(er_),
                         jnp.asarray(evalid),
+                        jnp.asarray(churn_gate, dtype),
                         max_moves=next_bucket(chunk, 128),
                         allow_leader=cfg.allow_leader_rebalancing,
                         batch=max(1, batch),

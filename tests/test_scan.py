@@ -236,6 +236,44 @@ def test_leader_session_swap_branch():
         assert len(set(p.replicas)) == len(p.replicas)
 
 
+def test_leader_session_batched_converges():
+    """The batched rebalance-leaders extension (batch > 1: K heaviest
+    brokers paired with K lightest, best-gain led partition per pair,
+    improving transfers only — solvers/leader.py module docstring) must
+    actually CONVERGE below the reference gate (su < min_unbalance,
+    steps.go:249-253) where the batch=1 reference trajectory merely
+    replays transfers, and every emitted entry must reflect the live
+    final assignment."""
+    from kafkabalancer_tpu.utils.synth import synth_cluster
+
+    pl = synth_cluster(300, 12, rf=3, seed=7, weighted=True)
+    cfg = default_rebalance_config()
+    cfg.rebalance_leaders = True
+    u0 = unbalance_of(pl)
+    opl = plan(pl, cfg, 1 << 14, batch=8)
+    uf = unbalance_of(pl)
+    assert uf < cfg.min_unbalance, (u0, uf)
+    live = {
+        (p.topic, p.partition): tuple(p.replicas)
+        for p in pl.iter_partitions()
+    }
+    for entry in opl.partitions or []:
+        assert tuple(entry.replicas) == live[(entry.topic, entry.partition)]
+        assert len(set(entry.replicas)) == len(entry.replicas)
+
+
+def test_leader_session_batched_respects_budget():
+    """Batched transfer rounds must trim to the remaining budget instead
+    of overshooting it (the in-round cumsum cap)."""
+    from kafkabalancer_tpu.utils.synth import synth_cluster
+
+    pl = synth_cluster(200, 10, rf=3, seed=11, weighted=True)
+    cfg = default_rebalance_config()
+    cfg.rebalance_leaders = True
+    opl = plan(pl, cfg, 5, batch=8)
+    assert len(opl) <= 5
+
+
 def test_pallas_vmem_gate_falls_back_to_xla():
     """Past the whole-session kernel's scoped-VMEM ceiling, plan() must
     fall back to the XLA session instead of OOMing Mosaic compilation.
