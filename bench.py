@@ -23,6 +23,14 @@ Prints exactly ONE JSON line on stdout:
 where value is the flagship wall-clock to convergence (median of three
 warm runs, compile cached). Diagnostics go to stderr.
 
+The cold-start protocol (deployment-realistic: the reference is a
+stateless CLI run once per move, README.md:21-33): after the warm runs
+populate the persistent compile cache, a FRESH child process re-runs one
+flagship plan. The reported ``cold_plan_s`` is what a new CLI invocation
+pays for the planning call itself on a cache-warm machine (compile
+replaced by cache deserialization); ``cold_total_s`` adds interpreter
+start, jax import and backend init.
+
 Env knobs: BENCH_FAST=1 shrinks the instance for smoke-testing;
 BENCH_PARTITIONS / BENCH_BROKERS / BENCH_BATCH / BENCH_ENGINE override.
 """
@@ -31,6 +39,7 @@ from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -39,10 +48,89 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def main() -> None:
-    fast = os.environ.get("BENCH_FAST") == "1"
+def _enable_persistent_cache(jax) -> None:
+    """Point jax at the repo-local persistent compile cache; repeat bench
+    invocations (and fresh CLI processes) deserialize executables instead
+    of recompiling."""
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+        )
+        # cache every executable: the session dispatches a few sub-second
+        # helper kernels (tensorize transfers, decode packing) whose
+        # recompiles would otherwise dominate a cold process
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception as exc:
+        log(f"persistent compile cache unavailable: {exc!r}")
+
+
+FLAGSHIP_BUDGET = 1 << 19
+
+
+def _flagship_inputs(fast: bool):
     n_parts = int(os.environ.get("BENCH_PARTITIONS", 1000 if fast else 10_000))
     n_brokers = int(os.environ.get("BENCH_BROKERS", 20 if fast else 100))
+    batch = int(os.environ.get("BENCH_BATCH", "100"))
+    engine = os.environ.get("BENCH_ENGINE", "pallas")
+    return n_parts, n_brokers, batch, engine
+
+
+def _flagship_case(n_parts: int, n_brokers: int, allow_leader: bool = True):
+    """The flagship instance + config — ONE builder shared by the warm
+    runs and the cold child: identical inputs are what make the child hit
+    the persistent cache, so any drift here silently turns the cold
+    number into a full-compile measurement."""
+    from kafkabalancer_tpu.models import default_rebalance_config
+    from kafkabalancer_tpu.utils.synth import synth_cluster
+
+    pl = synth_cluster(n_parts, n_brokers, rf=3, seed=42, weighted=True)
+    cfg = default_rebalance_config()
+    cfg.min_unbalance = 0.0
+    cfg.allow_leader_rebalancing = allow_leader
+    return pl, cfg
+
+
+def cold_child() -> None:
+    """One flagship plan in a fresh interpreter (see module docstring);
+    prints a single JSON line with the phase timings."""
+    t_start = time.perf_counter()
+    fast = os.environ.get("BENCH_FAST") == "1"
+    n_parts, n_brokers, batch, engine = _flagship_inputs(fast)
+
+    import jax
+    import jax.numpy as jnp
+
+    _enable_persistent_cache(jax)
+
+    from kafkabalancer_tpu.solvers.scan import plan
+
+    t_import = time.perf_counter() - t_start  # jax + solver stack
+    jax.devices()  # backend init (on axon: the relay handshake)
+    t_backend = time.perf_counter() - t_start - t_import
+
+    pl, cfg = _flagship_case(n_parts, n_brokers)
+    t0 = time.perf_counter()
+    opl = plan(
+        pl, cfg, FLAGSHIP_BUDGET, dtype=jnp.float32, batch=batch,
+        engine=engine, polish=True,
+    )
+    t_plan = time.perf_counter() - t0
+    print(
+        json.dumps(
+            {
+                "cold_import_s": round(t_import, 3),
+                "cold_backend_s": round(t_backend, 3),
+                "cold_plan_s": round(t_plan, 3),
+                "n_moves": len(opl),
+            }
+        )
+    )
+
+
+def main() -> None:
+    fast = os.environ.get("BENCH_FAST") == "1"
+    n_parts, n_brokers, batch, engine = _flagship_inputs(fast)
 
     import jax
     import jax.numpy as jnp
@@ -53,29 +141,17 @@ def main() -> None:
         get_broker_load,
         get_unbalance_bl,
     )
-    from kafkabalancer_tpu.models import default_rebalance_config
     from kafkabalancer_tpu.solvers.scan import plan
-    from kafkabalancer_tpu.utils.synth import synth_cluster
 
     # persistent compilation cache: repeat bench invocations skip the
     # one-time XLA/Mosaic compiles (the reported value is warm either way)
-    try:
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
-        )
-    except Exception as exc:
-        log(f"persistent compile cache unavailable: {exc!r}")
+    _enable_persistent_cache(jax)
 
     log(f"devices: {jax.devices()}")
     log(f"instance: {n_parts} partitions x {n_brokers} brokers, rf=3")
 
     def fresh(allow_leader=False):
-        pl = synth_cluster(n_parts, n_brokers, rf=3, seed=42, weighted=True)
-        cfg = default_rebalance_config()
-        cfg.min_unbalance = 0.0
-        cfg.allow_leader_rebalancing = allow_leader
-        return pl, cfg
+        return _flagship_case(n_parts, n_brokers, allow_leader)
 
     # --- baseline: reference-transcribed greedy moves, median of 3 --------
     pl, cfg = fresh()
@@ -98,9 +174,7 @@ def main() -> None:
         f"n={len(greedy_times)})"
     )
 
-    budget = 1 << 19
-    batch = int(os.environ.get("BENCH_BATCH", "100"))
-    engine = os.environ.get("BENCH_ENGINE", "pallas")
+    budget = FLAGSHIP_BUDGET
 
     # --- reference-trajectory move count: a batch=1 session walks the same
     # one-move-at-a-time trajectory the greedy solver would (follower-only,
@@ -156,6 +230,35 @@ def main() -> None:
     warm.sort()
     t_tpu = warm[len(warm) // 2]
 
+    # --- cold start: a FRESH process against the now-populated persistent
+    # cache — what one stateless CLI invocation actually pays ------------
+    cold = {}
+    try:
+        t0 = time.perf_counter()
+        # the child re-derives its config from env: hand it the RESOLVED
+        # engine so a pallas->xla fallback above carries over (identical
+        # inputs are what make the child hit the warm cache)
+        child_env = dict(os.environ)
+        child_env["BENCH_ENGINE"] = engine
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--cold-child"],
+            capture_output=True, text=True, timeout=1800, env=child_env,
+        )
+        cold_total = time.perf_counter() - t0
+        if proc.returncode == 0:
+            cold = json.loads(proc.stdout.strip().splitlines()[-1])
+            cold["cold_total_s"] = round(cold_total, 3)
+            log(
+                f"cold start (fresh process, cache-warm): plan "
+                f"{cold['cold_plan_s']:.3f}s, import {cold['cold_import_s']:.3f}s, "
+                f"backend {cold['cold_backend_s']:.3f}s, process total "
+                f"{cold_total:.3f}s"
+            )
+        else:
+            log(f"cold-start child failed: {proc.stderr[-500:]}")
+    except Exception as exc:
+        log(f"cold-start measurement unavailable: {exc!r}")
+
     est_mid = t_move * max(1, n_ref)
     est_lo = greedy_times[0] * max(1, n_ref)
     est_hi = greedy_times[-1] * max(1, n_ref)
@@ -183,10 +286,16 @@ def main() -> None:
                     round(est_hi / t_tpu, 2),
                 ],
                 "engine": engine,
+                **{k: cold[k] for k in (
+                    "cold_plan_s", "cold_total_s",
+                ) if k in cold},
             }
         )
     )
 
 
 if __name__ == "__main__":
-    main()
+    if "--cold-child" in sys.argv[1:]:
+        cold_child()
+    else:
+        main()

@@ -535,7 +535,9 @@ def _beam_round(pl, cfg, opl, budget, dtype):
 
     from kafkabalancer_tpu.solvers.scan import _decode_packed
 
-    return _decode_packed(packed, dp, opl)
+    # beam is always an extension trajectory (no batch=1 parity mode), so
+    # superseded same-slot writes are always safe to elide
+    return _decode_packed(packed, dp, opl, drop_superseded=True)
 
 
 def beam_move(

@@ -328,14 +328,45 @@ def _cfg_broker_mask(dp, cfg: RebalanceConfig) -> "np.ndarray":
     return mask
 
 
-def _decode_packed(packed: "np.ndarray", dp, opl: PartitionList) -> int:
+def _superseded_mask(mp, mslot) -> "np.ndarray":
+    """``keep`` mask collapsing consecutive same-slot runs per partition.
+
+    A batched session can re-move a (partition, slot) cell a later
+    iteration already overwrites; each emitted entry is real Kafka data
+    movement (kafkabalancer.go:177-221 — the deployment loop executes
+    every move), so the intermediate write is pure churn. Dropping is
+    exact ONLY within a consecutive run of plain moves on the same
+    (partition, slot): nothing reads the partition's state in between
+    (moves on other partitions never do; a later move on this partition
+    breaks the run). Leadership swaps (slot == SWAP_SLOT) read positions
+    via ``replicas.index`` — they are never dropped and break runs.
+    """
+    n = len(mp)
+    keep = np.ones(n, dtype=bool)
+    last_by_p: dict = {}
+    for i in range(n):
+        p, s = int(mp[i]), int(mslot[i])
+        prev = last_by_p.get(p)
+        if prev is not None and s >= 0 and prev[1] == s:
+            keep[prev[0]] = False
+        last_by_p[p] = (i, s)
+    return keep
+
+
+def _decode_packed(
+    packed: "np.ndarray", dp, opl: PartitionList,
+    drop_superseded: bool = False,
+) -> int:
     """Replay a packed ``[move_p | move_slot | move_tgt | n]`` move log
     onto the live partitions, appending each to ``opl`` in move order
     (the CLI main-loop output contract, kafkabalancer.go:177-221).
 
     A slot of ``leader.SWAP_SLOT`` is a leadership exchange (``replacepl``
     swap branch, utils.go:181-188): the target broker — already a
-    follower — trades positions with the leader. Returns the move count.
+    follower — trades positions with the leader. Returns the move count
+    CONSUMED from the session budget (the raw commit count — the caller's
+    chunk accounting must see device-side progress even when
+    ``drop_superseded`` elides emissions; see :func:`_superseded_mask`).
     """
     from kafkabalancer_tpu.solvers.leader import SWAP_SLOT
 
@@ -344,10 +375,18 @@ def _decode_packed(packed: "np.ndarray", dp, opl: PartitionList) -> int:
     mp = packed[:n]
     mslot = packed[ml : ml + n]
     mtgt = packed[2 * ml : 2 * ml + n]
+    keep = _superseded_mask(mp, mslot) if drop_superseded else None
     for i in range(n):
         part = dp.partitions[int(mp[i])]
         slot = int(mslot[i])
         tgt = int(dp.broker_ids[int(mtgt[i])])
+        if keep is not None and not keep[i]:
+            continue
+        if keep is not None and slot >= 0 and part.replicas[slot] == tgt:
+            # a collapsed run whose final write restores the original
+            # broker is a net no-op — emitting it would burn a real
+            # reassignment cycle on zero data movement
+            continue
         if slot == SWAP_SLOT:
             j = part.replicas.index(tgt)
             part.replicas[j] = part.replicas[0]
@@ -488,7 +527,7 @@ def _leader_plan(
                 [mp, mslot, mtgt, n.astype(jnp.int32).reshape(1)]
             )
         )
-        n = _decode_packed(packed, dp, opl)
+        n = _decode_packed(packed, dp, opl, drop_superseded=batch > 1)
         remaining -= n
         if n < chunk:
             break
@@ -652,7 +691,9 @@ def plan(
                         f"or 'pallas-interpret'"
                     ) from exc
                 raise
-            n = _decode_packed(packed, dp, opl)
+            # polish interleaves swap/shuffle phases — never a batch=1
+            # parity trajectory, so superseded writes always elide
+            n = _decode_packed(packed, dp, opl, drop_superseded=True)
             remaining -= n
             if n < chunk:
                 break
@@ -694,7 +735,12 @@ def plan(
                 [mp, mslot, mtgt, n.astype(jnp.int32).reshape(1)]
             )
         )
-        n = _decode_packed(packed, dp, opl)
+        # the pallas kernel always runs the pooled batched selection (even
+        # at batch=1 there is no strict-trajectory contract — see the plan
+        # docstring), so its superseded writes elide too
+        n = _decode_packed(
+            packed, dp, opl, drop_superseded=batch > 1 or use_pallas
+        )
         remaining -= n
         if n < chunk:
             break

@@ -21,17 +21,56 @@ def synth_cluster(
     weighted: bool = True,
     skew: float = 3.0,
     num_consumers_max: int = 0,
+    zipf_topics: bool = False,
 ) -> PartitionList:
     """An unbalanced ``n_partitions`` × ``n_brokers`` assignment.
 
     Brokers are skewed: low-ID brokers are ``skew``× likelier to hold
     replicas, mimicking a cluster that grew by adding brokers (the
     README.md:109-124 scenario at scale).
+
+    ``zipf_topics`` replaces the uniform 50-partition topic blocks with
+    power-law topic sizes (a few huge topics, a long tail of small ones
+    — the shape real Kafka clusters have) and gives each topic a base
+    throughput so partitions of one topic carry similar weights. This is
+    the realistic instance shape for the anti-colocation objective: big
+    topics are exactly the ones whose replicas crowd onto hot brokers.
     """
     rng = random.Random(seed)
     brokers = list(range(1, n_brokers + 1))
     # population weights: broker i gets weight skew..1 linearly
     bw = [skew - (skew - 1.0) * i / max(1, n_brokers - 1) for i in range(n_brokers)]
+
+    if zipf_topics and n_partitions > 0:
+        # ~n/32 topics with power-law sizes normalized to sum to
+        # n_partitions: a few hundred-partition topics, a long tail of
+        # small ones (floor 2, shrunk when the instance is tiny so the
+        # remainder distribution below always terminates)
+        n_topics = max(1, min(n_partitions // 2, max(4, n_partitions // 32)))
+        floor = 2 if n_partitions >= 2 * n_topics else 1
+        raw = [1.0 / (t + 1) ** 0.9 for t in range(n_topics)]
+        scale = n_partitions / sum(raw)
+        sizes = [max(floor, int(r * scale)) for r in raw]
+        total = sum(sizes)
+        # distribute the rounding remainder over the largest topics
+        t = 0
+        while total != n_partitions:
+            step = 1 if total < n_partitions else -1
+            if sizes[t % n_topics] + step >= floor:
+                sizes[t % n_topics] += step
+                total += step
+            t += 1
+        topic_of = []
+        for t, s in enumerate(sizes):
+            base = rng.uniform(0.5, 2.0)
+            topic_of.extend([(f"t{t}", i, base) for i in range(s)])
+        rng.shuffle(topic_of)
+    else:
+        topic_of = [
+            (f"t{i % max(1, n_partitions // 50)}", i, None)
+            for i in range(n_partitions)
+        ]
+
     parts = []
     for i in range(n_partitions):
         replicas: list = []
@@ -39,12 +78,21 @@ def synth_cluster(
             (b,) = rng.choices(brokers, weights=bw)
             if b not in replicas:
                 replicas.append(b)
+        topic, pid, base = topic_of[i]
+        if weighted:
+            if base is not None:
+                # same-topic partitions carry similar throughput
+                weight = round(base * rng.uniform(0.8, 1.25), 3)
+            else:
+                weight = round(rng.uniform(0.5, 2.0), 3)
+        else:
+            weight = 0.0
         parts.append(
             Partition(
-                topic=f"t{i % max(1, n_partitions // 50)}",
-                partition=i,
+                topic=topic,
+                partition=pid,
                 replicas=replicas,
-                weight=round(rng.uniform(0.5, 2.0), 3) if weighted else 0.0,
+                weight=weight,
                 num_consumers=(
                     rng.randint(0, num_consumers_max) if num_consumers_max else 0
                 ),

@@ -1,0 +1,76 @@
+"""Child process for the hardware Pallas parity test.
+
+Launched by tests/test_pallas_tpu.py with the test harness's CPU pins
+scrubbed so the ambient backend (the real TPU, when one is attached)
+initializes instead. Exit codes: 0 = parity checked, 77 = no TPU here
+(parent skips), anything else = real failure.
+
+Runs the same 1k x 32 session through the compiled Mosaic kernel
+(engine='pallas') and the XLA batch path (engine='xla') and prints one
+JSON line with both results. The documented hardware-vs-interpreter
+caveat (solvers/pallas_session.py: float reduction order may resolve
+exact candidate ties differently on hardware) means move LOGS may
+diverge; move count, final unbalance (to f32 round-off) and plan
+validity must not.
+"""
+
+import json
+import sys
+
+NO_TPU = 77
+
+
+def main() -> int:
+    try:
+        import jax
+
+        devs = jax.devices()
+    except Exception as exc:  # no usable backend at all
+        print(json.dumps({"skip": f"backend init failed: {exc!r}"}))
+        return NO_TPU
+    platform = devs[0].platform.lower()
+    if "tpu" not in platform and "axon" not in platform:
+        print(json.dumps({"skip": f"platform is {platform!r}, not tpu"}))
+        return NO_TPU
+
+    import jax.numpy as jnp
+
+    from kafkabalancer_tpu.balancer.costmodel import (
+        get_bl,
+        get_broker_load,
+        get_unbalance_bl,
+    )
+    from kafkabalancer_tpu.models import default_rebalance_config
+    from kafkabalancer_tpu.solvers.scan import plan
+    from kafkabalancer_tpu.utils.synth import synth_cluster
+
+    def run(engine):
+        pl = synth_cluster(1000, 32, rf=3, seed=123, weighted=True)
+        cfg = default_rebalance_config()
+        cfg.min_unbalance = 0.0
+        cfg.allow_leader_rebalancing = True
+        opl = plan(pl, cfg, 2048, dtype=jnp.float32, batch=32, engine=engine)
+        live = {
+            (p.topic, p.partition): tuple(p.replicas)
+            for p in pl.iter_partitions()
+        }
+        valid = all(
+            tuple(e.replicas) == live[(e.topic, e.partition)]
+            and len(set(e.replicas)) == len(e.replicas)
+            for e in (opl.partitions or [])
+        )
+        return {
+            "n_moves": len(opl),
+            "unbalance": get_unbalance_bl(get_bl(get_broker_load(pl))),
+            "valid": valid,
+        }
+
+    out = {"platform": platform}
+    out["pallas"] = run("pallas")
+    out["xla"] = run("xla")
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

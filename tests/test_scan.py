@@ -236,6 +236,57 @@ def test_leader_session_swap_branch():
         assert len(set(p.replicas)) == len(p.replicas)
 
 
+def test_churn_bound_config2_shape():
+    """Suite-wide churn bound (VERDICT r2 weak #3 / next #6): on the
+    suite's config-2 shape (1k partitions / 12 brokers, equal weights,
+    rf=2) the batched session must emit within 2% of the batch=1
+    reference trajectory's move count at the same final unbalance. The
+    supersede post-pass (_superseded_mask) collapses same-(partition,
+    slot) re-writes — each emitted entry is real Kafka data movement
+    (kafkabalancer.go:177-221)."""
+    from kafkabalancer_tpu.utils.synth import synth_cluster
+
+    res = {}
+    for batch in (1, 12):
+        pl = synth_cluster(1000, 12, rf=2, seed=7, weighted=False)
+        cfg = default_rebalance_config()
+        cfg.min_unbalance = 1e-6
+        opl = plan(pl, cfg, 2000, batch=batch)
+        res[batch] = (len(opl), unbalance_of(pl))
+    n1, u1 = res[1]
+    nb, ub = res[12]
+    assert nb <= n1 * 1.02 + 1, res
+    assert ub <= u1 * 1.0 + 1e-12, res
+
+
+def test_superseded_mask_semantics():
+    """Only consecutive same-(partition, slot) plain-move runs collapse;
+    leadership swaps (SWAP_SLOT) are kept and break runs; interleaved
+    different-slot moves on the same partition break runs (the
+    intermediate state is observable by the in-between move's replay)."""
+    import numpy as np
+
+    from kafkabalancer_tpu.solvers.leader import SWAP_SLOT
+    from kafkabalancer_tpu.solvers.scan import _superseded_mask
+
+    # run of three same-slot writes on p0 -> keep only the last
+    mp = np.array([0, 0, 0])
+    ms = np.array([1, 1, 1])
+    assert _superseded_mask(mp, ms).tolist() == [False, False, True]
+    # different slot in between breaks the run
+    mp = np.array([0, 0, 0])
+    ms = np.array([1, 2, 1])
+    assert _superseded_mask(mp, ms).tolist() == [True, True, True]
+    # swap in between breaks the run and is itself kept
+    mp = np.array([0, 0, 0])
+    ms = np.array([1, SWAP_SLOT, 1])
+    assert _superseded_mask(mp, ms).tolist() == [True, True, True]
+    # other partitions never break a run
+    mp = np.array([0, 5, 0])
+    ms = np.array([1, 1, 1])
+    assert _superseded_mask(mp, ms).tolist() == [False, True, True]
+
+
 def test_leader_session_batched_converges():
     """The batched rebalance-leaders extension (batch > 1: K heaviest
     brokers paired with K lightest, best-gain led partition per pair,
