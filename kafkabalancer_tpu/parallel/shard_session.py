@@ -1,0 +1,353 @@
+"""Partition-sharded CONVERGE session: the whole batched move loop on a
+mesh.
+
+``solvers/scan.py session`` runs the full plan-to-convergence on one
+chip; past the Pallas kernel's VMEM ceilings the XLA fallback still holds
+the ``[P, B]`` member/allowed state and the ``[P, R]+[P, B]`` per-
+iteration scoring on a single device (100k x 256 ≈ 17 s warm, round 2).
+This module shards the session itself over the ``part`` mesh axis
+(SURVEY.md §2.9 mapping): every device owns ``P/S`` partitions, scoring
+is local, and one ``all_gather`` of four ``[B]`` vectors per iteration
+combines the per-shard per-target winners — the collective payload is
+O(S·B), never O(P).
+
+Exactness: the combine key is ``(val, is_leader, partition)`` — a total
+order under which the unsharded ``factored_target_best`` selection
+(follower argmin over partitions, leader argmin, strict-< merge) is an
+associative min, so the sharded winner set is IDENTICAL to the
+single-device one (pinned by tests/test_parallel.py). Broker loads,
+claim/commit selection, and move logs are replicated computations (all
+derive from the combined ``[B]`` winners), so every shard carries
+bit-identical copies; replica/membership state updates apply only on the
+owning shard.
+
+Scaling story (RESULTS.md): per-device memory and per-iteration scoring
+work drop S-fold — the 128k x 256 single-chip kernel ceiling becomes a
+per-shard ceiling (S x 128k partitions per pod slice with Pallas shard
+bodies; the XLA path scales to HBM). On one real chip this module runs
+on the virtual CPU mesh (tests + dryrun); the mesh axis rides ICI on
+real multi-chip topologies.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+from kafkabalancer_tpu.ops.runtime import ensure_x64
+
+ensure_x64()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax import lax  # noqa: E402
+from jax.sharding import Mesh  # noqa: E402
+from jax.sharding import PartitionSpec as PS  # noqa: E402
+
+from kafkabalancer_tpu.ops import cost  # noqa: E402
+from kafkabalancer_tpu.parallel.mesh import PART_AXIS  # noqa: E402
+
+
+@partial(
+    jax.jit,
+    static_argnames=("max_moves", "allow_leader", "batch", "mesh"),
+)
+def sharded_session(
+    loads,
+    replicas,
+    member,
+    allowed,
+    weights,
+    nrep_cur,
+    nrep_tgt,
+    ncons,
+    pvalid,
+    always_valid,
+    universe_valid,
+    min_replicas,
+    min_unbalance,
+    budget,
+    churn_gate,
+    *,
+    max_moves: int,
+    allow_leader: bool,
+    batch: int,
+    mesh: Mesh,
+):
+    """``scan.session``'s batch path with the partition axis sharded over
+    ``mesh``'s ``part`` axis; same return contract ``(replicas, loads, n,
+    move_p, move_slot, move_src, move_tgt, final_su)`` with ``replicas``
+    sharded and everything else replicated.
+
+    The partition bucket must divide by the axis size (tensorize with
+    ``min_bucket`` a multiple of it). Requires ``batch >= 1``; there is no
+    batch=1 parity contract here — the sharded session is always the
+    pooled batched selection (like the Pallas kernel).
+    """
+    P, R = replicas.shape
+    B = loads.shape[0]
+    S = mesh.shape[PART_AXIS]
+    if P % S:
+        raise ValueError(
+            f"partition bucket {P} not divisible by part axis {S}; "
+            f"tensorize with min_bucket a multiple of {S}"
+        )
+    P_l = P // S
+    dtype = loads.dtype
+
+    rep = PS()
+    pshard = PS(PART_AXIS)
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            rep,      # loads
+            pshard,   # replicas
+            pshard,   # member
+            pshard,   # allowed
+            rep,      # weights (full: _applied_delta indexes global p)
+            rep,      # nrep_cur
+            rep,      # nrep_tgt
+            rep,      # ncons
+            rep,      # pvalid
+            rep, rep, rep, rep, rep, rep,
+        ),
+        out_specs=(pshard, rep, rep, rep, rep, rep, rep, rep),
+        # winner indices derive from axis_index; the varying-mode analysis
+        # cannot see they are replicated after the gather+min combine
+        check_vma=False,
+    )
+    def run(loads, replicas, member, allowed, weights, nrep_cur, nrep_tgt,
+            ncons, pvalid, always_valid, universe_valid, min_replicas,
+            min_unbalance, budget, churn_gate):
+        shard_i = lax.axis_index(PART_AXIS)
+        off = (shard_i * P_l).astype(jnp.int32)
+
+        def lslice(v):
+            return lax.dynamic_slice_in_dim(v, off, P_l)
+
+        w_l = lslice(weights)
+        ncur_l = lslice(nrep_cur)
+        ntgt_l = lslice(nrep_tgt)
+        ncons_l = lslice(ncons)
+        pvalid_l = lslice(pvalid)
+
+        t = jnp.arange(B, dtype=jnp.int32)
+        mp0 = jnp.full(max_moves + 1, -1, jnp.int32)
+        bcount0 = jax.lax.psum(
+            jnp.sum((member & pvalid_l[:, None]).astype(jnp.int32), axis=0),
+            PART_AXIS,
+        )
+
+        def _applied_delta(p, slot):
+            # full-vector lookups: p is a GLOBAL partition index
+            return jnp.where(
+                slot == 0,
+                weights[p] * (nrep_cur[p].astype(dtype) + ncons[p]),
+                weights[p],
+            )
+
+        def cond(state):
+            n, done = state[4], state[5]
+            return (~done) & (n < budget) & (n < max_moves)
+
+        def body(state):
+            loads, replicas, member, bcount, n, done, mp, mslot, msrc, mtgt = state
+
+            bvalid = (always_valid | (bcount > 0)) & universe_valid
+            nb = jnp.sum(bvalid).astype(dtype)
+            # local per-target winners over this shard's partition rows;
+            # loads/bvalid are replicated so su/avg arithmetic is
+            # bit-identical on every shard
+            su, vals, p_loc, slot = cost.factored_target_best(
+                loads, replicas, allowed, member, bvalid, w_l, ncur_l,
+                ntgt_l, ncons_l, pvalid_l, nb, min_replicas,
+                allow_leader=allow_leader,
+            )
+            s_loc = replicas[jnp.clip(p_loc, 0), jnp.clip(slot, 0)].astype(
+                jnp.int32
+            )
+            p_glob = p_loc + off
+
+            # cross-shard combine under the total-order key
+            # (val, is_leader, partition) — see module docstring
+            vals_all = lax.all_gather(vals, PART_AXIS)          # [S, B]
+            p_all = lax.all_gather(p_glob, PART_AXIS)
+            slot_all = lax.all_gather(slot, PART_AXIS)
+            s_all = lax.all_gather(s_loc, PART_AXIS)
+            vmin = jnp.min(vals_all, axis=0)                    # [B]
+            is_lead = (slot_all == 0).astype(jnp.int32)
+            tiekey = jnp.where(
+                vals_all == vmin[None, :],
+                is_lead * (P + 1) + p_all,
+                jnp.iinfo(jnp.int32).max,
+            )
+            k_star = jnp.argmin(tiekey, axis=0)                 # [S]-index
+            vals = vmin
+            p = jnp.take_along_axis(p_all, k_star[None, :], axis=0)[0]
+            slot = jnp.take_along_axis(slot_all, k_star[None, :], axis=0)[0]
+            s_ = jnp.take_along_axis(s_all, k_star[None, :], axis=0)[0]
+
+            # ---- from here on: identical replicated computation on every
+            # shard (mirrors scan.session body_batch) ---------------------
+            improving = (
+                jnp.isfinite(vals) & (vals < su - min_unbalance) & (vals < su)
+            )
+            best_gain = su - jnp.min(vals)
+            improving &= (su - vals) * churn_gate >= best_gain
+
+            bigb = jnp.int32(B + 1)
+            prio = jnp.where(improving, t, bigb)
+            first_p = jnp.full(P, bigb).at[p].min(prio)
+            first_b = jnp.full(B, bigb).at[s_].min(prio).at[t].min(prio)
+            ok = (
+                improving
+                & (first_p[p] == t)
+                & (first_b[s_] == t)
+                & (first_b[t] == t)
+            )
+            pos = n + jnp.cumsum(ok.astype(jnp.int32), dtype=jnp.int32) - 1
+            ok &= (pos < n + batch) & (pos < budget) & (pos < max_moves)
+            oki = ok.astype(jnp.int32)
+            cnt = jnp.sum(oki, dtype=jnp.int32)
+
+            delta = _applied_delta(p, slot) * oki.astype(dtype)
+            loads = loads.at[s_].add(-delta).at[t].add(delta)
+            bcount = bcount.at[s_].add(-oki).at[t].add(oki)
+
+            # ---- owner-shard application --------------------------------
+            mine = ok & (p >= off) & (p < off + P_l)
+            mine_i = mine.astype(jnp.int32)
+            p_l = jnp.where(mine, p - off, P_l)  # OOB rows drop
+            replicas = replicas.at[p_l, slot].add(
+                ((t - s_) * mine_i).astype(replicas.dtype), mode="drop"
+            )
+            toggles = (
+                jnp.zeros((P_l, B), jnp.int32)
+                .at[p_l, s_].add(mine_i, mode="drop")
+                .at[p_l, t].add(mine_i, mode="drop")
+            )
+            member = member ^ (toggles > 0)
+
+            logpos = jnp.where(ok, pos, max_moves)
+            mp = mp.at[logpos].set(jnp.where(ok, p, -1))
+            mslot = mslot.at[logpos].set(jnp.where(ok, slot, -1))
+            msrc = msrc.at[logpos].set(jnp.where(ok, s_, -1))
+            mtgt = mtgt.at[logpos].set(jnp.where(ok, t, -1))
+
+            n = n + cnt
+            return (
+                loads, replicas, member, bcount, n, cnt == 0,
+                mp, mslot, msrc, mtgt,
+            )
+
+        state = (
+            loads, replicas, member, bcount0, jnp.int32(0), jnp.bool_(False),
+            mp0, mp0, mp0, mp0,
+        )
+        (loads, replicas, member, bcount, n, _done,
+         mp, mslot, msrc, mtgt) = lax.while_loop(cond, body, state)
+        bvalid = (always_valid | (bcount > 0)) & universe_valid
+        final_su = cost.unbalance(loads, bvalid, jnp.sum(bvalid).astype(dtype))
+        return (
+            replicas, loads, n,
+            mp[:max_moves], mslot[:max_moves], msrc[:max_moves],
+            mtgt[:max_moves], final_su,
+        )
+
+    return run(
+        loads, replicas, member, allowed, weights, nrep_cur, nrep_tgt,
+        ncons, pvalid, always_valid, universe_valid, min_replicas,
+        min_unbalance, budget, churn_gate,
+    )
+
+
+def plan_sharded(
+    pl,
+    cfg,
+    max_reassign: int,
+    mesh: Mesh,
+    dtype=None,
+    batch: int = 16,
+    chunk_moves: "int | None" = None,
+):
+    """Mesh-sharded analog of ``solvers.scan.plan`` (move sessions only —
+    repairs settle host-side first, chunks re-enter like plan; no polish
+    phases, and ``rebalance_leaders`` is rejected: the leadership session
+    lives in ``solvers/leader.py`` and has no sharded variant).
+    Output/mutation contract matches ``plan``."""
+    from kafkabalancer_tpu.models.partition import empty_partition_list
+    from kafkabalancer_tpu.ops import tensorize
+    from kafkabalancer_tpu.ops.runtime import next_bucket
+    from kafkabalancer_tpu.solvers.scan import (
+        _cfg_broker_mask,
+        _decode_packed,
+        _settle_head,
+        DEFAULT_CHURN_GATE,
+    )
+
+    if cfg.rebalance_leaders:
+        raise ValueError(
+            "plan_sharded does not support rebalance_leaders; use "
+            "solvers.scan.plan (the fused leader session is single-device)"
+        )
+    opl = empty_partition_list()
+    if max_reassign <= 0:
+        return opl
+    repaired, budget = _settle_head(pl, cfg, max_reassign)
+    opl.append(*repaired)
+    if dtype is None:
+        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+    if chunk_moves is None:
+        # mirror plan()'s auto-chunking: convergence-scale sessions stay
+        # single-dispatch (moves-to-converge tracks ~P/8)
+        npart = len(pl.partitions or [])
+        chunk_moves = max(8192, 1 << (npart // 4).bit_length())
+    S = mesh.shape[PART_AXIS]
+    # buckets are min_bucket·2^k: a min_bucket that is a multiple of the
+    # axis size keeps every bucket divisible by it
+    min_bucket = 8 * S
+
+    remaining = budget
+    while remaining > 0:
+        dp = tensorize(pl, cfg, min_bucket=min_bucket)
+        loads = cost.broker_loads(
+            jnp.asarray(dp.replicas),
+            jnp.asarray(dp.weights, dtype),
+            jnp.asarray(dp.nrep_cur),
+            jnp.asarray(dp.ncons, dtype),
+            dp.bvalid.shape[0],
+        )
+        chunk = min(remaining, max(1, chunk_moves))
+        _replicas, _loads, n, mp, mslot, _msrc, mtgt, _su = sharded_session(
+            loads,
+            jnp.asarray(dp.replicas),
+            jnp.asarray(dp.member),
+            jnp.asarray(dp.allowed),
+            jnp.asarray(dp.weights, dtype),
+            jnp.asarray(dp.nrep_cur),
+            jnp.asarray(dp.nrep_tgt),
+            jnp.asarray(dp.ncons, dtype),
+            jnp.asarray(dp.pvalid),
+            jnp.asarray(_cfg_broker_mask(dp, cfg)),
+            jnp.asarray(dp.bvalid),
+            jnp.int32(cfg.min_replicas_for_rebalancing),
+            jnp.asarray(cfg.min_unbalance, dtype),
+            jnp.int32(chunk),
+            jnp.asarray(DEFAULT_CHURN_GATE, dtype),
+            max_moves=next_bucket(chunk, 128),
+            allow_leader=cfg.allow_leader_rebalancing,
+            batch=max(1, batch),
+            mesh=mesh,
+        )
+        packed = np.asarray(
+            jnp.concatenate(
+                [mp, mslot, mtgt, n.astype(jnp.int32).reshape(1)]
+            )
+        )
+        n = _decode_packed(packed, dp, opl, drop_superseded=True)
+        remaining -= n
+        if n < chunk:
+            break
+    return opl

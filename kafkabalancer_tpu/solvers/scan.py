@@ -540,7 +540,7 @@ def plan(
     max_reassign: int,
     dtype=None,
     batch: int = 1,
-    chunk_moves: int = 8192,
+    chunk_moves: "int | None" = None,
     engine: str = "xla",
     polish: bool = False,
     churn_gate: float = DEFAULT_CHURN_GATE,
@@ -574,6 +574,15 @@ def plan(
     opl = empty_partition_list()
     if max_reassign <= 0:
         return opl
+
+    if chunk_moves is None:
+        # auto: scale the per-dispatch move budget with the instance so
+        # convergence-scale sessions stay single-dispatch (profiled at
+        # 100k x 256: two chunks cost ~2.3 s of re-tensorize + re-entry
+        # for zero quality; moves-to-converge tracks ~P/8). Small
+        # instances keep the 8192 floor (one compiled bucket).
+        npart = len(pl.partitions or [])
+        chunk_moves = max(8192, 1 << (npart // 4).bit_length())
 
     if cfg.rebalance_leaders:
         return _leader_plan(

@@ -46,17 +46,26 @@ def main() -> int:
 
     def run(engine):
         pl = synth_cluster(1000, 32, rf=3, seed=123, weighted=True)
+        # snapshot BEFORE planning: opl entries alias the live partitions
+        # (the reference's aliasing-visible output), so comparing entry
+        # vs live is vacuous — the real invariant is that every CHANGED
+        # partition appears in the emitted plan
+        before = {
+            (p.topic, p.partition): tuple(p.replicas)
+            for p in pl.iter_partitions()
+        }
         cfg = default_rebalance_config()
         cfg.min_unbalance = 0.0
         cfg.allow_leader_rebalancing = True
         opl = plan(pl, cfg, 2048, dtype=jnp.float32, batch=32, engine=engine)
-        live = {
-            (p.topic, p.partition): tuple(p.replicas)
+        emitted = {(e.topic, e.partition) for e in (opl.partitions or [])}
+        changed = {
+            (p.topic, p.partition)
             for p in pl.iter_partitions()
+            if tuple(p.replicas) != before[(p.topic, p.partition)]
         }
-        valid = all(
-            tuple(e.replicas) == live[(e.topic, e.partition)]
-            and len(set(e.replicas)) == len(e.replicas)
+        valid = changed <= emitted and all(
+            len(set(e.replicas)) == len(e.replicas)
             for e in (opl.partitions or [])
         )
         return {
