@@ -1,0 +1,180 @@
+"""Multi-host what-if sweep: the operator-facing deployment example.
+
+The reference is a single-process CLI (`/root/reference/kafkabalancer.go:68-70`);
+its what-if story is "rerun the CLI once per scenario" (README.md:109-137).
+This framework's equivalent runs ALL scenarios in one SPMD program over a
+device mesh that may span hosts — this script is the deployment recipe.
+
+Real deployment (one command, run on EVERY host of a TPU pod slice):
+
+    # Cloud TPU pods: the runtime discovers coordinator/process_id itself
+    python examples/multihost_sweep.py --input cluster.json \
+        --add-brokers 2 --remove-brokers 1
+
+    # generic clusters (e.g. two v5e hosts over DCN): pin the coordinator
+    python examples/multihost_sweep.py --input cluster.json \
+        --coordinator 10.0.0.1:8476 --num-processes 2 --process-id $RANK \
+        --add-brokers 2
+
+Every host runs the same program on the same input (SPMD: the partition
+list and scenario table must be byte-identical everywhere — ship the same
+JSON to each host or read it from shared storage). Scenario sessions shard
+over the mesh's ``sweep`` axis, so each scenario's fused move loop runs
+entirely on its own device(s) — ICI/DCN traffic is one result-replication
+all_gather at the end, not per-iteration chatter. Process 0 alone prints
+the ranked table (all processes hold identical replicated results).
+
+Local rehearsal (no TPU needed — spawns N CPU processes on this machine,
+same code path end to end including jax.distributed over loopback):
+
+    python examples/multihost_sweep.py --local-demo 2 --input tests/data/test.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+# runnable from a checkout without installation (the package itself is
+# what `pip install -e .` provides; examples/ sits beside it)
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def parse_args(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--input", required=True, help="partition-list JSON")
+    ap.add_argument("--add-brokers", type=int, default=0, metavar="N",
+                    help="what-if scenarios adding 1..N fresh brokers")
+    ap.add_argument("--remove-brokers", type=int, default=0, metavar="N",
+                    help="what-if scenarios removing each of the N "
+                         "least-loaded observed brokers")
+    ap.add_argument("--scenarios", help="JSON file: list of broker-ID lists "
+                                        "(overrides --add/--remove)")
+    ap.add_argument("--max-reassign", type=int, default=1 << 16)
+    ap.add_argument("--batch", type=int, default=16,
+                    help="disjoint moves per device iteration (1 = "
+                         "reference-parity trajectories)")
+    ap.add_argument("--coordinator", help="host:port of process 0 "
+                                          "(omit on Cloud TPU pods)")
+    ap.add_argument("--num-processes", type=int)
+    ap.add_argument("--process-id", type=int)
+    ap.add_argument("--local-demo", type=int, metavar="NPROC",
+                    help="rehearse locally: spawn NPROC CPU worker "
+                         "processes joined over loopback")
+    return ap.parse_args(argv)
+
+
+def _local_demo(n: int, args) -> int:
+    """Spawn n fresh CPU worker processes over loopback — the same worker
+    path a real pod runs, minus the TPUs."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)  # scrub single-chip TPU tunnel
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env["JAX_ENABLE_X64"] = "1"
+    base = [sys.executable, os.path.abspath(__file__),
+            f"--input={args.input}",
+            f"--add-brokers={args.add_brokers}",
+            f"--remove-brokers={args.remove_brokers}",
+            f"--max-reassign={args.max_reassign}",
+            f"--batch={args.batch}"]
+    if args.scenarios:
+        base.append(f"--scenarios={args.scenarios}")
+    procs = [
+        subprocess.Popen(
+            base + [f"--coordinator=127.0.0.1:{port}",
+                    f"--num-processes={n}", f"--process-id={i}"],
+            env=env,
+        )
+        for i in range(n)
+    ]
+    rcs = [p.wait(timeout=600) for p in procs]
+    return max(rcs)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    if args.local_demo:
+        # re-enter as n coordinated worker processes
+        return _local_demo(args.local_demo, args)
+
+    # --- join the multi-host runtime BEFORE any other JAX use ------------
+    from kafkabalancer_tpu.parallel.distributed import initialize
+
+    if args.coordinator or args.num_processes is not None:
+        initialize(args.coordinator, args.num_processes, args.process_id)
+    else:
+        try:  # Cloud TPU pod: runtime self-discovers; single host: no-op
+            initialize()
+        except Exception:
+            pass  # plain single-process run
+
+    import jax
+
+    from kafkabalancer_tpu.balancer.costmodel import (
+        get_bl,
+        get_broker_load,
+    )
+    from kafkabalancer_tpu.codecs import get_partition_list_from_reader
+    from kafkabalancer_tpu.models import default_rebalance_config
+    from kafkabalancer_tpu.parallel.mesh import make_mesh
+    from kafkabalancer_tpu.parallel.sweep import sweep
+
+    is_proc0 = jax.process_index() == 0
+
+    with open(args.input) as f:
+        pl = get_partition_list_from_reader(f, True, [])
+    cfg = default_rebalance_config()
+
+    observed = sorted({b for p in pl.partitions for b in p.replicas})
+    if args.scenarios:
+        with open(args.scenarios) as f:
+            scenarios = [list(map(int, s)) for s in json.load(f)]
+    else:
+        scenarios = [list(observed)]  # baseline: current broker set
+        nxt = max(observed) + 1
+        for k in range(1, args.add_brokers + 1):
+            scenarios.append(observed + list(range(nxt, nxt + k)))
+        if args.remove_brokers:
+            loads = get_bl(get_broker_load(pl))  # sorted by (load, ID)
+            coldest = [bid for bid, _load in loads[: args.remove_brokers]]
+            for b in coldest:
+                keep = [x for x in observed if x != b]
+                if keep:
+                    scenarios.append(keep)
+
+    mesh = make_mesh()  # ALL devices across ALL hosts
+    if is_proc0:
+        print(
+            f"processes={jax.process_count()} devices={len(jax.devices())} "
+            f"mesh={dict(zip(mesh.axis_names, mesh.devices.shape))} "
+            f"scenarios={len(scenarios)}",
+            file=sys.stderr,
+        )
+
+    results = sweep(pl, cfg, scenarios, max_reassign=args.max_reassign,
+                    mesh=mesh, batch=args.batch)
+
+    if is_proc0:  # replicated results — one host reports
+        ranked = sorted(
+            zip(scenarios, results),
+            key=lambda sr: (not sr[1].feasible, sr[1].unbalance),
+        )
+        w = max(len(str(s)) for s, _ in ranked) + 2
+        print(f"{'brokers':<{w}}{'feasible':>9}{'moves':>7}  unbalance")
+        for s, r in ranked:
+            print(f"{str(s):<{w}}{str(r.feasible):>9}{r.n_moves:>7}  "
+                  f"{r.unbalance:.3e}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,8 +7,9 @@ the ``[P, B]`` member/allowed state and the ``[P, R]+[P, B]`` per-
 iteration scoring on a single device (100k x 256 ≈ 17 s warm, round 2).
 This module shards the session itself over the ``part`` mesh axis
 (SURVEY.md §2.9 mapping): every device owns ``P/S`` partitions, scoring
-is local, and one ``all_gather`` of four ``[B]`` vectors per iteration
-combines the per-shard per-target winners — the collective payload is
+is local, and two ``all_gather`` launches per iteration (the ``[B]``
+float winner values plus one stacked ``[3, B]`` int32 attribute gather)
+combine the per-shard per-target winners — the collective payload is
 O(S·B), never O(P).
 
 Exactness: the combine key is ``(val, is_leader, partition)`` — a total
@@ -171,11 +172,18 @@ def sharded_session(
             p_glob = p_loc + off
 
             # cross-shard combine under the total-order key
-            # (val, is_leader, partition) — see module docstring
+            # (val, is_leader, partition) — see module docstring. Two
+            # collectives per iteration: the [B] float winner values and
+            # one stacked [3, B] int32 gather for their attributes (ICI
+            # payloads here are latency-bound, so launches matter more
+            # than the few-KB size)
             vals_all = lax.all_gather(vals, PART_AXIS)          # [S, B]
-            p_all = lax.all_gather(p_glob, PART_AXIS)
-            slot_all = lax.all_gather(slot, PART_AXIS)
-            s_all = lax.all_gather(s_loc, PART_AXIS)
+            attr_all = lax.all_gather(
+                jnp.stack([p_glob, slot, s_loc]), PART_AXIS
+            )                                                   # [S, 3, B]
+            p_all = attr_all[:, 0]
+            slot_all = attr_all[:, 1]
+            s_all = attr_all[:, 2]
             vmin = jnp.min(vals_all, axis=0)                    # [B]
             is_lead = (slot_all == 0).astype(jnp.int32)
             tiekey = jnp.where(
@@ -271,12 +279,15 @@ def plan_sharded(
     dtype=None,
     batch: int = 16,
     chunk_moves: "int | None" = None,
+    churn_gate: "float | None" = None,
 ):
     """Mesh-sharded analog of ``solvers.scan.plan`` (move sessions only —
     repairs settle host-side first, chunks re-enter like plan; no polish
     phases, and ``rebalance_leaders`` is rejected: the leadership session
     lives in ``solvers/leader.py`` and has no sharded variant).
-    Output/mutation contract matches ``plan``."""
+    Output/mutation contract matches ``plan``, including the
+    ``churn_gate`` knob and the auto/clamped ``chunk_moves`` heuristic
+    (both shared with it, not copied)."""
     from kafkabalancer_tpu.models.partition import empty_partition_list
     from kafkabalancer_tpu.ops import tensorize
     from kafkabalancer_tpu.ops.runtime import next_bucket
@@ -284,6 +295,7 @@ def plan_sharded(
         _cfg_broker_mask,
         _decode_packed,
         _settle_head,
+        auto_chunk_moves,
         DEFAULT_CHURN_GATE,
     )
 
@@ -300,10 +312,10 @@ def plan_sharded(
     if dtype is None:
         dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
     if chunk_moves is None:
-        # mirror plan()'s auto-chunking: convergence-scale sessions stay
-        # single-dispatch (moves-to-converge tracks ~P/8)
-        npart = len(pl.partitions or [])
-        chunk_moves = max(8192, 1 << (npart // 4).bit_length())
+        chunk_moves = auto_chunk_moves(len(pl.partitions or []))
+    chunk_moves = max(1, min(chunk_moves, 1 << 20))
+    if churn_gate is None:
+        churn_gate = DEFAULT_CHURN_GATE
     S = mesh.shape[PART_AXIS]
     # buckets are min_bucket·2^k: a min_bucket that is a multiple of the
     # axis size keeps every bucket divisible by it
@@ -319,7 +331,7 @@ def plan_sharded(
             jnp.asarray(dp.ncons, dtype),
             dp.bvalid.shape[0],
         )
-        chunk = min(remaining, max(1, chunk_moves))
+        chunk = min(remaining, chunk_moves)
         _replicas, _loads, n, mp, mslot, _msrc, mtgt, _su = sharded_session(
             loads,
             jnp.asarray(dp.replicas),
@@ -335,7 +347,7 @@ def plan_sharded(
             jnp.int32(cfg.min_replicas_for_rebalancing),
             jnp.asarray(cfg.min_unbalance, dtype),
             jnp.int32(chunk),
-            jnp.asarray(DEFAULT_CHURN_GATE, dtype),
+            jnp.asarray(churn_gate, dtype),
             max_moves=next_bucket(chunk, 128),
             allow_leader=cfg.allow_leader_rebalancing,
             batch=max(1, batch),

@@ -64,6 +64,18 @@ from kafkabalancer_tpu.ops.runtime import next_bucket  # noqa: E402
 # commits at BETTER final unbalance and equal wall-clock.
 DEFAULT_CHURN_GATE = 1.5
 
+
+def auto_chunk_moves(npart: int) -> int:
+    """Per-dispatch move budget scaled to the instance, clamped to the
+    watchdog bound. Convergence-scale sessions stay single-dispatch
+    (profiled at 100k x 256: two chunks cost ~2.3 s of re-tensorize +
+    re-entry for zero quality; moves-to-converge tracks ~P/8). Small
+    instances keep the 8192 floor (one compiled bucket). Shared by
+    ``plan`` and ``parallel.shard_session.plan_sharded`` so the heuristic
+    cannot drift between the single-device and sharded paths."""
+    return min(max(8192, 1 << (npart // 4).bit_length()), 1 << 20)
+
+
 # whole-session kernel capacity: partition-bucket x broker-bucket cells
 # that still fit the v5e scoped-VMEM budget with the transposed compact
 # layout. All-allowed sessions carry no [P, B] matrix at all (128k x 256
@@ -576,13 +588,7 @@ def plan(
         return opl
 
     if chunk_moves is None:
-        # auto: scale the per-dispatch move budget with the instance so
-        # convergence-scale sessions stay single-dispatch (profiled at
-        # 100k x 256: two chunks cost ~2.3 s of re-tensorize + re-entry
-        # for zero quality; moves-to-converge tracks ~P/8). Small
-        # instances keep the 8192 floor (one compiled bucket).
-        npart = len(pl.partitions or [])
-        chunk_moves = max(8192, 1 << (npart // 4).bit_length())
+        chunk_moves = auto_chunk_moves(len(pl.partitions or []))
 
     if cfg.rebalance_leaders:
         return _leader_plan(

@@ -15,7 +15,10 @@ validity must not.
 """
 
 import json
+import os
 import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 NO_TPU = 77
 
