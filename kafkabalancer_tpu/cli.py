@@ -201,6 +201,16 @@ def run(i, o, e, args: List[str]) -> int:
             "session (compound two-replica exchanges escape single-move "
             "local optima; an extension beyond the reference)",
         )
+        f_shard = f.bool(
+            "fused-shard",
+            False,
+            "Fused mode: shard the converge session over all attached "
+            "devices (partition-sharded scoring, cross-shard winner "
+            "combine; bit-identical plans to the single-device batched "
+            "session). Requires -fused; incompatible with "
+            "-rebalance-leader; on one device it degenerates to the "
+            "plain session",
+        )
         f_jaxprof = f.string(
             "jax-profile",
             "",
@@ -332,15 +342,51 @@ def run(i, o, e, args: List[str]) -> int:
                 log(f"unknown fused engine {f_engine.value!r}")
                 usage()
                 return 3
-            try:
-                from kafkabalancer_tpu.solvers.scan import plan
-
-                opl = plan(
-                    pl, cfg, r,
-                    batch=max(1, f_batch.value),
-                    engine=f_engine.value,
-                    polish=f_polish.value,
+            if f_shard.value and f_rebalance_leader.value:
+                log(
+                    "-fused-shard does not support -rebalance-leader (the "
+                    "fused leader session is single-device)"
                 )
+                usage()
+                return 3
+            try:
+                if f_shard.value:
+                    # mesh-sharded converge session over every attached
+                    # device (parallel/shard_session.py); polish phases and
+                    # the pallas engine are single-device concerns
+                    if f_polish.value:
+                        log(
+                            "-fused-polish does not apply to the sharded "
+                            "session; ignoring it"
+                        )
+                    if f_engine.value != "xla":
+                        log(
+                            f"-fused-shard uses the XLA session; ignoring "
+                            f"-fused-engine={f_engine.value}"
+                        )
+                    import jax
+
+                    from kafkabalancer_tpu.parallel.mesh import make_mesh
+                    from kafkabalancer_tpu.parallel.shard_session import (
+                        plan_sharded,
+                    )
+
+                    ndev = len(jax.devices())
+                    # every device on the part axis: one session, S shards
+                    mesh = make_mesh(ndev, shape=(1, ndev))
+                    opl = plan_sharded(
+                        pl, cfg, r, mesh,
+                        batch=max(1, f_batch.value),
+                    )
+                else:
+                    from kafkabalancer_tpu.solvers.scan import plan
+
+                    opl = plan(
+                        pl, cfg, r,
+                        batch=max(1, f_batch.value),
+                        engine=f_engine.value,
+                        polish=f_polish.value,
+                    )
             except BalanceError as exc:
                 log(f"failed optimizing distribution: {exc}")
                 return 3

@@ -558,6 +558,29 @@ def test_fused_rebalance_leader():
     assert final_unbalance(out_b) <= final_unbalance(out_hf) + 1e-9
 
 
+def test_fused_shard():
+    """-fused -fused-shard runs the mesh-sharded converge session over
+    the conftest 8-device virtual mesh; plans are bit-identical to the
+    single-device batched session (shard_session's exactness contract),
+    and -rebalance-leader is rejected up front."""
+    base = [
+        "-input-json", "-input", FIXTURE, "-fused", "-fused-batch=8",
+        "-max-reassign=8", "-unique",
+    ]
+    rv_s, out_s, err_s = run_cli(base + ["-fused-shard"])
+    assert rv_s == 0, err_s
+    rv_1, out_1, err_1 = run_cli(base)
+    assert rv_1 == 0, err_1
+    assert json.loads(out_s) == json.loads(out_1)
+
+    rv, _out, err = run_cli(
+        ["-input-json", "-input", FIXTURE, "-fused", "-fused-shard",
+         "-rebalance-leader"]
+    )
+    assert rv == 3
+    assert "does not support -rebalance-leader" in err
+
+
 def test_cli_byte_parity_fuzz():
     """Randomized instances through the FULL CLI: -solver=tpu stdout must
     be byte-identical to -solver=greedy (and thus the Go reference) across
