@@ -509,12 +509,22 @@ def beam_plan(
         chunk_moves = _auto_chunk(len(pl.partitions or []))
     chunk_moves = max(1, min(chunk_moves, 1 << 16))
 
+    depth = max(1, int(cfg.beam_depth))
+    # a chunk smaller than the lookahead could never search at full depth
+    chunk_moves = max(chunk_moves, depth)
+
     remaining = budget
     while remaining > 0:
         chunk_cap = min(remaining, chunk_moves)
         n = _beam_round(pl, cfg, opl, chunk_cap, dtype)
         remaining -= n
-        if n < chunk_cap:  # converged before exhausting the dispatch
+        # converged ONLY if the session stopped with full lookahead still
+        # affordable (n + depth <= chunk_cap): near the chunk boundary
+        # beam_session caps depth_cap at the remaining chunk budget, so a
+        # stop there may be boundary truncation (an improving sequence
+        # longer than the leftover budget exists) — re-enter, don't
+        # abandon the remaining global budget
+        if n == 0 or n + depth <= chunk_cap:
             break
     return opl
 

@@ -340,6 +340,42 @@ def _cfg_broker_mask(dp, cfg: RebalanceConfig) -> "np.ndarray":
     return mask
 
 
+@partial(jax.jit, static_argnames=("dtype", "all_allowed"))
+def _device_prep(
+    replicas, weights, nrep_cur, ncons, allowed, bvalid,
+    ew, *, dtype, all_allowed: bool,
+):
+    """All per-chunk device input preparation as ONE compiled program.
+
+    A cold process pays a full relay round trip per jitted program it
+    dispatches on a remote-attached TPU (~0.1-0.15 s each even on a
+    persistent-cache hit); eagerly building the session inputs (dtype
+    casts, the broker-load scatter, the all-allowed broadcast, the polish
+    entry-table cast) dispatched ~25 tiny programs and dominated cold CLI
+    latency. ``allowed``/``ew`` may be None (all-allowed mode / no polish
+    phase). Returns ``(loads, weights, ncons, allowed_dev, ew)``."""
+    w = weights.astype(dtype)
+    nc = ncons.astype(dtype)
+    B = bvalid.shape[0]
+    loads = cost.broker_loads(replicas, w, nrep_cur, nc, B)
+    if all_allowed:
+        # the [P, B] allowed matrix is the broker validity row broadcast —
+        # built on device from the [B] mask instead of transferred
+        allowed_dev = jnp.broadcast_to(
+            bvalid[None, :], (replicas.shape[0], B)
+        )
+    else:
+        allowed_dev = allowed
+    ew_c = None if ew is None else ew.astype(dtype)
+    return loads, w, nc, allowed_dev, ew_c
+
+
+@jax.jit
+def _pack_log(mp, mslot, mtgt, n):
+    """Device-side packing of the move log + count into one transfer."""
+    return jnp.concatenate([mp, mslot, mtgt, n.astype(jnp.int32).reshape(1)])
+
+
 def _superseded_mask(mp, mslot) -> "np.ndarray":
     """``keep`` mask collapsing consecutive same-slot runs per partition.
 
@@ -507,23 +543,27 @@ def _leader_plan(
     remaining = budget
     while remaining > 0:
         dp = tensorize(pl, cfg)
-        loads = cost.broker_loads(
+        loads, w_dev, nc_dev, allowed_dev, _ew = _device_prep(
             jnp.asarray(dp.replicas),
-            jnp.asarray(dp.weights, dtype),
+            jnp.asarray(dp.weights),
             jnp.asarray(dp.nrep_cur),
-            jnp.asarray(dp.ncons, dtype),
-            dp.bvalid.shape[0],
+            jnp.asarray(dp.ncons),
+            jnp.asarray(dp.allowed),
+            jnp.asarray(dp.bvalid),
+            None,
+            dtype=dtype,
+            all_allowed=False,
         )
         chunk = min(remaining, chunk_moves)
         _replicas, _loads, n, mp, mslot, mtgt = leader_session(
             loads,
             jnp.asarray(dp.replicas),
             jnp.asarray(dp.member),
-            jnp.asarray(dp.allowed),
-            jnp.asarray(dp.weights, dtype),
+            allowed_dev,
+            w_dev,
             jnp.asarray(dp.nrep_cur),
             jnp.asarray(dp.nrep_tgt),
-            jnp.asarray(dp.ncons, dtype),
+            nc_dev,
             jnp.asarray(dp.pvalid),
             jnp.asarray(_cfg_broker_mask(dp, cfg)),
             jnp.asarray(dp.bvalid),
@@ -534,11 +574,7 @@ def _leader_plan(
             allow_leader=cfg.allow_leader_rebalancing,
             batch=max(1, batch),
         )
-        packed = np.asarray(
-            jnp.concatenate(
-                [mp, mslot, mtgt, n.astype(jnp.int32).reshape(1)]
-            )
-        )
+        packed = np.asarray(_pack_log(mp, mslot, mtgt, n))
         n = _decode_packed(packed, dp, opl, drop_superseded=batch > 1)
         remaining -= n
         if n < chunk:
@@ -633,23 +669,32 @@ def plan(
             engine = "xla"
             use_pallas = False
             dp = tensorize(pl, cfg)
-        loads = cost.broker_loads(
-            jnp.asarray(dp.replicas),
-            jnp.asarray(dp.weights, dtype),
-            jnp.asarray(dp.nrep_cur),
-            jnp.asarray(dp.ncons, dtype),
-            dp.bvalid.shape[0],
-        )
         chunk = min(remaining, chunk_moves)
-        # all-allowed: the [P, B] allowed matrix is just the broker
-        # validity row broadcast — build it ON DEVICE from the [B] mask
-        # instead of transferring it (and the kernel skips storing it)
-        if all_allowed:
-            allowed_dev = jnp.broadcast_to(
-                jnp.asarray(dp.bvalid)[None, :], dp.allowed.shape
+        if polish:
+            from kafkabalancer_tpu.solvers.polish import (
+                converge_session,
+                entry_table,
+            )
+
+            ew_np, ep_, er_, evalid = entry_table(
+                dp, cfg.min_replicas_for_rebalancing
             )
         else:
-            allowed_dev = jnp.asarray(dp.allowed)
+            ew_np = None
+        # one compiled program builds every derived device input (the
+        # eager version dispatched ~25 tiny programs — each a relay round
+        # trip on a cold process)
+        loads, w_dev, nc_dev, allowed_dev, ew_dev = _device_prep(
+            jnp.asarray(dp.replicas),
+            jnp.asarray(dp.weights),
+            jnp.asarray(dp.nrep_cur),
+            jnp.asarray(dp.ncons),
+            None if all_allowed else jnp.asarray(dp.allowed),
+            jnp.asarray(dp.bvalid),
+            None if ew_np is None else jnp.asarray(ew_np),
+            dtype=dtype,
+            all_allowed=all_allowed,
+        )
         args = (
             loads,
             jnp.asarray(dp.replicas),
@@ -657,10 +702,10 @@ def plan(
             # skip the [P, B] transfer (the largest session input) there
             None if use_pallas else jnp.asarray(dp.member),
             allowed_dev,
-            jnp.asarray(dp.weights, dtype),
+            w_dev,
             jnp.asarray(dp.nrep_cur),
             jnp.asarray(dp.nrep_tgt),
-            jnp.asarray(dp.ncons, dtype),
+            nc_dev,
             jnp.asarray(dp.pvalid),
             jnp.asarray(_cfg_broker_mask(dp, cfg)),
             jnp.asarray(dp.bvalid),
@@ -669,14 +714,6 @@ def plan(
             jnp.int32(chunk),
         )
         if polish:
-            from kafkabalancer_tpu.solvers.polish import (
-                converge_session,
-                entry_table,
-            )
-
-            ew, ep_, er_, evalid = entry_table(
-                dp, cfg.min_replicas_for_rebalancing
-            )
             # drop only the member slot (index 2 — recomputed on device);
             # the trailing chunk scalar stays and binds converge_session's
             # ``budget`` parameter
@@ -685,7 +722,7 @@ def plan(
                 packed = np.asarray(
                     converge_session(
                         *sargs,
-                        jnp.asarray(ew, dtype),
+                        ew_dev,
                         jnp.asarray(ep_),
                         jnp.asarray(er_),
                         jnp.asarray(evalid),
@@ -745,11 +782,7 @@ def plan(
         # one device->host transfer for everything the decode needs: on a
         # remote-attached TPU each fetch pays a full relay round trip
         # (~0.15 s), so n + the three log arrays are packed device-side
-        packed = np.asarray(
-            jnp.concatenate(
-                [mp, mslot, mtgt, n.astype(jnp.int32).reshape(1)]
-            )
-        )
+        packed = np.asarray(_pack_log(mp, mslot, mtgt, n))
         # the pallas kernel always runs the pooled batched selection (even
         # at batch=1 there is no strict-trajectory contract — see the plan
         # docstring), so its superseded writes elide too

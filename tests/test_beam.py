@@ -196,3 +196,27 @@ def test_beam_siblings_mode():
     # a wider frontier cannot end catastrophically worse; allow small
     # trajectory differences
     assert res[True] <= res[False] * 1.5 + 1e-9
+
+
+def test_beam_chunked_no_premature_convergence():
+    """Chunked beam dispatches must not misread a chunk-boundary depth
+    truncation as convergence (near the boundary beam_session caps its
+    lookahead at the leftover chunk budget, so 'stopped before the cap'
+    can mean 'the improving sequence was longer than the leftover', not
+    'no improving sequence exists'). After a chunked plan converges
+    within a generous budget, a fresh full-depth search must find
+    nothing."""
+    from kafkabalancer_tpu.solvers.beam import _search_once
+
+    # seed chosen so the first 8-move chunk stops at n=7 (a boundary
+    # stop: 7 + depth > 8) with improving sequences still available — the
+    # pre-fix code broke there and abandoned them
+    rng = random.Random(9)
+    pl = random_partition_list(rng, 24, 6, weighted=True)
+    cfg = default_rebalance_config()
+    cfg.min_unbalance = 1e-9
+    cfg.beam_width = 4
+    cfg.beam_depth = 4
+    opl = beam_plan(pl, copy.deepcopy(cfg), 256, chunk_moves=8)
+    assert len(opl) < 256  # converged within budget
+    assert _search_once(pl, copy.deepcopy(cfg), depth=4) is None
