@@ -294,6 +294,8 @@ def plan_sharded(
     from kafkabalancer_tpu.solvers.scan import (
         _cfg_broker_mask,
         _decode_packed,
+        _pack_log,
+        _prep_from_dp,
         _settle_head,
         auto_chunk_moves,
         DEFAULT_CHURN_GATE,
@@ -324,23 +326,17 @@ def plan_sharded(
     remaining = budget
     while remaining > 0:
         dp = tensorize(pl, cfg, min_bucket=min_bucket)
-        loads = cost.broker_loads(
-            jnp.asarray(dp.replicas),
-            jnp.asarray(dp.weights, dtype),
-            jnp.asarray(dp.nrep_cur),
-            jnp.asarray(dp.ncons, dtype),
-            dp.bvalid.shape[0],
-        )
+        loads, w_dev, nc_dev, allowed_dev, _ew = _prep_from_dp(dp, dtype)[1]
         chunk = min(remaining, chunk_moves)
         _replicas, _loads, n, mp, mslot, _msrc, mtgt, _su = sharded_session(
             loads,
             jnp.asarray(dp.replicas),
             jnp.asarray(dp.member),
-            jnp.asarray(dp.allowed),
-            jnp.asarray(dp.weights, dtype),
+            allowed_dev,
+            w_dev,
             jnp.asarray(dp.nrep_cur),
             jnp.asarray(dp.nrep_tgt),
-            jnp.asarray(dp.ncons, dtype),
+            nc_dev,
             jnp.asarray(dp.pvalid),
             jnp.asarray(_cfg_broker_mask(dp, cfg)),
             jnp.asarray(dp.bvalid),
@@ -353,11 +349,7 @@ def plan_sharded(
             batch=max(1, batch),
             mesh=mesh,
         )
-        packed = np.asarray(
-            jnp.concatenate(
-                [mp, mslot, mtgt, n.astype(jnp.int32).reshape(1)]
-            )
-        )
+        packed = np.asarray(_pack_log(mp, mslot, mtgt, n))
         n = _decode_packed(packed, dp, opl, drop_superseded=True)
         remaining -= n
         if n < chunk:

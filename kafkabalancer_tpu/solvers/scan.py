@@ -376,6 +376,32 @@ def _pack_log(mp, mslot, mtgt, n):
     return jnp.concatenate([mp, mslot, mtgt, n.astype(jnp.int32).reshape(1)])
 
 
+def _prep_from_dp(dp, dtype, all_allowed=None, ew=None):
+    """:func:`_device_prep` from a DensePlan — the one call site shared by
+    ``plan``, ``_leader_plan`` and ``parallel.shard_session.plan_sharded``.
+
+    ``all_allowed`` (computed from ``dp`` when None) skips transferring
+    the ``[P, B]`` allowed matrix — the largest session input — when it
+    is just the broker-validity row broadcast (the default FillDefaults
+    outcome). Returns ``(all_allowed, (loads, weights, ncons,
+    allowed_dev, ew_dev))``."""
+    if all_allowed is None:
+        all_allowed = bool(
+            dp.allowed[:, : dp.nb].all(axis=1)[: dp.np_].all()
+        )
+    return all_allowed, _device_prep(
+        jnp.asarray(dp.replicas),
+        jnp.asarray(dp.weights),
+        jnp.asarray(dp.nrep_cur),
+        jnp.asarray(dp.ncons),
+        None if all_allowed else jnp.asarray(dp.allowed),
+        jnp.asarray(dp.bvalid),
+        None if ew is None else jnp.asarray(ew),
+        dtype=dtype,
+        all_allowed=all_allowed,
+    )
+
+
 def _superseded_mask(mp, mslot) -> "np.ndarray":
     """``keep`` mask collapsing consecutive same-slot runs per partition.
 
@@ -543,16 +569,8 @@ def _leader_plan(
     remaining = budget
     while remaining > 0:
         dp = tensorize(pl, cfg)
-        loads, w_dev, nc_dev, allowed_dev, _ew = _device_prep(
-            jnp.asarray(dp.replicas),
-            jnp.asarray(dp.weights),
-            jnp.asarray(dp.nrep_cur),
-            jnp.asarray(dp.ncons),
-            jnp.asarray(dp.allowed),
-            jnp.asarray(dp.bvalid),
-            None,
-            dtype=dtype,
-            all_allowed=False,
+        _, (loads, w_dev, nc_dev, allowed_dev, _ew) = _prep_from_dp(
+            dp, dtype
         )
         chunk = min(remaining, chunk_moves)
         _replicas, _loads, n, mp, mslot, mtgt = leader_session(
@@ -684,16 +702,8 @@ def plan(
         # one compiled program builds every derived device input (the
         # eager version dispatched ~25 tiny programs — each a relay round
         # trip on a cold process)
-        loads, w_dev, nc_dev, allowed_dev, ew_dev = _device_prep(
-            jnp.asarray(dp.replicas),
-            jnp.asarray(dp.weights),
-            jnp.asarray(dp.nrep_cur),
-            jnp.asarray(dp.ncons),
-            None if all_allowed else jnp.asarray(dp.allowed),
-            jnp.asarray(dp.bvalid),
-            None if ew_np is None else jnp.asarray(ew_np),
-            dtype=dtype,
-            all_allowed=all_allowed,
+        _, (loads, w_dev, nc_dev, allowed_dev, ew_dev) = _prep_from_dp(
+            dp, dtype, all_allowed=all_allowed, ew=ew_np
         )
         args = (
             loads,

@@ -1,0 +1,30 @@
+"""The multi-host example's loopback rehearsal is a real jax.distributed
+run (docs/MULTIHOST.md) — guard it so the deployment story can't rot."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLE = os.path.join(REPO, "examples", "multihost_sweep.py")
+FIXTURE = os.path.join(REPO, "tests", "data", "test.json")
+
+
+def test_multihost_sweep_local_demo():
+    proc = subprocess.run(
+        [
+            sys.executable, EXAMPLE, "--local-demo", "2",
+            "--input", FIXTURE, "--add-brokers", "1",
+            "--remove-brokers", "1",
+        ],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        env=dict(os.environ),
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = proc.stdout
+    # rank 0 printed the ranked table exactly once (replicated results)
+    assert out.count("feasible") == 1, out
+    # baseline + one add + one remove scenario rows
+    assert out.count("True") + out.count("False") == 3, out
