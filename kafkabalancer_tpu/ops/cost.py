@@ -187,12 +187,18 @@ def factored_target_best(
     colo_sub=None,
     colo_add=None,
     exclude_p=None,
+    top2: bool = False,
 ):
     """Best candidate per TARGET broker via the factorized rank-1 objective.
 
     ``exclude_p [B]`` (optional) bars one partition row per target — used
-    by the beam solver's sibling expansion to fetch the SECOND-best
-    candidate per target (the best one's partition is excluded).
+    to fetch the SECOND-best candidate per target (the best one's
+    partition is excluded). ``top2`` returns both in ONE pass — the
+    per-candidate ``[P, B]`` tensors are already materialized, so the
+    second-best costs two masked argmins instead of a full re-score
+    (equivalent to a second call with ``exclude_p=p``, pinned by
+    tests) — and extends the return to ``(su, vals, p, slot, vals2, p2,
+    slot2)``.
 
     The move objective factorizes as ``u = su + A[source] + C[target]``
     (move_candidate_scores docstring), so per-target minimization needs
@@ -270,4 +276,23 @@ def factored_target_best(
         p = jnp.where(lead_better, p_l, p)
         slot = jnp.where(lead_better, 0, slot)
 
-    return su, su + vals, p, slot
+    if not top2:
+        return su, su + vals, p, slot
+
+    # second-best per target among candidates whose partition differs
+    # from the (merged) winner — the [P, B] value tensors are live, so
+    # this is two masked argmins, not a re-score
+    excl = jnp.arange(P, dtype=jnp.int32)[:, None] == p[None, :]  # [P, B]
+    V2 = jnp.where(excl, jnp.inf, V)
+    p2 = jnp.argmin(V2, axis=0).astype(jnp.int32)
+    vals2 = V2[p2, t]
+    slot2 = r_star[p2]
+    if allow_leader:
+        V2_l = jnp.where(excl, jnp.inf, V_l)
+        p2_l = jnp.argmin(V2_l, axis=0).astype(jnp.int32)
+        vals2_l = V2_l[p2_l, t]
+        lb2 = vals2_l < vals2
+        vals2 = jnp.where(lb2, vals2_l, vals2)
+        p2 = jnp.where(lb2, p2_l, p2)
+        slot2 = jnp.where(lb2, 0, slot2)
+    return su, su + vals, p, slot, su + vals2, p2, slot2

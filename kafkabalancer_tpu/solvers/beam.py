@@ -55,14 +55,6 @@ from kafkabalancer_tpu.solvers.scan import (  # noqa: E402
 )
 
 
-def _colocation_cost(member, topic_id, n_topics, lam):
-    """λ·Σ max(0, same-topic replicas per broker − 1)."""
-    counts = jnp.zeros((n_topics, member.shape[1]), member.dtype).at[
-        topic_id
-    ].add(member)
-    return lam * jnp.sum(jnp.maximum(counts - 1, 0))
-
-
 def _scan_factory(
     allowed, weights, nrep_cur, nrep_tgt, ncons, pvalid, always_valid,
     universe_valid, topic_id, min_replicas, lam, dtype, P, R, B,
@@ -82,17 +74,15 @@ def _scan_factory(
     """
     W, D = width, depth
 
-    def state_cost(loads, member):
+    def state_cost(loads, member, counts):
         observed = jnp.any(member & pvalid[:, None], axis=0)
         bvalid = (always_valid | observed) & universe_valid
         u = cost.unbalance(loads, bvalid, jnp.sum(bvalid).astype(dtype))
         if n_topics:
-            u = u + _colocation_cost(
-                member.astype(dtype), topic_id, n_topics, lam
-            )
+            u = u + lam * jnp.sum(jnp.maximum(counts - 1, 0))
         return u
 
-    def expand(loads, replicas, member, alive):
+    def expand(loads, replicas, member, counts, alive):
         """Per-TARGET best candidate of one beam via the shared factorized
         scorer (ops/cost.py factored_target_best); the frontier takes the
         top-W of the W×B per-target bests. Restricting to one candidate per
@@ -106,9 +96,10 @@ def _scan_factory(
         nb = jnp.sum(bvalid).astype(dtype)
 
         if n_topics:
-            counts = jnp.zeros((n_topics, B), dtype).at[topic_id].add(
-                member.astype(dtype)
-            )
+            # counts ride as INCREMENTAL beam state (updated per applied
+            # move) — rebuilding them here was a [P, B]->[T, B]
+            # scatter-add per beam per depth step and dominated beam
+            # round cost at 10k x 100 (~1/3 of wall-clock)
             c_rows = counts[topic_id]  # [P, B]
             c_src = jnp.take_along_axis(
                 c_rows, jnp.clip(replicas, 0), axis=1
@@ -120,35 +111,39 @@ def _scan_factory(
             colo_sub = colo_add = None
             colo_now = 0.0
 
-        _su, vals, p, slot = cost.factored_target_best(
-            loads, replicas, allowed, member, bvalid, weights, nrep_cur,
-            nrep_tgt, ncons, pvalid, nb, min_replicas,
-            allow_leader=allow_leader,
-            colo_sub=colo_sub, colo_add=colo_add,
-        )
         if siblings:
             # sibling expansion: the SECOND-best candidate per target (the
             # best one's partition excluded) joins the frontier — on
             # plateaus the per-target-best restriction loses compound
             # sequences whose later moves need a different source for the
-            # same cold target (VERDICT r1 weak #9)
-            _su2, vals2, p2, slot2 = cost.factored_target_best(
-                loads, replicas, allowed, member, bvalid, weights,
-                nrep_cur, nrep_tgt, ncons, pvalid, nb, min_replicas,
-                allow_leader=allow_leader,
-                colo_sub=colo_sub, colo_add=colo_add, exclude_p=p,
+            # same cold target (VERDICT r1 weak #9). top2 fetches both in
+            # one pass (two masked argmins instead of a full re-score —
+            # expand dominates beam round cost)
+            _su, vals, p, slot, vals2, p2, slot2 = (
+                cost.factored_target_best(
+                    loads, replicas, allowed, member, bvalid, weights,
+                    nrep_cur, nrep_tgt, ncons, pvalid, nb, min_replicas,
+                    allow_leader=allow_leader,
+                    colo_sub=colo_sub, colo_add=colo_add, top2=True,
+                )
             )
             vals = jnp.stack([vals, vals2])  # [C=2, B]
             p = jnp.stack([p, p2])
             slot = jnp.stack([slot, slot2])
         else:
+            _su, vals, p, slot = cost.factored_target_best(
+                loads, replicas, allowed, member, bvalid, weights, nrep_cur,
+                nrep_tgt, ncons, pvalid, nb, min_replicas,
+                allow_leader=allow_leader,
+                colo_sub=colo_sub, colo_add=colo_add,
+            )
             vals = vals[None, :]  # [C=1, B]
             p = p[None, :]
             slot = slot[None, :]
         vals = jnp.where(alive, vals + colo_now, jnp.inf)
         return vals, p, slot
 
-    def apply_move(loads, replicas, member, p, slot, t):
+    def apply_move(loads, replicas, member, counts, p, slot, t):
         s = replicas[p, slot]
         delta = jnp.where(
             slot == 0,
@@ -158,22 +153,37 @@ def _scan_factory(
         loads = loads.at[s].add(-delta).at[t].add(delta)
         replicas = replicas.at[p, slot].set(t.astype(replicas.dtype))
         member = member.at[p, s].set(False).at[p, t].set(True)
-        return loads, replicas, member
+        if n_topics:
+            tid = topic_id[p]
+            counts = counts.at[tid, s].add(-1.0).at[tid, t].add(1.0)
+        return loads, replicas, member, counts
 
     def run(loads, replicas, member, depth_cap):
-        su0 = state_cost(loads, member)
+        # colocation counts build ONCE per search (one scatter), then ride
+        # as incremental beam state through apply_move
+        counts0 = (
+            jnp.zeros((n_topics, B), dtype).at[topic_id].add(
+                member.astype(dtype)
+            )
+            if n_topics
+            else None
+        )
+        su0 = state_cost(loads, member, counts0)
 
         # beam state: [W, ...] with beam 0 = the start, others dead
         loads_b = jnp.broadcast_to(loads, (W, B))
         replicas_b = jnp.broadcast_to(replicas, (W, P, R))
         member_b = jnp.broadcast_to(member, (W, P, B))
+        counts_b = (
+            jnp.broadcast_to(counts0, (W, n_topics, B)) if n_topics else None
+        )
         alive = jnp.zeros(W, bool).at[0].set(True)
 
         def depth_step(carry, _):
-            loads_b, replicas_b, member_b, alive, best = carry
+            loads_b, replicas_b, member_b, counts_b, alive, best = carry
 
             vals, cp, cslot = jax.vmap(expand)(
-                loads_b, replicas_b, member_b, alive
+                loads_b, replicas_b, member_b, counts_b, alive
             )  # each [W, C, B] (C = 2 with sibling expansion)
 
             C = vals.shape[1]
@@ -196,14 +206,17 @@ def _scan_factory(
                     replicas_b[parent[i]],
                     member_b[parent[i]],
                 )
+                ct_ = counts_b[parent[i]] if n_topics else None
                 return lax.cond(
                     ok[i],
                     lambda a: apply_move(*a, p_sel[i], slot_sel[i], t_sel[i]),
                     lambda a: a,
-                    (pl_, rp_, mb_),
+                    (pl_, rp_, mb_, ct_),
                 )
 
-            loads_b, replicas_b, member_b = lax.map(build, jnp.arange(W))
+            loads_b, replicas_b, member_b, counts_b = lax.map(
+                build, jnp.arange(W)
+            )
             alive = ok
             # re-evaluate the TRUE state cost: candidate scores are
             # incremental estimates; ranking/acceptance must use real
@@ -211,7 +224,10 @@ def _scan_factory(
             su_b = jnp.where(
                 ok,
                 lax.map(
-                    lambda i: state_cost(loads_b[i], member_b[i]),
+                    lambda i: state_cost(
+                        loads_b[i], member_b[i],
+                        counts_b[i] if n_topics else None,
+                    ),
                     jnp.arange(W),
                 ),
                 jnp.inf,
@@ -233,15 +249,15 @@ def _scan_factory(
                 jnp.where(better, replicas_b[arg], bs_replicas),
                 jnp.where(better, member_b[arg], bs_member),
             )
-            carry = (loads_b, replicas_b, member_b, alive, best)
+            carry = (loads_b, replicas_b, member_b, counts_b, alive, best)
             return carry, (parent, p_sel, slot_sel, t_sel)
 
         best0 = (
             su0, jnp.int32(-1), jnp.int32(-1), jnp.int32(0),
             loads, replicas, member,
         )
-        carry0 = (loads_b, replicas_b, member_b, alive, best0)
-        (_, _, _, _, best), logs = lax.scan(
+        carry0 = (loads_b, replicas_b, member_b, counts_b, alive, best0)
+        (_, _, _, _, _, best), logs = lax.scan(
             depth_step, carry0, None, length=D
         )
         (best_u, best_beam, best_depth, _,

@@ -146,3 +146,52 @@ def test_rank_brokers_matches_bl_order():
         assert (np.asarray(rank_of)[perm_np] == np.arange(len(perm_np))).all()
         # padded brokers rank last
         assert (perm_np[dp.nb :] >= dp.nb).all() or dp.nb == dp.bvalid.shape[0]
+
+
+@pytest.mark.parametrize("allow_leader", [False, True])
+def test_factored_target_best_top2_matches_exclude_call(allow_leader):
+    """top2=True must return exactly what a second full call with
+    exclude_p=<first winners> returns (the beam sibling-expansion
+    contract) — one pass vs re-score is a pure efficiency change."""
+    rng = random.Random(4242 + allow_leader)
+    for _ in range(6):
+        pl = filled(random_partition_list(
+            rng, rng.randint(8, 40), rng.randint(3, 10),
+            weighted=True, with_consumers=True,
+        ))
+        dp = tensorize(pl)
+        loads = cost.broker_loads(
+            jnp.asarray(dp.replicas),
+            jnp.asarray(dp.weights),
+            jnp.asarray(dp.nrep_cur),
+            jnp.asarray(dp.ncons),
+            dp.bvalid.shape[0],
+        )
+        args = (
+            loads,
+            jnp.asarray(dp.replicas),
+            jnp.asarray(dp.allowed),
+            jnp.asarray(dp.member),
+            jnp.asarray(dp.bvalid),
+            jnp.asarray(dp.weights),
+            jnp.asarray(dp.nrep_cur),
+            jnp.asarray(dp.nrep_tgt),
+            jnp.asarray(dp.ncons),
+            jnp.asarray(dp.pvalid),
+            jnp.asarray(float(dp.nb)),
+            2,
+        )
+        su, v1, p1, s1, v2, p2, s2 = cost.factored_target_best(
+            *args, allow_leader=allow_leader, top2=True
+        )
+        su_a, v1_a, p1_a, s1_a = cost.factored_target_best(
+            *args, allow_leader=allow_leader
+        )
+        su_b, v2_b, p2_b, s2_b = cost.factored_target_best(
+            *args, allow_leader=allow_leader, exclude_p=p1_a
+        )
+        assert float(su) == float(su_a) == float(su_b)
+        for got, want in ((v1, v1_a), (v2, v2_b)):
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        for got, want in ((p1, p1_a), (s1, s1_a), (p2, p2_b), (s2, s2_b)):
+            assert (np.asarray(got) == np.asarray(want)).all()
