@@ -351,6 +351,46 @@ def test_sharded_session_matches_single_device(allow_leader):
     assert pl_s == pl_1
 
 
+def test_sharded_session_matches_single_device_restricted():
+    """Same exactness contract on an instance with PER-PARTITION broker
+    restrictions — the sharded session's [P, B] allowed-matrix path (the
+    all-allowed detection in _prep_from_dp skips that matrix entirely, so
+    all-allowed instances no longer exercise it)."""
+    import random as _random
+
+    from kafkabalancer_tpu.parallel.shard_session import plan_sharded
+    from kafkabalancer_tpu.solvers.scan import plan
+    from kafkabalancer_tpu.utils.synth import synth_cluster
+
+    def restricted(seed):
+        pl = synth_cluster(200, 16, rf=3, seed=seed, weighted=True)
+        rng = _random.Random(seed)
+        for p in pl.iter_partitions():
+            # half the partitions: restrict to their own replicas plus a
+            # random extra half of the universe
+            if rng.random() < 0.5:
+                extra = [b for b in range(1, 17) if rng.random() < 0.5]
+                p.brokers = sorted(set(p.replicas) | set(extra))
+        return pl
+
+    mesh = make_mesh(8, shape=(1, 8))
+    pl_s, pl_1 = restricted(91), restricted(91)
+    cfg = default_rebalance_config()
+    cfg.min_unbalance = 1e-7
+    opl_s = plan_sharded(pl_s, copy.deepcopy(cfg), 2000, mesh, batch=8)
+    opl_1 = plan(pl_1, copy.deepcopy(cfg), 2000, batch=8)
+    ms = [
+        (p.topic, p.partition, tuple(p.replicas))
+        for p in (opl_s.partitions or [])
+    ]
+    m1 = [
+        (p.topic, p.partition, tuple(p.replicas))
+        for p in (opl_1.partitions or [])
+    ]
+    assert ms == m1
+    assert pl_s == pl_1
+
+
 def test_sharded_session_chunk_reentry():
     """Chunked sharded sessions re-enter with the mutated assignment and
     still land a valid plan (same contract as plan's chunking)."""
