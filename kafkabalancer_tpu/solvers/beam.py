@@ -437,22 +437,19 @@ def _reconstruct(best_beam, best_depth, parents, mp, mslot, mtgt):
 
 
 def _device_setup(pl, cfg, dtype):
-    """Shared device-setup for one search/round: dense plan, loads, dtype,
-    colocation config. Keeps beam_move (_search_once) and _beam_round from
-    drifting apart."""
+    """Shared device-setup for one search/round: dense plan, prepped
+    device inputs (one compiled program — see scan._device_prep), dtype,
+    colocation config. Keeps beam_move (_search_once) and _beam_round
+    from drifting apart."""
+    from kafkabalancer_tpu.solvers.scan import _prep_from_dp
+
     dp = tensorize(pl, cfg)
     if dtype is None:
         dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
-    loads = cost.broker_loads(
-        jnp.asarray(dp.replicas),
-        jnp.asarray(dp.weights, dtype),
-        jnp.asarray(dp.nrep_cur),
-        jnp.asarray(dp.ncons, dtype),
-        dp.bvalid.shape[0],
-    )
+    _, (loads, w_dev, nc_dev, allowed_dev, _ew) = _prep_from_dp(dp, dtype)
     lam = float(cfg.anti_colocation)
     n_topics = next_bucket(len(dp.topics), 2) if lam > 0 else 0
-    return dp, dtype, loads, lam, n_topics
+    return dp, dtype, loads, w_dev, nc_dev, allowed_dev, lam, n_topics
 
 
 def _search_once(pl: PartitionList, cfg: RebalanceConfig, depth: int,
@@ -460,17 +457,19 @@ def _search_once(pl: PartitionList, cfg: RebalanceConfig, depth: int,
     """One beam search on the live list; returns the accepted move sequence
     as ``[(partition row, slot, target broker id)]`` with its DensePlan, or
     ``None`` when no sequence clears ``min_unbalance``."""
-    dp, dtype, loads, lam, n_topics = _device_setup(pl, cfg, dtype)
+    dp, dtype, loads, w_dev, nc_dev, allowed_dev, lam, n_topics = (
+        _device_setup(pl, cfg, dtype)
+    )
 
     su0, best_u, best_beam, best_depth, parents, mp, mslot, mtgt = beam_search(
         loads,
         jnp.asarray(dp.replicas),
         jnp.asarray(dp.member),
-        jnp.asarray(dp.allowed),
-        jnp.asarray(dp.weights, dtype),
+        allowed_dev,
+        w_dev,
         jnp.asarray(dp.nrep_cur),
         jnp.asarray(dp.nrep_tgt),
-        jnp.asarray(dp.ncons, dtype),
+        nc_dev,
         jnp.asarray(dp.pvalid),
         jnp.asarray(_cfg_broker_mask(dp, cfg)),
         jnp.asarray(dp.bvalid),
@@ -548,18 +547,20 @@ def beam_plan(
 def _beam_round(pl, cfg, opl, budget, dtype):
     """One fused beam dispatch of up to 2^16 moves; applies the moves to the
     live list and appends them to ``opl``; returns the move count."""
-    dp, dtype, loads, lam, n_topics = _device_setup(pl, cfg, dtype)
+    dp, dtype, loads, w_dev, nc_dev, allowed_dev, lam, n_topics = (
+        _device_setup(pl, cfg, dtype)
+    )
     ML = next_bucket(min(budget, 1 << 16), 64)
 
     packed = np.asarray(beam_session(
         loads,
         jnp.asarray(dp.replicas),
         jnp.asarray(dp.member),
-        jnp.asarray(dp.allowed),
-        jnp.asarray(dp.weights, dtype),
+        allowed_dev,
+        w_dev,
         jnp.asarray(dp.nrep_cur),
         jnp.asarray(dp.nrep_tgt),
-        jnp.asarray(dp.ncons, dtype),
+        nc_dev,
         jnp.asarray(dp.pvalid),
         jnp.asarray(_cfg_broker_mask(dp, cfg)),
         jnp.asarray(dp.bvalid),
