@@ -49,20 +49,16 @@ def log(msg: str) -> None:
 
 
 def _enable_persistent_cache(jax) -> None:
-    """Point jax at the repo-local persistent compile cache; repeat bench
-    invocations (and fresh CLI processes) deserialize executables instead
-    of recompiling."""
-    try:
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
-        )
-        # cache every executable: the session dispatches a few sub-second
-        # helper kernels (tensorize transfers, decode packing) whose
-        # recompiles would otherwise dominate a cold process
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    except Exception as exc:
-        log(f"persistent compile cache unavailable: {exc!r}")
+    """Point jax at the repo-local persistent compile cache (shared
+    helper: ops/runtime.py); repeat bench invocations (and fresh CLI
+    processes) deserialize executables instead of recompiling."""
+    from kafkabalancer_tpu.ops.runtime import ensure_persistent_cache
+
+    err = ensure_persistent_cache(
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache")
+    )
+    if err:
+        log(f"persistent compile cache unavailable: {err}")
 
 
 FLAGSHIP_BUDGET = 1 << 19

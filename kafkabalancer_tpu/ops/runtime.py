@@ -22,6 +22,7 @@ def ensure_x64() -> None:
     if _configured:
         return
     _configured = True
+    ensure_persistent_cache()
     if os.environ.get("KAFKABALANCER_TPU_NO_X64", "").lower() in (
         "1",
         "true",
@@ -32,6 +33,54 @@ def ensure_x64() -> None:
     import jax
 
     jax.config.update("jax_enable_x64", True)
+
+
+def ensure_persistent_cache(path: "str | None" = None) -> "str | None":
+    """Point JAX at a persistent compilation cache.
+
+    The deployment model is the reference's: one stateless process per
+    move (README.md:21-33 there), so without a persistent cache every CLI
+    invocation pays the full XLA/Mosaic compile. With ``path=None`` the
+    default is ``$XDG_CACHE_HOME/kafkabalancer-tpu/jax-cache``
+    (``~/.cache/...``); every executable is cached (sessions dispatch
+    sub-second helper programs whose recompiles would dominate a cold
+    process otherwise).
+
+    Deference rules for the default: a ``JAX_COMPILATION_CACHE_DIR`` env
+    var or an already-configured ``jax_compilation_cache_dir`` wins;
+    ``KAFKABALANCER_TPU_NO_COMPILE_CACHE=1`` disables. An explicit
+    ``path`` (bench.py points at a repo-local dir) overrides a
+    previously-set default. Failures are non-fatal (read-only HOME, old
+    jax) — planning works without a cache, just slower per process;
+    returns the error as a string for callers that want to log it, else
+    None.
+    """
+    if os.environ.get("KAFKABALANCER_TPU_NO_COMPILE_CACHE", "").lower() in (
+        "1",
+        "true",
+        "yes",
+        "on",
+    ):
+        return None
+    try:
+        import jax
+
+        if path is None and getattr(
+            jax.config, "jax_compilation_cache_dir", None
+        ):
+            return None  # env var or explicit earlier configuration wins
+        target = path or os.path.join(
+            os.environ.get("XDG_CACHE_HOME")
+            or os.path.join(os.path.expanduser("~"), ".cache"),
+            "kafkabalancer-tpu",
+            "jax-cache",
+        )
+        os.makedirs(target, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", target)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        return None
+    except Exception as exc:
+        return repr(exc)
 
 
 def next_bucket(n: int, minimum: int = 8) -> int:

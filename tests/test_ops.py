@@ -195,3 +195,43 @@ def test_factored_target_best_top2_matches_exclude_call(allow_leader):
             np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
         for got, want in ((p1, p1_a), (s1, s1_a), (p2, p2_b), (s2, s2_b)):
             assert (np.asarray(got) == np.asarray(want)).all()
+
+
+def test_persistent_cache_default(tmp_path):
+    """Fresh processes point JAX at the XDG persistent compile cache by
+    default (the deployment model is one stateless process per move, so
+    without it every CLI invocation pays full compiles); env opt-out and
+    a pre-set JAX_COMPILATION_CACHE_DIR win."""
+    import os as _os
+    import subprocess
+    import sys
+
+    code = (
+        "import jax\n"
+        "from kafkabalancer_tpu.ops.runtime import ensure_x64\n"
+        "ensure_x64()\n"
+        "print(repr(jax.config.jax_compilation_cache_dir))\n"
+    )
+
+    def run(extra_env):
+        env = dict(_os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XDG_CACHE_HOME"] = str(tmp_path)
+        env.pop("JAX_COMPILATION_CACHE_DIR", None)
+        env.pop("KAFKABALANCER_TPU_NO_COMPILE_CACHE", None)
+        env.update(extra_env)
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=120, env=env,
+            cwd=_os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+        )
+        assert out.returncode == 0, out.stderr[-1000:]
+        return out.stdout.strip().splitlines()[-1]
+
+    got = run({})
+    assert str(tmp_path) in got and "jax-cache" in got
+    assert _os.path.isdir(
+        _os.path.join(str(tmp_path), "kafkabalancer-tpu", "jax-cache")
+    )
+    assert run({"KAFKABALANCER_TPU_NO_COMPILE_CACHE": "1"}) == "None"
+    assert "/elsewhere" in run({"JAX_COMPILATION_CACHE_DIR": "/elsewhere"})
