@@ -48,18 +48,34 @@ def ensure_persistent_cache(path: "str | None" = None) -> "str | None":
 
     Deference rules for the default: a ``JAX_COMPILATION_CACHE_DIR`` env
     var or an already-configured ``jax_compilation_cache_dir`` wins;
-    ``KAFKABALANCER_TPU_NO_COMPILE_CACHE=1`` disables. An explicit
-    ``path`` (bench.py points at a repo-local dir) overrides a
-    previously-set default. Failures are non-fatal (read-only HOME, old
-    jax) — planning works without a cache, just slower per process;
-    returns the error as a string for callers that want to log it, else
-    None.
+    ``KAFKABALANCER_TPU_NO_COMPILE_CACHE=1`` disables. Processes pinned
+    to the CPU platform (``JAX_PLATFORMS=cpu`` — test/CI/dryrun runs)
+    skip the default: CPU executables are machine-feature-sensitive
+    (XLA's AOT loader warns about SIGILL when a shared cache — e.g. an
+    NFS home — crosses host generations) and recompile fast anyway; set
+    ``KAFKABALANCER_TPU_COMPILE_CACHE=1`` to force it on. An explicit
+    ``path`` (bench.py points at a repo-local dir) overrides all of the
+    above. Failures are non-fatal (read-only HOME, old jax) — planning
+    works without a cache, just slower per process; returns the error as
+    a string for callers that want to log it, else None.
     """
     if os.environ.get("KAFKABALANCER_TPU_NO_COMPILE_CACHE", "").lower() in (
         "1",
         "true",
         "yes",
         "on",
+    ):
+        return None
+    forced = os.environ.get("KAFKABALANCER_TPU_COMPILE_CACHE", "").lower() in (
+        "1",
+        "true",
+        "yes",
+        "on",
+    )
+    if (
+        path is None
+        and not forced
+        and os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
     ):
         return None
     try:

@@ -219,6 +219,7 @@ def test_persistent_cache_default(tmp_path):
         env["XDG_CACHE_HOME"] = str(tmp_path)
         env.pop("JAX_COMPILATION_CACHE_DIR", None)
         env.pop("KAFKABALANCER_TPU_NO_COMPILE_CACHE", None)
+        env.pop("KAFKABALANCER_TPU_COMPILE_CACHE", None)
         env.update(extra_env)
         out = subprocess.run(
             [sys.executable, "-c", code], capture_output=True, text=True,
@@ -228,10 +229,16 @@ def test_persistent_cache_default(tmp_path):
         assert out.returncode == 0, out.stderr[-1000:]
         return out.stdout.strip().splitlines()[-1]
 
-    got = run({})
+    # CPU-pinned processes (tests/CI/dryrun) skip the default — CPU
+    # executables are machine-feature-sensitive in shared caches
+    assert run({}) == "None"
+    got = run({"KAFKABALANCER_TPU_COMPILE_CACHE": "1"})
     assert str(tmp_path) in got and "jax-cache" in got
     assert _os.path.isdir(
         _os.path.join(str(tmp_path), "kafkabalancer-tpu", "jax-cache")
     )
-    assert run({"KAFKABALANCER_TPU_NO_COMPILE_CACHE": "1"}) == "None"
+    assert run({
+        "KAFKABALANCER_TPU_COMPILE_CACHE": "1",
+        "KAFKABALANCER_TPU_NO_COMPILE_CACHE": "1",
+    }) == "None"
     assert "/elsewhere" in run({"JAX_COMPILATION_CACHE_DIR": "/elsewhere"})
