@@ -220,3 +220,38 @@ def test_beam_chunked_no_premature_convergence():
     opl = beam_plan(pl, copy.deepcopy(cfg), 256, chunk_moves=8)
     assert len(opl) < 256  # converged within budget
     assert _search_once(pl, copy.deepcopy(cfg), depth=4) is None
+
+
+def test_session_then_beam_pipeline_reaches_colocation_floor():
+    """The deployment recipe for anti-colocation at scale (suite config
+    4b): converge balance with the fused session first, then beam +
+    anti-colocation from the balanced state. On a weighted zipf-topic
+    instance the pipeline must reach the UNAVOIDABLE colocation floor
+    without giving the balance back (beam spends its budget on
+    colocation structure, not raw balance)."""
+    import benchmarks.suite as suite
+    from kafkabalancer_tpu.solvers.scan import plan
+    from kafkabalancer_tpu.utils.synth import synth_cluster
+
+    pl = synth_cluster(240, 16, rf=3, seed=5, weighted=True,
+                       zipf_topics=True)
+    floor = suite.colocation_floor(pl, 16)
+    start = suite.colocations(pl)
+    assert start > floor  # instance has avoidable colocations
+
+    cfg_bal = default_rebalance_config()
+    cfg_bal.min_unbalance = 1e-7
+    cfg_beam = default_rebalance_config()
+    cfg_beam.min_unbalance = 1e-7
+    cfg_beam.beam_width = 4
+    cfg_beam.beam_depth = 4
+    cfg_beam.beam_siblings = True
+    cfg_beam.anti_colocation = 1e-3
+
+    plan(pl, copy.deepcopy(cfg_bal), 2048, batch=16)
+    u_mid = unbalance_of(pl)
+    beam_plan(pl, copy.deepcopy(cfg_beam), 2048)
+    assert suite.colocations(pl) == floor
+    # colocation fixes may trade a little balance (lambda-priced), never
+    # wreck it
+    assert unbalance_of(pl) <= max(2 * u_mid, u_mid + 1e-3)
