@@ -96,7 +96,25 @@ def _local_demo(n: int, args) -> int:
         )
         for i in range(n)
     ]
-    rcs = [p.wait(timeout=600) for p in procs]
+    import time
+
+    # One shared deadline across ALL workers (sequential per-process waits
+    # would let each hung worker consume the full budget). The default sits
+    # below the 420 s outer timeout tests/test_examples.py applies to this
+    # launcher so a hung worker is killed here, not orphaned; operators on
+    # slow machines can raise it.
+    budget = float(os.environ.get("KAFKABALANCER_TPU_DEMO_TIMEOUT", "390"))
+    deadline = time.monotonic() + budget
+    try:
+        rcs = [
+            p.wait(timeout=max(0.0, deadline - time.monotonic()))
+            for p in procs
+        ]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
     return max(rcs)
 
 

@@ -265,6 +265,11 @@ def run(i, o, e, args: List[str]) -> int:
             usage()
             return 3
 
+        if f_shard.value and not f_fused.value:
+            log("-fused-shard requires -fused")
+            usage()
+            return 3
+
         in_stream = i
         close_input = False
         if f_input.value != "":

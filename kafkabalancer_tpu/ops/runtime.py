@@ -72,11 +72,14 @@ def ensure_persistent_cache(path: "str | None" = None) -> "str | None":
         "yes",
         "on",
     )
-    if (
-        path is None
-        and not forced
-        and os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu"
-    ):
+    platforms = [
+        p.strip()
+        for p in os.environ.get("JAX_PLATFORMS", "").lower().split(",")
+        if p.strip()
+    ]
+    # JAX_PLATFORMS is a priority list; the first entry is the platform the
+    # process actually runs on, so "cpu,tpu" is just as CPU-pinned as "cpu".
+    if path is None and not forced and platforms[:1] == ["cpu"]:
         return None
     try:
         import jax

@@ -580,6 +580,14 @@ def test_fused_shard():
     assert rv == 3
     assert "does not support -rebalance-leader" in err
 
+    # -fused-shard without -fused is a config error (exit 3), not a
+    # silently ignored flag
+    rv, _out, err = run_cli(
+        ["-input-json", "-input", FIXTURE, "-fused-shard"]
+    )
+    assert rv == 3
+    assert "-fused-shard requires -fused" in err
+
 
 def test_cli_byte_parity_fuzz():
     """Randomized instances through the FULL CLI: -solver=tpu stdout must

@@ -242,3 +242,7 @@ def test_persistent_cache_default(tmp_path):
         "KAFKABALANCER_TPU_NO_COMPILE_CACHE": "1",
     }) == "None"
     assert "/elsewhere" in run({"JAX_COMPILATION_CACHE_DIR": "/elsewhere"})
+    # composite priority lists whose FIRST entry is cpu are just as
+    # CPU-pinned as the exact value "cpu"
+    assert run({"JAX_PLATFORMS": "cpu,tpu"}) == "None"
+    assert run({"JAX_PLATFORMS": " CPU , tpu "}) == "None"
