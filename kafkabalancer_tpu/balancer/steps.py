@@ -249,49 +249,67 @@ def greedy_move(
     cu = su
 
     for p in pl.iter_partitions():
-        if p.num_replicas < cfg.min_replicas_for_rebalancing:
-            continue
-
-        movable = p.replicas[0:1] if leaders else p.replicas[1:]
-
-        for r in movable:
-            ridx = -1
-            rload = 0.0
-            for idx, (bid, bload) in enumerate(bl):
-                if bid == r:
-                    ridx = idx
-                    rload = bload
-                    bl[idx][1] -= p.weight
-            if ridx == -1:
-                raise BalanceError(
-                    f"assertion failed: replica {r} not in broker loads {bl}"
-                )
-
-            for idx in range(len(bl)):
-                bid = bl[idx][0]
-                if bid not in p.brokers:
-                    continue
-                # the slot's current holder set — the target must be new
-                if bid in p.replicas:
-                    continue
-
-                bload = bl[idx][1]
-                bl[idx][1] += p.weight
-                u = get_unbalance_bl(bl)
-
-                if u < cu:
-                    cu = u
-                    best = (p, r, bid)
-
-                bl[idx][1] = bload
-
-            bl[ridx][1] = rload
+        cu, best = scan_partition_move(p, bl, cu, best, cfg, leaders)
 
     if cu < su - cfg.min_unbalance:
         p, r, b = best
         return replace_replica(p, r, b)
 
     return None
+
+
+def scan_partition_move(
+    p: Partition, bl, cu: float, best: Optional[tuple],
+    cfg: RebalanceConfig, leaders: bool,
+) -> "tuple[float, Optional[tuple]]":
+    """One partition's slice of the greedy scan (reference ``move`` loop
+    body, steps.go:167-223) — ``bl`` is mutated and restored exactly like
+    the reference so candidate objectives accumulate in ``bl`` order.
+
+    Shared by :func:`greedy_move` (every partition) and the vectorized
+    solver's tie resolution (solvers/tpu.py — only partitions the device
+    pass flags as candidate-window members), which is what makes the two
+    paths byte-identical by construction.
+    """
+    if p.num_replicas < cfg.min_replicas_for_rebalancing:
+        return cu, best
+
+    movable = p.replicas[0:1] if leaders else p.replicas[1:]
+
+    for r in movable:
+        ridx = -1
+        rload = 0.0
+        for idx, (bid, bload) in enumerate(bl):
+            if bid == r:
+                ridx = idx
+                rload = bload
+                bl[idx][1] -= p.weight
+        if ridx == -1:
+            raise BalanceError(
+                f"assertion failed: replica {r} not in broker loads {bl}"
+            )
+
+        for idx in range(len(bl)):
+            bid = bl[idx][0]
+            if bid not in p.brokers:
+                continue
+            # the slot's current holder set — the target must be new
+            if bid in p.replicas:
+                continue
+
+            bload = bl[idx][1]
+            bl[idx][1] += p.weight
+            u = get_unbalance_bl(bl)
+
+            if u < cu:
+                cu = u
+                best = (p, r, bid)
+
+            bl[idx][1] = bload
+
+        bl[ridx][1] = rload
+
+    return cu, best
 
 
 def distribute_leaders(

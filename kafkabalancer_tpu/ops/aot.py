@@ -1,0 +1,206 @@
+"""AOT executable store: fresh processes skip tracing AND compilation.
+
+The deployment model is the reference's — a stateless CLI run once per
+move by an outer supervision loop (its README.md:21-33), so per-process
+startup cost is the contractual latency. The persistent XLA compile cache
+(ops/runtime.py) already replaces *compilation* with deserialization, but
+a fresh process still pays jit tracing/lowering (~1.4 s for the fused
+session program at the 16k-partition bucket), the pallas module import
+(~0.9 s — tracing pulls it in), and the cache-lookup machinery (~0.5 s).
+
+This module persists the *compiled executable itself*
+(``jax.experimental.serialize_executable``): the next process with the
+same instance bucket deserializes and jumps straight to load + execute —
+no tracing, no lowering, no pallas import. Measured on the bench TPU at
+the 10k x 100 flagship: 6.2 s → 4.8 s per fresh-process plan, with the
+remainder dominated by shipping the ~33 MB executable to the accelerator
+(an attach-transport cost a locally-attached device pays in tens of
+milliseconds; see bench.py's relay accounting).
+
+Keys cover the jax version, backend platform + device kind + device
+count, every argument's shape/dtype (None args included), the static
+kwargs, and an md5 of the solver sources — any drift silently falls back
+to the ordinary jit path. Entries are written best-effort, atomically,
+into an ``aot/`` sibling of the persistent compile cache; corrupt or
+stale entries are removed on load failure. ``KAFKABALANCER_TPU_NO_AOT=1``
+disables both load and save.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import tempfile
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+_SALT_MODULES = (
+    "kafkabalancer_tpu.ops.cost",
+    "kafkabalancer_tpu.solvers.tpu",
+    "kafkabalancer_tpu.solvers.scan",
+    "kafkabalancer_tpu.solvers.polish",
+    "kafkabalancer_tpu.solvers.pallas_session",
+    "kafkabalancer_tpu.solvers.leader",
+    "kafkabalancer_tpu.solvers.beam",
+)
+
+_source_salt: Optional[str] = None
+_loaded: Dict[str, Any] = {}
+
+
+def _disabled() -> bool:
+    return os.environ.get("KAFKABALANCER_TPU_NO_AOT", "").lower() in (
+        "1", "true", "yes", "on",
+    )
+
+
+def source_salt() -> str:
+    """md5 over the solver module sources: ANY edit to the code that shapes
+    the traced program invalidates every stored executable."""
+    global _source_salt
+    if _source_salt is None:
+        h = hashlib.md5()
+        base = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        for mod in _SALT_MODULES:
+            rel = mod.split(".", 1)[1].replace(".", os.sep) + ".py"
+            try:
+                with open(os.path.join(base, rel), "rb") as f:
+                    h.update(f.read())
+            except OSError:
+                h.update(mod.encode())
+        _source_salt = h.hexdigest()
+    return _source_salt
+
+
+def aot_dir() -> Optional[str]:
+    """``aot/`` sibling of the configured persistent compile cache; None
+    (= store disabled) when no cache is configured — the same processes
+    that skip the compile cache (CPU-pinned tests/CI) skip this store."""
+    if _disabled():
+        return None
+    try:
+        import jax
+
+        cache = getattr(jax.config, "jax_compilation_cache_dir", None)
+    except Exception:
+        return None
+    if not cache:
+        return None
+    return os.path.join(cache, "aot")
+
+
+def _leaf_sig(x: Any) -> str:
+    if x is None:
+        return "None"
+    a = np.asarray(x)
+    return f"{a.dtype.str}{a.shape}"
+
+
+def aot_key(name: str, args: Tuple, statics: Dict[str, Any]) -> str:
+    """Stable content key for one (function, arg-shapes, statics) combo."""
+    import jax
+
+    dev = jax.devices()[0]
+    parts = [
+        name,
+        jax.__version__,
+        dev.platform,
+        getattr(dev, "device_kind", "?"),
+        str(jax.device_count()),
+        source_salt(),
+    ]
+    parts.extend(_leaf_sig(a) for a in args)
+    for k in sorted(statics):
+        v = statics[k]
+        if isinstance(v, type):  # dtype classes (jnp.float32 etc.)
+            v = np.dtype(v).str
+        parts.append(f"{k}={v}")
+    return hashlib.md5("|".join(parts).encode()).hexdigest()
+
+
+def try_load(
+    name: str, args: Tuple, statics: Dict[str, Any], out_leaves: int = 1
+):
+    """Deserialize a stored executable for this call, or None.
+
+    The pytree defs ``serialize`` hands back are deliberately NOT stored:
+    they are reconstructed from the very args the caller is about to pass
+    plus ``out_leaves`` (1 = a single output array, n = a flat n-tuple),
+    so a mismatch is impossible by construction. Any failure — missing
+    entry, stale jax/runtime, relay hiccup — removes the entry when
+    corrupt and falls back to the jit path.
+    """
+    d = aot_dir()
+    if d is None:
+        return None
+    key = aot_key(name, args, statics)
+    if key in _loaded:
+        return _loaded[key]
+    path = os.path.join(d, key + ".bin")
+    if not os.path.exists(path):
+        return None
+    try:
+        import jax
+        from jax.experimental.serialize_executable import (
+            deserialize_and_load,
+        )
+
+        with open(path, "rb") as f:
+            blob = f.read()
+        in_tree = jax.tree_util.tree_flatten((args, {}))[1]
+        skel = 0 if out_leaves == 1 else (0,) * out_leaves
+        out_tree = jax.tree_util.tree_flatten(skel)[1]
+        # the stored executables are single-device programs; restrict
+        # execution to device 0 (the default would hand a multi-device
+        # backend's full device list over and demand N-sharded args)
+        compiled = deserialize_and_load(
+            blob, in_tree, out_tree,
+            execution_devices=jax.devices()[:1],
+        )
+        _loaded[key] = compiled  # repeat chunks skip re-deserialization
+        return compiled
+    except Exception:
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        return None
+
+
+def maybe_save(
+    name: str, fn, args: Tuple, statics: Dict[str, Any]
+) -> Optional[str]:
+    """Compile ``fn`` for ``args`` AOT and store the executable if absent.
+
+    One-time cost per bucket (the AOT ``lower().compile()`` path keys the
+    persistent compile cache differently from the jit call path, so this
+    pays a real compile once); every later fresh process skips tracing
+    entirely. Best-effort: returns the path written, else None.
+    """
+    d = aot_dir()
+    if d is None:
+        return None
+    path = os.path.join(d, aot_key(name, args, statics) + ".bin")
+    if os.path.exists(path):
+        return None
+    try:
+        from jax.experimental.serialize_executable import serialize
+
+        compiled = fn.lower(*args, **statics).compile()
+        blob, _in_tree, _out_tree = serialize(compiled)
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+        return path
+    except Exception:
+        return None

@@ -139,45 +139,58 @@ def tensorize(
     bvalid = np.zeros(B, dtype=bool)
     bvalid[:nb] = True
 
-    idx_of = {int(b): j for j, b in enumerate(ids)}
-
     topics: List[str] = []
     topic_idx = {}
     topic_id = np.zeros(P, dtype=np.int32)
 
-    # after FillDefaults most partitions share one brokers list object
-    # (steps.go:47-56 assigns the same slice) — cache dense rows by identity
-    allowed_rows: dict = {}
+    if np_real:
+        pvalid[:np_real] = True
+        weights[:np_real] = [p.weight for p in parts]
+        lens = np.asarray([len(p.replicas) for p in parts], dtype=np.int32)
+        nrep_cur[:np_real] = lens
+        nrep_tgt[:np_real] = [p.num_replicas for p in parts]
+        ncons[:np_real] = [p.num_consumers for p in parts]
 
-    def allowed_row(brokers) -> np.ndarray:
-        key = id(brokers)
-        row = allowed_rows.get(key)
-        if row is None:
+        # replica broker IDs → dense indices in one vectorized pass (the
+        # universe is sorted, so searchsorted IS the id→index map); a
+        # per-slot Python dict lookup dominated host prep at 10k-partition
+        # scale (~0.7 s of the ~1 s tensorize)
+        flat = np.asarray(
+            [b for p in parts for b in p.replicas], dtype=np.int64
+        )
+        if flat.size:
+            rows = np.repeat(np.arange(np_real, dtype=np.int64), lens)
+            ends = np.cumsum(lens, dtype=np.int64)
+            slots = np.arange(flat.size, dtype=np.int64) - (ends - lens)[rows]
+            replicas[rows, slots] = np.searchsorted(ids, flat)
+
+        # topic interning (first-appearance order) — one dict hit per row
+        for i, p in enumerate(parts):
+            tid = topic_idx.get(p.topic)
+            if tid is None:
+                tid = topic_idx[p.topic] = len(topics)
+                topics.append(p.topic)
+            topic_id[i] = tid
+
+        # after FillDefaults most partitions share one brokers list object
+        # (steps.go:47-56 assigns the same slice) — group rows by identity
+        # and fill each distinct allowed row with one vectorized write
+        groups: dict = {}
+        for i, p in enumerate(parts):
+            groups.setdefault(
+                None if p.brokers is None else id(p.brokers), (p.brokers, [])
+            )[1].append(i)
+        for brokers, rows_i in groups.values():
             row = np.zeros(B, dtype=bool)
-            for bid in brokers:
-                j = idx_of.get(int(bid))
-                if j is not None:  # allowed-but-unobserved: see broker_universe
-                    row[j] = True
-            allowed_rows[key] = row
-        return row
-
-    full_row = np.zeros(B, dtype=bool)
-    full_row[:nb] = True
-
-    for i, p in enumerate(parts):
-        tid = topic_idx.get(p.topic)
-        if tid is None:
-            tid = topic_idx[p.topic] = len(topics)
-            topics.append(p.topic)
-        topic_id[i] = tid
-        pvalid[i] = True
-        weights[i] = p.weight
-        nrep_cur[i] = len(p.replicas)
-        nrep_tgt[i] = p.num_replicas
-        ncons[i] = p.num_consumers
-        for s, bid in enumerate(p.replicas):
-            replicas[i, s] = idx_of[int(bid)]
-        allowed[i] = full_row if p.brokers is None else allowed_row(p.brokers)
+            if brokers is None:
+                row[:nb] = True
+            elif nb:
+                # allowed-but-unobserved IDs drop out: see broker_universe
+                want = np.asarray(list(brokers), dtype=np.int64)
+                pos = np.searchsorted(ids, want)
+                pos = pos[(pos < nb) & (ids[np.minimum(pos, nb - 1)] == want)]
+                row[pos] = True
+            allowed[np.asarray(rows_i, dtype=np.int64)] = row
 
     rows, cols = np.nonzero(replicas >= 0)
     member[rows, replicas[rows, cols]] = True

@@ -64,7 +64,10 @@ import numpy as np  # noqa: E402
 from jax import lax  # noqa: E402
 
 from kafkabalancer_tpu.ops import cost  # noqa: E402
-from kafkabalancer_tpu.solvers.scan import DEFAULT_CHURN_GATE  # noqa: E402
+from kafkabalancer_tpu.solvers.scan import (  # noqa: E402
+    DEFAULT_CHURN_GATE,
+    member_from as _member_from,
+)
 
 # swap-phase convergence: shift rotations tried without progress before
 # declaring the pairing exhausted
@@ -419,15 +422,6 @@ def _leader_shuffle_loop(
         cond, body, st
     )
     return loads, replicas, member, n, mp, mslot, mtgt
-
-
-def _member_from(replicas, nrep_cur, pvalid, B: int):
-    """Recompute the [P, B] membership mask from the replica matrix."""
-    R = replicas.shape[1]
-    slot = jnp.arange(R)[None, :]
-    valid = (slot < nrep_cur[:, None]) & pvalid[:, None]
-    onehot = replicas[:, :, None] == jnp.arange(B, dtype=replicas.dtype)
-    return jnp.any(onehot & valid[:, :, None], axis=1)
 
 
 @partial(

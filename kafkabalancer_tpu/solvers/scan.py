@@ -376,6 +376,180 @@ def _pack_log(mp, mslot, mtgt, n):
     return jnp.concatenate([mp, mslot, mtgt, n.astype(jnp.int32).reshape(1)])
 
 
+def member_from(replicas, nrep_cur, pvalid, B: int):
+    """Recompute the ``[P, B]`` membership mask from the replica matrix
+    on device (skips transferring the largest boolean session input)."""
+    R = replicas.shape[1]
+    slot = jnp.arange(R)[None, :]
+    valid = (slot < nrep_cur[:, None]) & pvalid[:, None]
+    onehot = replicas[:, :, None] == jnp.arange(B, dtype=replicas.dtype)
+    return jnp.any(onehot & valid[:, :, None], axis=1)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "dtype", "all_allowed", "max_moves", "allow_leader", "batch",
+        "engine", "polish", "leader",
+    ),
+)
+def session_packed(
+    replicas,
+    weights,
+    nrep_cur,
+    nrep_tgt,
+    ncons,
+    allowed,
+    pvalid,
+    always_valid,
+    universe_valid,
+    min_replicas,
+    min_unbalance,
+    budget,
+    churn_gate,
+    ew,
+    ep,
+    er,
+    evalid,
+    *,
+    dtype,
+    all_allowed: bool,
+    max_moves: int,
+    allow_leader: bool,
+    batch: int,
+    engine: str = "xla",
+    polish: bool = False,
+    leader: bool = False,
+):
+    """The ENTIRE per-chunk device program as ONE dispatch.
+
+    A cold process on a remote-attached TPU pays a full relay round trip
+    per jitted program (~0.1-0.15 s each even on persistent-cache hits);
+    splitting prep / session / log-packing across programs dominated cold
+    CLI latency. This entry fuses all of it: dtype casts, the broker-load
+    scatter (utils.go:92-105), the all-allowed broadcast, membership
+    recomputation, the session itself (move / polish-alternation /
+    rebalance-leaders), and the move-log packing — raw host arrays in,
+    one packed int32 log out.
+
+    ``allowed``/``ew``/``ep``/``er``/``evalid`` may be None (all-allowed
+    mode / no polish phase). Returns ``packed`` =
+    ``[move_p | move_slot | move_tgt | n]`` (log length ``2 * max_moves``
+    when ``polish`` else ``max_moves``).
+    """
+    w = weights.astype(dtype)
+    nc = ncons.astype(dtype)
+    B = universe_valid.shape[0]
+    loads = cost.broker_loads(replicas, w, nrep_cur, nc, B)
+    if all_allowed:
+        allowed_dev = jnp.broadcast_to(
+            universe_valid[None, :], (replicas.shape[0], B)
+        )
+    else:
+        allowed_dev = allowed
+    mu = min_unbalance.astype(dtype)
+    cg = churn_gate.astype(dtype)
+
+    if leader:
+        from kafkabalancer_tpu.solvers.leader import leader_session
+
+        member = member_from(replicas, nrep_cur, pvalid, B)
+        _replicas, _loads, n, mp, mslot, mtgt = leader_session(
+            loads, replicas, member, allowed_dev, w, nrep_cur, nrep_tgt,
+            nc, pvalid, always_valid, universe_valid, min_replicas, mu,
+            budget, max_moves=max_moves, allow_leader=allow_leader,
+            batch=batch,
+        )
+    elif polish:
+        from kafkabalancer_tpu.solvers.polish import converge_session
+
+        return converge_session(
+            loads, replicas, allowed_dev, w, nrep_cur, nrep_tgt, nc,
+            pvalid, always_valid, universe_valid, min_replicas, mu,
+            budget, ew if ew is None else ew.astype(dtype), ep, er,
+            evalid, cg, max_moves=max_moves, allow_leader=allow_leader,
+            batch=batch, engine=engine, all_allowed=all_allowed,
+        )
+    elif engine in ("pallas", "pallas-interpret"):
+        from kafkabalancer_tpu.solvers.pallas_session import pallas_session
+
+        _replicas, _loads, n, mp, mslot, _msrc, mtgt = pallas_session(
+            loads, replicas, None, allowed_dev, w, nrep_cur, nrep_tgt,
+            nc, pvalid, always_valid, universe_valid, min_replicas, mu,
+            budget, jnp.int32(max(1, batch)), cg.astype(jnp.float32),
+            max_moves=max_moves, allow_leader=allow_leader,
+            interpret=(engine == "pallas-interpret"),
+            all_allowed=all_allowed,
+        )
+    else:
+        member = member_from(replicas, nrep_cur, pvalid, B)
+        _replicas, _loads, n, mp, mslot, _msrc, mtgt, _su = session(
+            loads, replicas, member, allowed_dev, w, nrep_cur, nrep_tgt,
+            nc, pvalid, always_valid, universe_valid, min_replicas, mu,
+            budget, cg, max_moves=max_moves, allow_leader=allow_leader,
+            batch=batch,
+        )
+    return _pack_log(mp, mslot, mtgt, n)
+
+
+def _dispatch_chunk(
+    dp, cfg: RebalanceConfig, chunk: int, dtype, batch: int, engine: str,
+    polish: bool, leader: bool, all_allowed: bool, churn_gate: float,
+    ew=None, ep=None, er=None, evalid=None,
+) -> "np.ndarray":
+    """Host wrapper assembling :func:`session_packed`'s arguments from a
+    DensePlan — the one call site shared by ``plan`` and ``_leader_plan``.
+
+    Args stay raw numpy (jit transfers them at dispatch) so the AOT
+    executable store (ops/aot.py) can key, load, and call the stored
+    executable with exactly the objects the jit path would see: on an AOT
+    hit a fresh process skips tracing, lowering, the pallas import, and
+    the compile-cache machinery entirely.
+    """
+    from kafkabalancer_tpu.ops import aot
+
+    npdt = np.dtype(dtype)
+    args = (
+        dp.replicas,
+        dp.weights,
+        dp.nrep_cur,
+        dp.nrep_tgt,
+        dp.ncons,
+        None if all_allowed else dp.allowed,
+        dp.pvalid,
+        _cfg_broker_mask(dp, cfg),
+        dp.bvalid,
+        np.int32(cfg.min_replicas_for_rebalancing),
+        np.asarray(cfg.min_unbalance, npdt),
+        np.int32(chunk),
+        np.asarray(churn_gate, npdt),
+        ew,
+        ep,
+        er,
+        evalid,
+    )
+    statics = dict(
+        dtype=dtype,
+        all_allowed=all_allowed,
+        max_moves=next_bucket(chunk, 128),
+        allow_leader=cfg.allow_leader_rebalancing,
+        batch=max(1, batch),
+        engine=engine,
+        polish=polish,
+        leader=leader,
+    )
+    compiled = aot.try_load("session_packed", args, statics)
+    if compiled is not None:
+        try:
+            return np.asarray(compiled(*args))
+        except Exception:
+            pass  # stale entry (already pruned on load; this one: shapes
+            # raced a concurrent writer) — fall back to the jit path
+    out = np.asarray(session_packed(*args, **statics))
+    aot.maybe_save("session_packed", session_packed, args, statics)
+    return out
+
+
 def _prep_from_dp(dp, dtype, all_allowed=None, ew=None):
     """:func:`_device_prep` from a DensePlan — the one call site shared by
     ``plan``, ``_leader_plan`` and ``parallel.shard_session.plan_sharded``.
@@ -556,8 +730,6 @@ def _leader_plan(
     ``batch > 1`` selects the convergent batched-transfer extension
     (solvers/leader.py module docstring); ``batch=1`` replays the
     reference trajectory."""
-    from kafkabalancer_tpu.solvers.leader import leader_session
-
     repaired, budget = _settle_head(
         pl, cfg, max_reassign, include_reassign_leaders=False
     )
@@ -569,30 +741,13 @@ def _leader_plan(
     remaining = budget
     while remaining > 0:
         dp = tensorize(pl, cfg)
-        _, (loads, w_dev, nc_dev, allowed_dev, _ew) = _prep_from_dp(
-            dp, dtype
-        )
+        all_allowed = bool(dp.allowed[:, : dp.nb].all(axis=1)[: dp.np_].all())
         chunk = min(remaining, chunk_moves)
-        _replicas, _loads, n, mp, mslot, mtgt = leader_session(
-            loads,
-            jnp.asarray(dp.replicas),
-            jnp.asarray(dp.member),
-            allowed_dev,
-            w_dev,
-            jnp.asarray(dp.nrep_cur),
-            jnp.asarray(dp.nrep_tgt),
-            nc_dev,
-            jnp.asarray(dp.pvalid),
-            jnp.asarray(_cfg_broker_mask(dp, cfg)),
-            jnp.asarray(dp.bvalid),
-            jnp.int32(cfg.min_replicas_for_rebalancing),
-            jnp.asarray(cfg.min_unbalance, dtype),
-            jnp.int32(chunk),
-            max_moves=next_bucket(chunk, 128),
-            allow_leader=cfg.allow_leader_rebalancing,
-            batch=max(1, batch),
+        packed = _dispatch_chunk(
+            dp, cfg, chunk, dtype, batch, "xla",
+            polish=False, leader=True, all_allowed=all_allowed,
+            churn_gate=DEFAULT_CHURN_GATE,
         )
-        packed = np.asarray(_pack_log(mp, mslot, mtgt, n))
         n = _decode_packed(packed, dp, opl, drop_superseded=batch > 1)
         remaining -= n
         if n < chunk:
@@ -662,10 +817,7 @@ def plan(
     chunk_moves = max(1, min(chunk_moves, 1 << 20))
     use_pallas = engine in ("pallas", "pallas-interpret")
     if use_pallas:
-        from kafkabalancer_tpu.solvers.pallas_session import (
-            TILE_P,
-            pallas_session,
-        )
+        from kafkabalancer_tpu.solvers.pallas_session import TILE_P
 
         dtype = jnp.float32
 
@@ -689,115 +841,41 @@ def plan(
             dp = tensorize(pl, cfg)
         chunk = min(remaining, chunk_moves)
         if polish:
-            from kafkabalancer_tpu.solvers.polish import (
-                converge_session,
-                entry_table,
-            )
+            from kafkabalancer_tpu.solvers.polish import entry_table
 
             ew_np, ep_, er_, evalid = entry_table(
                 dp, cfg.min_replicas_for_rebalancing
             )
         else:
-            ew_np = None
-        # one compiled program builds every derived device input (the
-        # eager version dispatched ~25 tiny programs — each a relay round
-        # trip on a cold process)
-        _, (loads, w_dev, nc_dev, allowed_dev, ew_dev) = _prep_from_dp(
-            dp, dtype, all_allowed=all_allowed, ew=ew_np
-        )
-        args = (
-            loads,
-            jnp.asarray(dp.replicas),
-            # the pallas kernel derives membership from the replica matrix;
-            # skip the [P, B] transfer (the largest session input) there
-            None if use_pallas else jnp.asarray(dp.member),
-            allowed_dev,
-            w_dev,
-            jnp.asarray(dp.nrep_cur),
-            jnp.asarray(dp.nrep_tgt),
-            nc_dev,
-            jnp.asarray(dp.pvalid),
-            jnp.asarray(_cfg_broker_mask(dp, cfg)),
-            jnp.asarray(dp.bvalid),
-            jnp.int32(cfg.min_replicas_for_rebalancing),
-            jnp.asarray(cfg.min_unbalance, dtype),
-            jnp.int32(chunk),
-        )
-        if polish:
-            # drop only the member slot (index 2 — recomputed on device);
-            # the trailing chunk scalar stays and binds converge_session's
-            # ``budget`` parameter
-            sargs = args[:2] + args[3:]
-            try:
-                packed = np.asarray(
-                    converge_session(
-                        *sargs,
-                        ew_dev,
-                        jnp.asarray(ep_),
-                        jnp.asarray(er_),
-                        jnp.asarray(evalid),
-                        jnp.asarray(churn_gate, dtype),
-                        max_moves=next_bucket(chunk, 128),
-                        allow_leader=cfg.allow_leader_rebalancing,
-                        batch=max(1, batch),
-                        engine=engine,
-                        all_allowed=all_allowed,
-                    )
-                )
-            except BalanceError:
-                raise
-            except Exception as exc:
-                if engine in ("pallas", "pallas-interpret"):
-                    raise BalanceError(
-                        f"pallas engine failed ({exc!r}); use engine='xla' "
-                        f"or 'pallas-interpret'"
-                    ) from exc
-                raise
-            # polish interleaves swap/shuffle phases — never a batch=1
-            # parity trajectory, so superseded writes always elide
-            n = _decode_packed(packed, dp, opl, drop_superseded=True)
-            remaining -= n
-            if n < chunk:
-                break
-            continue
-        if use_pallas:
-            try:
-                _replicas, _loads, n, mp, mslot, _msrc, mtgt = pallas_session(
-                    *args,
-                    jnp.int32(max(1, batch)),
-                    jnp.asarray(churn_gate, jnp.float32),
-                    max_moves=next_bucket(chunk, 128),
-                    allow_leader=cfg.allow_leader_rebalancing,
-                    interpret=(engine == "pallas-interpret"),
-                    all_allowed=all_allowed,
-                )
-            except BalanceError:
-                raise
-            except Exception as exc:
+            ew_np = ep_ = er_ = evalid = None
+        # ONE compiled program per chunk: input prep, the session, and the
+        # move-log packing all fuse into a single dispatch (each separate
+        # program is a full relay round trip on a cold process), and ONE
+        # device->host transfer returns everything the decode needs
+        try:
+            packed = _dispatch_chunk(
+                dp, cfg, chunk, dtype, batch, engine,
+                polish=polish, leader=False, all_allowed=all_allowed,
+                churn_gate=churn_gate,
+                ew=ew_np, ep=ep_, er=er_, evalid=evalid,
+            )
+        except BalanceError:
+            raise
+        except Exception as exc:
+            if engine in ("pallas", "pallas-interpret"):
                 # compiled Mosaic kernels need a TPU backend; surface a
                 # planning failure (CLI exit 3) instead of a raw traceback
                 raise BalanceError(
                     f"pallas engine failed ({exc!r}); use engine='xla' or "
                     f"'pallas-interpret'"
                 ) from exc
-        else:
-            _replicas, _loads, n, mp, mslot, _msrc, mtgt, _su = session(
-                *args,
-                jnp.asarray(churn_gate, dtype),
-                max_moves=next_bucket(chunk, 128),
-                allow_leader=cfg.allow_leader_rebalancing,
-                batch=batch,
-            )
-
-        # one device->host transfer for everything the decode needs: on a
-        # remote-attached TPU each fetch pays a full relay round trip
-        # (~0.15 s), so n + the three log arrays are packed device-side
-        packed = np.asarray(_pack_log(mp, mslot, mtgt, n))
-        # the pallas kernel always runs the pooled batched selection (even
-        # at batch=1 there is no strict-trajectory contract — see the plan
-        # docstring), so its superseded writes elide too
+            raise
+        # polish interleaves swap/shuffle phases and the pallas kernel
+        # always runs the pooled batched selection — neither is a batch=1
+        # parity trajectory, so their superseded writes elide
         n = _decode_packed(
-            packed, dp, opl, drop_superseded=batch > 1 or use_pallas
+            packed, dp, opl,
+            drop_superseded=polish or batch > 1 or use_pallas,
         )
         remaining -= n
         if n < chunk:

@@ -20,12 +20,16 @@ candidate accumulates floats in ``bl`` order, so mathematically tied
 candidates (ubiquitous with the default weight 1.0) are separated by
 last-ulp rounding noise — behaviour an order-free vectorized reduction
 cannot reproduce. The device pass therefore returns, besides the argmin,
-the top-K near-minimal candidates; the host re-scores just that window
-with the float64 oracle (same accumulation order as Go) and replays the
-reference's first-strict-improver scan (steps.go:211) over it in candidate
-order. Result: byte-identical plans to the greedy oracle at vectorized
-search cost, falling back to the full greedy scan only if the tie window
-overflows K.
+the per-partition candidate minima (pure reductions — no top_k, whose TPU
+sort machinery alone was ~17 MB of compiled executable, a real cost per
+fresh process on a remote-attached device); the host flags the partitions
+whose minimum lands within tolerance of the global minimum and replays
+the ORACLE's own per-partition scan (balancer/steps.py
+``scan_partition_move`` — same bl mutation order, same
+first-strict-improver rule, steps.go:211) over just those rows. Result:
+byte-identical plans to the greedy oracle at vectorized search cost,
+falling back to the full greedy scan only when the window spans more
+partitions than the host re-scan budget (``MAX_WINDOW_CANDIDATES``).
 
 Parity semantics pinned against the greedy oracle:
 
@@ -50,7 +54,7 @@ Parity semantics pinned against the greedy oracle:
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -61,16 +65,17 @@ ensure_x64()
 
 import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
-from jax import lax  # noqa: E402
 
 from kafkabalancer_tpu.balancer import costmodel  # noqa: E402
 from kafkabalancer_tpu.balancer.steps import greedy_move, replace_replica  # noqa: E402
 from kafkabalancer_tpu.ops import cost, tensorize  # noqa: E402
 from kafkabalancer_tpu.ops.tensorize import DensePlan  # noqa: E402
 
-# Size of the near-tie window re-scored exactly on the host. Overflowing it
-# (>TIE_K mathematically tied candidates) falls back to the greedy scan.
-TIE_K = 1024
+# Host tie-resolution budget: the oracle re-scan over window partitions
+# covers at most this many (slot x target) candidate evaluations; a wider
+# window (pervasive exact ties, e.g. all-uniform weights at scale) falls
+# back to the full greedy scan.
+MAX_WINDOW_CANDIDATES = 32768
 
 # Below this candidate count the greedy scan beats device dispatch latency;
 # since the tpu solver is byte-identical to greedy by contract, routing tiny
@@ -97,10 +102,11 @@ def score_moves(
     """Score every candidate move with the rank-1 objective update.
 
     Returns ``(u_min, flat_idx, su, perm)`` and, when ``tie_k > 0``,
-    additionally ``(topk_vals, topk_idx)`` — the ``tie_k`` smallest
-    candidates. ``flat_idx`` indexes the candidate tensor flattened in
-    ``(partition, replica slot, target bl-rank)`` order; ``perm`` maps
-    bl rank → dense broker index. Inputs are dense index space
+    additionally ``perpart`` — the per-partition candidate minima the
+    host uses to flag tie-window partitions. ``flat_idx`` indexes the
+    candidate tensor flattened in ``(partition, replica slot, target
+    bl-rank)`` order; ``perm`` maps bl rank → dense broker index. Inputs
+    are dense index space
     (:class:`kafkabalancer_tpu.ops.tensorize.DensePlan`).
     """
     _, R = replicas.shape
@@ -125,43 +131,36 @@ def score_moves(
 
     slot = jnp.arange(R)[None, :]
     movable = (slot == 0) if leaders else (slot >= 1)
-    flat = jnp.where(movable[:, :, None], u, jnp.inf).reshape(-1)
+    masked = jnp.where(movable[:, :, None], u, jnp.inf)
+    flat = masked.reshape(-1)
     idx = jnp.argmin(flat)
+    u_min = flat[idx]
     if tie_k <= 0:
-        return flat[idx], idx, su, perm
-    k = min(tie_k, flat.shape[0])
-    neg_vals, top_idx = lax.top_k(-flat, k)
-    return flat[idx], idx, su, perm, -neg_vals, top_idx
+        return u_min, idx, su, perm
+    # tie window as PER-PARTITION minima: pure reductions, no top_k (the
+    # TPU sort machinery dominated the compiled executable at ~17 MB — a
+    # real per-fresh-process cost on a remote-attached device) and no
+    # index scatter (worse still: ~50 MB of scatter lowering). The host
+    # flags partitions whose minimum lands in the tolerance window and
+    # replays the ORACLE's own per-partition scan over just those rows
+    # (balancer/steps.py scan_partition_move) — parity by construction.
+    perpart = jnp.min(masked, axis=(1, 2))
+    return u_min, idx, su, perm, perpart
 
 
-def _score_packed(*args, leaders: bool, tie_k: int):
-    """``score_moves`` with outputs packed into ONE float and ONE int
-    array device-side: each separate device->host fetch pays a full relay
-    round trip on a remote-attached TPU, and the single-move path is the
-    reference's per-invocation deployment unit (one move per CLI run,
-    README.md:21-33) — six fetches dominated its latency.
-
-    Requires ``tie_k > 0`` (the packed layout carries the tie window;
-    ``score_moves`` itself remains the raw API for tie_k == 0 callers)."""
-    if tie_k <= 0:
-        raise ValueError("_score_packed requires tie_k > 0")
-    u_min, idx, su, perm, tie_vals, tie_idx = score_moves(
-        *args, leaders=leaders, tie_k=tie_k
+def _score_window(*args, leaders: bool):
+    """``score_moves`` with everything the host tie-resolution needs
+    packed into ONE float64 array device-side — each separate fetch pays
+    a full relay round trip on a remote-attached TPU, and the single-move
+    path is the reference's per-invocation deployment unit (one move per
+    CLI run, README.md:21-33). Layout: ``[u_min, su, perpart_min...]``."""
+    u_min, _idx, su, _perm, perpart = score_moves(
+        *args, leaders=leaders, tie_k=1
     )
-    f = jnp.concatenate([u_min.reshape(1), su.reshape(1), tie_vals])
-    i = jnp.concatenate(
-        [
-            idx.reshape(1).astype(jnp.int64),
-            perm.astype(jnp.int64),
-            tie_idx.astype(jnp.int64),
-        ]
-    )
-    return f, i
+    return jnp.concatenate([u_min.reshape(1), su.reshape(1), perpart])
 
 
-_score_packed_jit = jax.jit(
-    _score_packed, static_argnames=("leaders", "tie_k")
-)
+_score_window_jit = jax.jit(_score_window, static_argnames=("leaders",))
 
 
 def _oracle_loads(pl: PartitionList, cfg: RebalanceConfig):
@@ -174,26 +173,6 @@ def _oracle_loads(pl: PartitionList, cfg: RebalanceConfig):
     return loads
 
 
-def _exact_rescore(
-    bl: List[List], rank_of_idx: np.ndarray, w: float, s_dense: int, t_dense: int
-) -> float:
-    """Exact objective of one candidate: mutate a copy of ``bl`` like the
-    reference (source −w, target +w; steps.go:179-208) and accumulate the
-    objective in ``bl`` order — bit-identical to the Go scan."""
-    s_rank = int(rank_of_idx[s_dense])
-    t_rank = int(rank_of_idx[t_dense])
-    # save/assign restore like the reference (steps.go:218, :221) — a ±w
-    # round-trip would not restore the cells bitwise
-    s_old = bl[s_rank][1]
-    t_old = bl[t_rank][1]
-    bl[s_rank][1] = s_old - w
-    bl[t_rank][1] = t_old + w
-    u = costmodel.get_unbalance_bl(bl)
-    bl[s_rank][1] = s_old
-    bl[t_rank][1] = t_old
-    return u
-
-
 def find_best_move(
     dp: DensePlan, cfg: RebalanceConfig, leaders: bool, loads_map=None
 ) -> Optional[Tuple[int, int, int]]:
@@ -204,6 +183,8 @@ def find_best_move(
     ``None`` also signals the caller must fall back to the greedy scan
     (tie-window overflow) via the :class:`TieOverflow` exception instead.
     """
+    from kafkabalancer_tpu.balancer.steps import scan_partition_move
+
     nb = dp.nb
     B = dp.bvalid.shape[0]
     R = dp.replicas.shape[1]
@@ -215,61 +196,73 @@ def find_best_move(
     for bid, load in loads_map.items():
         loads_np[dp.broker_index(bid)] = load
 
-    f_out, i_out = _score_packed_jit(
-        jnp.asarray(loads_np),
-        jnp.asarray(dp.replicas),
-        jnp.asarray(dp.allowed),
-        jnp.asarray(dp.member),
-        jnp.asarray(dp.weights),
-        jnp.asarray(dp.nrep_cur),
-        jnp.asarray(dp.nrep_tgt),
-        jnp.asarray(dp.pvalid),
-        jnp.asarray(dp.bvalid),
+    # raw numpy args: the AOT executable store (ops/aot.py) keys, loads and
+    # calls the stored single-move scorer with exactly the objects the jit
+    # path would see — a fresh process (the reference's per-invocation
+    # deployment unit) skips tracing and compilation entirely on a hit
+    from kafkabalancer_tpu.ops import aot
+
+    args = (
+        loads_np,
+        dp.replicas,
+        dp.allowed,
+        dp.member,
+        dp.weights,
+        dp.nrep_cur,
+        dp.nrep_tgt,
+        dp.pvalid,
+        dp.bvalid,
         float(nb),
         int(cfg.min_replicas_for_rebalancing),
-        leaders=leaders,
-        tie_k=TIE_K,
     )
-    f_out, i_out = np.asarray(f_out), np.asarray(i_out)
-    u_min, tie_vals = float(f_out[0]), f_out[2:]
-    perm, tie_idx = i_out[1 : 1 + B], i_out[1 + B :]
+    statics = dict(leaders=leaders)
+    out = None
+    compiled = aot.try_load("score_window", args, statics)
+    if compiled is not None:
+        try:
+            out = compiled(*args)
+        except Exception:
+            out = None  # raced/stale entry — fall back to the jit path
+    if out is None:
+        out = _score_window_jit(*args, **statics)
+        aot.maybe_save("score_window", _score_window_jit, args, statics)
+    f_out = np.asarray(out)
+    u_min, su_dev, perpart = float(f_out[0]), float(f_out[1]), f_out[2:]
     if not np.isfinite(u_min):  # no candidate, or NaN objective (zero loads)
         return None
 
     # --- host-exact tie resolution (module docstring) --------------------
-    bl = costmodel.get_bl(loads_map)  # oracle bl, (load, ID) ascending
-    su = costmodel.get_unbalance_bl(bl)
-    rank_of_idx = np.empty(B, dtype=np.int64)
-    rank_of_idx[np.asarray(perm)] = np.arange(B)
-
-    tol = 1e-9 * max(1.0, abs(u_min), abs(su)) + 1e-12
-    in_window = tie_vals <= u_min + tol
-    k = len(tie_vals)
-    if bool(in_window.all()) and k < R * B * dp.replicas.shape[0]:
-        # the window may extend past the K candidates we fetched — the
-        # vectorized result is unreliable, use the exact scan
+    # flag every partition whose best candidate lands in the tolerance
+    # window of the global minimum; the device values are f64 rank-1
+    # scores, so the tolerance covers both their accumulation-order drift
+    # vs the oracle AND genuine last-ulp ties
+    tol = 1e-9 * max(1.0, abs(u_min), abs(su_dev)) + 1e-12
+    rows = np.nonzero(perpart <= u_min + tol)[0]
+    if len(rows) * R * nb > MAX_WINDOW_CANDIDATES:
         raise TieOverflow
 
-    cand = np.sort(tie_idx[in_window])
-    cu, best = su, None
-    for flat in cand:
-        p, rem = divmod(int(flat), R * B)
-        r, t_rank = divmod(rem, B)
-        s_dense = int(dp.replicas[p, r])
-        t_dense = int(perm[t_rank])
-        u = _exact_rescore(bl, rank_of_idx, float(dp.weights[p]), s_dense, t_dense)
-        if u < cu:
-            cu = u
-            best = (p, s_dense, t_dense)
+    # replay the ORACLE's own per-partition scan over just the flagged
+    # rows — same bl table, same mutation/restore dance, same candidate
+    # order, same first-strict-improver rule — byte parity by construction
+    bl = costmodel.get_bl(loads_map)  # oracle bl, (load, ID) ascending
+    su = costmodel.get_unbalance_bl(bl)
+    cu, best, best_row = su, None, -1
+    for row in rows:
+        cu, nbest = scan_partition_move(
+            dp.partitions[int(row)], bl, cu, best, cfg, leaders
+        )
+        if nbest is not best:
+            best, best_row = nbest, int(row)
 
     if best is None or not (cu < su - cfg.min_unbalance):
         return None
-    p, s_dense, t_dense = best
-    return p, int(dp.broker_ids[s_dense]), int(dp.broker_ids[t_dense])
+    _p, r_id, t_id = best
+    return best_row, int(r_id), int(t_id)
 
 
 class TieOverflow(Exception):
-    """More than TIE_K near-minimal candidates: resolve with the exact scan."""
+    """The near-minimal candidate window spans more partitions than the
+    host re-scan budget covers: resolve with the full exact scan."""
 
 
 def _tpu_move(

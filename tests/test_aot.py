@@ -1,0 +1,103 @@
+"""AOT executable store (ops/aot.py): save/load round trip, keying, and
+fallback behavior — on the CPU backend with a temp cache dir."""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kafkabalancer_tpu.ops import aot
+
+
+@pytest.fixture
+def cache_dir(tmp_path, monkeypatch):
+    monkeypatch.delenv("KAFKABALANCER_TPU_NO_AOT", raising=False)
+    old = getattr(jax.config, "jax_compilation_cache_dir", None)
+    jax.config.update("jax_compilation_cache_dir", str(tmp_path))
+    yield str(tmp_path)
+    jax.config.update("jax_compilation_cache_dir", old)
+    aot._loaded.clear()
+
+
+def test_roundtrip(cache_dir):
+    """maybe_save writes an executable; try_load returns a callable whose
+    output matches the jit path exactly."""
+    fn = jax.jit(
+        lambda a, b, s: (a * b).sum() + s, static_argnames=()
+    )
+    a = np.arange(8.0)
+    b = np.ones(8)
+    args = (a, b, 2.0)
+    statics = {}
+    assert aot.try_load("t", args, statics) is None  # nothing stored yet
+    path = aot.maybe_save("t", fn, args, statics)
+    assert path is not None and os.path.exists(path)
+    aot._loaded.clear()
+    compiled = aot.try_load("t", args, statics)
+    assert compiled is not None
+    got = np.asarray(compiled(*args))
+    want = np.asarray(fn(*args))
+    np.testing.assert_array_equal(got, want)
+    # in-process memo: second load returns the same object
+    assert aot.try_load("t", args, statics) is compiled
+
+
+def test_multi_output(cache_dir):
+    fn = jax.jit(lambda a: (a + 1, (a * 2).sum()))
+    args = (np.arange(4.0),)
+    assert aot.maybe_save("m", fn, args, {}) is not None
+    aot._loaded.clear()
+    compiled = aot.try_load("m", args, {}, out_leaves=2)
+    assert compiled is not None
+    g1, g2 = compiled(*args)
+    w1, w2 = fn(*args)
+    np.testing.assert_array_equal(np.asarray(g1), np.asarray(w1))
+    np.testing.assert_array_equal(np.asarray(g2), np.asarray(w2))
+
+
+def test_key_separates_shapes_statics(cache_dir):
+    """Different arg shapes/dtypes/statics and None-vs-array args key
+    differently; identical calls key identically."""
+    k = aot.aot_key("f", (np.zeros(4), None), {"x": 1})
+    assert k == aot.aot_key("f", (np.zeros(4), None), {"x": 1})
+    assert k != aot.aot_key("f", (np.zeros(5), None), {"x": 1})
+    assert k != aot.aot_key("f", (np.zeros(4, np.float32), None), {"x": 1})
+    assert k != aot.aot_key("f", (np.zeros(4), np.zeros(1)), {"x": 1})
+    assert k != aot.aot_key("f", (np.zeros(4), None), {"x": 2})
+    assert k != aot.aot_key("f", (np.zeros(4), None), {"x": jnp.float32})
+    assert k != aot.aot_key("g", (np.zeros(4), None), {"x": 1})
+
+
+def test_corrupt_entry_pruned(cache_dir):
+    """A corrupt blob is removed and the caller falls back (returns None)."""
+    args = (np.zeros(3),)
+    path = os.path.join(
+        cache_dir, "aot", aot.aot_key("c", args, {}) + ".bin"
+    )
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "wb") as f:
+        f.write(b"not an executable")
+    assert aot.try_load("c", args, {}) is None
+    assert not os.path.exists(path)
+
+
+def test_disabled_by_env(cache_dir, monkeypatch):
+    monkeypatch.setenv("KAFKABALANCER_TPU_NO_AOT", "1")
+    fn = jax.jit(lambda a: a + 1)
+    args = (np.zeros(2),)
+    assert aot.maybe_save("d", fn, args, {}) is None
+    assert aot.try_load("d", args, {}) is None
+
+
+def test_no_cache_dir_disables(monkeypatch):
+    monkeypatch.delenv("KAFKABALANCER_TPU_NO_AOT", raising=False)
+    old = getattr(jax.config, "jax_compilation_cache_dir", None)
+    jax.config.update("jax_compilation_cache_dir", None)
+    try:
+        assert aot.aot_dir() is None
+        assert aot.try_load("x", (np.zeros(1),), {}) is None
+    finally:
+        jax.config.update("jax_compilation_cache_dir", old)
