@@ -201,6 +201,29 @@ def maybe_save(
             except OSError:
                 pass
             raise
+        # memoize: the just-compiled executable serves this process's
+        # next chunk directly — without this, chunk 2 would re-read and
+        # re-ship the multi-MB blob the device already has resident
+        _loaded[aot_key(name, args, statics)] = compiled
         return path
     except Exception:
         return None
+
+
+def call_or_compile(
+    name: str, fn, args: Tuple, statics: Dict[str, Any],
+    out_leaves: int = 1,
+):
+    """The one AOT dispatch policy: stored executable if loadable, else
+    the jit path plus a best-effort store write. Shared by every AOT call
+    site so fixes to the flow (pruning, memoization, fallback) live in
+    one place."""
+    compiled = try_load(name, args, statics, out_leaves=out_leaves)
+    if compiled is not None:
+        try:
+            return compiled(*args)
+        except Exception:
+            pass  # raced/stale entry — fall back to the jit path
+    out = fn(*args, **statics)
+    maybe_save(name, fn, args, statics)
+    return out

@@ -184,8 +184,8 @@ def factored_target_best(
     min_replicas,
     *,
     allow_leader: bool,
-    colo_sub=None,
-    colo_add=None,
+    c_rows=None,
+    lam=None,
     exclude_p=None,
     top2: bool = False,
 ):
@@ -202,7 +202,19 @@ def factored_target_best(
 
     The move objective factorizes as ``u = su + A[source] + C[target]``
     (move_candidate_scores docstring), so per-target minimization needs
-    only [P, R] + [P, B] work — the [P, R, B] tensor never materializes.
+    only [P, B] work — the [P, R, B] tensor never materializes, and
+    (deliberately) NO per-slot gathers do either: source-broker terms are
+    computed for every (partition, broker) cell from plain broadcasts and
+    masked to the partition's members. The gather formulation
+    (``loads[s_idx]``, ``F[s_idx]`` over [P, R] indices) lowered to XLA's
+    general gather path and dominated the beam depth step (~70% of
+    wall-clock at 10k x 100 on the bench TPU); the broadcast form is pure
+    VPU element-wise work, and it is tie-PRESERVING: ``slot_of`` recovers
+    the winning source slot by re-scanning the winner partitions' slots
+    in ascending order, exactly the old per-slot argmin (and the Pallas
+    kernel's source scan order, pinned by the kernel-vs-XLA parity
+    tests).
+
     Followers (slots ≥ 1) score with the plain weight; when
     ``allow_leader``, slot 0 scores with its TRUE applied delta
     ``w·(replicas+consumers)`` — the reference's plain-weight
@@ -210,14 +222,16 @@ def factored_target_best(
     between load recomputations, so every batched/lookahead consumer uses
     the true delta (the per-move parity paths keep the quirk).
 
-    ``colo_sub [P, R]`` / ``colo_add [P, B]`` are optional additive
-    objective offsets (the beam solver's anti-colocation deltas, which
-    also factorize over source/target).
+    ``c_rows [P, B]`` (optional, with scalar ``lam``) enables the
+    anti-colocation objective: per-partition same-topic replica counts
+    per broker; removing from a broker with ≥ 2 scores −λ, adding to one
+    with ≥ 1 scores +λ.
 
     Returns ``(su, vals [B], p [B], slot [B])`` with ``vals`` ABSOLUTE
     (already ``su``-based) and ineligible targets at +inf. Shared by
     ``solvers.scan`` (batched sessions), ``solvers.pallas_session``
-    (re-derived in kernel form), and ``solvers.beam``.
+    (re-derived in kernel form), ``solvers.beam``, and
+    ``parallel.shard_session`` (per-shard selection).
     """
     P, R = replicas.shape
     B = loads.shape[0]
@@ -226,23 +240,31 @@ def factored_target_best(
     su = jnp.sum(F)
 
     w = weights[:, None]
-    s_idx = jnp.clip(replicas, 0)
-    slot_iota = jnp.arange(R)[None, :]
-    eligible = pvalid[:, None] & (nrep_tgt >= min_replicas)[:, None]
+    eligible = pvalid & (nrep_tgt >= min_replicas)  # [P]
     tmask = allowed & ~member & bvalid[None, :]
     if exclude_p is not None:
         tmask = tmask & (
             jnp.arange(P, dtype=jnp.int32)[:, None] != exclude_p[None, :]
         )
-    t = jnp.arange(B, dtype=jnp.int32)
 
-    # follower pass (slots >= 1, delta = w)
-    srcmask_f = (slot_iota >= 1) & (slot_iota < nrep_cur[:, None]) & eligible
-    A_f = overload_penalty(loads[s_idx] - w, avg) - F[s_idx]
+    # the leader's broker column as a one-hot compare (pad rows hold -1
+    # and never match)
+    lead_oh = replicas[:, 0][:, None] == jnp.arange(
+        B, dtype=replicas.dtype
+    )[None, :]
+
+    if c_rows is not None:
+        colo_sub = jnp.where(c_rows >= 2, lam, 0.0)  # removing from b
+        colo_add = jnp.where(c_rows >= 1, lam, 0.0)  # adding to b
+    else:
+        colo_sub = colo_add = None
+
+    # follower pass (member brokers minus the leader, delta = w)
+    srcmask_f = member & ~lead_oh & eligible[:, None]
+    A_f = overload_penalty(loads[None, :] - w, avg) - F[None, :]
     if colo_sub is not None:
         A_f = A_f - colo_sub
     A_f = jnp.where(srcmask_f, A_f, jnp.inf)
-    r_star = jnp.argmin(A_f, axis=1).astype(jnp.int32)  # [P]
     A_star = jnp.min(A_f, axis=1)
     C_f = overload_penalty(loads[None, :] + w, avg) - F[None, :]
     if colo_add is not None:
@@ -251,18 +273,40 @@ def factored_target_best(
         tmask & jnp.isfinite(A_star)[:, None], A_star[:, None] + C_f, jnp.inf
     )
     p = jnp.argmin(V, axis=0).astype(jnp.int32)  # [B]
-    vals = V[p, t]
-    slot = r_star[p]
+    vals = jnp.min(V, axis=0)
+
+    def slot_of(p_win):
+        """Source slot recovery for the [B] winner partitions ONLY: a
+        [P]-wide argmin over the minor broker axis was the single most
+        expensive op at beam scale (~45% of a depth step); gathering the
+        winners' source rows and arg-minning [B, R] is noise. Ties break
+        by ascending SLOT (matching the Pallas kernel's source scan
+        order, pinned by the kernel-vs-XLA parity tests). Rows with no
+        eligible source yield garbage but carry A_star = +inf, so no
+        consumer ever selects them."""
+        nwin = p_win.shape[0]
+        rows = A_f[p_win]  # [nwin, B]
+        rp = replicas[p_win]  # [nwin, R]
+        slot_vals = rows[
+            jnp.arange(nwin)[:, None], jnp.clip(rp, 0)
+        ]  # [nwin, R]
+        slot_iota = jnp.arange(R)[None, :]
+        valid = (slot_iota >= 1) & (slot_iota < nrep_cur[p_win][:, None])
+        slot_vals = jnp.where(valid, slot_vals, jnp.inf)
+        return jnp.argmin(slot_vals, axis=1).astype(jnp.int32)
+
+    slot = slot_of(p)
 
     if allow_leader:
         # leader pass (slot 0, delta = w·(replicas+consumers))
         wl = weights * (nrep_cur.astype(loads.dtype) + ncons)
-        s0 = jnp.clip(replicas[:, 0], 0)
-        ok_l = (nrep_cur >= 1) & eligible[:, 0]
-        A_l = overload_penalty(loads[s0] - wl, avg) - F[s0]
+        ok_l = (nrep_cur >= 1) & eligible
+        A_l_pb = overload_penalty(loads[None, :] - wl[:, None], avg) - F[None, :]
         if colo_sub is not None:
-            A_l = A_l - colo_sub[:, 0]
-        A_l = jnp.where(ok_l, A_l, jnp.inf)
+            A_l_pb = A_l_pb - colo_sub
+        A_l = jnp.min(
+            jnp.where(lead_oh & ok_l[:, None], A_l_pb, jnp.inf), axis=1
+        )
         C_l = overload_penalty(loads[None, :] + wl[:, None], avg) - F[None, :]
         if colo_add is not None:
             C_l = C_l + colo_add
@@ -270,7 +314,7 @@ def factored_target_best(
             tmask & jnp.isfinite(A_l)[:, None], A_l[:, None] + C_l, jnp.inf
         )
         p_l = jnp.argmin(V_l, axis=0).astype(jnp.int32)
-        vals_l = V_l[p_l, t]
+        vals_l = jnp.min(V_l, axis=0)
         lead_better = vals_l < vals
         vals = jnp.where(lead_better, vals_l, vals)
         p = jnp.where(lead_better, p_l, p)
@@ -285,12 +329,12 @@ def factored_target_best(
     excl = jnp.arange(P, dtype=jnp.int32)[:, None] == p[None, :]  # [P, B]
     V2 = jnp.where(excl, jnp.inf, V)
     p2 = jnp.argmin(V2, axis=0).astype(jnp.int32)
-    vals2 = V2[p2, t]
-    slot2 = r_star[p2]
+    vals2 = jnp.min(V2, axis=0)
+    slot2 = slot_of(p2)
     if allow_leader:
         V2_l = jnp.where(excl, jnp.inf, V_l)
         p2_l = jnp.argmin(V2_l, axis=0).astype(jnp.int32)
-        vals2_l = V2_l[p2_l, t]
+        vals2_l = jnp.min(V2_l, axis=0)
         lb2 = vals2_l < vals2
         vals2 = jnp.where(lb2, vals2_l, vals2)
         p2 = jnp.where(lb2, p2_l, p2)

@@ -538,16 +538,9 @@ def _dispatch_chunk(
         polish=polish,
         leader=leader,
     )
-    compiled = aot.try_load("session_packed", args, statics)
-    if compiled is not None:
-        try:
-            return np.asarray(compiled(*args))
-        except Exception:
-            pass  # stale entry (already pruned on load; this one: shapes
-            # raced a concurrent writer) — fall back to the jit path
-    out = np.asarray(session_packed(*args, **statics))
-    aot.maybe_save("session_packed", session_packed, args, statics)
-    return out
+    return np.asarray(
+        aot.call_or_compile("session_packed", session_packed, args, statics)
+    )
 
 
 def _prep_from_dp(dp, dtype, all_allowed=None, ew=None):

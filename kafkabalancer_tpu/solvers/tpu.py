@@ -216,17 +216,9 @@ def find_best_move(
         int(cfg.min_replicas_for_rebalancing),
     )
     statics = dict(leaders=leaders)
-    out = None
-    compiled = aot.try_load("score_window", args, statics)
-    if compiled is not None:
-        try:
-            out = compiled(*args)
-        except Exception:
-            out = None  # raced/stale entry — fall back to the jit path
-    if out is None:
-        out = _score_window_jit(*args, **statics)
-        aot.maybe_save("score_window", _score_window_jit, args, statics)
-    f_out = np.asarray(out)
+    f_out = np.asarray(
+        aot.call_or_compile("score_window", _score_window_jit, args, statics)
+    )
     u_min, su_dev, perpart = float(f_out[0]), float(f_out[1]), f_out[2:]
     if not np.isfinite(u_min):  # no candidate, or NaN objective (zero loads)
         return None
