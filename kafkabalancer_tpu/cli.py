@@ -357,17 +357,14 @@ def run(i, o, e, args: List[str]) -> int:
             try:
                 if f_shard.value:
                     # mesh-sharded converge session over every attached
-                    # device (parallel/shard_session.py); polish phases and
-                    # the pallas engine are single-device concerns
+                    # device (parallel/shard_session.py); polish phases
+                    # are single-device concerns, but the pallas engines
+                    # select the fused per-shard scoring kernel
+                    # (parallel/shard_kernel.py)
                     if f_polish.value:
                         log(
                             "-fused-polish does not apply to the sharded "
                             "session; ignoring it"
-                        )
-                    if f_engine.value != "xla":
-                        log(
-                            f"-fused-shard uses the XLA session; ignoring "
-                            f"-fused-engine={f_engine.value}"
                         )
                     import jax
 
@@ -382,6 +379,7 @@ def run(i, o, e, args: List[str]) -> int:
                     opl = plan_sharded(
                         pl, cfg, r, mesh,
                         batch=max(1, f_batch.value),
+                        engine=f_engine.value,
                     )
                 else:
                     from kafkabalancer_tpu.solvers.scan import plan

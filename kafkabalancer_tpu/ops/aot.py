@@ -181,7 +181,8 @@ def maybe_save(
     d = aot_dir()
     if d is None:
         return None
-    path = os.path.join(d, aot_key(name, args, statics) + ".bin")
+    key = aot_key(name, args, statics)
+    path = os.path.join(d, key + ".bin")
     if os.path.exists(path):
         return None
     try:
@@ -204,7 +205,7 @@ def maybe_save(
         # memoize: the just-compiled executable serves this process's
         # next chunk directly — without this, chunk 2 would re-read and
         # re-ship the multi-MB blob the device already has resident
-        _loaded[aot_key(name, args, statics)] = compiled
+        _loaded[key] = compiled
         return path
     except Exception:
         return None
@@ -221,7 +222,13 @@ def call_or_compile(
     compiled = try_load(name, args, statics, out_leaves=out_leaves)
     if compiled is not None:
         try:
-            return compiled(*args)
+            import jax
+
+            out = compiled(*args)
+            # materialize INSIDE the fallback scope: a stale/raced entry
+            # can fail asynchronously, surfacing only at transfer time
+            jax.block_until_ready(out)
+            return out
         except Exception:
             pass  # raced/stale entry — fall back to the jit path
     out = fn(*args, **statics)

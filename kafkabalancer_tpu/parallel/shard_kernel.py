@@ -1,0 +1,234 @@
+"""Pallas shard body: the per-shard scoring pass of the sharded converge
+session as ONE fused TPU kernel.
+
+``parallel/shard_session.py`` runs the whole batched move loop on a mesh:
+per iteration every shard scores its local partition rows
+(``cost.factored_target_best``) and two small collectives combine the
+per-target winners. The scoring pass is the only O(P/S · B) work in the
+loop — the XLA form materializes several ``[P_l, B]`` intermediates
+(A, C, V, masks) as separate HBM passes; this kernel streams the local
+rows tile-by-tile and keeps every intermediate in VMEM, one pass over the
+inputs per iteration.
+
+Unlike the single-chip whole-session kernel
+(``solvers/pallas_session.py``), which holds ALL state in scoped VMEM and
+therefore hits a hard 128k x 256 capacity ceiling, this kernel is
+gridded: state stays in HBM and tiles stream through VMEM, so there is NO
+kernel-side partition ceiling — the per-shard row count P/S is bounded by
+HBM alone, and sharding divides it S-fold (the scaling story
+RESULTS.md documents).
+
+Exactness: the kernel reproduces ``factored_target_best``'s selection
+bit-for-bit in float32 — same ``overload_penalty`` (the shared function;
+element-wise, so accumulation order cannot drift), same masks, same
+per-target argmin-over-rows with lowest-row tie-break (running strict-<
+accumulation over ascending tiles), same strict-< leader merge (done
+OUTSIDE the kernel by the shard body, together with the winner-only slot
+recovery, so that code is shared with the XLA engine). Pinned by
+tests/test_parallel.py: the pallas-interpret sharded session's move log
+is bit-identical to the XLA sharded session's.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+from kafkabalancer_tpu.ops.runtime import ensure_x64
+
+ensure_x64()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax import lax  # noqa: E402
+from jax.experimental import pallas as pl  # noqa: E402
+
+from kafkabalancer_tpu.ops import cost  # noqa: E402
+
+# rows streamed per grid step. MUST stay a power of two: per-shard row
+# counts are power-of-two multiples of 8 (plan_sharded tensorizes with
+# min_bucket = 8*S and buckets are min_bucket·2^k), so divisibility by
+# the tile — or the tile shrinking to P_l via min() — holds exactly
+# because both are powers of two.
+SHARD_TILE_P = 256
+
+
+def _kernel(
+    replicas_ref,  # [T, R] i32 dense broker indices (-1 pad)
+    cols_ref,      # [T, 5] f32: w | ncur | ntgt | ncons | pvalid
+    member_ref,    # [T, B] bool
+    allowed_ref,   # [T, B] bool
+    loads_ref,     # [1, B] f32
+    F_ref,         # [1, B] f32 (bvalid-masked penalty terms)
+    bvalid_ref,    # [1, B] bool
+    scal_ref,      # [1, 2] f32: avg | min_replicas
+    vf_ref,        # [1, B] f32 out: best follower A*+C per target
+    pf_ref,        # [1, B] i32 out: its LOCAL partition row
+    vl_ref,        # [1, B] f32 out: best leader A+C per target
+    pl_ref,        # [1, B] i32 out: its LOCAL partition row
+    *,
+    allow_leader: bool,
+):
+    ti = pl.program_id(0)
+    T, B = member_ref.shape[0], member_ref.shape[1]
+    f32 = jnp.float32
+    i32 = jnp.int32
+
+    reps = replicas_ref[...]
+    cols = cols_ref[...]
+    w = cols[:, 0:1]
+    ncur = cols[:, 1:2]
+    ntgt = cols[:, 2:3]
+    ncons = cols[:, 3:4]
+    pvalid = cols[:, 4:5] > jnp.zeros((1, 1), f32)
+
+    # bool (pred) mask inputs: Mosaic legalizes pred loads fine while i8
+    # loads failed to legalize on the bench toolchain
+    member = member_ref[...]
+    allowed = allowed_ref[...]
+    bvalid = bvalid_ref[...]  # [1, B]
+    loads = loads_ref[...]  # [1, B]
+    F = F_ref[...]
+    avg = scal_ref[0, 0]
+    minrep = scal_ref[0, 1]
+
+    iota_b = lax.broadcasted_iota(i32, (T, B), 1)
+    row_iota = lax.broadcasted_iota(i32, (T, B), 0)
+    inf = jnp.full((T, B), jnp.inf, f32)
+    big = jnp.full((T, B), jnp.iinfo(jnp.int32).max, i32)
+
+    lead_oh = iota_b == reps[:, 0:1]
+    eligible = pvalid & (ntgt >= minrep)
+    tmask = allowed & ~member & bvalid
+
+    # NOTE on structure: every output ref is initialized in the first
+    # grid step AND written on every later step, with the running
+    # strict-< accumulation written out inline — outputs touched only
+    # under ``pl.when(ti == 0)``, and helper-closure formulations of this
+    # same accumulation, both failed to legalize in Mosaic on the bench
+    # toolchain ("failed to legalize operation 'func.return'").
+    @pl.when(ti == 0)
+    def _():
+        vf_ref[...] = jnp.full((1, B), jnp.inf, f32)
+        pf_ref[...] = jnp.zeros((1, B), i32)
+        vl_ref[...] = jnp.full((1, B), jnp.inf, f32)
+        pl_ref[...] = jnp.zeros((1, B), i32)
+
+    # --- follower pass (member brokers minus the leader, delta = w) -----
+    srcmask = member & ~lead_oh & eligible
+    A = cost.overload_penalty(loads - w, avg) - F
+    A = jnp.where(srcmask, A, inf)
+    A_star = jnp.min(A, axis=1, keepdims=True)  # [T, 1]
+    C = cost.overload_penalty(loads + w, avg) - F
+    V = jnp.where(tmask & jnp.isfinite(A_star), A_star + C, inf)
+    vmin = jnp.min(V, axis=0, keepdims=True)  # [1, B]
+    arg = jnp.min(
+        jnp.where(V == vmin, row_iota, big), axis=0, keepdims=True
+    ) + ti * jnp.full((1, B), T, i32)
+    cur = vf_ref[...]
+    better = vmin < cur  # strict <: earlier tiles (lower rows) win ties
+    vf_ref[...] = jnp.where(better, vmin, cur)
+    pf_ref[...] = jnp.where(better, arg, pf_ref[...])
+
+    if allow_leader:
+        # --- leader pass (slot 0, delta = w·(replicas+consumers)) -------
+        wl = w * (ncur + ncons)
+        ok_l = (ncur >= jnp.ones((1, 1), f32)) & eligible
+        A_l = cost.overload_penalty(loads - wl, avg) - F
+        A_l = jnp.min(
+            jnp.where(lead_oh & ok_l, A_l, inf), axis=1, keepdims=True
+        )
+        C_l = cost.overload_penalty(loads + wl, avg) - F
+        V_l = jnp.where(tmask & jnp.isfinite(A_l), A_l + C_l, inf)
+        vmin_l = jnp.min(V_l, axis=0, keepdims=True)
+        arg_l = jnp.min(
+            jnp.where(V_l == vmin_l, row_iota, big), axis=0, keepdims=True
+        ) + ti * jnp.full((1, B), T, i32)
+        cur_l = vl_ref[...]
+        better_l = vmin_l < cur_l
+        vl_ref[...] = jnp.where(better_l, vmin_l, cur_l)
+        pl_ref[...] = jnp.where(better_l, arg_l, pl_ref[...])
+    else:
+        # dead outputs still written every step (same Mosaic constraint)
+        vl_ref[...] = jnp.where(better, vl_ref[...], vl_ref[...])
+        pl_ref[...] = jnp.where(better, pl_ref[...], pl_ref[...])
+
+
+def shard_score(
+    replicas,  # [P_l, R] i32
+    cols,      # [P_l, 5] f32 packed per-partition columns (pack_cols)
+    member,    # [P_l, B] bool
+    allowed,   # [P_l, B] bool
+    loads,     # [1, B] f32
+    F,         # [1, B] f32
+    bvalid,    # [1, B] bool
+    scal,      # [1, 2] f32: avg | min_replicas
+    *,
+    allow_leader: bool,
+    interpret: bool = False,
+):
+    """One fused scoring pass over this shard's local rows. Returns
+    ``(vals_f [B], p_f [B], vals_l [B], p_l [B])`` — raw ``A*+C`` minima
+    (no ``su`` offset) with LOCAL winner rows; the caller does the leader
+    merge and slot recovery (shared with the XLA engine)."""
+    P_l, R = replicas.shape
+    B = member.shape[1]
+    T = min(SHARD_TILE_P, P_l)
+    if P_l % T:
+        raise ValueError(f"shard rows {P_l} not a multiple of tile {T}")
+    grid = (P_l // T,)
+
+    # index maps cast to int32 explicitly: under global x64 the grid
+    # indices trace as 64-bit and Mosaic fails to legalize the whole
+    # kernel ("failed to legalize operation 'func.return'")
+    def tile_map(i):
+        return (jnp.int32(i), jnp.int32(0))
+
+    def const_map(i):
+        return (jnp.int32(0), jnp.int32(0))
+
+    out = pl.pallas_call(
+        partial(_kernel, allow_leader=allow_leader),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((T, R), tile_map),
+            pl.BlockSpec((T, 5), tile_map),
+            pl.BlockSpec((T, B), tile_map),
+            pl.BlockSpec((T, B), tile_map),
+            pl.BlockSpec((1, B), const_map),
+            pl.BlockSpec((1, B), const_map),
+            pl.BlockSpec((1, B), const_map),
+            pl.BlockSpec((1, 2), const_map),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, B), const_map),
+            pl.BlockSpec((1, B), const_map),
+            pl.BlockSpec((1, B), const_map),
+            pl.BlockSpec((1, B), const_map),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((1, B), jnp.float32),
+            jax.ShapeDtypeStruct((1, B), jnp.int32),
+            jax.ShapeDtypeStruct((1, B), jnp.float32),
+            jax.ShapeDtypeStruct((1, B), jnp.int32),
+        ],
+        interpret=interpret,
+    )(replicas, cols, member, allowed, loads, F, bvalid, scal)
+    vf, pf, vl, pl_ = out
+    return vf[0], pf[0], vl[0], pl_[0]
+
+
+def pack_cols(weights, nrep_cur, nrep_tgt, ncons, pvalid):
+    """Pack the session-static per-partition vectors into the kernel's
+    single gridded ``[P_l, 5]`` f32 input (all values are exact in f32:
+    weights are f32 inputs, counts are small ints)."""
+    f32 = jnp.float32
+    return jnp.stack(
+        [
+            weights.astype(f32),
+            nrep_cur.astype(f32),
+            nrep_tgt.astype(f32),
+            ncons.astype(f32),
+            pvalid.astype(f32),
+        ],
+        axis=1,
+    )

@@ -23,11 +23,17 @@ bit-identical copies; replica/membership state updates apply only on the
 owning shard.
 
 Scaling story (RESULTS.md): per-device memory and per-iteration scoring
-work drop S-fold — the 128k x 256 single-chip kernel ceiling becomes a
-per-shard ceiling (S x 128k partitions per pod slice with Pallas shard
-bodies; the XLA path scales to HBM). On one real chip this module runs
-on the virtual CPU mesh (tests + dryrun); the mesh axis rides ICI on
-real multi-chip topologies.
+work drop S-fold. With ``engine="pallas"`` each shard's scoring pass
+runs as one fused Mosaic kernel (parallel/shard_kernel.py) that STREAMS
+tiles through VMEM instead of holding session state there — unlike the
+single-chip whole-session kernel (solvers/pallas_session.py) it has no
+VMEM partition ceiling, so instances past the 128k x 256 single-chip
+cap plan through this path and sharding divides the per-device work
+S-fold on top. Move logs are bit-identical to the XLA engine at the
+same dtype (pinned by tests/test_parallel.py and dryrun_multichip). On
+one real chip this module runs on the virtual CPU mesh (tests + dryrun)
+or a trivial S=1 mesh; the mesh axis rides ICI on real multi-chip
+topologies.
 """
 
 from __future__ import annotations
@@ -51,7 +57,7 @@ from kafkabalancer_tpu.parallel.mesh import PART_AXIS  # noqa: E402
 
 @partial(
     jax.jit,
-    static_argnames=("max_moves", "allow_leader", "batch", "mesh"),
+    static_argnames=("max_moves", "allow_leader", "batch", "mesh", "engine"),
 )
 def sharded_session(
     loads,
@@ -74,6 +80,7 @@ def sharded_session(
     allow_leader: bool,
     batch: int,
     mesh: Mesh,
+    engine: str = "xla",
 ):
     """``scan.session``'s batch path with the partition axis sharded over
     ``mesh``'s ``part`` axis; same return contract ``(replicas, loads, n,
@@ -84,6 +91,11 @@ def sharded_session(
     ``min_bucket`` a multiple of it). Requires ``batch >= 1``; there is no
     batch=1 parity contract here — the sharded session is always the
     pooled batched selection (like the Pallas kernel).
+
+    ``engine="pallas"`` runs each shard's per-iteration scoring pass as
+    one fused Mosaic kernel (parallel/shard_kernel.py — float32 only;
+    ``"pallas-interpret"`` for CPU testing); move logs are bit-identical
+    to the XLA engine at the same dtype (pinned by tests).
     """
     P, R = replicas.shape
     B = loads.shape[0]
@@ -95,6 +107,11 @@ def sharded_session(
         )
     P_l = P // S
     dtype = loads.dtype
+    use_pallas = engine in ("pallas", "pallas-interpret")
+    if use_pallas and dtype != jnp.float32:
+        raise ValueError("the pallas shard engine is float32 only")
+    if engine not in ("xla", "pallas", "pallas-interpret"):
+        raise ValueError(f"unknown shard engine {engine!r}")
 
     rep = PS()
     pshard = PS(PART_AXIS)
@@ -141,6 +158,63 @@ def sharded_session(
             PART_AXIS,
         )
 
+        if use_pallas:
+            from kafkabalancer_tpu.parallel.shard_kernel import (
+                pack_cols,
+                shard_score,
+            )
+
+            # session-static kernel inputs, built once per call
+            cols_k = pack_cols(w_l, ncur_l, ntgt_l, ncons_l, pvalid_l)
+            allowed_k = allowed
+            slot_iota_r = jnp.arange(R)[None, :]
+            iota_bb = jnp.arange(B, dtype=jnp.int32)[:, None]
+
+        def _score_pallas(loads, replicas, member, bvalid, nb):
+            """Kernel-backed analog of the XLA branch's
+            ``factored_target_best`` call: same avg/F/su arithmetic, the
+            fused kernel for the [P_l, B] passes, and the shared leader
+            merge + winner-only slot recovery OUTSIDE the kernel."""
+            avg = jnp.sum(jnp.where(bvalid, loads, 0.0)) / nb
+            F = jnp.where(bvalid, cost.overload_penalty(loads, avg), 0.0)
+            su = jnp.sum(F)
+            vals_f, p_f, vals_l, p_l2 = shard_score(
+                replicas,
+                cols_k,
+                member,
+                allowed_k,
+                loads.reshape(1, B),
+                F.reshape(1, B),
+                bvalid.reshape(1, B),
+                jnp.stack([avg, min_replicas.astype(dtype)]).reshape(1, 2),
+                allow_leader=allow_leader,
+                interpret=(engine == "pallas-interpret"),
+            )
+            # follower slot recovery for the [B] winners — mirrors
+            # cost.factored_target_best's slot_of (ascending-slot ties)
+            rowsA = (
+                cost.overload_penalty(
+                    loads[None, :] - w_l[p_f][:, None], avg
+                )
+                - F[None, :]
+            )  # [B, B]
+            rp = replicas[p_f]  # [B, R]
+            slot_vals = rowsA[iota_bb, jnp.clip(rp, 0)]
+            valids = (slot_iota_r >= 1) & (
+                slot_iota_r < ncur_l[p_f][:, None]
+            )
+            slot_f = jnp.argmin(
+                jnp.where(valids, slot_vals, jnp.inf), axis=1
+            ).astype(jnp.int32)
+            if allow_leader:
+                lead_better = vals_l < vals_f
+                vals_raw = jnp.where(lead_better, vals_l, vals_f)
+                p_loc = jnp.where(lead_better, p_l2, p_f).astype(jnp.int32)
+                slot = jnp.where(lead_better, 0, slot_f)
+            else:
+                vals_raw, p_loc, slot = vals_f, p_f.astype(jnp.int32), slot_f
+            return su, su + vals_raw, p_loc, slot
+
         def _applied_delta(p, slot):
             # full-vector lookups: p is a GLOBAL partition index
             return jnp.where(
@@ -161,11 +235,16 @@ def sharded_session(
             # local per-target winners over this shard's partition rows;
             # loads/bvalid are replicated so su/avg arithmetic is
             # bit-identical on every shard
-            su, vals, p_loc, slot = cost.factored_target_best(
-                loads, replicas, allowed, member, bvalid, w_l, ncur_l,
-                ntgt_l, ncons_l, pvalid_l, nb, min_replicas,
-                allow_leader=allow_leader,
-            )
+            if use_pallas:
+                su, vals, p_loc, slot = _score_pallas(
+                    loads, replicas, member, bvalid, nb
+                )
+            else:
+                su, vals, p_loc, slot = cost.factored_target_best(
+                    loads, replicas, allowed, member, bvalid, w_l, ncur_l,
+                    ntgt_l, ncons_l, pvalid_l, nb, min_replicas,
+                    allow_leader=allow_leader,
+                )
             s_loc = replicas[jnp.clip(p_loc, 0), jnp.clip(slot, 0)].astype(
                 jnp.int32
             )
@@ -280,6 +359,7 @@ def plan_sharded(
     batch: int = 16,
     chunk_moves: "int | None" = None,
     churn_gate: "float | None" = None,
+    engine: str = "xla",
 ):
     """Mesh-sharded analog of ``solvers.scan.plan`` (move sessions only —
     repairs settle host-side first, chunks re-enter like plan; no polish
@@ -287,7 +367,10 @@ def plan_sharded(
     lives in ``solvers/leader.py`` and has no sharded variant).
     Output/mutation contract matches ``plan``, including the
     ``churn_gate`` knob and the auto/clamped ``chunk_moves`` heuristic
-    (both shared with it, not copied)."""
+    (both shared with it, not copied). ``engine="pallas"`` selects the
+    fused per-shard scoring kernel (float32, parallel/shard_kernel.py);
+    plans are bit-identical to the XLA engine at the same dtype."""
+    from kafkabalancer_tpu.balancer.steps import BalanceError
     from kafkabalancer_tpu.models.partition import empty_partition_list
     from kafkabalancer_tpu.ops import tensorize
     from kafkabalancer_tpu.ops.runtime import next_bucket
@@ -311,7 +394,9 @@ def plan_sharded(
         return opl
     repaired, budget = _settle_head(pl, cfg, max_reassign)
     opl.append(*repaired)
-    if dtype is None:
+    if engine in ("pallas", "pallas-interpret"):
+        dtype = jnp.float32  # the Mosaic kernel is 32-bit by construction
+    elif dtype is None:
         dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
     if chunk_moves is None:
         chunk_moves = auto_chunk_moves(len(pl.partitions or []))
@@ -328,27 +413,42 @@ def plan_sharded(
         dp = tensorize(pl, cfg, min_bucket=min_bucket)
         loads, w_dev, nc_dev, allowed_dev, _ew = _prep_from_dp(dp, dtype)[1]
         chunk = min(remaining, chunk_moves)
-        _replicas, _loads, n, mp, mslot, _msrc, mtgt, _su = sharded_session(
-            loads,
-            jnp.asarray(dp.replicas),
-            jnp.asarray(dp.member),
-            allowed_dev,
-            w_dev,
-            jnp.asarray(dp.nrep_cur),
-            jnp.asarray(dp.nrep_tgt),
-            nc_dev,
-            jnp.asarray(dp.pvalid),
-            jnp.asarray(_cfg_broker_mask(dp, cfg)),
-            jnp.asarray(dp.bvalid),
-            jnp.int32(cfg.min_replicas_for_rebalancing),
-            jnp.asarray(cfg.min_unbalance, dtype),
-            jnp.int32(chunk),
-            jnp.asarray(churn_gate, dtype),
-            max_moves=next_bucket(chunk, 128),
-            allow_leader=cfg.allow_leader_rebalancing,
-            batch=max(1, batch),
-            mesh=mesh,
-        )
+        try:
+            (_replicas, _loads, n, mp, mslot, _msrc, mtgt, _su) = (
+                sharded_session(
+                    loads,
+                    jnp.asarray(dp.replicas),
+                    jnp.asarray(dp.member),
+                    allowed_dev,
+                    w_dev,
+                    jnp.asarray(dp.nrep_cur),
+                    jnp.asarray(dp.nrep_tgt),
+                    nc_dev,
+                    jnp.asarray(dp.pvalid),
+                    jnp.asarray(_cfg_broker_mask(dp, cfg)),
+                    jnp.asarray(dp.bvalid),
+                    jnp.int32(cfg.min_replicas_for_rebalancing),
+                    jnp.asarray(cfg.min_unbalance, dtype),
+                    jnp.int32(chunk),
+                    jnp.asarray(churn_gate, dtype),
+                    max_moves=next_bucket(chunk, 128),
+                    allow_leader=cfg.allow_leader_rebalancing,
+                    batch=max(1, batch),
+                    mesh=mesh,
+                    engine=engine,
+                )
+            )
+        except BalanceError:
+            raise
+        except Exception as exc:
+            if engine in ("pallas", "pallas-interpret"):
+                # compiled Mosaic kernels need a TPU backend; surface a
+                # planning failure (CLI exit 3) instead of a raw traceback
+                raise BalanceError(
+                    f"pallas shard engine failed ({exc!r}); use "
+                    f"engine='xla' or 'pallas-interpret'"
+                ) from exc
+            raise
         packed = np.asarray(_pack_log(mp, mslot, mtgt, n))
         n = _decode_packed(packed, dp, opl, drop_superseded=True)
         remaining -= n
