@@ -207,9 +207,11 @@ def run(i, o, e, args: List[str]) -> int:
             "Fused mode: shard the converge session over all attached "
             "devices (partition-sharded scoring, cross-shard winner "
             "combine; bit-identical plans to the single-device batched "
-            "session). Requires -fused; incompatible with "
-            "-rebalance-leader; on one device it degenerates to the "
-            "plain session",
+            "session). Requires -fused; composes with -fused-polish "
+            "(single-device polish tail) and -rebalance-leader (the "
+            "fused leader session is single-device by design and runs "
+            "as such); on one device it degenerates to the plain "
+            "session",
         )
         f_jaxprof = f.string(
             "jax-profile",
@@ -347,24 +349,20 @@ def run(i, o, e, args: List[str]) -> int:
                 log(f"unknown fused engine {f_engine.value!r}")
                 usage()
                 return 3
-            if f_shard.value and f_rebalance_leader.value:
-                log(
-                    "-fused-shard does not support -rebalance-leader (the "
-                    "fused leader session is single-device)"
-                )
-                usage()
-                return 3
             try:
                 if f_shard.value:
                     # mesh-sharded converge session over every attached
-                    # device (parallel/shard_session.py); polish phases
-                    # are single-device concerns, but the pallas engines
-                    # select the fused per-shard scoring kernel
-                    # (parallel/shard_kernel.py)
-                    if f_polish.value:
+                    # device (parallel/shard_session.py); the pallas
+                    # engines select the fused per-shard scoring kernel
+                    # (parallel/shard_kernel.py); -fused-polish runs the
+                    # single-device polish tail on the sharded session's
+                    # move-floor state; -rebalance-leader delegates to
+                    # the (single-device by design) fused leader session
+                    if f_rebalance_leader.value:
                         log(
-                            "-fused-polish does not apply to the sharded "
-                            "session; ignoring it"
+                            "-fused-shard with -rebalance-leader runs the "
+                            "fused leader session single-device (its "
+                            "Balance loop is sequential by contract)"
                         )
                     import jax
 
@@ -380,6 +378,7 @@ def run(i, o, e, args: List[str]) -> int:
                         pl, cfg, r, mesh,
                         batch=max(1, f_batch.value),
                         engine=f_engine.value,
+                        polish=f_polish.value,
                     )
                 else:
                     from kafkabalancer_tpu.solvers.scan import plan

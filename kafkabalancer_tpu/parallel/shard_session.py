@@ -231,7 +231,7 @@ def sharded_session(
             loads, replicas, member, bcount, n, done, mp, mslot, msrc, mtgt = state
 
             bvalid = (always_valid | (bcount > 0)) & universe_valid
-            nb = jnp.sum(bvalid).astype(dtype)
+            nb = jnp.sum(bvalid, dtype=jnp.int32).astype(dtype)
             # local per-target winners over this shard's partition rows;
             # loads/bvalid are replicated so su/avg arithmetic is
             # bit-identical on every shard
@@ -336,7 +336,7 @@ def sharded_session(
         (loads, replicas, member, bcount, n, _done,
          mp, mslot, msrc, mtgt) = lax.while_loop(cond, body, state)
         bvalid = (always_valid | (bcount > 0)) & universe_valid
-        final_su = cost.unbalance(loads, bvalid, jnp.sum(bvalid).astype(dtype))
+        final_su = cost.unbalance(loads, bvalid, jnp.sum(bvalid, dtype=jnp.int32).astype(dtype))
         return (
             replicas, loads, n,
             mp[:max_moves], mslot[:max_moves], msrc[:max_moves],
@@ -350,6 +350,29 @@ def sharded_session(
     )
 
 
+# positions of the partition-sharded session inputs (replicas, member,
+# allowed) in the sharded_session argument tuple; everything else
+# replicates
+_PSHARD_ARGS = (1, 2, 3)
+
+
+def _globalize(args, mesh: Mesh):
+    """Promote host-resident session inputs to global arrays for a mesh
+    spanning multiple processes. Every process passes identical host
+    values (tensorize of the same partition list), so ``device_put``
+    with the target ``NamedSharding`` materializes each process's
+    addressable shards of one coherent global array — the partition-axis
+    state shards over ``part``, everything else fully replicates."""
+    from jax.sharding import NamedSharding
+
+    pshard = NamedSharding(mesh, PS(PART_AXIS))
+    rep = NamedSharding(mesh, PS())
+    return tuple(
+        jax.device_put(a, pshard if i in _PSHARD_ARGS else rep)
+        for i, a in enumerate(args)
+    )
+
+
 def plan_sharded(
     pl,
     cfg,
@@ -360,16 +383,36 @@ def plan_sharded(
     chunk_moves: "int | None" = None,
     churn_gate: "float | None" = None,
     engine: str = "xla",
+    polish: bool = False,
 ):
-    """Mesh-sharded analog of ``solvers.scan.plan`` (move sessions only —
-    repairs settle host-side first, chunks re-enter like plan; no polish
-    phases, and ``rebalance_leaders`` is rejected: the leadership session
-    lives in ``solvers/leader.py`` and has no sharded variant).
+    """Mesh-sharded analog of ``solvers.scan.plan`` — repairs settle
+    host-side first, sharded move-session chunks re-enter like ``plan``.
     Output/mutation contract matches ``plan``, including the
     ``churn_gate`` knob and the auto/clamped ``chunk_moves`` heuristic
     (both shared with it, not copied). ``engine="pallas"`` selects the
     fused per-shard scoring kernel (float32, parallel/shard_kernel.py);
-    plans are bit-identical to the XLA engine at the same dtype."""
+    plans are bit-identical to the XLA engine at the same dtype.
+
+    ``polish=True`` closes the quality gap to the single-chip path: once
+    the sharded move sessions converge (the single-move neighborhood is
+    exhausted), the remaining budget runs the fused swap/leader-shuffle
+    alternation (solvers/polish.py ``converge_session``) on ONE device.
+    The gathered state is cheap by construction — the sharded phase
+    already drove the instance to the move floor, so the polish pass is
+    a handful of near-converged iterations on HBM-resident state (no
+    VMEM ceiling: the polish pass always uses the XLA engine, whatever
+    ``engine`` the sharded phase ran), and the expensive O(P·B)
+    per-iteration move scoring that sharding exists to divide stays
+    sharded. The sharded flagship therefore lands at the same ~1e-11
+    floor as ``plan(polish=True)`` (pinned by tests/test_parallel.py).
+
+    ``rebalance_leaders`` delegates to ``plan``'s fused leader session:
+    its Balance loop (leadership redistribution interleaved with greedy
+    moves, solvers/leader.py) replays the reference's step precedence
+    sequentially and is single-device by design — [P, B] state is
+    HBM-resident with no VMEM ceiling, so delegation changes speed at
+    extreme scale, never capability or results (pinned identical to
+    ``plan`` by tests)."""
     from kafkabalancer_tpu.balancer.steps import BalanceError
     from kafkabalancer_tpu.models.partition import empty_partition_list
     from kafkabalancer_tpu.ops import tensorize
@@ -377,6 +420,7 @@ def plan_sharded(
     from kafkabalancer_tpu.solvers.scan import (
         _cfg_broker_mask,
         _decode_packed,
+        _dispatch_chunk,
         _pack_log,
         _prep_from_dp,
         _settle_head,
@@ -385,9 +429,11 @@ def plan_sharded(
     )
 
     if cfg.rebalance_leaders:
-        raise ValueError(
-            "plan_sharded does not support rebalance_leaders; use "
-            "solvers.scan.plan (the fused leader session is single-device)"
+        from kafkabalancer_tpu.solvers.scan import plan
+
+        return plan(
+            pl, cfg, max_reassign, dtype=dtype, batch=batch,
+            chunk_moves=chunk_moves,
         )
     opl = empty_partition_list()
     if max_reassign <= 0:
@@ -407,30 +453,65 @@ def plan_sharded(
     # buckets are min_bucket·2^k: a min_bucket that is a multiple of the
     # axis size keeps every bucket divisible by it
     min_bucket = 8 * S
+    # a mesh spanning multiple processes (jax.distributed) needs inputs
+    # promoted to GLOBAL arrays with explicit shardings — every process
+    # runs this same deterministic host code on identical inputs, so
+    # device_put of the shared host values is the standard
+    # multi-controller replication pattern; single-process meshes keep
+    # the committed-device fast path
+    multiproc = len({d.process_index for d in mesh.devices.flat}) > 1
 
     remaining = budget
     while remaining > 0:
         dp = tensorize(pl, cfg, min_bucket=min_bucket)
-        loads, w_dev, nc_dev, allowed_dev, _ew = _prep_from_dp(dp, dtype)[1]
+        all_allowed, (loads, w_dev, nc_dev, allowed_dev, _ew) = (
+            _prep_from_dp(dp, dtype)
+        )
         chunk = min(remaining, chunk_moves)
+        if multiproc:
+            # build from the HOST arrays (the [P, B]/[P, R] state must
+            # not round-trip through the default device before the
+            # global device_put; only the small device-prep outputs —
+            # loads [B], weights/ncons [P] — pull back)
+            allowed_host = (
+                np.broadcast_to(dp.bvalid[None, :], dp.member.shape)
+                if all_allowed
+                else dp.allowed
+            )
+            args = _globalize(
+                (
+                    np.asarray(loads), dp.replicas, dp.member,
+                    allowed_host, np.asarray(w_dev), dp.nrep_cur,
+                    dp.nrep_tgt, np.asarray(nc_dev), dp.pvalid,
+                    _cfg_broker_mask(dp, cfg), dp.bvalid,
+                    np.int32(cfg.min_replicas_for_rebalancing),
+                    np.asarray(cfg.min_unbalance, dtype),
+                    np.int32(chunk), np.asarray(churn_gate, dtype),
+                ),
+                mesh,
+            )
+        else:
+            args = (
+                loads,
+                jnp.asarray(dp.replicas),
+                jnp.asarray(dp.member),
+                allowed_dev,
+                w_dev,
+                jnp.asarray(dp.nrep_cur),
+                jnp.asarray(dp.nrep_tgt),
+                nc_dev,
+                jnp.asarray(dp.pvalid),
+                jnp.asarray(_cfg_broker_mask(dp, cfg)),
+                jnp.asarray(dp.bvalid),
+                jnp.int32(cfg.min_replicas_for_rebalancing),
+                jnp.asarray(cfg.min_unbalance, dtype),
+                jnp.int32(chunk),
+                jnp.asarray(churn_gate, dtype),
+            )
         try:
             (_replicas, _loads, n, mp, mslot, _msrc, mtgt, _su) = (
                 sharded_session(
-                    loads,
-                    jnp.asarray(dp.replicas),
-                    jnp.asarray(dp.member),
-                    allowed_dev,
-                    w_dev,
-                    jnp.asarray(dp.nrep_cur),
-                    jnp.asarray(dp.nrep_tgt),
-                    nc_dev,
-                    jnp.asarray(dp.pvalid),
-                    jnp.asarray(_cfg_broker_mask(dp, cfg)),
-                    jnp.asarray(dp.bvalid),
-                    jnp.int32(cfg.min_replicas_for_rebalancing),
-                    jnp.asarray(cfg.min_unbalance, dtype),
-                    jnp.int32(chunk),
-                    jnp.asarray(churn_gate, dtype),
+                    *args,
                     max_moves=next_bucket(chunk, 128),
                     allow_leader=cfg.allow_leader_rebalancing,
                     batch=max(1, batch),
@@ -449,7 +530,42 @@ def plan_sharded(
                     f"engine='xla' or 'pallas-interpret'"
                 ) from exc
             raise
-        packed = np.asarray(_pack_log(mp, mslot, mtgt, n))
+        if multiproc:
+            # the replicated log outputs are fully addressable on every
+            # process; pack host-side (_pack_log is a single-device jit)
+            packed = np.concatenate(
+                [
+                    np.asarray(mp), np.asarray(mslot), np.asarray(mtgt),
+                    np.asarray(n, dtype=np.int32).reshape(1),
+                ]
+            )
+        else:
+            packed = np.asarray(_pack_log(mp, mslot, mtgt, n))
+        n = _decode_packed(packed, dp, opl, drop_superseded=True)
+        remaining -= n
+        if n < chunk:
+            break
+
+    # polish tail: swap + leadership-shuffle alternation on the move-floor
+    # state, single-device (see docstring). Chunks re-enter like plan's
+    # polish path; the embedded move phase re-opens only the handful of
+    # single moves each swap phase exposes.
+    while polish and remaining > 0:
+        from kafkabalancer_tpu.solvers.polish import entry_table
+        from kafkabalancer_tpu.solvers.scan import all_allowed_of
+
+        dp = tensorize(pl, cfg)
+        all_allowed = all_allowed_of(dp)
+        ew_np, ep_, er_, evalid = entry_table(
+            dp, cfg.min_replicas_for_rebalancing
+        )
+        chunk = min(remaining, chunk_moves)
+        packed = _dispatch_chunk(
+            dp, cfg, chunk, dtype, batch, "xla",
+            polish=True, leader=False, all_allowed=all_allowed,
+            churn_gate=churn_gate,
+            ew=ew_np, ep=ep_, er=er_, evalid=evalid,
+        )
         n = _decode_packed(packed, dp, opl, drop_superseded=True)
         remaining -= n
         if n < chunk:

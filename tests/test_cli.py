@@ -561,8 +561,8 @@ def test_fused_rebalance_leader():
 def test_fused_shard():
     """-fused -fused-shard runs the mesh-sharded converge session over
     the conftest 8-device virtual mesh; plans are bit-identical to the
-    single-device batched session (shard_session's exactness contract),
-    and -rebalance-leader is rejected up front."""
+    single-device batched session (shard_session's exactness contract);
+    -fused-polish and -rebalance-leader both compose with it."""
     base = [
         "-input-json", "-input", FIXTURE, "-fused", "-fused-batch=8",
         "-max-reassign=8", "-unique",
@@ -573,12 +573,24 @@ def test_fused_shard():
     assert rv_1 == 0, err_1
     assert json.loads(out_s) == json.loads(out_1)
 
-    rv, _out, err = run_cli(
-        ["-input-json", "-input", FIXTURE, "-fused", "-fused-shard",
-         "-rebalance-leader"]
-    )
-    assert rv == 3
-    assert "does not support -rebalance-leader" in err
+    # -fused-polish composes: the sharded session runs first, the polish
+    # tail on one device after
+    rv_p, out_p, err_p = run_cli(base + ["-fused-shard", "-fused-polish"])
+    assert rv_p == 0, err_p
+    assert json.loads(out_p)["version"] == 1
+
+    # -rebalance-leader delegates to the fused leader session and must
+    # match the non-sharded run exactly
+    lead = [
+        "-input-json", "-input", FIXTURE, "-fused", "-rebalance-leader",
+        "-max-reassign=4", "-unique",
+    ]
+    rv_l, out_l, err_l = run_cli(lead + ["-fused-shard"])
+    assert rv_l == 0, err_l
+    assert "single-device" in err_l
+    rv_l1, out_l1, err_l1 = run_cli(lead)
+    assert rv_l1 == 0, err_l1
+    assert json.loads(out_l) == json.loads(out_l1)
 
     # -fused-shard without -fused is a config error (exit 3), not a
     # silently ignored flag

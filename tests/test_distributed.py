@@ -58,6 +58,25 @@ def test_two_process_distributed_scoring():
     ]
     assert len(best) == 2 and best[0] == best[1]
 
+    # the whole sharded converge session ran over a part axis spanning
+    # both processes (every per-iteration all_gather combine crossed the
+    # process boundary) and its move log matched the single-device
+    # batched session bit-for-bit; the polish tail then improved on the
+    # move floor
+    for i, out in enumerate(outs):
+        assert f"SESSION_OK proc={i}" in out, out
+        assert f"POLISH_OK proc={i}" in out, out
+    sess = [
+        line.split(" ", 1)[1]
+        for out in outs
+        for line in out.splitlines()
+        if "SESSION_OK" in line or "POLISH_OK" in line
+    ]
+    # identical markers modulo the proc id (already split off above is the
+    # full remainder including proc=; compare with proc stripped)
+    norm = [s.replace("proc=0", "proc=x").replace("proc=1", "proc=x") for s in sess]
+    assert norm[: len(norm) // 2] == norm[len(norm) // 2 :]
+
     # the what-if sweep ran sharded over the cross-process mesh, with
     # replicated results identical on both processes AND identical to a
     # single-process run of the same scenarios (this test process runs on
