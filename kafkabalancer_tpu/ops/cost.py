@@ -36,7 +36,7 @@ def broker_loads(replicas, weights, nrep_cur, ncons, num_brokers: int):
     ``nrep_cur``: [P] replica counts; ``ncons``: [P] num_consumers.
     """
     P, R = replicas.shape
-    slot = jnp.arange(R)[None, :]
+    slot = jnp.arange(R, dtype=jnp.int32)[None, :]
     valid = slot < nrep_cur[:, None]
     # leader premium: slot 0 carries weight*(len+num_consumers), others weight
     w = jnp.where(
@@ -272,7 +272,7 @@ def factored_target_best(
     V = jnp.where(
         tmask & jnp.isfinite(A_star)[:, None], A_star[:, None] + C_f, jnp.inf
     )
-    p = jnp.argmin(V, axis=0).astype(jnp.int32)  # [B]
+    p = lax.argmin(V, 0, jnp.int32)  # [B]
     vals = jnp.min(V, axis=0)
 
     def slot_of(p_win):
@@ -288,12 +288,12 @@ def factored_target_best(
         rows = A_f[p_win]  # [nwin, B]
         rp = replicas[p_win]  # [nwin, R]
         slot_vals = rows[
-            jnp.arange(nwin)[:, None], jnp.clip(rp, 0)
+            jnp.arange(nwin, dtype=jnp.int32)[:, None], jnp.clip(rp, 0)
         ]  # [nwin, R]
-        slot_iota = jnp.arange(R)[None, :]
+        slot_iota = jnp.arange(R, dtype=jnp.int32)[None, :]
         valid = (slot_iota >= 1) & (slot_iota < nrep_cur[p_win][:, None])
         slot_vals = jnp.where(valid, slot_vals, jnp.inf)
-        return jnp.argmin(slot_vals, axis=1).astype(jnp.int32)
+        return lax.argmin(slot_vals, 1, jnp.int32)
 
     slot = slot_of(p)
 
@@ -313,7 +313,7 @@ def factored_target_best(
         V_l = jnp.where(
             tmask & jnp.isfinite(A_l)[:, None], A_l[:, None] + C_l, jnp.inf
         )
-        p_l = jnp.argmin(V_l, axis=0).astype(jnp.int32)
+        p_l = lax.argmin(V_l, 0, jnp.int32)
         vals_l = jnp.min(V_l, axis=0)
         lead_better = vals_l < vals
         vals = jnp.where(lead_better, vals_l, vals)

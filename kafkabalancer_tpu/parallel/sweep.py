@@ -184,7 +184,7 @@ def _scenario_body(
         observed = jnp.any(member_f & pvalid[:, None], axis=0)
         bvalid = (always_valid | observed) & universe_valid
         su = cost.unbalance(
-            loads_f, bvalid, jnp.sum(bvalid).astype(loads_f.dtype)
+            loads_f, bvalid, jnp.sum(bvalid, dtype=jnp.int32).astype(loads_f.dtype)
         )
     else:
         replicas, _loads, n_moves, _mp, _mslot, _msrc, _mtgt, su = session(

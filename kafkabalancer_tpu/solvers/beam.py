@@ -79,7 +79,7 @@ def _scan_factory(
         via the per-broker replica counts (no [P, B] reduction) and the
         colocation total as the tracked scalar (no [T, B] reduction)."""
         bvalid = (always_valid | (bcount > 0)) & universe_valid
-        u = cost.unbalance(loads, bvalid, jnp.sum(bvalid).astype(dtype))
+        u = cost.unbalance(loads, bvalid, jnp.sum(bvalid, dtype=jnp.int32).astype(dtype))
         if n_topics:
             u = u + colo
         return u
@@ -94,7 +94,7 @@ def _scan_factory(
         the beam's accumulated colocation cost, so cross-beam frontier
         ranking is unbiased."""
         bvalid = (always_valid | (bcount > 0)) & universe_valid
-        nb = jnp.sum(bvalid).astype(dtype)
+        nb = jnp.sum(bvalid, dtype=jnp.int32).astype(dtype)
 
         if n_topics:
             # counts ride as INCREMENTAL beam state (updated per applied

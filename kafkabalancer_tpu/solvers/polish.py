@@ -179,8 +179,9 @@ def _swap_loop(
 
         La = loads[src_b]
         Lb = loads[tgt_b]
-        ca = jnp.where(La > avg, 1.0, 0.5).astype(dtype)
-        cb = jnp.where(Lb > avg, 1.0, 0.5).astype(dtype)
+        one, half = jnp.asarray(1.0, dtype), jnp.asarray(0.5, dtype)
+        ca = jnp.where(La > avg, one, half)
+        cb = jnp.where(Lb > avg, one, half)
         dstar = (ca * (La - avg) - cb * (Lb - avg)) / (ca + cb)  # [nh]
 
         # entry -> its holder's pair (via a trash slot at broker index B)
@@ -353,7 +354,7 @@ def _leader_shuffle_loop(
 
     P, R = replicas.shape
     dtype = loads.dtype
-    slot_iota = jnp.arange(R)[None, :]
+    slot_iota = jnp.arange(R, dtype=jnp.int32)[None, :]
 
     def cond(st):
         n, done = st[3], st[4]
@@ -394,9 +395,9 @@ def _leader_shuffle_loop(
         )
         delta = jnp.where(valid, delta, jnp.inf)
         flat = delta.reshape(-1)
-        i = jnp.argmin(flat)
+        i = lax.argmin(flat, 0, jnp.int32)
         accept = flat[i] < -eps
-        p, r = jnp.divmod(i, R)
+        p, r = jnp.divmod(i, jnp.int32(R))
         l_b = lead[p]
         f_b = replicas[p, r]
 

@@ -151,11 +151,12 @@ def session(
     move_src = jnp.full(max_moves + 1, -1, jnp.int32)
     move_tgt = jnp.full(max_moves + 1, -1, jnp.int32)
 
-    slot_iota = jnp.arange(R)[None, :]
+    slot_iota = jnp.arange(R, dtype=jnp.int32)[None, :]
     # per-broker replica counts: observed-broker tracking in O(1) per move
     # instead of an O(P*B) reduction per iteration
     bcount0 = jnp.sum(
-        (member & pvalid[:, None]).astype(jnp.int32), axis=0
+        (member & pvalid[:, None]).astype(jnp.int32), axis=0,
+        dtype=jnp.int32,
     )
 
     def cond(state):
@@ -174,7 +175,7 @@ def session(
     def _scored(loads, replicas, member, bcount):
         # (load, ID) target ordering for reference-style tie-breaks
         bvalid = (always_valid | (bcount > 0)) & universe_valid
-        nb = jnp.sum(bvalid).astype(dtype)
+        nb = jnp.sum(bvalid, dtype=jnp.int32).astype(dtype)
         _, perm, rank_of = cost.rank_brokers(loads, bvalid)
         u, su = cost.move_candidate_scores(
             loads, replicas, allowed[:, perm], member[:, perm], bvalid,
@@ -198,7 +199,7 @@ def session(
         # moves scored with their TRUE applied delta (the reference's
         # plain-weight under-modelling oscillates under batched commits).
         bvalid = (always_valid | (bcount > 0)) & universe_valid
-        nb = jnp.sum(bvalid).astype(dtype)
+        nb = jnp.sum(bvalid, dtype=jnp.int32).astype(dtype)
         su, vals, p, slot = cost.factored_target_best(
             loads, replicas, allowed, member, bvalid, weights, nrep_cur,
             nrep_tgt, ncons, pvalid, nb, min_replicas,
@@ -322,7 +323,7 @@ def session(
         lax.while_loop(cond, body_batch if batch > 1 else body, state)
     )
     bvalid = (always_valid | (bcount > 0)) & universe_valid
-    final_su = cost.unbalance(loads, bvalid, jnp.sum(bvalid).astype(dtype))
+    final_su = cost.unbalance(loads, bvalid, jnp.sum(bvalid, dtype=jnp.int32).astype(dtype))
     # drop the batched path's trash slot
     return (
         replicas, loads, n,
@@ -380,7 +381,7 @@ def member_from(replicas, nrep_cur, pvalid, B: int):
     """Recompute the ``[P, B]`` membership mask from the replica matrix
     on device (skips transferring the largest boolean session input)."""
     R = replicas.shape[1]
-    slot = jnp.arange(R)[None, :]
+    slot = jnp.arange(R, dtype=jnp.int32)[None, :]
     valid = (slot < nrep_cur[:, None]) & pvalid[:, None]
     onehot = replicas[:, :, None] == jnp.arange(B, dtype=replicas.dtype)
     return jnp.any(onehot & valid[:, :, None], axis=1)
@@ -543,6 +544,14 @@ def _dispatch_chunk(
     )
 
 
+def all_allowed_of(dp) -> bool:
+    """True when the [P, B] allowed matrix is just the broker-validity
+    row broadcast (the default FillDefaults outcome) — the detection the
+    all-allowed session/kernel modes key on. ONE definition: plan,
+    _leader_plan, _prep_from_dp and parallel.shard_session all share it."""
+    return bool(dp.allowed[:, : dp.nb].all(axis=1)[: dp.np_].all())
+
+
 def _prep_from_dp(dp, dtype, all_allowed=None, ew=None):
     """:func:`_device_prep` from a DensePlan — the one call site shared by
     ``plan``, ``_leader_plan`` and ``parallel.shard_session.plan_sharded``.
@@ -553,9 +562,7 @@ def _prep_from_dp(dp, dtype, all_allowed=None, ew=None):
     outcome). Returns ``(all_allowed, (loads, weights, ncons,
     allowed_dev, ew_dev))``."""
     if all_allowed is None:
-        all_allowed = bool(
-            dp.allowed[:, : dp.nb].all(axis=1)[: dp.np_].all()
-        )
+        all_allowed = all_allowed_of(dp)
     return all_allowed, _device_prep(
         jnp.asarray(dp.replicas),
         jnp.asarray(dp.weights),
@@ -734,7 +741,7 @@ def _leader_plan(
     remaining = budget
     while remaining > 0:
         dp = tensorize(pl, cfg)
-        all_allowed = bool(dp.allowed[:, : dp.nb].all(axis=1)[: dp.np_].all())
+        all_allowed = all_allowed_of(dp)
         chunk = min(remaining, chunk_moves)
         packed = _dispatch_chunk(
             dp, cfg, chunk, dtype, batch, "xla",
@@ -821,7 +828,7 @@ def plan(
         # the default FillDefaults outcome allows every broker everywhere
         # (detected by value, before the capacity gate — the all-allowed
         # kernel mode stores no [P, B] matrix and has a far higher ceiling)
-        all_allowed = bool(dp.allowed[:, : dp.nb].all(axis=1)[: dp.np_].all())
+        all_allowed = all_allowed_of(dp)
         if engine == "pallas" and (
             dp.replicas.shape[0] * max(dp.bvalid.shape[0], 128)
             > (PALLAS_VMEM_CELLS if all_allowed else PALLAS_VMEM_CELLS_RESTRICTED)
