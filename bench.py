@@ -157,6 +157,9 @@ def cold_child() -> None:
         rts.append(time.perf_counter() - t0)
     rts.sort()
 
+    from kafkabalancer_tpu.ops import aot
+
+    session_stats = aot.stats.get("session_packed", {})
     print(
         json.dumps(
             {
@@ -168,52 +171,162 @@ def cold_child() -> None:
                 "cold_engine": engine,
                 "n_moves": len(opl),
                 "n_moves_warm": len(opl2),
+                # attribution of cold_plan_s (ops/aot.py stats): blob MB
+                # deserialized, its load time, and the first on-device
+                # execution (which pays the relay's program upload; the
+                # same dispatch warm is cold_warm_plan_s's session share)
+                "aot_blob_mb": round(session_stats.get("blob_mb", 0.0), 2),
+                "aot_load_s": round(session_stats.get("load_s", 0.0), 3),
+                "aot_exec1_s": round(session_stats.get("exec1_s", 0.0), 3),
             }
         )
     )
 
 
+def cold_single_child() -> None:
+    """Fresh-process ``-solver=tpu -max-reassign=1`` on the flagship-scale
+    instance — the reference's LITERAL deployment unit (one stateless CLI
+    invocation per move, its README.md:21-33). Times the full CLI ``run``
+    (parse -> pipeline -> single device-scored move -> emit) and prints
+    one JSON line; instance synthesis is excluded (a real deployment
+    reads cluster state, it doesn't synthesize it — but parse is
+    included)."""
+    import io
+
+    t_start = time.perf_counter()
+    fast = os.environ.get("BENCH_FAST") == "1"
+    n_parts, n_brokers, _batch, _engine = _flagship_inputs(fast)
+
+    import jax
+
+    _enable_persistent_cache(jax)
+
+    from kafkabalancer_tpu import cli
+    from kafkabalancer_tpu.codecs.writer import write_partition_list
+
+    pl, _cfg = _flagship_case(n_parts, n_brokers)
+    buf = io.StringIO()
+    write_partition_list(buf, pl)
+    src = buf.getvalue()
+    t_setup = time.perf_counter() - t_start
+
+    out, err = io.StringIO(), io.StringIO()
+    t0 = time.perf_counter()
+    rc = cli.run(
+        io.StringIO(src), out, err,
+        ["kafkabalancer", "-input-json", "-solver=tpu", "-max-reassign=1"],
+    )
+    t_run = time.perf_counter() - t0
+
+    from kafkabalancer_tpu.ops import aot
+
+    sw = aot.stats.get("score_window", {})
+    print(
+        json.dumps(
+            {
+                "single_move_run_s": round(t_run, 3),
+                "rc": rc,
+                "setup_s": round(t_setup, 3),
+                "aot_blob_mb": round(sw.get("blob_mb", 0.0), 2),
+                "aot_load_s": round(sw.get("load_s", 0.0), 3),
+                "aot_exec1_s": round(sw.get("exec1_s", 0.0), 3),
+            }
+        )
+    )
+
+
+def _run_child(mode: str):
+    """One fresh bench child; returns (payload, wall_s) or (None, wall)."""
+    base = [sys.executable, os.path.abspath(__file__), mode]
+    t0 = time.perf_counter()
+    proc = subprocess.run(base, capture_output=True, text=True, timeout=1800)
+    wall = time.perf_counter() - t0
+    if proc.returncode != 0:
+        log(f"bench child {mode} failed: {proc.stderr[-500:]}")
+        return None, wall
+    return json.loads(proc.stdout.strip().splitlines()[-1]), wall
+
+
+N_COLD_SAMPLES = 3
+
+
 def _run_cold_children() -> dict:
     """Warm-up child (pays any pending compiles, writes the AOT store),
-    then the clean cold child. Runs BEFORE the parent touches the JAX
-    backend: on the remote-attached bench TPU a parent holding the relay
-    inflates a child's dispatches several-fold (round 3 measured 25 s for
-    a plan that costs ~5 s with the relay free)."""
+    then N clean cold children, reporting the MINIMUM — the tunnelled
+    bench TPU's relay adds multi-second contention noise run to run
+    (round 4 observed 5.2 s .. 67 s for the identical child), so the min
+    is the hardware-capability number and the samples list carries the
+    spread. Runs BEFORE the parent touches the JAX backend: a parent
+    holding the relay inflates a child's dispatches several-fold (round 3
+    measured 25 s for a plan that costs ~5 s with the relay free).
+
+    Also measures the fresh-process ``-solver=tpu -max-reassign=1`` CLI
+    invocation the same way — the reference's literal per-move deployment
+    unit."""
     cold = {}
-    base = [sys.executable, os.path.abspath(__file__), "--cold-child"]
     try:
-        t0 = time.perf_counter()
-        proc = subprocess.run(
-            base, capture_output=True, text=True, timeout=1800,
-        )
-        warm_total = time.perf_counter() - t0
-        if proc.returncode != 0:
-            log(f"cold-start warmup child failed: {proc.stderr[-500:]}")
+        warm, warm_total = _run_child("--cold-child")
+        if warm is None:
             return cold
-        warm = json.loads(proc.stdout.strip().splitlines()[-1])
         log(
             f"cold-start warmup child: plan {warm['cold_plan_s']:.3f}s, "
             f"process total {warm_total:.3f}s"
         )
 
-        t0 = time.perf_counter()
-        proc = subprocess.run(
-            base, capture_output=True, text=True, timeout=1800,
-        )
-        cold_total = time.perf_counter() - t0
-        if proc.returncode != 0:
-            log(f"cold-start child failed: {proc.stderr[-500:]}")
+        samples = []
+        for _ in range(N_COLD_SAMPLES):
+            payload, total = _run_child("--cold-child")
+            if payload is not None:
+                payload["cold_total_s"] = round(total, 3)
+                samples.append(payload)
+        if not samples:
             return cold
-        cold = json.loads(proc.stdout.strip().splitlines()[-1])
-        cold["cold_total_s"] = round(cold_total, 3)
+        cold = min(samples, key=lambda p: p["cold_plan_s"])
+        cold["cold_plan_samples"] = [p["cold_plan_s"] for p in samples]
         log(
-            f"cold start (fresh process, cache-warm, relay free): plan "
+            f"cold start (fresh process, cache-warm, relay free, min of "
+            f"{len(samples)}: {cold['cold_plan_samples']}): plan "
             f"{cold['cold_plan_s']:.3f}s, same-process re-plan "
             f"{cold['cold_warm_plan_s']:.3f}s (local-attach equivalent), "
-            f"relay round trip {cold['relay_roundtrip_s']:.3f}s, "
-            f"import {cold['cold_import_s']:.3f}s, backend "
-            f"{cold['cold_backend_s']:.3f}s, process total {cold_total:.3f}s"
+            f"aot load {cold['aot_load_s']:.2f}s "
+            f"({cold['aot_blob_mb']:.1f}MB blob), first device dispatch "
+            f"{cold['aot_exec1_s']:.2f}s, relay round trip "
+            f"{cold['relay_roundtrip_s']:.3f}s, import "
+            f"{cold['cold_import_s']:.3f}s, backend "
+            f"{cold['cold_backend_s']:.3f}s, process total "
+            f"{cold['cold_total_s']:.3f}s"
         )
+
+        # fresh-process single-move CLI: warm-up then min-of-N
+        sm_warm, sm_total = _run_child("--cold-single-child")
+        if sm_warm is not None:
+            log(
+                f"single-move warmup child: run {sm_warm['single_move_run_s']:.3f}s, "
+                f"process total {sm_total:.3f}s"
+            )
+            sm_samples = []
+            for _ in range(N_COLD_SAMPLES):
+                payload, total = _run_child("--cold-single-child")
+                if payload is not None and payload.get("rc") == 0:
+                    payload["total_s"] = round(total, 3)
+                    sm_samples.append(payload)
+            if sm_samples:
+                best = min(sm_samples, key=lambda p: p["single_move_run_s"])
+                cold["single_move_cold_s"] = best["single_move_run_s"]
+                cold["single_move_total_s"] = best["total_s"]
+                cold["single_move_samples"] = [
+                    p["single_move_run_s"] for p in sm_samples
+                ]
+                cold["single_move_aot_blob_mb"] = best["aot_blob_mb"]
+                log(
+                    f"single-move cold (fresh -solver=tpu -max-reassign=1, "
+                    f"min of {len(sm_samples)}: "
+                    f"{cold['single_move_samples']}): run "
+                    f"{best['single_move_run_s']:.3f}s (aot "
+                    f"{best['aot_load_s']:.2f}s/{best['aot_blob_mb']:.1f}MB, "
+                    f"first dispatch {best['aot_exec1_s']:.2f}s), process "
+                    f"total {best['total_s']:.3f}s"
+                )
     except Exception as exc:
         log(f"cold-start measurement unavailable: {exc!r}")
     return cold
@@ -352,8 +465,11 @@ def main() -> None:
                 ],
                 "engine": engine,
                 **{k: cold[k] for k in (
-                    "cold_plan_s", "cold_total_s", "cold_warm_plan_s",
-                    "relay_roundtrip_s",
+                    "cold_plan_s", "cold_plan_samples", "cold_total_s",
+                    "cold_warm_plan_s", "relay_roundtrip_s",
+                    "aot_blob_mb", "aot_load_s", "aot_exec1_s",
+                    "single_move_cold_s", "single_move_total_s",
+                    "single_move_samples", "single_move_aot_blob_mb",
                 ) if k in cold},
             }
         )
@@ -363,5 +479,7 @@ def main() -> None:
 if __name__ == "__main__":
     if "--cold-child" in sys.argv[1:]:
         cold_child()
+    elif "--cold-single-child" in sys.argv[1:]:
+        cold_single_child()
     else:
         main()

@@ -47,12 +47,29 @@ _SALT_MODULES = (
 
 _source_salt: Optional[str] = None
 _loaded: Dict[str, Any] = {}
+# per-name phase timings of the LAST dispatch (load/exec/jit seconds,
+# blob MB) — bench.py's cold children read these to attribute the
+# stateless per-invocation cost between relay transport and compute
+stats: Dict[str, Dict[str, float]] = {}
 
 
 def _disabled() -> bool:
     return os.environ.get("KAFKABALANCER_TPU_NO_AOT", "").lower() in (
         "1", "true", "yes", "on",
     )
+
+
+def _log_enabled() -> bool:
+    return os.environ.get("KAFKABALANCER_TPU_AOT_LOG", "").lower() in (
+        "1", "true", "yes", "on",
+    )
+
+
+def _log(msg: str) -> None:
+    if _log_enabled():
+        import sys
+
+        print(f"aot: {msg}", file=sys.stderr, flush=True)
 
 
 def source_salt() -> str:
@@ -141,11 +158,14 @@ def try_load(
     if not os.path.exists(path):
         return None
     try:
+        import time
+
         import jax
         from jax.experimental.serialize_executable import (
             deserialize_and_load,
         )
 
+        t0 = time.perf_counter()
         with open(path, "rb") as f:
             blob = f.read()
         in_tree = jax.tree_util.tree_flatten((args, {}))[1]
@@ -159,6 +179,11 @@ def try_load(
             execution_devices=jax.devices()[:1],
         )
         _loaded[key] = compiled  # repeat chunks skip re-deserialization
+        dt = time.perf_counter() - t0
+        stats.setdefault(name, {})
+        stats[name]["load_s"] = dt
+        stats[name]["blob_mb"] = len(blob) / 1e6
+        _log(f"load {name} {len(blob) / 1e6:.1f}MB {dt:.2f}s")
         return compiled
     except Exception:
         try:
@@ -219,18 +244,31 @@ def call_or_compile(
     the jit path plus a best-effort store write. Shared by every AOT call
     site so fixes to the flow (pruning, memoization, fallback) live in
     one place."""
+    import time
+
     compiled = try_load(name, args, statics, out_leaves=out_leaves)
     if compiled is not None:
         try:
             import jax
 
+            t0 = time.perf_counter()
             out = compiled(*args)
             # materialize INSIDE the fallback scope: a stale/raced entry
             # can fail asynchronously, surfacing only at transfer time
             jax.block_until_ready(out)
+            dt = time.perf_counter() - t0
+            st = stats.setdefault(name, {})
+            st.setdefault("exec1_s", dt)
+            st["exec_s"] = dt
+            _log(f"exec {name} {dt:.2f}s")
             return out
         except Exception:
             pass  # raced/stale entry — fall back to the jit path
+    t0 = time.perf_counter()
     out = fn(*args, **statics)
-    maybe_save(name, fn, args, statics)
+    stats.setdefault(name, {})["jit_s"] = time.perf_counter() - t0
+    _log(f"jit-path {name} {stats[name]['jit_s']:.2f}s")
+    t0 = time.perf_counter()
+    if maybe_save(name, fn, args, statics) is not None:
+        _log(f"save {name} {time.perf_counter() - t0:.2f}s")
     return out

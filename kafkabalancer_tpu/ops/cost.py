@@ -136,7 +136,7 @@ def move_candidate_scores(
     )  # [P, R, B]
 
     R = replicas.shape[1]
-    slot = jnp.arange(R)[None, :]
+    slot = jnp.arange(R, dtype=jnp.int32)[None, :]
     srcmask = (
         (slot < nrep_cur[:, None])
         & pvalid[:, None]

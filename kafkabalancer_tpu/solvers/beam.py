@@ -198,7 +198,8 @@ def _scan_factory(
             else jnp.asarray(0.0, dtype)
         )
         bcount0 = jnp.sum(
-            (member & pvalid[:, None]).astype(jnp.int32), axis=0
+            (member & pvalid[:, None]).astype(jnp.int32), axis=0,
+            dtype=jnp.int32,
         )
         su0 = state_cost(loads, bcount0, colo0)
 
@@ -271,7 +272,7 @@ def _scan_factory(
             (best_u, best_beam, best_depth, d,
              bs_loads, bs_replicas, bs_member) = best
             m = jnp.min(su_b)
-            arg = jnp.argmin(su_b).astype(jnp.int32)
+            arg = lax.argmin(su_b, 0, jnp.int32)
             # the depth cap keeps sequences within the caller's remaining
             # move budget
             better = (m < best_u) & (d < depth_cap)

@@ -151,7 +151,7 @@ def leader_session(
                 pen(lh - delta, avg) + pen(ll + delta, avg)
             )
             gain = jnp.where(elig, gain, -jnp.inf)
-            p_star = jnp.argmax(gain, axis=1).astype(jnp.int32)
+            p_star = lax.argmax(gain, 1, jnp.int32)
             g_star = jnp.max(gain, axis=1)
             fire0 = (
                 valid_pair
@@ -193,7 +193,7 @@ def leader_session(
                 iota_r < nrep_cur[p]
             )
             has = jnp.any(eqj)
-            j = jnp.argmax(eqj).astype(jnp.int32)
+            j = lax.argmax(eqj, 0, jnp.int32)
 
             old_leader = replicas[p, 0].astype(jnp.int32)
             new_row = jnp.where(
@@ -207,11 +207,13 @@ def leader_session(
             member = member.at[p, old_leader].set(
                 jnp.where(has, member[p, old_leader], False)
             ).at[p, light].set(True)
-            one = jnp.where(has, 0, 1).astype(jnp.int32)
+            one = jnp.where(has, jnp.int32(0), jnp.int32(1))
             bcount = bcount.at[old_leader].add(-one).at[light].add(one)
 
             mp = mp.at[log_idx].set(p)
-            mslot = mslot.at[log_idx].set(jnp.where(has, SWAP_SLOT, 0))
+            mslot = mslot.at[log_idx].set(
+                jnp.where(has, jnp.int32(SWAP_SLOT), jnp.int32(0))
+            )
             mtgt = mtgt.at[log_idx].set(light)
             return loads, replicas, member, bcount, mp, mslot, mtgt
 
@@ -229,7 +231,7 @@ def leader_session(
                     return lax.cond(fire[k], do, lambda c: c, (state, cnt))
 
                 state, cnt = lax.fori_loop(
-                    0, K, apply_k, (args, jnp.int32(0))
+                    jnp.int32(0), jnp.int32(K), apply_k, (args, jnp.int32(0))
                 )
                 return (*state, cnt)
 
@@ -255,7 +257,7 @@ def leader_session(
                 flat = jnp.where(
                     mask_slots[None, :, None], u, jnp.inf
                 ).reshape(-1)
-                i = jnp.argmin(flat)
+                i = lax.argmin(flat, 0, jnp.int32)
                 return flat[i], i
 
             fol_u, fol_i = best(slot_iota[0] >= 1)
@@ -269,8 +271,8 @@ def leader_session(
             accept = accept_lead | accept_fol
             chosen = jnp.where(accept_lead, lead_i, fol_i)
 
-            p, rem = jnp.divmod(chosen, R * B)
-            slot, t_rank = jnp.divmod(rem, B)
+            p, rem = jnp.divmod(chosen, jnp.int32(R * B))
+            slot, t_rank = jnp.divmod(rem, jnp.int32(B))
             t_dense = perm[t_rank]
             s_dense = replicas[p, slot]
             delta = jnp.where(

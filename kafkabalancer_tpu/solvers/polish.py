@@ -28,9 +28,9 @@ The search is sort-free and fully fused on device:
   convergence);
 - per entry held by a hot broker: query ``w1 - d*`` in the static weight
   order (one ``searchsorted`` against the immutable sorted weights), then
-  map to the nearest entries actually held by the paired cold broker with
-  next/prev occupied-rank tables ([pairs, Nc] cummin/cummax scans — no
-  per-iteration sort);
+  map to the nearest entries actually held by the paired cold broker
+  with the occupied-rank lookup (``nearest_occupied`` — [pairs, Nc]
+  next/prev scans; no per-iteration sort);
 - the two bracketing candidates are evaluated EXACTLY (true penalty at
   the actual ``d``, so coefficient crossings cost nothing), feasibility-
   masked (allowed/member both directions, eligibility), reduced to the
@@ -75,6 +75,38 @@ N_SHIFTS = 4
 # adaptive acceptance floor: gains below su * SWAP_REL_EPS are noise-level
 # churn, not progress
 SWAP_REL_EPS = 1e-4
+
+
+def nearest_occupied(holder, tgt_b, pair_live, pe_c, rq):
+    """Per-query nearest entries held by the query's paired cold broker,
+    in the static weight order. With ``occ[k, j] = (holder[j] ==
+    tgt_b[k]) & pair_live[k]`` and ``k = pe_c[q]``:
+
+        j_above[q] = min{ j >= min(rq[q], Nc-1) : occ[k, j] }   (else Nc+1)
+        j_below[q] = max{ j <= clip(rq[q]-1, 0, Nc-1) : occ[k, j] }  (else -1)
+
+    Implementation: per-pair next/prev occupied-rank tables via one
+    reverse ``cummin`` and one ``cummax`` over the [pairs, Nc] occupancy
+    mask, then two row gathers per query. Two alternatives were measured
+    on the bench chip and rejected (r4): 128-wide windowed gathers per
+    query cut the generated code 26.9 -> 24.3 MB but quadrupled the warm
+    flagship wall-clock (TPU general-path gathers); packed 128-bit
+    occupancy bitsets with ``population_count`` bit search kept the
+    runtime but grew the code to 34 MB (uint32 legalization). The scans
+    are the smallest program that stays fast. Outputs are pinned
+    bit-identical to a brute-force reference by tests/test_polish.py.
+    """
+    Nc = holder.shape[0]
+    iota_e = jnp.arange(Nc, dtype=jnp.int32)
+    BIGI = jnp.int32(Nc + 1)
+    occ = (holder[None, :] == tgt_b[:, None]) & pair_live[:, None]
+    nxt = lax.cummin(
+        jnp.where(occ, iota_e[None, :], BIGI), axis=1, reverse=True
+    )
+    prv = lax.cummax(jnp.where(occ, iota_e[None, :], -1), axis=1)
+    j_above = nxt[pe_c, jnp.clip(rq, 0, Nc - 1)]
+    j_below = prv[pe_c, jnp.clip(rq - 1, 0, Nc - 1)]
+    return j_above.astype(jnp.int32), j_below.astype(jnp.int32)
 
 
 def entry_table(
@@ -201,17 +233,14 @@ def _swap_loop(
         feas1 = live_e & allowed[ep, t_e] & ~member[ep, t_e]
 
         # nearest cold-broker entries by weight around w1 - d*: one
-        # searchsorted into the STATIC weight order, then next/prev
-        # occupied-rank tables per pair
+        # searchsorted into the STATIC weight order, then the per-pair
+        # occupied-rank lookup (nearest_occupied; see its docstring for
+        # the measured code-size/runtime trade behind the scan tables)
         wq = ew - dstar[pe_c]
         rq = jnp.searchsorted(ew, wq).astype(jnp.int32)  # [Nc]
-        occ = (holder[None, :] == tgt_b[:, None]) & pair_live[:, None]
-        nxt = lax.cummin(
-            jnp.where(occ, iota_e[None, :], BIGI), axis=1, reverse=True
+        j_above, j_below = nearest_occupied(
+            holder, tgt_b, pair_live, pe_c, rq
         )
-        prv = lax.cummax(jnp.where(occ, iota_e[None, :], -1), axis=1)
-        j_above = nxt[pe_c, jnp.clip(rq, 0, Nc - 1)]
-        j_below = prv[pe_c, jnp.clip(rq - 1, 0, Nc - 1)]
         va = (rq < Nc) & (j_above < BIGI)
         vb = (rq > 0) & (j_below >= 0)
 

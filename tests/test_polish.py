@@ -201,3 +201,51 @@ def test_polish_near_global_optimum_tiny():
         plan(pl, cfg, 10_000, batch=2, engine="xla", polish=True)
         got = u_of(pl)
         assert got <= max(best * 3.0, best + 1e-9), (weights, rf, got, best)
+
+
+def test_nearest_occupied_matches_bruteforce():
+    """polish.nearest_occupied must reproduce the brute-force next/prev
+    occupied entry EXACTLY for random holders, pair tables and query
+    ranks — including dead pairs, empty rows, 128-aligned ranks (the
+    boundary case for any future block-decomposed implementation) and
+    the rq=0 / rq=Nc edges."""
+    import numpy as np
+
+    from kafkabalancer_tpu.solvers.polish import nearest_occupied
+
+    W = 128  # probe block-boundary ranks regardless of implementation
+
+    rng = np.random.default_rng(1234)
+    for trial in range(8):
+        Nc = int(rng.choice([256, 512, 1024]))
+        nh = int(rng.choice([4, 8, 16]))
+        B = 16
+        holder = rng.integers(0, B + 1, size=Nc).astype(np.int32)
+        tgt_b = rng.integers(0, B, size=nh).astype(np.int32)
+        pair_live = rng.random(nh) < 0.8
+        pe_c = rng.integers(0, nh, size=Nc).astype(np.int32)
+        # ranks hit edges and block boundaries on purpose
+        rq = np.concatenate(
+            [
+                rng.integers(0, Nc + 1, size=Nc - 6),
+                [0, Nc, W - 1, W, Nc - 1, Nc - W],
+            ]
+        ).astype(np.int32)[:Nc]
+
+        ja, jb = nearest_occupied(
+            jnp.asarray(holder), jnp.asarray(tgt_b),
+            jnp.asarray(pair_live), jnp.asarray(pe_c), jnp.asarray(rq)
+        )
+        ja, jb = np.asarray(ja), np.asarray(jb)
+
+        occ = (holder[None, :] == tgt_b[:, None]) & pair_live[:, None]
+        for q in range(Nc):
+            row = occ[pe_c[q]]
+            start = min(int(rq[q]), Nc - 1)
+            idx = np.nonzero(row[start:])[0]
+            want_a = start + idx[0] if len(idx) else Nc + 1
+            end = min(max(int(rq[q]) - 1, 0), Nc - 1)
+            idx = np.nonzero(row[: end + 1])[0]
+            want_b = idx[-1] if len(idx) else -1
+            assert ja[q] == want_a, (trial, q, ja[q], want_a)
+            assert jb[q] == want_b, (trial, q, jb[q], want_b)
