@@ -17,6 +17,9 @@ Configs (BASELINE.md):
   7. 3x north-star scale through the whole-session kernel (no greedy
      baseline — one greedy move alone costs ~100 s there; the baseline
      column renders '-')
+  8. beyond the single-chip kernel's 128k x 256 ceiling: the sharded
+     converge session (streaming Pallas shard body) + polish tail at
+     160k x 250 (no baseline for the same reason)
 
 Each row reports wall-clock and final unbalance for the CPU-greedy baseline
 (where one is measurable) and the TPU path. Output is a human-readable
@@ -459,6 +462,48 @@ def config7_scale():
     )
 
 
+def config8_beyond_ceiling():
+    """PAST the single-chip whole-session kernel's 128k x 256 VMEM
+    ceiling: the sharded converge session with the streaming Pallas
+    shard body (parallel/shard_kernel.py, no VMEM partition ceiling)
+    plus the polish tail — flagship-quality floors at a scale the
+    single-chip kernel cannot hold. Runs on however many devices are
+    attached (S=1 on the bench chip: the value measured here is the
+    ceiling-free engine + full quality, not mesh speedup — tests and
+    dryrun_multichip pin the S>1 exactness)."""
+    import jax
+    import jax.numpy as jnp
+
+    from kafkabalancer_tpu.parallel.mesh import make_mesh
+    from kafkabalancer_tpu.parallel.shard_session import plan_sharded
+
+    n_parts = 10_000 if FAST else 160_000
+    n_brokers = 32 if FAST else 250
+    cfg = default_rebalance_config()
+    cfg.min_unbalance = 0.0
+    cfg.allow_leader_rebalancing = True
+
+    def fresh():
+        return synth_cluster(n_parts, n_brokers, rf=3, seed=42,
+                             weighted=True)
+
+    ndev = len(jax.devices())
+    mesh = make_mesh(ndev, shape=(1, ndev))
+    budget = 1 << 19
+    plan_sharded(fresh(), copy.deepcopy(cfg), budget, mesh,
+                 batch=n_brokers // 2, engine="pallas", polish=True)  # warm
+    pl_t = fresh()
+    tt, opl = timed(plan_sharded, pl_t, copy.deepcopy(cfg), budget, mesh,
+                    batch=n_brokers // 2, engine="pallas", polish=True)
+    row(
+        f"8: beyond-ceiling {n_parts // 1000}k/{n_brokers} shard+polish",
+        None, None, tt, unbalance_of(pl_t),
+        f"{len(opl)} moves to convergence on a {ndev}-device mesh "
+        f"(u={unbalance_of(pl_t):.2e}; single-chip kernel cap is "
+        f"128k x 256)",
+    )
+
+
 def main():
     import jax
 
@@ -466,7 +511,8 @@ def main():
     for fn in (config1_single_move, config2_text_input,
                config3_weighted_leader, config4_beam_quality,
                config4b_beam_scale, config5_sweep,
-               config6_rebalance_leader, config7_scale):
+               config6_rebalance_leader, config7_scale,
+               config8_beyond_ceiling):
         fn()
 
     w = max(len(r[0]) for r in ROWS) + 2
