@@ -147,6 +147,155 @@ def move_candidate_scores(
     return jnp.where(mask, u, jnp.inf), su
 
 
+def paired_best(
+    loads,
+    replicas,
+    allowed,
+    member,
+    bvalid,
+    weights,
+    nrep_cur,
+    nrep_tgt,
+    ncons,
+    pvalid,
+    min_replicas,
+    *,
+    allow_leader: bool,
+):
+    """Best candidate per hot/cold broker-rank PAIR.
+
+    The per-target selection (:func:`factored_target_best`) degenerates
+    early in a session: the global best source partition wins nearly
+    every target's argmin, the partition claim then rejects all but one,
+    and a "batched" pass commits ~1-3 moves (measured on the bench chip:
+    2.3 commits/pass over the first 5k moves at 131k x 256). This
+    selection supplies the partition DIVERSITY the batched commit needs:
+    rank the valid brokers ascending by (load, ID) (the reference ``bl``
+    order, utils.go:14-28) and pair the hottest with the coldest —
+    hot rank ``nb-1-i`` with cold rank ``i`` — then pick the best
+    (partition, slot) moving OFF each pair's hot broker INTO its cold
+    broker. Winners have distinct sources and distinct targets by
+    construction, and mostly distinct partitions (a partition must hold
+    a replica on the pair's hot broker to qualify).
+
+    Column selection uses one-hot matmuls, never gathers (XLA lowers
+    [P, B2] gathers through its general gather path — the same trap
+    factored_target_best's docstring documents), and the one-hot form is
+    exact in any dtype. The math mirrors factored_target_best term for
+    term (same ``A + C`` factorization, same true-delta leader scoring),
+    so XLA CSEs the shared [P, B] tensors when both run in one pass.
+
+    Returns ``(vals [B2], p, slot, s, t, live)`` with ``B2 = B // 2``,
+    ``vals`` ABSOLUTE (su-based) and dead/ineligible pairs at +inf.
+    Shared by ``solvers.scan`` (batched sessions), the whole-session
+    Pallas kernel (re-derived in kernel form), and
+    ``parallel.shard_session`` (per-shard selection).
+    """
+    P, R = replicas.shape
+    B = loads.shape[0]
+    dtype = loads.dtype
+    nb = jnp.sum(bvalid.astype(jnp.int32)).astype(dtype)
+    avg = jnp.sum(jnp.where(bvalid, loads, 0.0)) / nb
+    F = jnp.where(bvalid, overload_penalty(loads, avg), 0.0)
+    su = jnp.sum(F)
+
+    s_onehot, t_onehot, s_i, t_i, live = pair_frame(loads, bvalid)
+
+    w = weights[:, None]
+    eligible = pvalid & (nrep_tgt >= min_replicas)  # [P]
+    tmask = allowed & ~member & bvalid[None, :]
+    lead_oh = replicas[:, 0][:, None] == jnp.arange(
+        B, dtype=replicas.dtype
+    )[None, :]
+
+    s_sel = s_onehot.astype(dtype)
+    t_sel = t_onehot.astype(dtype)
+
+    def cols(values, mask, sel):
+        # masked one-hot column selection: zero the masked entries BEFORE
+        # the contraction (0 * masked-out is exact; inf would poison it)
+        v = jnp.dot(jnp.where(mask, values, 0.0), sel)
+        ok = jnp.dot(mask.astype(dtype), sel) > 0.5
+        return jnp.where(ok, v, jnp.inf)
+
+    # follower pass (same terms as factored_target_best)
+    srcmask_f = member & ~lead_oh & eligible[:, None]
+    A_f = overload_penalty(loads[None, :] - w, avg) - F[None, :]
+    C_f = overload_penalty(loads[None, :] + w, avg) - F[None, :]
+    Vp = cols(A_f, srcmask_f, s_sel) + cols(C_f, tmask, t_sel)  # [P, B2]
+    p_f = lax.argmin(Vp, 0, jnp.int32)
+    vals_f = jnp.min(Vp, axis=0)
+
+    if allow_leader:
+        wl = weights * (nrep_cur.astype(dtype) + ncons)
+        ok_l = (nrep_cur >= 1) & eligible
+        A_l = overload_penalty(loads[None, :] - wl[:, None], avg) - F[None, :]
+        C_l = overload_penalty(loads[None, :] + wl[:, None], avg) - F[None, :]
+        Vp_l = cols(A_l, lead_oh & ok_l[:, None], s_sel) + cols(
+            C_l, tmask, t_sel
+        )
+        p_l = lax.argmin(Vp_l, 0, jnp.int32)
+        vals_l = jnp.min(Vp_l, axis=0)
+    else:
+        p_l = vals_l = None
+
+    vals, p, slot = pair_finish(
+        replicas, nrep_cur, s_i, live, vals_f, p_f, vals_l, p_l,
+        allow_leader=allow_leader,
+    )
+    return su + vals, p, slot, s_i, t_i, live
+
+
+def pair_frame(loads, bvalid):
+    """Hot/cold rank-pairing frame shared by :func:`paired_best` and the
+    sharded scoring kernel's host side (parallel/shard_kernel.py): pair
+    ``i`` moves OFF the broker at ascending-(load, ID) rank ``nb-1-i``
+    INTO the broker at rank ``i``. Returns ``(s_onehot [B, B2] bool,
+    t_onehot, s_i [B2], t_i, live)``; dead columns (``i >= nb // 2``) are
+    all-zero with ``s_i/t_i == 0``."""
+    B = loads.shape[0]
+    B2 = max(1, B // 2)
+    nb_i = jnp.sum(bvalid.astype(jnp.int32))
+    _, _, rank_of = rank_brokers(loads, bvalid)
+    i2 = jnp.arange(B2, dtype=jnp.int32)
+    live = i2 < nb_i // 2
+    # hot/cold one-hot columns straight from the rank table — ranks are
+    # unique, so each live column selects exactly one broker
+    s_onehot = rank_of[:, None] == (nb_i - 1 - i2)[None, :]  # [B, B2]
+    t_onehot = rank_of[:, None] == i2[None, :]  # [B, B2]
+    s_i = jnp.argmax(s_onehot, axis=0).astype(jnp.int32)  # [B2]
+    t_i = jnp.argmax(t_onehot, axis=0).astype(jnp.int32)
+    return s_onehot, t_onehot, s_i, t_i, live
+
+
+def pair_finish(
+    replicas, nrep_cur, s_i, live, vals_f, p_f, vals_l, p_l,
+    *, allow_leader: bool,
+):
+    """Pair-winner epilogue shared by :func:`paired_best` and the sharded
+    kernel path: recover the (unique) follower slot holding the pair's
+    hot broker on the winner partition, merge the leader winners
+    (strict <, follower wins ties), and kill dead pairs. Returns
+    ``(vals_raw, p, slot)`` with ``vals_raw`` su-less (+inf dead)."""
+    R = replicas.shape[1]
+    rp = replicas[p_f]  # [B2, R]
+    slot_iota = jnp.arange(R, dtype=jnp.int32)[None, :]
+    hit = (
+        (rp == s_i[:, None].astype(rp.dtype))
+        & (slot_iota >= 1)
+        & (slot_iota < nrep_cur[p_f][:, None])
+    )
+    slot_f = lax.argmin(jnp.where(hit, slot_iota, R), 1, jnp.int32)
+
+    vals, p, slot = vals_f, p_f, slot_f
+    if allow_leader:
+        lead_better = vals_l < vals  # strict: follower wins ties
+        vals = jnp.where(lead_better, vals_l, vals)
+        p = jnp.where(lead_better, p_l, p)
+        slot = jnp.where(lead_better, 0, slot)
+    return jnp.where(live, vals, jnp.inf), p, slot
+
+
 def rank_brokers(loads, bvalid):
     """Ascending (load, broker-index) ranking of the valid brokers
     (utils.go:14-28, utils.go:107-117).

@@ -18,15 +18,22 @@ kernel-side partition ceiling — the per-shard row count P/S is bounded by
 HBM alone, and sharding divides it S-fold (the scaling story
 RESULTS.md documents).
 
-Exactness: the kernel reproduces ``factored_target_best``'s selection
-bit-for-bit in float32 — same ``overload_penalty`` (the shared function;
-element-wise, so accumulation order cannot drift), same masks, same
-per-target argmin-over-rows with lowest-row tie-break (running strict-<
-accumulation over ascending tiles), same strict-< leader merge (done
-OUTSIDE the kernel by the shard body, together with the winner-only slot
-recovery, so that code is shared with the XLA engine). Pinned by
-tests/test_parallel.py: the pallas-interpret sharded session's move log
-is bit-identical to the XLA sharded session's.
+Exactness: the kernel reproduces ``factored_target_best``'s per-target
+selection AND ``paired_best``'s per-broker-pair selection bit-for-bit in
+float32 — same ``overload_penalty`` (the shared function; element-wise,
+so accumulation order cannot drift), same masks, same argmin-over-rows
+with lowest-row tie-break (running strict-< accumulation over ascending
+tiles), same masked one-hot column matmuls for the pair hot/cold
+selection (exact in any matmul precision — each output sums exactly one
+value), and the same strict-< leader merges (done OUTSIDE the kernel by
+the shard body via ``cost.pair_frame``/``cost.pair_finish`` and the
+winner-only slot recovery, so that code is shared with the XLA engine).
+Pair outputs are ``(vpf, ppf, vpl, ppl)`` — follower/leader bests per
+pair column, +inf where no feasible candidate; with ``allow_leader``
+False the leader refs are dead but still written every grid step (the
+Mosaic constraint below). Pinned by tests/test_parallel.py: the
+pallas-interpret sharded session's move log is bit-identical to the XLA
+sharded session's.
 """
 
 from __future__ import annotations
@@ -61,15 +68,22 @@ def _kernel(
     F_ref,         # [1, B] f32 (bvalid-masked penalty terms)
     bvalid_ref,    # [1, B] bool
     scal_ref,      # [1, 2] f32: avg | min_replicas
+    ssel_ref,      # [B, B2] f32 hot-broker one-hot columns (pair_frame)
+    tsel_ref,      # [B, B2] f32 cold-broker one-hot columns
     vf_ref,        # [1, B] f32 out: best follower A*+C per target
     pf_ref,        # [1, B] i32 out: its LOCAL partition row
     vl_ref,        # [1, B] f32 out: best leader A+C per target
     pl_ref,        # [1, B] i32 out: its LOCAL partition row
+    vpf_ref,       # [1, B2] f32 out: best follower A+C per broker pair
+    ppf_ref,       # [1, B2] i32 out: its LOCAL partition row
+    vpl_ref,       # [1, B2] f32 out: best leader A+C per broker pair
+    ppl_ref,       # [1, B2] i32 out: its LOCAL partition row
     *,
     allow_leader: bool,
 ):
     ti = pl.program_id(0)
     T, B = member_ref.shape[0], member_ref.shape[1]
+    B2 = ssel_ref.shape[1]
     f32 = jnp.float32
     i32 = jnp.int32
 
@@ -112,11 +126,31 @@ def _kernel(
         pf_ref[...] = jnp.zeros((1, B), i32)
         vl_ref[...] = jnp.full((1, B), jnp.inf, f32)
         pl_ref[...] = jnp.zeros((1, B), i32)
+        vpf_ref[...] = jnp.full((1, B2), jnp.inf, f32)
+        ppf_ref[...] = jnp.zeros((1, B2), i32)
+        vpl_ref[...] = jnp.full((1, B2), jnp.inf, f32)
+        ppl_ref[...] = jnp.zeros((1, B2), i32)
+
+    s_sel = ssel_ref[...]  # [B, B2]
+    t_sel = tsel_ref[...]
+    zero_tb = jnp.zeros((T, B), f32)
+    one_tb = jnp.ones((T, B), f32)
+    row_iota_p = lax.broadcasted_iota(i32, (T, B2), 0)
+    big_p = jnp.full((T, B2), jnp.iinfo(jnp.int32).max, i32)
+    inf_tp = jnp.full((T, B2), jnp.inf, f32)
+
+    def dsel(m, sel):  # [T, B] @ [B, B2] one-hot column selection (exact)
+        return jax.lax.dot_general(
+            m, sel,
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=f32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
 
     # --- follower pass (member brokers minus the leader, delta = w) -----
     srcmask = member & ~lead_oh & eligible
-    A = cost.overload_penalty(loads - w, avg) - F
-    A = jnp.where(srcmask, A, inf)
+    A0 = cost.overload_penalty(loads - w, avg) - F
+    A = jnp.where(srcmask, A0, inf)
     A_star = jnp.min(A, axis=1, keepdims=True)  # [T, 1]
     C = cost.overload_penalty(loads + w, avg) - F
     V = jnp.where(tmask & jnp.isfinite(A_star), A_star + C, inf)
@@ -129,13 +163,30 @@ def _kernel(
     vf_ref[...] = jnp.where(better, vmin, cur)
     pf_ref[...] = jnp.where(better, arg, pf_ref[...])
 
+    # --- follower PAIR pass (cost.paired_best's [P, B2] work) -----------
+    srcf = jnp.where(srcmask, one_tb, zero_tb)
+    tmf = jnp.where(tmask, one_tb, zero_tb)
+    a_sel = dsel(jnp.where(srcmask, A0, zero_tb), s_sel)
+    ok_s = dsel(srcf, s_sel) > 0.5
+    c_sel = dsel(jnp.where(tmask, C, zero_tb), t_sel)
+    ok_t = dsel(tmf, t_sel) > 0.5
+    Vp = jnp.where(ok_s & ok_t, a_sel + c_sel, inf_tp)
+    vminp = jnp.min(Vp, axis=0, keepdims=True)  # [1, B2]
+    argp = jnp.min(
+        jnp.where(Vp == vminp, row_iota_p, big_p), axis=0, keepdims=True
+    ) + ti * jnp.full((1, B2), T, i32)
+    curp = vpf_ref[...]
+    betterp = vminp < curp
+    vpf_ref[...] = jnp.where(betterp, vminp, curp)
+    ppf_ref[...] = jnp.where(betterp, argp, ppf_ref[...])
+
     if allow_leader:
         # --- leader pass (slot 0, delta = w·(replicas+consumers)) -------
         wl = w * (ncur + ncons)
         ok_l = (ncur >= jnp.ones((1, 1), f32)) & eligible
-        A_l = cost.overload_penalty(loads - wl, avg) - F
+        A_l0 = cost.overload_penalty(loads - wl, avg) - F
         A_l = jnp.min(
-            jnp.where(lead_oh & ok_l, A_l, inf), axis=1, keepdims=True
+            jnp.where(lead_oh & ok_l, A_l0, inf), axis=1, keepdims=True
         )
         C_l = cost.overload_penalty(loads + wl, avg) - F
         V_l = jnp.where(tmask & jnp.isfinite(A_l), A_l + C_l, inf)
@@ -147,10 +198,31 @@ def _kernel(
         better_l = vmin_l < cur_l
         vl_ref[...] = jnp.where(better_l, vmin_l, cur_l)
         pl_ref[...] = jnp.where(better_l, arg_l, pl_ref[...])
+
+        # --- leader PAIR pass -------------------------------------------
+        srcm_l = lead_oh & ok_l
+        srcf_l = jnp.where(srcm_l, one_tb, zero_tb)
+        al_sel = dsel(jnp.where(srcm_l, A_l0, zero_tb), s_sel)
+        ok_sl = dsel(srcf_l, s_sel) > 0.5
+        cl_sel = dsel(
+            jnp.where(tmask, C_l, zero_tb), t_sel
+        )
+        Vpl = jnp.where(ok_sl & ok_t, al_sel + cl_sel, inf_tp)
+        vminpl = jnp.min(Vpl, axis=0, keepdims=True)
+        argpl = jnp.min(
+            jnp.where(Vpl == vminpl, row_iota_p, big_p), axis=0,
+            keepdims=True,
+        ) + ti * jnp.full((1, B2), T, i32)
+        curpl = vpl_ref[...]
+        betterpl = vminpl < curpl
+        vpl_ref[...] = jnp.where(betterpl, vminpl, curpl)
+        ppl_ref[...] = jnp.where(betterpl, argpl, ppl_ref[...])
     else:
         # dead outputs still written every step (same Mosaic constraint)
         vl_ref[...] = jnp.where(better, vl_ref[...], vl_ref[...])
         pl_ref[...] = jnp.where(better, pl_ref[...], pl_ref[...])
+        vpl_ref[...] = jnp.where(betterp, vpl_ref[...], vpl_ref[...])
+        ppl_ref[...] = jnp.where(betterp, ppl_ref[...], ppl_ref[...])
 
 
 def shard_score(
@@ -162,16 +234,21 @@ def shard_score(
     F,         # [1, B] f32
     bvalid,    # [1, B] bool
     scal,      # [1, 2] f32: avg | min_replicas
+    ssel,      # [B, B2] f32 hot one-hot columns (cost.pair_frame)
+    tsel,      # [B, B2] f32 cold one-hot columns
     *,
     allow_leader: bool,
     interpret: bool = False,
 ):
     """One fused scoring pass over this shard's local rows. Returns
-    ``(vals_f [B], p_f [B], vals_l [B], p_l [B])`` — raw ``A*+C`` minima
-    (no ``su`` offset) with LOCAL winner rows; the caller does the leader
-    merge and slot recovery (shared with the XLA engine)."""
+    ``(vals_f [B], p_f [B], vals_l [B], p_l [B], vals_pf [B2], p_pf [B2],
+    vals_pl [B2], p_pl [B2])`` — raw ``A+C`` minima (no ``su`` offset)
+    with LOCAL winner rows, per target and per broker pair; the caller
+    does the leader merges and slot recovery (shared with the XLA
+    engine)."""
     P_l, R = replicas.shape
     B = member.shape[1]
+    B2 = ssel.shape[1]
     T = min(SHARD_TILE_P, P_l)
     if P_l % T:
         raise ValueError(f"shard rows {P_l} not a multiple of tile {T}")
@@ -198,23 +275,36 @@ def shard_score(
             pl.BlockSpec((1, B), const_map),
             pl.BlockSpec((1, B), const_map),
             pl.BlockSpec((1, 2), const_map),
+            pl.BlockSpec((B, B2), const_map),
+            pl.BlockSpec((B, B2), const_map),
         ],
         out_specs=[
             pl.BlockSpec((1, B), const_map),
             pl.BlockSpec((1, B), const_map),
             pl.BlockSpec((1, B), const_map),
             pl.BlockSpec((1, B), const_map),
+            pl.BlockSpec((1, B2), const_map),
+            pl.BlockSpec((1, B2), const_map),
+            pl.BlockSpec((1, B2), const_map),
+            pl.BlockSpec((1, B2), const_map),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((1, B), jnp.float32),
             jax.ShapeDtypeStruct((1, B), jnp.int32),
             jax.ShapeDtypeStruct((1, B), jnp.float32),
             jax.ShapeDtypeStruct((1, B), jnp.int32),
+            jax.ShapeDtypeStruct((1, B2), jnp.float32),
+            jax.ShapeDtypeStruct((1, B2), jnp.int32),
+            jax.ShapeDtypeStruct((1, B2), jnp.float32),
+            jax.ShapeDtypeStruct((1, B2), jnp.int32),
         ],
         interpret=interpret,
-    )(replicas, cols, member, allowed, loads, F, bvalid, scal)
-    vf, pf, vl, pl_ = out
-    return vf[0], pf[0], vl[0], pl_[0]
+    )(replicas, cols, member, allowed, loads, F, bvalid, scal, ssel, tsel)
+    vf, pf, vl, pl_, vpf, ppf, vpl, ppl = out
+    return (
+        vf[0], pf[0], vl[0], pl_[0],
+        vpf[0], ppf[0], vpl[0], ppl[0],
+    )
 
 
 def pack_cols(weights, nrep_cur, nrep_tgt, ncons, pvalid):

@@ -7,20 +7,23 @@ the ``[P, B]`` member/allowed state and the ``[P, R]+[P, B]`` per-
 iteration scoring on a single device (100k x 256 ≈ 17 s warm, round 2).
 This module shards the session itself over the ``part`` mesh axis
 (SURVEY.md §2.9 mapping): every device owns ``P/S`` partitions, scoring
-is local, and two ``all_gather`` launches per iteration (the ``[B]``
-float winner values plus one stacked ``[3, B]`` int32 attribute gather)
-combine the per-shard per-target winners — the collective payload is
-O(S·B), never O(P).
+is local, and two ``all_gather`` launches per iteration (the ``[K]``
+float winner values plus one stacked ``[3, K]`` int32 attribute gather,
+``K = B + B//2`` — the per-target winners plus the hot/cold broker-pair
+winners) combine the per-shard candidate pools — the collective payload
+is O(S·B), never O(P).
 
 Exactness: the combine key is ``(val, is_leader, partition)`` — a total
-order under which the unsharded ``factored_target_best`` selection
-(follower argmin over partitions, leader argmin, strict-< merge) is an
-associative min, so the sharded winner set is IDENTICAL to the
-single-device one (pinned by tests/test_parallel.py). Broker loads,
-claim/commit selection, and move logs are replicated computations (all
-derive from the combined ``[B]`` winners), so every shard carries
-bit-identical copies; replica/membership state updates apply only on the
-owning shard.
+order under which BOTH unsharded selections (``factored_target_best``
+per target and ``paired_best`` per broker pair: follower argmin over
+partitions, leader argmin, strict-< merge) are associative mins, so the
+sharded candidate pool is IDENTICAL to the single-device one (pinned by
+tests/test_parallel.py). Broker loads, the prefix-exact acceptance
+(``scan.prefix_accept`` — literally the same function the single-device
+batch session runs), and move logs are replicated computations (all
+derive from the combined ``[K]`` candidates), so every shard carries
+bit-identical copies; replica/membership state updates apply only on
+the owning shard.
 
 Scaling story (RESULTS.md): per-device memory and per-iteration scoring
 work drop S-fold. With ``engine="pallas"`` each shard's scoring pass
@@ -53,6 +56,7 @@ from jax.sharding import PartitionSpec as PS  # noqa: E402
 
 from kafkabalancer_tpu.ops import cost  # noqa: E402
 from kafkabalancer_tpu.parallel.mesh import PART_AXIS  # noqa: E402
+from kafkabalancer_tpu.solvers.scan import prefix_accept  # noqa: E402
 
 
 @partial(
@@ -151,7 +155,6 @@ def sharded_session(
         ncons_l = lslice(ncons)
         pvalid_l = lslice(pvalid)
 
-        t = jnp.arange(B, dtype=jnp.int32)
         mp0 = jnp.full(max_moves + 1, -1, jnp.int32)
         bcount0 = jax.lax.psum(
             jnp.sum((member & pvalid_l[:, None]).astype(jnp.int32), axis=0),
@@ -172,13 +175,18 @@ def sharded_session(
 
         def _score_pallas(loads, replicas, member, bvalid, nb):
             """Kernel-backed analog of the XLA branch's
-            ``factored_target_best`` call: same avg/F/su arithmetic, the
-            fused kernel for the [P_l, B] passes, and the shared leader
-            merge + winner-only slot recovery OUTSIDE the kernel."""
+            ``factored_target_best`` + ``paired_best`` calls: same
+            avg/F/su/rank arithmetic, the fused kernel for the [P_l, B] +
+            [P_l, B2] passes, and the shared leader merges + winner-only
+            slot recovery OUTSIDE the kernel (cost.pair_frame /
+            cost.pair_finish are literally the same functions the XLA
+            engine uses)."""
             avg = jnp.sum(jnp.where(bvalid, loads, 0.0)) / nb
             F = jnp.where(bvalid, cost.overload_penalty(loads, avg), 0.0)
             su = jnp.sum(F)
-            vals_f, p_f, vals_l, p_l2 = shard_score(
+            s_oh, t_oh, s_i, t_i, live = cost.pair_frame(loads, bvalid)
+            (vals_f, p_f, vals_l, p_l2,
+             vals_pf, p_pf, vals_pl, p_pl) = shard_score(
                 replicas,
                 cols_k,
                 member,
@@ -187,6 +195,8 @@ def sharded_session(
                 F.reshape(1, B),
                 bvalid.reshape(1, B),
                 jnp.stack([avg, min_replicas.astype(dtype)]).reshape(1, 2),
+                s_oh.astype(dtype),
+                t_oh.astype(dtype),
                 allow_leader=allow_leader,
                 interpret=(engine == "pallas-interpret"),
             )
@@ -213,7 +223,16 @@ def sharded_session(
                 slot = jnp.where(lead_better, 0, slot_f)
             else:
                 vals_raw, p_loc, slot = vals_f, p_f.astype(jnp.int32), slot_f
-            return su, su + vals_raw, p_loc, slot
+            vals_p_raw, p_p, slot_p = cost.pair_finish(
+                replicas, ncur_l, s_i, live, vals_pf, p_pf,
+                vals_pl if allow_leader else None,
+                p_pl if allow_leader else None,
+                allow_leader=allow_leader,
+            )
+            return (
+                su, su + vals_raw, p_loc, slot,
+                su + vals_p_raw, p_p, slot_p, s_i, t_i,
+            )
 
         def _applied_delta(p, slot):
             # full-vector lookups: p is a GLOBAL partition index
@@ -232,38 +251,54 @@ def sharded_session(
 
             bvalid = (always_valid | (bcount > 0)) & universe_valid
             nb = jnp.sum(bvalid, dtype=jnp.int32).astype(dtype)
-            # local per-target winners over this shard's partition rows;
-            # loads/bvalid are replicated so su/avg arithmetic is
-            # bit-identical on every shard
+            avg = jnp.sum(jnp.where(bvalid, loads, 0.0)) / nb
+            # local per-target + per-pair winners over this shard's
+            # partition rows; loads/bvalid are replicated so su/avg/rank
+            # arithmetic is bit-identical on every shard
             if use_pallas:
-                su, vals, p_loc, slot = _score_pallas(
-                    loads, replicas, member, bvalid, nb
-                )
+                su, vals_t_l, p_t_l, slot_t_l, vals_p_l, p_p_l, slot_p_l, \
+                    s_p, t_p = _score_pallas(
+                        loads, replicas, member, bvalid, nb
+                    )
             else:
-                su, vals, p_loc, slot = cost.factored_target_best(
+                su, vals_t_l, p_t_l, slot_t_l = cost.factored_target_best(
                     loads, replicas, allowed, member, bvalid, w_l, ncur_l,
                     ntgt_l, ncons_l, pvalid_l, nb, min_replicas,
                     allow_leader=allow_leader,
                 )
-            s_loc = replicas[jnp.clip(p_loc, 0), jnp.clip(slot, 0)].astype(
-                jnp.int32
-            )
+                vals_p_l, p_p_l, slot_p_l, s_p, t_p, _live = (
+                    cost.paired_best(
+                        loads, replicas, allowed, member, bvalid, w_l,
+                        ncur_l, ntgt_l, ncons_l, pvalid_l, min_replicas,
+                        allow_leader=allow_leader,
+                    )
+                )
+            s_t_l = replicas[
+                jnp.clip(p_t_l, 0), jnp.clip(slot_t_l, 0)
+            ].astype(jnp.int32)
+            # the union pool, K = B + B//2 local candidates (a pair
+            # candidate's source IS the pair's hot broker, leader or not)
+            vals_loc = jnp.concatenate([vals_t_l, vals_p_l])
+            p_loc = jnp.concatenate([p_t_l, p_p_l])
+            slot_loc = jnp.concatenate([slot_t_l, slot_p_l])
+            s_loc = jnp.concatenate([s_t_l, s_p])
+            t = jnp.concatenate([jnp.arange(B, dtype=jnp.int32), t_p])
             p_glob = p_loc + off
 
             # cross-shard combine under the total-order key
             # (val, is_leader, partition) — see module docstring. Two
-            # collectives per iteration: the [B] float winner values and
-            # one stacked [3, B] int32 gather for their attributes (ICI
+            # collectives per iteration: the [K] float winner values and
+            # one stacked [3, K] int32 gather for their attributes (ICI
             # payloads here are latency-bound, so launches matter more
             # than the few-KB size)
-            vals_all = lax.all_gather(vals, PART_AXIS)          # [S, B]
+            vals_all = lax.all_gather(vals_loc, PART_AXIS)      # [S, K]
             attr_all = lax.all_gather(
-                jnp.stack([p_glob, slot, s_loc]), PART_AXIS
-            )                                                   # [S, 3, B]
+                jnp.stack([p_glob, slot_loc, s_loc]), PART_AXIS
+            )                                                   # [S, 3, K]
             p_all = attr_all[:, 0]
             slot_all = attr_all[:, 1]
             s_all = attr_all[:, 2]
-            vmin = jnp.min(vals_all, axis=0)                    # [B]
+            vmin = jnp.min(vals_all, axis=0)                    # [K]
             is_lead = (slot_all == 0).astype(jnp.int32)
             tiekey = jnp.where(
                 vals_all == vmin[None, :],
@@ -277,29 +312,16 @@ def sharded_session(
             s_ = jnp.take_along_axis(s_all, k_star[None, :], axis=0)[0]
 
             # ---- from here on: identical replicated computation on every
-            # shard (mirrors scan.session body_batch) ---------------------
-            improving = (
-                jnp.isfinite(vals) & (vals < su - min_unbalance) & (vals < su)
+            # shard (mirrors scan.session body_batch; prefix_accept is
+            # literally the same function) --------------------------------
+            w_k = _applied_delta(p, slot)
+            ok, pos, cnt = prefix_accept(
+                vals, p, s_, t, w_k, loads, avg, su,
+                min_unbalance, churn_gate, n, batch, budget, max_moves,
             )
-            best_gain = su - jnp.min(vals)
-            improving &= (su - vals) * churn_gate >= best_gain
-
-            bigb = jnp.int32(B + 1)
-            prio = jnp.where(improving, t, bigb)
-            first_p = jnp.full(P, bigb).at[p].min(prio)
-            first_b = jnp.full(B, bigb).at[s_].min(prio).at[t].min(prio)
-            ok = (
-                improving
-                & (first_p[p] == t)
-                & (first_b[s_] == t)
-                & (first_b[t] == t)
-            )
-            pos = n + jnp.cumsum(ok.astype(jnp.int32), dtype=jnp.int32) - 1
-            ok &= (pos < n + batch) & (pos < budget) & (pos < max_moves)
             oki = ok.astype(jnp.int32)
-            cnt = jnp.sum(oki, dtype=jnp.int32)
 
-            delta = _applied_delta(p, slot) * oki.astype(dtype)
+            delta = w_k * oki.astype(dtype)
             loads = loads.at[s_].add(-delta).at[t].add(delta)
             bcount = bcount.at[s_].add(-oki).at[t].add(oki)
 

@@ -9,10 +9,12 @@ disjoint selection, application, move logging, convergence check — as one
 iterations and the device never returns to the dispatcher until the
 session converges or exhausts its budget.
 
-Same algorithm as ``scan.session`` with ``batch > 1`` (per-target
-candidate selection with the factorized rank-1 objective
-``u = su + A[p,r] + C[p,t]``, first-claimant disjointness, churn gate,
-dynamic broker-table membership), with kernel-friendly re-formulations:
+Same algorithm as ``scan.session`` with ``batch > 1`` (the candidate
+union — per-TARGET winners plus hot/cold broker-PAIR winners — scored
+with the factorized rank-1 objective ``u = su + A[p,r] + C[p,t]``, then
+``scan.prefix_accept``'s prefix-exact acceptance with per-broker net
+prefix sums, churn gate, dynamic broker-table membership), with
+kernel-friendly re-formulations:
 
 - ALL state lives TRANSPOSED with the partition axis on lanes
   (replicas ``[R, P]`` as exact-integer f32, per-partition columns
@@ -23,18 +25,25 @@ dynamic broker-table membership), with kernel-friendly re-formulations:
   per-partition broker list keeps the int8 ``[P, B]`` allowed matrix
   resident; scan.plan gates and falls back to the XLA session beyond);
 - per-tile compute transposes lane slices back to ``[T, R]``/``[T, 5]``
-  with one MXU identity-dot each (dynamic lane slicing at 256-aligned
+  with one MXU identity-dot each (dynamic lane slicing at TILE_P-aligned
   offsets); commit writes blend one (slot, partition) cell inside the
   aligned lane tile holding the partition;
 - no int<->float vector conversion exists anywhere: ``arith.sitofp``
   fails to legalize in Mosaic, so integers ride f32 exactly (< 2^24)
   and float iotas arrive as constant inputs (``tpu.iota`` is int-only);
 - the ``loads[s]`` gather becomes a one-hot contraction per P-tile (MXU);
-- the per-target winner's attributes (slot, source, delta) are captured
-  IN the tile loop as payload columns contracted with the winner
-  one-hot — no post-selection re-reads;
-- claims/disjointness become pairwise ``[B, B]`` masks (no scatters);
-- cumsum becomes a lower-triangular ``[B, B]`` contraction;
+- each winner's attributes (slot, source, delta) are captured IN the
+  tile loop as payload columns contracted with the winner one-hot — no
+  post-selection re-reads;
+- broker (load, ID) ranks for the hot/cold pairing come from pairwise
+  ``[B, B]`` comparison counting (``lax.sort`` does not exist in
+  Mosaic), and the pair columns are selected with masked one-hot
+  matmuls (exact in any precision);
+- the candidate union lives on ``K = B + B//2`` lanes, assembled with
+  one-hot placement matmuls (lane-concatenating 1-D vectors at a
+  non-tile-aligned offset crashes Mosaic layout inference), and the
+  acceptance order/claims/net-prefix sums/cumsums are pairwise
+  ``[K, K]`` masks and triangular contractions (no scatters, no sorts);
 - move logs live in ``[max_moves/128, 128]`` VMEM buffers (exact (8,128)
   tiles) written with dynamic-sublane row selection + masked-lane
   blending. The replicas output aliases the replicas input.
@@ -65,7 +74,7 @@ from kafkabalancer_tpu.ops.cost import overload_penalty as _pen  # noqa: E402
 from kafkabalancer_tpu.solvers.scan import DEFAULT_CHURN_GATE  # noqa: E402
 
 BIG = 1e30  # inf stand-in (avoids inf−inf NaNs in masking)
-TILE_P = 256
+TILE_P = 128
 
 
 def _kernel(
@@ -178,6 +187,23 @@ def _kernel(
 
     iota_sub_t = lax.broadcasted_iota(jnp.int32, (TILE_P, 1), 0)
 
+    B2 = max(1, B // 2)
+    K = B + B2
+
+    eye_b = (
+        lax.broadcasted_iota(jnp.int32, (B, B), 0)
+        == lax.broadcasted_iota(jnp.int32, (B, B), 1)
+    ).astype(f32)
+
+    def to_col0(vec_f32):  # [B] lanes -> [B, 1] sublanes (MXU transpose)
+        return jax.lax.dot_general(
+            eye_b,
+            vec_f32.reshape(1, B),
+            dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=f32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+
     def iteration(carry):
         n, _done = carry
 
@@ -191,6 +217,28 @@ def _kernel(
         F = jnp.where(bvalid, _pen(loads, avg), jnp.zeros_like(loads))  # [B]
         su = jnp.sum(F)
 
+        # ---- broker (load, ID) ranks + hot/cold pair one-hots -----------
+        # pairwise rank counting replaces lax.sort (unavailable in
+        # Mosaic): rank_b = #{b' : key_b' < key_b} with the pad key
+        # (BIG, id) standing in for rank_brokers' (+inf, id) — identical
+        # counts, so identical ranks. Hot rank nb-1-i pairs with cold
+        # rank i (ops/cost.py paired_best).
+        keyload = jnp.where(bvalid, loads, jnp.full_like(loads, BIG))
+        lrow = keyload.reshape(1, B)
+        lcol = to_col0(keyload)  # [B, 1]
+        brow = lanef_ref[:]  # [1, B] broker ids f32
+        bcol = to_col0(brow[0, :])
+        lessb = (lcol < lrow) | ((lcol == lrow) & (bcol < brow))
+        rank_row = jnp.sum(lessb.astype(f32), axis=0, keepdims=True)  # [1, B]
+        rank_col = to_col0(rank_row[0, :])  # [B, 1]
+        i2f = lanef_ref[:, :B2]  # [1, B2] float pair iota
+        npair = jnp.floor(nb * 0.5)
+        live_p = i2f[0, :] < npair  # [B2]
+        s_sel = (rank_col == (nb - 1.0 - i2f)).astype(f32)  # [B, B2]
+        t_sel = (rank_col == i2f).astype(f32)  # [B, B2]
+        s_pair = _dot(brow, s_sel, 1, 0)[0, :]  # [B2] hot broker ids f32
+        t_pair = _dot(brow, t_sel, 1, 0)[0, :]  # [B2] cold broker ids f32
+
         # ---- tile loop over partitions: best candidate per target -------
         # carries: (bestv [1,B], bestp [1,B])
         loadsF = jnp.concatenate(
@@ -198,7 +246,8 @@ def _kernel(
         )  # [B, 2]
 
         def tile_body(ti, bc):
-            bestv, bestp, bestpay, bestv_l, bestp_l, bestpay_l = bc
+            (bestv, bestp, bestpay, bestv_l, bestp_l, bestpay_l,
+             bv_pf, bp_pf, pay_pf, bv_pl, bp_pl, pay_pl) = bc
             off = ti * TILE_P
             reps, w_t, nrc, nrt, ncons_t, pv_t = read_tile(off)
             # one-hot contraction replaces the loads/F gather (replica
@@ -282,6 +331,38 @@ def _kernel(
             paysel = _dot(_dot(paymat, eye_t, 0, 0), onehot_win, 1, 0)
             bestpay = jnp.where(better, paysel, bestpay)  # [3, B]
 
+            # ---- follower PAIR candidates (cost.paired_best in kernel
+            # form): best partition moving OFF each pair's hot broker INTO
+            # its cold broker. The [T, B] membership formulation replaces
+            # the per-slot one (each broker appears in at most one slot, so
+            # the values coincide); one-hot column matmuls replace gathers.
+            folmask = valid_slots * (slotf_ref[:] >= 0.5).astype(f32)  # [T, R]
+            memb_fol = jnp.max(onehot * folmask[:, :, None], axis=1)  # [T, B]
+            slotmat = jnp.sum(
+                onehot * (folmask * slotf_ref[:])[:, :, None], axis=1
+            )  # [T, B] slot index at each follower-member lane
+            eligf = elig.astype(f32)  # [T, 1]
+            srcm_f = memb_fol * eligf  # [T, B]
+            A_pb = _pen(loads.reshape(1, B) - w_t, avg) - F.reshape(1, B)
+            Af_sel = _dot(A_pb * srcm_f, s_sel, 1, 0)  # [T, B2]
+            okS = _dot(srcm_f, s_sel, 1, 0) > 0.5
+            tm_f = tmask.astype(f32)
+            Cf_sel = _dot(C * tm_f, t_sel, 1, 0)
+            okT = _dot(tm_f, t_sel, 1, 0) > 0.5
+            Vp = jnp.where(okS & okT, Af_sel + Cf_sel, jnp.full_like(Af_sel, BIG))
+            vminp = jnp.min(Vp, axis=0, keepdims=True)  # [1, B2]
+            vargp = lax.argmin(Vp, axis=0, index_dtype=jnp.int32).reshape(1, B2)
+            onehot_wp = (iota_sub_t[:, :1] == vargp).astype(f32)  # [T, B2]
+            slot_selp = _dot(slotmat, s_sel, 1, 0)  # [T, B2]
+            slotw = jnp.sum(slot_selp * onehot_wp, axis=0, keepdims=True)
+            ww = jnp.sum(w_t * onehot_wp, axis=0, keepdims=True)
+            betterp = vminp < bv_pf
+            bv_pf = jnp.where(betterp, vminp, bv_pf)
+            bp_pf = jnp.where(betterp, off + vargp, bp_pf)
+            pay_pf = jnp.where(
+                betterp, jnp.concatenate([slotw, ww], axis=0), pay_pf
+            )  # [2, B2] (slot, w)
+
             if allow_leader:
                 # leader pass: slot 0 scored with its TRUE applied delta
                 # w*(replicas+consumers) — see scan.py body_batch for why
@@ -316,15 +397,46 @@ def _kernel(
                 paysel_l = _dot(_dot(paymat_l, eye_t, 0, 0), onehot_l, 1, 0)
                 bestpay_l = jnp.where(better_l, paysel_l, bestpay_l)
 
-            return bestv, bestp, bestpay, bestv_l, bestp_l, bestpay_l
+                # ---- leader PAIR candidates (true applied premium) ------
+                lead_m = onehot[:, 0, :] * (
+                    ((nrc > 0.5) & elig).astype(f32)
+                )  # [T, B]
+                A_lpb = _pen(loads.reshape(1, B) - wl, avg) - F.reshape(1, B)
+                Al_sel = _dot(A_lpb * lead_m, s_sel, 1, 0)
+                okSl = _dot(lead_m, s_sel, 1, 0) > 0.5
+                Cl_sel = _dot(C_l * tm_f, t_sel, 1, 0)
+                Vpl = jnp.where(
+                    okSl & okT, Al_sel + Cl_sel, jnp.full_like(Al_sel, BIG)
+                )
+                vminpl = jnp.min(Vpl, axis=0, keepdims=True)
+                vargpl = lax.argmin(
+                    Vpl, axis=0, index_dtype=jnp.int32
+                ).reshape(1, B2)
+                onehot_wpl = (iota_sub_t[:, :1] == vargpl).astype(f32)
+                wwl = jnp.sum(wl * onehot_wpl, axis=0, keepdims=True)
+                betterpl = vminpl < bv_pl
+                bv_pl = jnp.where(betterpl, vminpl, bv_pl)
+                bp_pl = jnp.where(betterpl, off + vargpl, bp_pl)
+                pay_pl = jnp.where(betterpl, wwl, pay_pl)  # [1, B2] (wl)
+
+            return (
+                bestv, bestp, bestpay, bestv_l, bestp_l, bestpay_l,
+                bv_pf, bp_pf, pay_pf, bv_pl, bp_pl, pay_pl,
+            )
 
         bestv0 = jnp.full((1, B), BIG, f32)
         bestp0 = jnp.zeros((1, B), jnp.int32)
         pay0 = jnp.zeros((3, B), f32)
         pay0_l = jnp.zeros((2, B), f32)
-        bestv, bestp, bestpay, bestv_l, bestp_l, bestpay_l = lax.fori_loop(
+        bv0_p = jnp.full((1, B2), BIG, f32)
+        bp0_p = jnp.zeros((1, B2), jnp.int32)
+        pay0_pf = jnp.zeros((2, B2), f32)
+        pay0_pl = jnp.zeros((1, B2), f32)
+        (bestv, bestp, bestpay, bestv_l, bestp_l, bestpay_l,
+         bv_pf, bp_pf, pay_pf, bv_pl, bp_pl, pay_pl) = lax.fori_loop(
             jnp.int32(0), jnp.int32(P // TILE_P), tile_body,
-            (bestv0, bestp0, pay0, bestv0, bestp0, pay0_l)
+            (bestv0, bestp0, pay0, bestv0, bestp0, pay0_l,
+             bv0_p, bp0_p, pay0_pf, bv0_p, bp0_p, pay0_pl)
         )
         # global leader-vs-follower merge, strict < (follower wins ties)
         lead = bestv_l < bestv
@@ -351,122 +463,186 @@ def _kernel(
             cs = bestpay[1, :].astype(jnp.int32)
             cdelta = bestpay[2, :]
 
+        # ---- pair winners: leader-vs-follower merge + payloads ----------
+        if allow_leader:
+            leadp = bv_pl < bv_pf  # strict: follower wins ties
+            bvp = jnp.where(leadp, bv_pl, bv_pf)[0, :]
+            cp_p = jnp.where(leadp, bp_pl, bp_pf)[0, :]
+            cslot_p = jnp.where(
+                leadp[0, :], jnp.int32(0), pay_pf[0, :].astype(jnp.int32)
+            )
+            cdelta_p = jnp.where(leadp[0, :], pay_pl[0, :], pay_pf[1, :])
+        else:
+            bvp = bv_pf[0, :]
+            cp_p = bp_pf[0, :]
+            cslot_p = pay_pf[0, :].astype(jnp.int32)
+            cdelta_p = pay_pf[1, :]
+        vals_p = jnp.where(live_p, su + bvp, jnp.full_like(bvp, BIG))
+
+        # ---- the union pool, K = B + B//2 lanes -------------------------
+        # lane CONCATENATION via one-hot matmuls: jnp.concatenate of 1-D
+        # lane vectors at a non-tile-aligned offset (B + B2) crashes
+        # Mosaic's layout inference ("Check failed: offsets_[0] <
+        # tiling_[0]"); placing each part with an exact one-hot
+        # contraction sidesteps the layout entirely
+        krow = lax.broadcasted_iota(jnp.int32, (1, K), 1).astype(f32)
+        M1 = (bcol == krow).astype(f32)  # [B, K] lanes 0..B-1
+        M2 = (bcol[:B2, :] == (krow - jnp.asarray(B, f32))).astype(f32)
+
+        def cat(vt, vp):  # [B] lanes ++ [B2] lanes -> [K] lanes (exact)
+            return (
+                _dot(vt.reshape(1, B), M1, 1, 0)
+                + _dot(vp.reshape(1, B2), M2, 1, 0)
+            )[0, :]
+
+        vals_u = cat(vals, vals_p)
+        cp_uf = cat(cp.astype(f32), cp_p.astype(f32))
+        cslot_uf = cat(cslot.astype(f32), cslot_p.astype(f32))
+        cs_uf = cat(cs.astype(f32), s_pair)
+        ct_uf = cat(lane_b[0, :].astype(f32), t_pair)
+        w_u = cat(cdelta, cdelta_p)
+        cp_u = cp_uf.astype(jnp.int32)
+        cslot_u = cslot_uf.astype(jnp.int32)
+        ct_u = ct_uf.astype(jnp.int32)
+        cs_u = cs_uf.astype(jnp.int32)
+
         # scalar extraction from lane vectors via masked reduction (vector
         # dynamic-slice along lanes is not portable Mosaic)
-        def ext_i(vec, i):
+        lane_k = lax.broadcasted_iota(jnp.int32, (1, K), 1)  # [1, K]
+
+        def ext_k(vec, i):
             # exactly one lane matches and all extracted values are >= 0;
             # max does not promote the accumulator dtype (integer sums
             # would upcast to unsupported int64 under global x64)
-            return jnp.max(jnp.where(lane_b[0, :] == i, vec, jnp.zeros_like(vec)))
+            return jnp.max(jnp.where(lane_k[0, :] == i, vec, jnp.zeros_like(vec)))
 
         # ---- improvement + churn gate -----------------------------------
-        improving = (vals < su - min_unb) & (vals < su) & (bestv[0, :] < BIG * 0.5)
-        best_gain = su - jnp.min(vals)
-        improving &= (su - vals) * churn >= best_gain
+        improving = (
+            (vals_u < su - min_unb) & (vals_u < su) & (vals_u < BIG * 0.5)
+        )
+        best_gain = su - jnp.min(vals_u)
+        improving &= (su - vals_u) * churn >= best_gain
 
-        # ---- pairwise first-claimant disjointness [B, B] ----------------
-        # row j = earlier candidate, col i = later; t_j == j, t_i == i.
+        # ---- PREFIX-EXACT acceptance (mirrors scan.py body_batch) -------
+        # Order claimants by (gain, index): E[j, k] = "j strictly earlier".
         # Lane->sublane reshapes of vectors crash the Mosaic backend, so
         # column versions are produced with an MXU transpose (eye @ row);
-        # values are exact in f32 (p < 2^24, brokers < 2^24)
-        iota2_r = lax.broadcasted_iota(jnp.int32, (B, B), 0)  # row index j
-        iota2_c = lax.broadcasted_iota(jnp.int32, (B, B), 1)  # col index i
-        eye = (iota2_r == iota2_c).astype(f32)
+        # values are exact in f32 (p < 2^24, brokers < 2^24, w < 2^24)
+        iotaK_r = lax.broadcasted_iota(jnp.int32, (K, K), 0)
+        iotaK_c = lax.broadcasted_iota(jnp.int32, (K, K), 1)
+        eyeK = (iotaK_r == iotaK_c).astype(f32)
 
-        def to_col(vec_f32):  # [B] lanes -> [B, 1] sublanes
+        def to_colK(vec_f32):  # [K] lanes -> [K, 1] sublanes
             return jax.lax.dot_general(
-                eye,
-                vec_f32.reshape(1, B),
+                eyeK,
+                vec_f32.reshape(1, K),
                 dimension_numbers=(((1,), (1,)), ((), ())),
                 preferred_element_type=f32,
                 precision=jax.lax.Precision.HIGHEST,
             )
 
-        cpf = cp.astype(f32)
-        csf = cs.astype(f32)
-        pj = to_col(cpf)  # [B, 1]
-        sj = to_col(csf)
-        pi = cpf.reshape(1, B)
-        si = csf.reshape(1, B)
-        tif = lane_b.astype(f32)  # [1, B]
-        tjf = iota2_r.astype(f32)[:, :1]  # [B, 1] row indices as f32
-        conflict = (pj == pi) | (sj == si) | (sj == tif) | (tjf == si)
-        earlier = iota2_r < iota2_c
-        imp_col = to_col(jnp.where(improving, jnp.ones(B, f32), jnp.zeros(B, f32))) > 0.5
-        blocked = (
-            jnp.max(
-                (earlier & imp_col & conflict).astype(f32), axis=0
-            )
-            > 0.5
-        )  # [B]
-        ok = improving & ~blocked
+        lane_kf = krow[0, :]  # [K] float candidate iota
+        vcol = to_colK(vals_u)
+        vrow = vals_u.reshape(1, K)
+        kcol = to_colK(lane_kf)
+        krow = lane_kf.reshape(1, K)
+        E = (vcol < vrow) | ((vcol == vrow) & (kcol < krow))  # [K, K]
+        Ef = E.astype(f32)
 
-        # ---- budget/batch cap via triangular cumsum ---------------------
-        tri = (iota2_r <= iota2_c).astype(f32)  # cols accumulate
-        csum = jax.lax.dot_general(
-            jnp.where(ok, jnp.ones(B, f32), jnp.zeros(B, f32)).reshape(1, B),
-            tri,
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=f32,
-            precision=jax.lax.Precision.HIGHEST,
-        ).reshape(B).astype(jnp.int32)  # inclusive cumsum over candidates
-        pos = n + csum - 1
+        # partition first-claim (replica-row writes must be unique)
+        onesK = jnp.ones(K, f32)
+        zerosK = jnp.zeros(K, f32)
+        imp_col = to_colK(jnp.where(improving, onesK, zerosK)) > 0.5
+        cp_uf = cp_u.astype(f32)
+        pcol = to_colK(cp_uf)
+        prow = cp_uf.reshape(1, K)
+        surv = improving & ~(
+            jnp.max((E & imp_col & (pcol == prow)).astype(f32), axis=0) > 0.5
+        )
+
+        # per-broker net prefix sums over earlier survivors: each
+        # candidate's source/target load AS OF ITS TURN, so d_k is the
+        # EXACT sequential delta even when candidates share brokers
+        w_col = to_colK(w_u)
+        surv_col = to_colK(jnp.where(surv, onesK, zerosK))
+        Ejw = Ef * surv_col * w_col  # [K, K]
+        scol = to_colK(cs_uf)
+        tcol = to_colK(ct_uf)
+        srow = cs_uf.reshape(1, K)
+        trow = ct_uf.reshape(1, K)
+        to_s = (tcol == srow).astype(f32) - (scol == srow).astype(f32)
+        to_t = (tcol == trow).astype(f32) - (scol == trow).astype(f32)
+        netS = jnp.sum(Ejw * to_s, axis=0)  # [K]
+        netT = jnp.sum(Ejw * to_t, axis=0)
+
+        # loads at each candidate's source/target via one-hot contraction
+        M_s = (bcol == srow).astype(f32)  # [B, K]
+        M_t = (bcol == trow).astype(f32)
+        Ls = _dot(loads.reshape(1, B), M_s, 1, 0)[0, :] + netS  # [K]
+        Lt = _dot(loads.reshape(1, B), M_t, 1, 0)[0, :] + netT
+        d_k = (
+            _pen(Ls - w_u, avg)
+            - _pen(Ls, avg)
+            + _pen(Lt + w_u, avg)
+            - _pen(Lt, avg)
+        )
+        ok = surv & (d_k < -min_unb) & (d_k < 0.0)
+        # cut at the first survivor whose sequential delta fails — nets
+        # for later candidates would assume commits that never happen
+        fail_col = to_colK(jnp.where(surv & ~ok, onesK, zerosK))
+        ok &= ~(jnp.max(Ef * fail_col, axis=0) > 0.5)
+        # cap at the batch width and remaining budget, best-first
+        ok_col = to_colK(jnp.where(ok, onesK, zerosK))
+        pos = n + jnp.sum(Ef * ok_col, axis=0).astype(jnp.int32)  # [K]
         ok &= (pos < n + batch) & (pos < budget) & (pos < ML)
-        oki = jnp.where(ok, jnp.ones(B, jnp.int32), jnp.zeros(B, jnp.int32))
-        cnt = jnp.sum(oki.astype(f32)).astype(jnp.int32)
+        oki = jnp.where(ok, jnp.ones(K, jnp.int32), jnp.zeros(K, jnp.int32))
+        okif = jnp.where(ok, onesK, zerosK)
+        cnt = jnp.sum(okif).astype(jnp.int32)
 
-        # ---- apply: loads and bcount (vectorized) -----------------------
-        okd = jnp.where(ok, cdelta, jnp.zeros_like(cdelta))  # [B]
-        s_onehot = (sj == tif).astype(f32)  # [B, B]: s_j one-hot rows
-        sub = jax.lax.dot_general(
-            okd.reshape(1, B),
-            s_onehot,
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=f32,
-            precision=jax.lax.Precision.HIGHEST,
-        ).reshape(B)
-        loads_ref[0, :] = loads + okd - sub
-        subc = jax.lax.dot_general(
-            oki.astype(f32).reshape(1, B),
-            s_onehot,
-            dimension_numbers=(((1,), (0,)), ((), ())),
-            preferred_element_type=f32,
-            precision=jax.lax.Precision.HIGHEST,
-        ).reshape(B)
-        bcount_ref[0, :] = bcount_ref[0, :] + oki - subc.astype(jnp.int32)
+        # ---- apply: loads and bcount (vectorized one-hot scatters) ------
+        okd = jnp.where(ok, w_u, jnp.zeros_like(w_u))  # [K]
 
-        # ---- apply: member/replica rows + move logs (per commit) --------
+        def scat(vec_k, M):  # Σ_k vec_k · onehot(broker axis) -> [B]
+            return jax.lax.dot_general(
+                vec_k.reshape(1, K),
+                M,
+                dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=f32,
+                precision=jax.lax.Precision.HIGHEST,
+            ).reshape(B)
+
+        loads_ref[0, :] = loads + scat(okd, M_t) - scat(okd, M_s)
+        bcount_ref[0, :] = bcount_ref[0, :] + (
+            scat(okif, M_t) - scat(okif, M_s)
+        ).astype(jnp.int32)
+
+        # ---- apply: replica rows + move logs (per commit) ---------------
         # commits are partition-disjoint, so each touched row is written by
         # exactly one candidate
         lane_t = lax.broadcasted_iota(jnp.int32, (1, TILE_P), 1)
         sub_r = lax.broadcasted_iota(jnp.int32, (R, 1), 0)
 
         def commit(i, n_acc):
-            ok_i = ext_i(oki, i) > 0
+            ok_i = ext_k(oki, i) > 0
 
             @pl.when(ok_i)
             def _():
-                p_i = ext_i(cp, i)
-                s_i = ext_i(cs, i)
-                slot_i = ext_i(cslot, i)
-                at = ext_i(jnp.where(ok, pos, jnp.zeros_like(pos)), i)
+                p_i = ext_k(cp_u, i)
+                s_i = ext_k(cs_u, i)
+                slot_i = ext_k(cslot_u, i)
+                t_i = ext_k(ct_u, i)
+                at = ext_k(jnp.where(ok, pos, jnp.zeros_like(pos)), i)
                 # transposed replica write: blend one (slot, partition)
-                # cell inside the 256-aligned lane tile holding p_i; the
-                # new entry is the target broker index as exact f32
+                # cell inside the TILE_P-aligned lane tile holding p_i;
+                # the new entry is the target broker index as exact f32
                 base = lax.mul(
                     lax.div(p_i, jnp.int32(TILE_P)), jnp.int32(TILE_P)
                 )
                 p_loc = lax.rem(p_i, jnp.int32(TILE_P))
-                i_f = jnp.max(
-                    jnp.where(
-                        lane_b[0, :] == i,
-                        lanef_ref[0, :],
-                        jnp.zeros((B,), f32),
-                    )
-                )
+                t_f = ext_k(ct_uf, i)
                 tile = replicas_ref[:, pl.ds(base, TILE_P)]  # [R, T]
                 tile = jnp.where(
-                    (lane_t == p_loc) & (sub_r == slot_i), i_f, tile
+                    (lane_t == p_loc) & (sub_r == slot_i), t_f, tile
                 )
                 replicas_ref[:, pl.ds(base, TILE_P)] = tile
                 # packed log write: dynamic row + masked-lane blend (the
@@ -483,11 +659,11 @@ def _kernel(
                 logw(mp_ref, p_i)
                 logw(mslot_ref, slot_i)
                 logw(msrc_ref, s_i)
-                logw(mtgt_ref, i)
+                logw(mtgt_ref, t_i)
 
             return n_acc
 
-        lax.fori_loop(jnp.int32(0), jnp.int32(B), commit, jnp.int32(0))
+        lax.fori_loop(jnp.int32(0), jnp.int32(K), commit, jnp.int32(0))
 
         return n + cnt, cnt == 0
 

@@ -401,3 +401,172 @@ def test_leader_plan_chunk_reentry():
             [(p.topic, p.partition, tuple(p.replicas)) for p in pl.iter_partitions()],
         )
     assert res[2] == res[8192]
+
+
+def _pen(load, avg):
+    rel = load / avg - 1.0
+    return rel * rel * (1.0 if rel > 0 else 0.5)
+
+
+def test_prefix_accept_sequential_exactness():
+    """Direct invariant test for the shared acceptance core: replaying
+    the accepted moves ONE AT A TIME in log order must (a) strictly
+    improve the objective at every step by more than min_unbalance,
+    (b) end with exactly the loads the batch application computes, and
+    (c) always accept the rank-0 candidate when it improves. Candidates
+    deliberately share sources and targets so the per-broker net prefix
+    sums are load-bearing."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kafkabalancer_tpu.solvers.scan import prefix_accept
+
+    rng = random.Random(4242)
+    B, K = 8, 24
+    min_unb = 1e-9
+    for trial in range(20):
+        loads = np.array([rng.uniform(1.0, 10.0) for _ in range(B)])
+        avg = loads.sum() / B
+        su = sum(_pen(x, avg) for x in loads)
+        p = np.array([rng.randrange(1000) for _ in range(K)], np.int32)
+        s_ = np.array([rng.randrange(B) for _ in range(K)], np.int32)
+        t = np.array(
+            [(s + 1 + rng.randrange(B - 1)) % B for s in s_], np.int32
+        )
+        w = np.array([rng.uniform(0.01, 2.0) for _ in range(K)])
+        # plain deltas as the scorers produce them (A + C form)
+        vals = np.array(
+            [
+                su
+                + (_pen(loads[s_[k]] - w[k], avg) - _pen(loads[s_[k]], avg))
+                + (_pen(loads[t[k]] + w[k], avg) - _pen(loads[t[k]], avg))
+                for k in range(K)
+            ]
+        )
+        ok, pos, cnt = prefix_accept(
+            jnp.asarray(vals), jnp.asarray(p), jnp.asarray(s_),
+            jnp.asarray(t), jnp.asarray(w), jnp.asarray(loads),
+            jnp.asarray(avg), jnp.asarray(su), jnp.asarray(min_unb),
+            jnp.asarray(1e9), jnp.int32(0), jnp.int32(K), jnp.int32(K),
+            K,
+        )
+        ok = np.asarray(ok)
+        pos = np.asarray(pos)
+        # (c) the global best candidate is accepted iff it improves
+        best = int(np.argmin(vals))
+        if vals[best] < su - min_unb:
+            assert ok[best], (trial, vals, ok)
+        else:
+            assert int(cnt) == 0
+        # accepted partitions are unique
+        acc = np.nonzero(ok)[0]
+        assert len({int(p[k]) for k in acc}) == len(acc)
+        # (a) + (b): sequential replay in log order
+        L = loads.copy()
+        prev = su
+        for k in sorted(acc, key=lambda k: pos[k]):
+            L[s_[k]] -= w[k]
+            L[t[k]] += w[k]
+            cur = sum(_pen(x, avg) for x in L)
+            assert cur < prev - min_unb, (trial, k, prev, cur)
+            prev = cur
+        batch_L = loads.copy()
+        np.add.at(batch_L, s_[acc], -w[acc])
+        np.add.at(batch_L, t[acc], w[acc])
+        assert np.allclose(L, batch_L, rtol=0, atol=1e-12)
+
+
+def test_paired_best_brute_force():
+    """paired_best's winners checked against a brute-force scan: for
+    every live pair, the reported candidate is feasible (holds a replica
+    on the hot broker, target allowed and not a member) and achieves the
+    minimum A+C over all partitions."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kafkabalancer_tpu.models import RebalanceConfig
+    from kafkabalancer_tpu.ops import cost, tensorize
+    from kafkabalancer_tpu.solvers.scan import _settle_head
+
+    rng = random.Random(77)
+    pl = random_partition_list(rng, 60, 9, weighted=True, with_consumers=True)
+    cfg = default_rebalance_config()
+    cfg.allow_leader_rebalancing = True
+    _settle_head(pl, cfg, 10)
+    dp = tensorize(pl, cfg)
+    P, R = dp.replicas.shape
+    B = dp.bvalid.shape[0]
+    w = jnp.asarray(dp.weights)
+    nc = jnp.asarray(dp.ncons, w.dtype)
+    loads = cost.broker_loads(
+        jnp.asarray(dp.replicas), w, jnp.asarray(dp.nrep_cur), nc, B
+    )
+    bvalid = jnp.asarray(dp.bvalid)
+    vals, p, slot, s_i, t_i, live = cost.paired_best(
+        loads, jnp.asarray(dp.replicas), jnp.asarray(dp.allowed),
+        jnp.asarray(dp.member), bvalid, w, jnp.asarray(dp.nrep_cur),
+        jnp.asarray(dp.nrep_tgt), nc, jnp.asarray(dp.pvalid),
+        jnp.int32(cfg.min_replicas_for_rebalancing),
+        allow_leader=True,
+    )
+    vals, p, slot = np.asarray(vals), np.asarray(p), np.asarray(slot)
+    s_i, t_i, live = np.asarray(s_i), np.asarray(t_i), np.asarray(live)
+    loads_np = np.asarray(loads)
+    nb = int(np.asarray(bvalid).sum())
+    avg = float(np.where(np.asarray(bvalid), loads_np, 0.0).sum()) / nb
+    F = np.where(
+        np.asarray(bvalid),
+        np.asarray([_pen(x, avg) for x in loads_np]),
+        0.0,
+    )
+    su = float(F.sum())
+
+    member = np.asarray(dp.member)
+    allowed = np.asarray(dp.allowed)
+    reps = np.asarray(dp.replicas)
+    ncur = np.asarray(dp.nrep_cur)
+    ntgt = np.asarray(dp.nrep_tgt)
+    ncons = np.asarray(dp.ncons)
+    pvalid = np.asarray(dp.pvalid)
+    weights = np.asarray(dp.weights)
+    minrep = cfg.min_replicas_for_rebalancing
+
+    order = sorted(range(B), key=lambda b: (loads_np[b] if bvalid[b] else np.inf, b))
+    checked = 0
+    for i in range(len(vals)):
+        if not live[i]:
+            assert vals[i] == np.inf
+            continue
+        assert order[nb - 1 - i] == s_i[i] and order[i] == t_i[i]
+        # brute-force best over all (partition, slot is implied by s_i)
+        best = np.inf
+        for q in range(P):
+            if not pvalid[q] or ntgt[q] < minrep:
+                continue
+            if not (allowed[q, t_i[i]] and not member[q, t_i[i]] and bvalid[t_i[i]]):
+                continue
+            # follower: s_i in a follower slot
+            for r in range(1, ncur[q]):
+                if reps[q, r] == s_i[i]:
+                    d = (
+                        _pen(loads_np[s_i[i]] - weights[q], avg) - F[s_i[i]]
+                        + _pen(loads_np[t_i[i]] + weights[q], avg) - F[t_i[i]]
+                    )
+                    best = min(best, d)
+            # leader with true premium
+            if ncur[q] >= 1 and reps[q, 0] == s_i[i]:
+                wl = weights[q] * (ncur[q] + ncons[q])
+                d = (
+                    _pen(loads_np[s_i[i]] - wl, avg) - F[s_i[i]]
+                    + _pen(loads_np[t_i[i]] + wl, avg) - F[t_i[i]]
+                )
+                best = min(best, d)
+        if best == np.inf:
+            assert vals[i] == np.inf
+            continue
+        assert vals[i] - su == pytest.approx(best, rel=1e-9, abs=1e-12)
+        # the reported (p, slot) realizes the value
+        q, r = int(p[i]), int(slot[i])
+        assert reps[q, r] == s_i[i]
+        checked += 1
+    assert checked > 0
