@@ -184,9 +184,10 @@ def run(i, o, e, args: List[str]) -> int:
         )
         f_batch = f.int(
             "fused-batch",
-            16,
-            "Fused mode: commit up to this many broker-disjoint moves per "
-            "device iteration (1 = strict one-move-at-a-time)",
+            128,
+            "Fused mode: commit up to this many partition-distinct moves "
+            "per device iteration, each exact via sequential-delta "
+            "acceptance (1 = strict one-move-at-a-time)",
         )
         f_engine = f.string(
             "fused-engine",
