@@ -163,8 +163,10 @@ def run(i, o, e, args: List[str]) -> int:
         )
         f_anti_coloc = f.float(
             "anti-colocation", defaults.anti_colocation,
-            "Beam solver: penalty weight for same-topic replicas sharing a "
-            "broker (0 disables)",
+            "Penalty weight for same-topic replicas sharing a broker "
+            "(0 disables). With -solver=beam: lookahead search over the "
+            "combined objective; with -fused: the colocation-aware "
+            "batched session (greedy in the combined objective)",
         )
         f_beam_siblings = f.bool(
             "beam-siblings", defaults.beam_siblings,
@@ -272,6 +274,36 @@ def run(i, o, e, args: List[str]) -> int:
             log("-fused-shard requires -fused")
             usage()
             return 3
+
+        if f_fused.value and f_anti_coloc.value > 0:
+            # the colocation session's own constraints, surfaced as flag
+            # validation instead of a planning failure
+            if f_polish.value:
+                log("-anti-colocation with -fused excludes -fused-polish")
+                usage()
+                return 3
+            if f_shard.value:
+                log("-anti-colocation with -fused excludes -fused-shard")
+                usage()
+                return 3
+            if f_rebalance_leader.value:
+                log(
+                    "-anti-colocation with -fused excludes "
+                    "-rebalance-leader"
+                )
+                usage()
+                return 3
+            if f_batch.value <= 1:
+                log("-anti-colocation with -fused requires -fused-batch>1")
+                usage()
+                return 3
+            if f_engine.value.startswith("pallas"):
+                # not an error (plan() runs the XLA colocation session),
+                # but the engine request is overridden — say so
+                log(
+                    "-anti-colocation runs the XLA colocation session; "
+                    f"-fused-engine={f_engine.value} is ignored"
+                )
 
         in_stream = i
         close_input = False
@@ -389,6 +421,7 @@ def run(i, o, e, args: List[str]) -> int:
                         batch=max(1, f_batch.value),
                         engine=f_engine.value,
                         polish=f_polish.value,
+                        anti_colocation=max(0.0, f_anti_coloc.value),
                     )
             except BalanceError as exc:
                 log(f"failed optimizing distribution: {exc}")

@@ -147,6 +147,18 @@ def move_candidate_scores(
     return jnp.where(mask, u, jnp.inf), su
 
 
+def colo_terms(c, lam):
+    """The anti-colocation delta rule, ONE definition for every scorer
+    and for the sequential-delta gate (scan.prefix_accept's ``colo_d``):
+    removing a replica from a broker holding ``c >= 2`` same-topic
+    replicas changes lam*max(0, c-1) by -lam; adding to one holding
+    ``c >= 1`` changes it by +lam. Returns ``(sub, add)``."""
+    return (
+        jnp.where(c >= 2, lam, 0.0),
+        jnp.where(c >= 1, lam, 0.0),
+    )
+
+
 def paired_best(
     loads,
     replicas,
@@ -161,6 +173,8 @@ def paired_best(
     min_replicas,
     *,
     allow_leader: bool,
+    c_rows=None,
+    lam=None,
 ):
     """Best candidate per hot/cold broker-rank PAIR.
 
@@ -184,6 +198,11 @@ def paired_best(
     exact in any dtype. The math mirrors factored_target_best term for
     term (same ``A + C`` factorization, same true-delta leader scoring),
     so XLA CSEs the shared [P, B] tensors when both run in one pass.
+
+    ``c_rows [P, B]`` (optional, with scalar ``lam``) enables the
+    anti-colocation objective exactly like factored_target_best's:
+    removing from a broker holding ≥ 2 same-topic replicas scores −λ,
+    adding to one holding ≥ 1 scores +λ.
 
     Returns ``(vals [B2], p, slot, s, t, live)`` with ``B2 = B // 2``,
     ``vals`` ABSOLUTE (su-based) and dead/ineligible pairs at +inf.
@@ -218,10 +237,18 @@ def paired_best(
         ok = jnp.dot(mask.astype(dtype), sel) > 0.5
         return jnp.where(ok, v, jnp.inf)
 
+    if c_rows is not None:
+        colo_sub, colo_add = colo_terms(c_rows, lam)
+    else:
+        colo_sub = colo_add = None
+
     # follower pass (same terms as factored_target_best)
     srcmask_f = member & ~lead_oh & eligible[:, None]
     A_f = overload_penalty(loads[None, :] - w, avg) - F[None, :]
     C_f = overload_penalty(loads[None, :] + w, avg) - F[None, :]
+    if colo_sub is not None:
+        A_f = A_f - colo_sub
+        C_f = C_f + colo_add
     Vp = cols(A_f, srcmask_f, s_sel) + cols(C_f, tmask, t_sel)  # [P, B2]
     p_f = lax.argmin(Vp, 0, jnp.int32)
     vals_f = jnp.min(Vp, axis=0)
@@ -231,6 +258,9 @@ def paired_best(
         ok_l = (nrep_cur >= 1) & eligible
         A_l = overload_penalty(loads[None, :] - wl[:, None], avg) - F[None, :]
         C_l = overload_penalty(loads[None, :] + wl[:, None], avg) - F[None, :]
+        if colo_sub is not None:
+            A_l = A_l - colo_sub
+            C_l = C_l + colo_add
         Vp_l = cols(A_l, lead_oh & ok_l[:, None], s_sel) + cols(
             C_l, tmask, t_sel
         )
@@ -403,8 +433,7 @@ def factored_target_best(
     )[None, :]
 
     if c_rows is not None:
-        colo_sub = jnp.where(c_rows >= 2, lam, 0.0)  # removing from b
-        colo_add = jnp.where(c_rows >= 1, lam, 0.0)  # adding to b
+        colo_sub, colo_add = colo_terms(c_rows, lam)
     else:
         colo_sub = colo_add = None
 

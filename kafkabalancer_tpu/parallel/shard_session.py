@@ -439,6 +439,12 @@ def plan_sharded(
     from kafkabalancer_tpu.models.partition import empty_partition_list
     from kafkabalancer_tpu.ops import tensorize
     from kafkabalancer_tpu.ops.runtime import next_bucket
+
+    if getattr(cfg, "anti_colocation", 0.0):
+        raise ValueError(
+            "the sharded session has no colocation state; use "
+            "solvers.scan.plan(anti_colocation=...) single-device"
+        )
     from kafkabalancer_tpu.solvers.scan import (
         _cfg_broker_mask,
         _decode_packed,

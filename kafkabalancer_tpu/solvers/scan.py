@@ -79,6 +79,7 @@ def auto_chunk_moves(npart: int) -> int:
 def prefix_accept(
     vals, p, s_, t, w_k, loads, avg, su,
     min_unbalance, churn_gate, n, batch, budget, max_moves,
+    topic=None, colo_d=None,
 ):
     """PREFIX-EXACT batched-commit acceptance over a candidate pool.
 
@@ -101,6 +102,15 @@ def prefix_accept(
     and ``parallel.shard_session`` (the Pallas whole-session kernel
     re-derives it in kernel form) so the acceptance order cannot drift
     between engines.
+
+    ``topic``/``colo_d`` (both [K], together) extend the exactness
+    contract to the anti-colocation objective: ``colo_d`` is each
+    candidate's colocation delta ±λ computed from pass-START counts, and
+    it stays exact under batching because same-TOPIC claimants whose
+    broker sets intersect are first-claimed like partitions — no two
+    accepted moves this pass touch the same (topic, broker) cell, so no
+    accepted move can invalidate another's colocation constant. ``d_k``
+    then scores the COMBINED objective (load delta + colo_d).
     """
     dtype = loads.dtype
     K = vals.shape[0]
@@ -120,7 +130,21 @@ def prefix_accept(
         (vals[:, None] == vals[None, :]) & (kk[:, None] < kk[None, :])
     )
     samep = p[:, None] == p[None, :]
-    surv = improving & ~jnp.any(E & improving[:, None] & samep, axis=0)
+    claimed = E & improving[:, None] & samep
+    if topic is not None:
+        # (topic, broker) first-claim: an earlier same-topic claimant
+        # sharing either broker would change this candidate's colocation
+        # counts mid-pass — its ±λ constant is only exact if no accepted
+        # earlier move touches its (topic, s/t) cells
+        sametopic = topic[:, None] == topic[None, :]
+        bshare = (
+            (s_[:, None] == s_[None, :])
+            | (s_[:, None] == t[None, :])
+            | (t[:, None] == s_[None, :])
+            | (t[:, None] == t[None, :])
+        )
+        claimed |= E & improving[:, None] & sametopic & bshare
+    surv = improving & ~jnp.any(claimed, axis=0)
 
     Ej = (E & surv[:, None]).astype(dtype)  # [K, K] j earlier & survives
     wEj = Ej * w_k[:, None]
@@ -138,6 +162,8 @@ def prefix_accept(
         + cost.overload_penalty(Lt + w_k, avg)
         - cost.overload_penalty(Lt, avg)
     )
+    if colo_d is not None:
+        d_k = d_k + colo_d
     ok = surv & (d_k < -min_unbalance) & (d_k < 0)
     # cut at the first survivor whose sequential delta fails — nets for
     # later candidates would assume commits that never happen
@@ -164,7 +190,7 @@ PALLAS_VMEM_CELLS_RESTRICTED = 65536 * 128
 
 @partial(
     jax.jit,
-    static_argnames=("max_moves", "allow_leader", "batch"),
+    static_argnames=("max_moves", "allow_leader", "batch", "n_topics"),
 )
 def session(
     loads,
@@ -182,10 +208,13 @@ def session(
     min_unbalance,
     budget,
     churn_gate=DEFAULT_CHURN_GATE,
+    topic_id=None,
+    lam=None,
     *,
     max_moves: int,
     allow_leader: bool,
     batch: int = 1,
+    n_topics: int = 0,
 ):
     """Run up to ``min(budget, max_moves)`` accepted moves on device.
 
@@ -237,6 +266,23 @@ def session(
         (member & pvalid[:, None]).astype(jnp.int32), axis=0,
         dtype=jnp.int32,
     )
+    # anti-colocation mode (n_topics > 0): per-(topic, broker) replica
+    # counts ride as incremental state exactly like beam's (solvers/
+    # beam.py); built once from the pad-masked membership, updated per
+    # commit. The combined objective is u + lam*sum(max(0, c-1)).
+    if n_topics:
+        if batch <= 1:
+            raise ValueError(
+                "the anti-colocation session requires batch > 1 "
+                "(the pooled batched selection)"
+            )
+        counts0 = (
+            jnp.zeros((n_topics, B), dtype)
+            .at[topic_id]
+            .add((member & pvalid[:, None]).astype(dtype))
+        )
+    else:
+        counts0 = jnp.zeros((1, 1), dtype)
 
     def cond(state):
         n, done = state[4], state[5]
@@ -264,7 +310,8 @@ def session(
         return u, su, perm
 
     def body_batch(state):
-        loads, replicas, member, bcount, n, done, mp, mslot, msrc, mtgt = state
+        (loads, replicas, member, bcount, n, done, mp, mslot, msrc, mtgt,
+         counts) = state
 
         # Candidate pool = per-TARGET winners ∪ hot/cold broker-rank PAIR
         # winners. Per-target selection alone degenerates: the global best
@@ -283,17 +330,18 @@ def session(
         bvalid = (always_valid | (bcount > 0)) & universe_valid
         nb = jnp.sum(bvalid, dtype=jnp.int32).astype(dtype)
         avg = jnp.sum(jnp.where(bvalid, loads, 0.0)) / nb
+        c_rows = counts[topic_id] if n_topics else None
         su, vals_t, p_t, slot_t = cost.factored_target_best(
             loads, replicas, allowed, member, bvalid, weights, nrep_cur,
             nrep_tgt, ncons, pvalid, nb, min_replicas,
-            allow_leader=allow_leader,
+            allow_leader=allow_leader, c_rows=c_rows, lam=lam,
         )
         t_axis = jnp.arange(B, dtype=jnp.int32)
         s_t = replicas[p_t, slot_t].astype(jnp.int32)
         vals_p, p_p, slot_p, s_p, t_p, _live = cost.paired_best(
             loads, replicas, allowed, member, bvalid, weights, nrep_cur,
             nrep_tgt, ncons, pvalid, min_replicas,
-            allow_leader=allow_leader,
+            allow_leader=allow_leader, c_rows=c_rows, lam=lam,
         )
 
         # the union pool, K = B + B//2 candidates
@@ -304,9 +352,20 @@ def session(
         t = jnp.concatenate([t_axis, t_p])
         w_k = _applied_delta(p, slot)
 
+        if n_topics:
+            # per-candidate colocation constants from pass-START counts;
+            # the (topic, broker) first-claims inside prefix_accept keep
+            # them exact for every accepted move
+            tid_k = topic_id[p]
+            sub_s, _ = cost.colo_terms(counts[tid_k, s_], lam)
+            _, add_t = cost.colo_terms(counts[tid_k, t], lam)
+            colo_d = add_t - sub_s
+        else:
+            tid_k = colo_d = None
         ok, pos, cnt = prefix_accept(
             vals, p, s_, t, w_k, loads, avg, su,
             min_unbalance, churn_gate, n, batch, budget, max_moves,
+            topic=tid_k, colo_d=colo_d,
         )
         oki = ok.astype(jnp.int32)
 
@@ -321,6 +380,12 @@ def session(
         member = member ^ (toggles > 0)
         bcount = bcount.at[s_].add(-oki).at[t].add(oki)
 
+        if n_topics:
+            okd = oki.astype(dtype)
+            counts = (
+                counts.at[tid_k, s_].add(-okd).at[tid_k, t].add(okd)
+            )
+
         logpos = jnp.where(ok, pos, max_moves)  # trash slot for rejected
         mp = mp.at[logpos].set(jnp.where(ok, p, -1))
         mslot = mslot.at[logpos].set(jnp.where(ok, slot, -1))
@@ -328,10 +393,14 @@ def session(
         mtgt = mtgt.at[logpos].set(jnp.where(ok, t, -1))
 
         n = n + cnt
-        return loads, replicas, member, bcount, n, cnt == 0, mp, mslot, msrc, mtgt
+        return (
+            loads, replicas, member, bcount, n, cnt == 0, mp, mslot, msrc,
+            mtgt, counts,
+        )
 
     def body(state):
-        loads, replicas, member, bcount, n, done, mp, mslot, msrc, mtgt = state
+        (loads, replicas, member, bcount, n, done, mp, mslot, msrc, mtgt,
+         counts) = state
         u, su, perm = _scored(loads, replicas, member, bcount)
 
         def best(mask_slots):
@@ -376,7 +445,10 @@ def session(
             (loads, replicas, member, bcount, mp, mslot, msrc, mtgt),
         )
         n = n + accept.astype(n.dtype)
-        return loads, replicas, member, bcount, n, ~accept, mp, mslot, msrc, mtgt
+        return (
+            loads, replicas, member, bcount, n, ~accept, mp, mslot, msrc,
+            mtgt, counts,
+        )
 
     state = (
         loads,
@@ -389,8 +461,10 @@ def session(
         move_slot,
         move_src,
         move_tgt,
+        counts0,
     )
-    (loads, replicas, member, bcount, n, _done, mp, mslot, msrc, mtgt) = (
+    (loads, replicas, member, bcount, n, _done, mp, mslot, msrc, mtgt,
+     _counts) = (
         lax.while_loop(cond, body_batch if batch > 1 else body, state)
     )
     bvalid = (always_valid | (bcount > 0)) & universe_valid
@@ -462,7 +536,7 @@ def member_from(replicas, nrep_cur, pvalid, B: int):
     jax.jit,
     static_argnames=(
         "dtype", "all_allowed", "max_moves", "allow_leader", "batch",
-        "engine", "polish", "leader",
+        "engine", "polish", "leader", "n_topics",
     ),
 )
 def session_packed(
@@ -483,6 +557,8 @@ def session_packed(
     ep,
     er,
     evalid,
+    tid=None,
+    lam=None,
     *,
     dtype,
     all_allowed: bool,
@@ -492,6 +568,7 @@ def session_packed(
     engine: str = "xla",
     polish: bool = False,
     leader: bool = False,
+    n_topics: int = 0,
 ):
     """The ENTIRE per-chunk device program as ONE dispatch.
 
@@ -558,8 +635,9 @@ def session_packed(
         _replicas, _loads, n, mp, mslot, _msrc, mtgt, _su = session(
             loads, replicas, member, allowed_dev, w, nrep_cur, nrep_tgt,
             nc, pvalid, always_valid, universe_valid, min_replicas, mu,
-            budget, cg, max_moves=max_moves, allow_leader=allow_leader,
-            batch=batch,
+            budget, cg, tid, None if lam is None else lam.astype(dtype),
+            max_moves=max_moves, allow_leader=allow_leader,
+            batch=batch, n_topics=n_topics,
         )
     return _pack_log(mp, mslot, mtgt, n)
 
@@ -568,6 +646,7 @@ def _dispatch_chunk(
     dp, cfg: RebalanceConfig, chunk: int, dtype, batch: int, engine: str,
     polish: bool, leader: bool, all_allowed: bool, churn_gate: float,
     ew=None, ep=None, er=None, evalid=None,
+    tid=None, lam=None, n_topics: int = 0,
 ) -> "np.ndarray":
     """Host wrapper assembling :func:`session_packed`'s arguments from a
     DensePlan — the one call site shared by ``plan`` and ``_leader_plan``.
@@ -599,6 +678,8 @@ def _dispatch_chunk(
         ep,
         er,
         evalid,
+        tid,
+        None if lam is None else np.asarray(lam, npdt),
     )
     statics = dict(
         dtype=dtype,
@@ -609,6 +690,7 @@ def _dispatch_chunk(
         engine=engine,
         polish=polish,
         leader=leader,
+        n_topics=n_topics,
     )
     return np.asarray(
         aot.call_or_compile("session_packed", session_packed, args, statics)
@@ -836,6 +918,7 @@ def plan(
     engine: str = "xla",
     polish: bool = False,
     churn_gate: float = DEFAULT_CHURN_GATE,
+    anti_colocation: "float | None" = None,
 ) -> PartitionList:
     """Full multi-move planning session: host-side repairs, then a fused
     on-device move loop. The output accumulates live partitions in move
@@ -860,9 +943,44 @@ def plan(
     escape the single-move local optimum the reference's greedy
     neighborhood cannot (its upstream lists N-way swaps as planned but
     never built, README.md:94-100).
+
+    ``anti_colocation=λ > 0`` optimizes the COMBINED objective
+    ``u + λ·Σ_{topic,broker} max(0, c-1)`` (the same objective the beam
+    solver searches, solvers/beam.py) directly in the batched session:
+    per-(topic, broker) replica counts ride as incremental device state,
+    candidates score with the ±λ colocation terms, and the prefix-exact
+    acceptance first-claims (topic, broker) cells so every committed
+    move improves the combined objective by exactly its delta. Greedy in
+    the combined objective (no beam lookahead, no uphill sequences) at
+    session speed — the bulk phase of the anti-colocation pipeline, with
+    beam as the optional quality tail. Requires ``batch > 1``; forces
+    the XLA engine (the kernel has no colocation state); excludes
+    ``polish`` (swap/shuffle phases are colocation-blind and would undo
+    it).
     """
     if engine not in ENGINES:
         raise ValueError(f"unknown engine {engine!r}")
+    if anti_colocation is None:
+        # one source of truth with the beam solver's convention: the
+        # kwarg overrides, cfg.anti_colocation is the default
+        anti_colocation = getattr(cfg, "anti_colocation", 0.0) or 0.0
+    anti_colocation = max(0.0, anti_colocation)
+    if anti_colocation and polish:
+        raise ValueError(
+            "anti_colocation and polish are mutually exclusive (the "
+            "swap/leader-shuffle phases do not model colocation)"
+        )
+    if anti_colocation and batch <= 1:
+        raise ValueError("anti_colocation requires batch > 1")
+    if anti_colocation and cfg.rebalance_leaders:
+        raise ValueError(
+            "anti_colocation is not supported with rebalance_leaders "
+            "(the fused leader session has no colocation state)"
+        )
+    if anti_colocation:
+        # the whole-session kernel carries no colocation state; the XLA
+        # session is the colocation engine
+        engine = "xla"
     opl = empty_partition_list()
     if max_reassign <= 0:
         return opl
@@ -919,6 +1037,15 @@ def plan(
             )
         else:
             ew_np = ep_ = er_ = evalid = None
+        if anti_colocation:
+            # bucket the topic-count static so topic-cardinality drift
+            # re-uses compiled programs (counts rows past the real count
+            # just stay zero)
+            tid = dp.topic_id
+            n_topics = next_bucket(max(1, len(dp.topics)), 64)
+        else:
+            tid = None
+            n_topics = 0
         # ONE compiled program per chunk: input prep, the session, and the
         # move-log packing all fuse into a single dispatch (each separate
         # program is a full relay round trip on a cold process), and ONE
@@ -929,6 +1056,9 @@ def plan(
                 polish=polish, leader=False, all_allowed=all_allowed,
                 churn_gate=churn_gate,
                 ew=ew_np, ep=ep_, er=er_, evalid=evalid,
+                tid=tid,
+                lam=anti_colocation if anti_colocation else None,
+                n_topics=n_topics,
             )
         except BalanceError:
             raise

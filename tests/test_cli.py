@@ -654,3 +654,29 @@ def test_cli_byte_parity_fuzz():
         )
         assert rv_g == rv_t, (trial, flags, err_g, err_t)
         assert out_g == out_t, (trial, flags)
+
+
+def test_fused_anti_colocation():
+    """-fused -anti-colocation routes the colocation-aware batched
+    session; invalid combinations exit 3 with a diagnostic."""
+    base = [
+        "-input-json", "-input", FIXTURE, "-fused", "-fused-batch=4",
+        "-max-reassign=64", "-min-unbalance=0",
+    ]
+    rv, out, err = run_cli(base + ["-anti-colocation=0.001"])
+    assert rv == 0, err
+    assert "fused session:" in err
+
+    rv, _out, err = run_cli(
+        base + ["-anti-colocation=0.001", "-fused-polish"]
+    )
+    assert rv == 3 and "excludes -fused-polish" in err
+    rv, _out, err = run_cli(
+        base + ["-anti-colocation=0.001", "-fused-shard"]
+    )
+    assert rv == 3 and "excludes -fused-shard" in err
+    rv, _out, err = run_cli(
+        ["-input-json", "-input", FIXTURE, "-fused", "-fused-batch=1",
+         "-anti-colocation=0.001"]
+    )
+    assert rv == 3 and "requires -fused-batch>1" in err
