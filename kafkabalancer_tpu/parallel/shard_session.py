@@ -401,7 +401,7 @@ def plan_sharded(
     max_reassign: int,
     mesh: Mesh,
     dtype=None,
-    batch: int = 16,
+    batch: int = 128,
     chunk_moves: "int | None" = None,
     churn_gate: "float | None" = None,
     engine: str = "xla",

@@ -960,10 +960,21 @@ def plan(
     """
     if engine not in ENGINES:
         raise ValueError(f"unknown engine {engine!r}")
-    if anti_colocation is None:
+    explicit_colo = anti_colocation is not None
+    if not explicit_colo:
         # one source of truth with the beam solver's convention: the
-        # kwarg overrides, cfg.anti_colocation is the default
+        # kwarg overrides, cfg.anti_colocation is the default — but a
+        # cfg-derived penalty only ACTIVATES where it changes nothing
+        # for legacy callers (a beam-config cfg reused for the bulk
+        # load-session pre-phase must keep planning loads, not raise)
         anti_colocation = getattr(cfg, "anti_colocation", 0.0) or 0.0
+        if anti_colocation and (
+            polish
+            or batch <= 1
+            or cfg.rebalance_leaders
+            or engine != "xla"
+        ):
+            anti_colocation = 0.0
     anti_colocation = max(0.0, anti_colocation)
     if anti_colocation and polish:
         raise ValueError(
@@ -979,7 +990,9 @@ def plan(
         )
     if anti_colocation:
         # the whole-session kernel carries no colocation state; the XLA
-        # session is the colocation engine
+        # session is the colocation engine (an EXPLICIT pallas request
+        # is overridden — the CLI logs this; a cfg-derived penalty
+        # instead deactivates above, preserving the requested engine)
         engine = "xla"
     opl = empty_partition_list()
     if max_reassign <= 0:
