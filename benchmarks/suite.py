@@ -567,10 +567,21 @@ def config7_scale():
     tt, opl = timed(plan, pl_t, copy.deepcopy(cfg), 1 << 19,
                     dtype=jnp.float32, batch=128, engine="pallas",
                     polish=True)
+    # the engine-crossover cross-check (RESULTS.md): the XLA session
+    # now edges the kernel at THIS scale, re-measured every round (the
+    # 10k/100k crossover points in RESULTS.md are one-off A/B sweeps)
+    plan(fresh(), copy.deepcopy(cfg), 1 << 19, dtype=jnp.float32,
+         batch=128, engine="xla", polish=True)  # warm
+    pl_x = fresh()
+    tx, _opl_x = timed(plan, pl_x, copy.deepcopy(cfg), 1 << 19,
+                       dtype=jnp.float32, batch=128, engine="xla",
+                       polish=True)
     row(
         f"7: scale {n_parts // 1000}k/100 allow-leader+polish", None, None,
         tt, unbalance_of(pl_t),
-        f"{len(opl)} moves to convergence (u={unbalance_of(pl_t):.2e})",
+        f"{len(opl)} moves to convergence (u={unbalance_of(pl_t):.2e}) "
+        f"via the whole-session kernel; engine crossover cross-check: "
+        f"xla {tx:.2f}s (u={unbalance_of(pl_x):.2e})",
     )
 
 
