@@ -645,3 +645,34 @@ def test_colocation_session_validation():
         plan(pl, cfg, 10, batch=1, anti_colocation=0.1)
     with pytest.raises(ValueError, match="mutually exclusive"):
         plan(pl, cfg, 10, batch=8, anti_colocation=0.1, polish=True)
+
+
+def test_colocation_session_restricted_brokers():
+    """The colocation session honors per-partition broker restrictions:
+    every emitted assignment stays inside the partition's allowed set
+    while the combined objective still improves (with consumers, so the
+    leader premium rides the true applied delta)."""
+    rng = random.Random(909)
+    pl = random_partition_list(
+        rng, 80, 10, weighted=True, with_consumers=True,
+        restrict_brokers=True,
+    )
+    cfg = default_rebalance_config()
+    cfg.allow_leader_rebalancing = True
+    cfg.min_unbalance = 1e-9
+    lam = 0.01
+    allowed = {
+        (p.topic, p.partition): set(p.brokers or [])
+        for p in pl.iter_partitions()
+        if p.brokers
+    }
+    u0 = unbalance_of(pl) + lam * _colo_count(pl)
+    opl = plan(pl, cfg, 100000, batch=8, anti_colocation=lam)
+    u1 = unbalance_of(pl) + lam * _colo_count(pl)
+    assert u1 <= u0
+    for p in pl.iter_partitions():
+        key = (p.topic, p.partition)
+        if key in allowed and allowed[key]:
+            assert set(p.replicas).issubset(allowed[key]), (key, p.replicas)
+        assert len(set(p.replicas)) == len(p.replicas)
+    assert len(opl) >= 0
