@@ -676,3 +676,33 @@ def test_colocation_session_restricted_brokers():
             assert set(p.replicas).issubset(allowed[key]), (key, p.replicas)
         assert len(set(p.replicas)) == len(p.replicas)
     assert len(opl) >= 0
+
+
+def test_colocation_session_leader_gated_optimum_certificate():
+    """Without -allow-leader the colocation session must stop at a TRUE
+    follower-move local optimum of the combined objective: the suite's
+    exhaustive vectorized certificate (benchmarks/suite.py
+    best_follower_delta) reports a non-improving best delta at the
+    converged state."""
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_suite",
+        os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "benchmarks", "suite.py",
+        ),
+    )
+    suite = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(suite)
+
+    from kafkabalancer_tpu.utils.synth import synth_cluster
+
+    lam = 0.001
+    pl = synth_cluster(800, 20, rf=3, seed=21, weighted=True, zipf_topics=True)
+    cfg = default_rebalance_config()
+    cfg.min_unbalance = 1e-9
+    plan(pl, cfg, 100000, batch=16, anti_colocation=lam)
+    bfd = suite.best_follower_delta(pl, lam)
+    assert bfd > -cfg.min_unbalance, bfd
