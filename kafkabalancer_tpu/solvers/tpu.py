@@ -19,17 +19,24 @@ with ``f`` the asymmetric per-broker penalty (utils.go:134-143).
 candidate accumulates floats in ``bl`` order, so mathematically tied
 candidates (ubiquitous with the default weight 1.0) are separated by
 last-ulp rounding noise — behaviour an order-free vectorized reduction
-cannot reproduce. The device pass therefore returns, besides the argmin,
-the per-partition candidate minima (pure reductions — no top_k, whose TPU
-sort machinery alone was ~17 MB of compiled executable, a real cost per
-fresh process on a remote-attached device); the host flags the partitions
-whose minimum lands within tolerance of the global minimum and replays
-the ORACLE's own per-partition scan (balancer/steps.py
-``scan_partition_move`` — same bl mutation order, same
-first-strict-improver rule, steps.go:211) over just those rows. Result:
-byte-identical plans to the greedy oracle at vectorized search cost,
-falling back to the full greedy scan only when the window spans more
-partitions than the host re-scan budget (``MAX_WINDOW_CANDIDATES``).
+cannot reproduce. The device pass therefore returns the per-partition
+candidate minima (pure reductions — no top_k, whose TPU sort machinery
+alone was ~17 MB of compiled executable, a real cost per fresh process on
+a remote-attached device); the host flags the partitions whose minimum
+lands within tolerance of the global minimum and replays the ORACLE's own
+per-partition scan (balancer/steps.py ``scan_partition_move`` — same bl
+mutation order, same first-strict-improver rule, steps.go:211) over just
+those rows. Result: byte-identical plans to the greedy oracle at
+vectorized search cost.
+
+The device pass is TIERED by precision (``find_best_move``): float32
+first — the filter only has to bound the window, so f32's wider
+error-bound tolerance costs host re-scan rows, never correctness, and it
+halves-again the stored executable (f64 is software-emulated on TPU) and
+cuts the dispatch ~4x — retrying with float64's last-ulp window when the
+f32 window overflows the host re-scan budget, and falling back to the
+full greedy scan only when even the f64 window does
+(``MAX_WINDOW_CANDIDATES``).
 
 Parity semantics pinned against the greedy oracle:
 
@@ -148,19 +155,104 @@ def score_moves(
     return u_min, idx, su, perm, perpart
 
 
-def _score_window(*args, leaders: bool):
-    """``score_moves`` with everything the host tie-resolution needs
-    packed into ONE float64 array device-side — each separate fetch pays
-    a full relay round trip on a remote-attached TPU, and the single-move
-    path is the reference's per-invocation deployment unit (one move per
-    CLI run, README.md:21-33). Layout: ``[u_min, su, perpart_min...]``."""
-    u_min, _idx, su, _perm, perpart = score_moves(
-        *args, leaders=leaders, tie_k=1
+def _score_window(ints, floats, allowed, *, leaders: bool,
+                  all_allowed: bool):
+    """``score_moves`` with the transfer layout of the stateless per-move
+    deployment unit (one move per CLI run, README.md:21-33): on a
+    remote-attached TPU every device_put and every fetch pays a full
+    relay round trip, so the eleven logical inputs pack into TWO host
+    arrays and the three outputs into ONE.
+
+    ``ints [P, R+3]`` carries ``replicas | nrep_cur | nrep_tgt | pvalid``;
+    ``floats [P+B+2]`` carries ``weights | loads | nb | min_replicas``
+    (scalars ride in the tail: a separate device_put per scalar is a
+    relay round trip, and a static would fork a multi-MB stored
+    executable per config value) and its dtype selects the scoring
+    precision (see ``find_best_move``'s tier ladder).
+    The ``[P, B]`` membership mask is recomputed from the replica matrix
+    on device and the allowed matrix is the validity-row broadcast in the
+    default all-allowed case (``allowed=None``), so neither [P, B] input
+    is ever transferred. Output: ``[u_min, su, perpart_min...]``.
+    """
+    P, W = ints.shape
+    R = W - 3
+    replicas = ints[:, :R]
+    nrep_cur = ints[:, R]
+    nrep_tgt = ints[:, R + 1]
+    pvalid = ints[:, R + 2] > 0
+    B = floats.shape[0] - P - 2
+    weights = floats[:P]
+    loads = floats[P : P + B]
+    nb = floats[P + B]
+    min_replicas = floats[P + B + 1].astype(jnp.int32)
+    # tensorize packs the real brokers contiguously (bvalid[:nb])
+    bvalid = jnp.arange(B, dtype=jnp.int32) < nb.astype(jnp.int32)
+    slot = jnp.arange(R, dtype=jnp.int32)[None, :]
+    valid = (slot < nrep_cur[:, None]) & pvalid[:, None]
+    member = jnp.any(
+        (replicas[:, :, None] == jnp.arange(B, dtype=replicas.dtype))
+        & valid[:, :, None],
+        axis=1,
     )
+    # Factored per-partition minima: the rank-1 objective decomposes as
+    # u = su + A(p, slot) + C(p, target) (move_candidate_scores docstring),
+    # so min over a partition's candidates is min_slot A + min_target C —
+    # [P, R] + [P, B] work. The [P, R, B] tensor and the (load, ID) broker
+    # sort exist only for exact candidate ORDER, which the host oracle
+    # rescan supplies; dropping both here shrinks the stored executable
+    # ~3x and the dispatch with it (score_moves keeps the full form for
+    # the argmin consumers: shard_move, the graft entry, tests).
+    avg = jnp.sum(jnp.where(bvalid, loads, 0.0)) / nb
+    F = jnp.where(bvalid, cost.overload_penalty(loads, avg), 0.0)
+    su = jnp.sum(F)
+    w = weights[:, None]
+    s = jnp.clip(replicas, 0)
+    movable = (slot == 0) if leaders else (slot >= 1)
+    srcok = (
+        movable
+        & valid
+        & (nrep_tgt >= min_replicas)[:, None]
+    )
+    A = cost.overload_penalty(loads[s] - w, avg) - F[s]
+    Amin = jnp.min(jnp.where(srcok, A, jnp.inf), axis=1)
+    tmask = ~member & bvalid[None, :] if all_allowed else (
+        allowed & ~member & bvalid[None, :]
+    )
+    C = cost.overload_penalty(loads[None, :] + w, avg) - F[None, :]
+    Cmin = jnp.min(jnp.where(tmask, C, jnp.inf), axis=1)
+    perpart = su + Amin + Cmin
+    u_min = jnp.min(perpart)
     return jnp.concatenate([u_min.reshape(1), su.reshape(1), perpart])
 
 
-_score_window_jit = jax.jit(_score_window, static_argnames=("leaders",))
+_score_window_jit = jax.jit(
+    _score_window, static_argnames=("leaders", "all_allowed")
+)
+
+
+def _pack_window_args(dp: DensePlan, loads_np, cfg: RebalanceConfig):
+    """The window scorer's transfer layout (see ``_score_window``), in ONE
+    place shared by ``find_best_move`` and the layout parity test —
+    returns ``(ints, floats64, allowed_or_None, all_allowed)``; the caller
+    casts ``floats64`` to the tier's dtype."""
+    ints = np.concatenate(
+        [
+            dp.replicas,
+            dp.nrep_cur[:, None],
+            dp.nrep_tgt[:, None],
+            dp.pvalid[:, None].astype(np.int32),
+        ],
+        axis=1,
+    ).astype(np.int32)
+    floats64 = np.concatenate(
+        [
+            dp.weights,
+            loads_np,
+            [float(dp.nb), float(cfg.min_replicas_for_rebalancing)],
+        ]
+    )
+    all_allowed = bool(dp.allowed[:, : dp.nb].all(axis=1)[: dp.np_].all())
+    return ints, floats64, None if all_allowed else dp.allowed, all_allowed
 
 
 def _oracle_loads(pl: PartitionList, cfg: RebalanceConfig):
@@ -202,35 +294,52 @@ def find_best_move(
     # deployment unit) skips tracing and compilation entirely on a hit
     from kafkabalancer_tpu.ops import aot
 
-    args = (
-        loads_np,
-        dp.replicas,
-        dp.allowed,
-        dp.member,
-        dp.weights,
-        dp.nrep_cur,
-        dp.nrep_tgt,
-        dp.pvalid,
-        dp.bvalid,
-        float(nb),
-        int(cfg.min_replicas_for_rebalancing),
+    ints, floats64, allowed_arg, all_allowed = _pack_window_args(
+        dp, loads_np, cfg
     )
-    statics = dict(leaders=leaders)
-    f_out = np.asarray(
-        aot.call_or_compile("score_window", _score_window_jit, args, statics)
-    )
-    u_min, su_dev, perpart = float(f_out[0]), float(f_out[1]), f_out[2:]
-    if not np.isfinite(u_min):  # no candidate, or NaN objective (zero loads)
-        return None
+    statics = dict(leaders=leaders, all_allowed=all_allowed)
 
-    # --- host-exact tie resolution (module docstring) --------------------
-    # flag every partition whose best candidate lands in the tolerance
-    # window of the global minimum; the device values are f64 rank-1
-    # scores, so the tolerance covers both their accumulation-order drift
-    # vs the oracle AND genuine last-ulp ties
-    tol = 1e-9 * max(1.0, abs(u_min), abs(su_dev)) + 1e-12
-    rows = np.nonzero(perpart <= u_min + tol)[0]
-    if len(rows) * R * nb > MAX_WINDOW_CANDIDATES:
+    # --- tiered device scoring: f32 filter, f64 on window overflow -------
+    # The device pass only FILTERS candidates; acceptance and ordering are
+    # decided by the host-exact oracle rescan below, so precision buys
+    # nothing but a narrower window. float32 halves the executable (f64 is
+    # software-emulated on TPU: 12.1 -> 6.4 MB measured at 10k x 100, a
+    # real per-fresh-process upload cost on a remote-attached device) and
+    # cuts the dispatch ~4x (0.63 -> 0.17 s). Its window tolerance bounds
+    # the f32 scorer's error at 4·B·eps32·scale — a summation-error bound
+    # with ~100x margin over the drift measured vs f64 at the flagship
+    # scale — and a window that overflows the host re-scan budget retries
+    # with the f64 scorer's last-ulp window before giving up to greedy.
+    rows = None
+    for npdt in (np.float32, np.float64):
+        args = (ints, floats64.astype(npdt), allowed_arg)
+        f_out = np.asarray(
+            aot.call_or_compile(
+                "score_window", _score_window_jit, args, statics
+            )
+        )
+        u_min, su_dev = float(f_out[0]), float(f_out[1])
+        perpart = f_out[2:]
+        if not np.isfinite(u_min):
+            # no candidate, or NaN objective (zero loads) — but only the
+            # f64 tier may conclude that: loads representable in f64 can
+            # underflow the f32 cast to a spurious 0/0 NaN, and the
+            # pre-tiering scorer (always f64) handled such inputs
+            if npdt is np.float64:
+                return None
+            continue
+        if npdt is np.float32:
+            tol = (
+                4.0 * B * float(np.finfo(np.float32).eps)
+                * max(abs(u_min), abs(su_dev))
+            )
+        else:
+            tol = 1e-9 * max(1.0, abs(u_min), abs(su_dev)) + 1e-12
+        cand = np.nonzero(perpart <= u_min + tol)[0]
+        if len(cand) * R * nb <= MAX_WINDOW_CANDIDATES:
+            rows = cand
+            break
+    if rows is None:
         raise TieOverflow
 
     # replay the ORACLE's own per-partition scan over just the flagged

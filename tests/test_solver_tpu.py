@@ -191,6 +191,69 @@ def test_tiny_instance_host_fallback_still_identical(monkeypatch):
     assert ppl == ppl_g
 
 
+@pytest.mark.parametrize("leaders", [False, True])
+@pytest.mark.parametrize("dtype", ["float32", "float64"])
+def test_score_window_matches_score_moves_minima(leaders, dtype):
+    """The packed window scorer's factored per-partition minima
+    (su + min_slot A + min_target C — no [P, R, B] tensor on device) must
+    equal the full candidate tensor's per-partition minima from
+    ``score_moves`` in the same dtype, for both precision tiers."""
+    import numpy as np
+
+    from kafkabalancer_tpu.balancer import costmodel
+    from kafkabalancer_tpu.balancer.steps import fill_defaults
+    from kafkabalancer_tpu.ops.tensorize import tensorize
+
+    rng = random.Random(321)
+    npdt = np.dtype(dtype)
+    for case in range(4):
+        pl = random_partition_list(
+            rng, rng.randint(4, 20), rng.randint(3, 7),
+            max_rf=3, weighted=True, with_consumers=True,
+            restrict_brokers=(case % 2 == 1),
+        )
+        cfg = default_rebalance_config()
+        fill_defaults(pl, cfg)
+        dp = tensorize(pl, cfg)
+        loads_map = tpu_solver._oracle_loads(pl, cfg)
+        B = dp.bvalid.shape[0]
+        loads = np.zeros(B, dtype=np.float64)
+        for bid, load in loads_map.items():
+            loads[dp.broker_index(bid)] = load
+
+        ints, floats64, allowed_arg, all_allowed = (
+            tpu_solver._pack_window_args(dp, loads, cfg)
+        )
+        out = np.asarray(
+            tpu_solver._score_window_jit(
+                ints, floats64.astype(npdt), allowed_arg,
+                leaders=leaders, all_allowed=all_allowed,
+            )
+        )
+        u_min, su, perpart = float(out[0]), float(out[1]), out[2:]
+
+        ref = tpu_solver.score_moves(
+            loads.astype(npdt), dp.replicas, dp.allowed, dp.member,
+            dp.weights.astype(npdt), dp.nrep_cur, dp.nrep_tgt, dp.pvalid,
+            dp.bvalid, npdt.type(dp.nb),
+            int(cfg.min_replicas_for_rebalancing),
+            leaders=leaders, tie_k=1,
+        )
+        ref_umin, ref_su, ref_pp = (
+            float(ref[0]), float(ref[2]), np.asarray(ref[4])
+        )
+        tol = 1e-5 if dtype == "float32" else 1e-12
+        scale = max(1.0, abs(su))
+        assert abs(su - ref_su) <= tol * scale
+        if np.isfinite(ref_umin) or np.isfinite(u_min):
+            assert abs(u_min - ref_umin) <= tol * scale
+        finite = np.isfinite(ref_pp)
+        assert np.array_equal(finite, np.isfinite(perpart))
+        assert np.allclose(
+            perpart[finite], ref_pp[finite], rtol=0, atol=tol * scale
+        )
+
+
 def test_duplicate_topic_partition_parity():
     """Duplicate topic+partition entries are legal input (that is what
     -unique exists for); apply_assignment matches by object identity, so
