@@ -413,6 +413,33 @@ def config4b_beam_scale():
     bfd = best_follower_delta(pl_nl, lam)
     assert bfd > -cfg.min_unbalance, bfd
 
+    # r5: the full composition the r4 verdict asked for — the combined
+    # objective THROUGH the sharded session (-fused-shard) with the
+    # colocation-aware polish tail. Floor certificate: the sharded+polish
+    # run must land on the same colocation count as the single-chip
+    # session (the pigeonhole floor on this instance) while the load
+    # objective reaches the polish-grade regime.
+    import jax as _jax
+
+    from kafkabalancer_tpu.parallel.mesh import make_mesh
+    from kafkabalancer_tpu.parallel.shard_session import plan_sharded
+
+    ndev = len(_jax.devices())
+    mesh = make_mesh(ndev, shape=(1, ndev))
+
+    def colo_shard(pl):
+        return plan_sharded(
+            pl, copy.deepcopy(cfg_al), 1 << 19, mesh, batch=128,
+            dtype=jnp.float32, anti_colocation=lam, polish=True,
+        )
+
+    colo_shard(fresh())  # warm
+    pl_sp = fresh()
+    tsp, opl_sp = timed(colo_shard, pl_sp)
+    u_sp = unbalance_of(pl_sp)
+    coloc_sp = colocations(pl_sp)
+    assert coloc_sp == colocations(pl_b), (coloc_sp, colocations(pl_b))
+
     def hybrid(pl):
         plan(pl, copy.deepcopy(cfg_g), 1 << 16, dtype=jnp.float32,
              batch=128, engine=os.environ.get("BENCH_ENGINE", "pallas"))
@@ -436,7 +463,10 @@ def config4b_beam_scale():
         f"no-leader session {obj_nl:.3f} ({colocations(pl_nl)} coloc, "
         f"{len(opl_nl)} moves) in {tn:.2f}s — a TRUE leader-gated "
         f"optimum (best follower-move delta {bfd:+.2e}, re-verified "
-        f"every run), matched by the session+beam pipeline cross-check "
+        f"every run); sharded+polish composition (S={ndev}): "
+        f"{coloc_sp} coloc (floor cert ==session, re-asserted) at "
+        f"u={u_sp:.2e} in {tsp:.2f}s/{len(opl_sp)} moves; "
+        f"matched by the session+beam pipeline cross-check "
         f"{obj_h:.3f} ({colocations(pl_h)} coloc) in {th:.1f}s/"
         f"{len(opl_h)} beam moves; "
         f"CPU greedy: {n_g} moves in {tg:.1f}s (~{tg / max(n_g, 1):.1f} "

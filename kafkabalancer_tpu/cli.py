@@ -275,17 +275,18 @@ def run(i, o, e, args: List[str]) -> int:
             usage()
             return 3
 
+        if f_fused.value and f_engine.value not in ENGINES:
+            # validated HERE, before the device-warmup thread below: a
+            # flag-error exit must not pay (or hang on) backend attach
+            log(f"unknown fused engine {f_engine.value!r}")
+            usage()
+            return 3
+
         if f_fused.value and f_anti_coloc.value > 0:
             # the colocation session's own constraints, surfaced as flag
-            # validation instead of a planning failure
-            if f_polish.value:
-                log("-anti-colocation with -fused excludes -fused-polish")
-                usage()
-                return 3
-            if f_shard.value:
-                log("-anti-colocation with -fused excludes -fused-shard")
-                usage()
-                return 3
+            # validation instead of a planning failure (-fused-polish and
+            # -fused-shard both compose: the polish alternation and the
+            # sharded session carry the colocation state)
             if f_rebalance_leader.value:
                 log(
                     "-anti-colocation with -fused excludes "
@@ -316,14 +317,19 @@ def run(i, o, e, args: List[str]) -> int:
             # inside the solve path. Started only after the -help and
             # flag-validation early returns, and never for the greedy
             # parity path, which must not pay backend init at all.
-            # Deliberately NON-daemon: paths that exit without touching
-            # the device (input-open/codec failures, tiny instances the
-            # solver routes to the host scan) must not tear down the
-            # interpreter mid-backend-init — native client threads dying
-            # under finalization can corrupt the exit-code contract the
-            # supervision loop parses — so the interpreter joins the
-            # thread at exit instead (the join only costs on paths that
-            # never used the device, and locally backend init is ms).
+            # Daemon + a BOUNDED exit-time join: paths that exit without
+            # touching the device (input-open/codec failures, tiny
+            # instances the solver routes to the host scan) should not
+            # tear down the interpreter mid-backend-init — native client
+            # threads dying under finalization can corrupt the exit-code
+            # contract the supervision loop parses — so exit waits for
+            # the attach, but only up to a deadline: an unbounded
+            # non-daemon join turned a WEDGED relay (TCP blackhole — no
+            # exception, ever) into an infinite hang on pure flag-error
+            # exits (r5 review). Healthy attach completes in ~1.3 s
+            # remote / ms local; past the deadline the backend is
+            # presumed hung in a syscall, where teardown is safe.
+            import atexit
             import threading
 
             def _warm_device():
@@ -335,7 +341,9 @@ def run(i, o, e, args: List[str]) -> int:
                 except Exception:
                     pass  # no backend: solvers surface their own errors
 
-            threading.Thread(target=_warm_device, daemon=False).start()
+            _warm = threading.Thread(target=_warm_device, daemon=True)
+            _warm.start()
+            atexit.register(_warm.join, 30.0)
 
         in_stream = i
         close_input = False
@@ -410,10 +418,6 @@ def run(i, o, e, args: List[str]) -> int:
                     "session (leadership redistribution has no swap "
                     "neighborhood); ignoring it"
                 )
-            if f_engine.value not in ENGINES:
-                log(f"unknown fused engine {f_engine.value!r}")
-                usage()
-                return 3
             try:
                 if f_shard.value:
                     # mesh-sharded converge session over every attached
@@ -444,6 +448,7 @@ def run(i, o, e, args: List[str]) -> int:
                         batch=max(1, f_batch.value),
                         engine=f_engine.value,
                         polish=f_polish.value,
+                        anti_colocation=max(0.0, f_anti_coloc.value),
                     )
                 else:
                     from kafkabalancer_tpu.solvers.scan import plan

@@ -78,6 +78,15 @@ class DensePlan:
         return out
 
 
+def all_allowed_of(dp: "DensePlan") -> bool:
+    """True when the [P, B] allowed matrix is just the broker-validity
+    row broadcast (the default FillDefaults outcome) — the detection the
+    all-allowed session/kernel/window-scorer modes key on. ONE
+    definition: solvers.scan (plan, _leader_plan, _prep_from_dp),
+    parallel.shard_session and solvers.tpu all share it."""
+    return bool(dp.allowed[:, : dp.nb].all(axis=1)[: dp.np_].all())
+
+
 def broker_universe(
     pl: PartitionList,
     cfg: Optional[RebalanceConfig] = None,

@@ -61,7 +61,9 @@ from kafkabalancer_tpu.solvers.scan import prefix_accept  # noqa: E402
 
 @partial(
     jax.jit,
-    static_argnames=("max_moves", "allow_leader", "batch", "mesh", "engine"),
+    static_argnames=(
+        "max_moves", "allow_leader", "batch", "mesh", "engine", "n_topics",
+    ),
 )
 def sharded_session(
     loads,
@@ -79,12 +81,15 @@ def sharded_session(
     min_unbalance,
     budget,
     churn_gate,
+    tid=None,
+    lam=None,
     *,
     max_moves: int,
     allow_leader: bool,
     batch: int,
     mesh: Mesh,
     engine: str = "xla",
+    n_topics: int = 0,
 ):
     """``scan.session``'s batch path with the partition axis sharded over
     ``mesh``'s ``part`` axis; same return contract ``(replicas, loads, n,
@@ -100,6 +105,19 @@ def sharded_session(
     one fused Mosaic kernel (parallel/shard_kernel.py — float32 only;
     ``"pallas-interpret"`` for CPU testing); move logs are bit-identical
     to the XLA engine at the same dtype (pinned by tests).
+
+    ``n_topics > 0`` (with ``tid [P]`` global topic ids and scalar
+    ``lam``) runs the COMBINED anti-colocation objective sharded: the
+    per-(topic, broker) counts matrix shards nothing — it is replicated
+    state exactly like broker loads (every update derives from the
+    combined, replicated candidate pool), while each shard scores its
+    own partition rows against the counts rows its local ``tid`` slice
+    selects. The combine key is unchanged (colocation terms ride inside
+    the candidate values), and ``prefix_accept``'s (topic, broker)
+    first-claims carry the exactness argument verbatim — so move logs
+    stay bit-identical to the single-device colocation session at the
+    same dtype. XLA shard engine only (the scoring kernel has no
+    colocation state).
     """
     P, R = replicas.shape
     B = loads.shape[0]
@@ -116,6 +134,21 @@ def sharded_session(
         raise ValueError("the pallas shard engine is float32 only")
     if engine not in ("xla", "pallas", "pallas-interpret"):
         raise ValueError(f"unknown shard engine {engine!r}")
+    if n_topics and use_pallas:
+        raise ValueError(
+            "the pallas shard engine has no colocation state; use the "
+            "xla shard engine with anti_colocation"
+        )
+    if n_topics and batch <= 1:
+        raise ValueError(
+            "the sharded anti-colocation session requires batch > 1 "
+            "(the pooled batched selection)"
+        )
+    if not n_topics:
+        # dummy replicated inputs keep ONE shard_map arity (a [P] int32
+        # and a scalar are noise next to the session state)
+        tid = jnp.zeros(P, jnp.int32)
+        lam = jnp.zeros((), dtype)
 
     rep = PS()
     pshard = PS(PART_AXIS)
@@ -134,6 +167,8 @@ def sharded_session(
             rep,      # ncons
             rep,      # pvalid
             rep, rep, rep, rep, rep, rep,
+            rep,      # tid (full: candidate topics index global p)
+            rep,      # lam
         ),
         out_specs=(pshard, rep, rep, rep, rep, rep, rep, rep),
         # winner indices derive from axis_index; the varying-mode analysis
@@ -142,7 +177,7 @@ def sharded_session(
     )
     def run(loads, replicas, member, allowed, weights, nrep_cur, nrep_tgt,
             ncons, pvalid, always_valid, universe_valid, min_replicas,
-            min_unbalance, budget, churn_gate):
+            min_unbalance, budget, churn_gate, tid, lam):
         shard_i = lax.axis_index(PART_AXIS)
         off = (shard_i * P_l).astype(jnp.int32)
 
@@ -160,6 +195,20 @@ def sharded_session(
             jnp.sum((member & pvalid_l[:, None]).astype(jnp.int32), axis=0),
             PART_AXIS,
         )
+        if n_topics:
+            # replicated [T, B] colocation counts: each shard contributes
+            # its local rows, the psum makes every copy global (after
+            # which updates derive from the replicated candidate pool and
+            # stay bit-identical on every shard, like loads)
+            tid_l = lslice(tid)
+            counts0 = jax.lax.psum(
+                jnp.zeros((n_topics, B), dtype)
+                .at[tid_l]
+                .add((member & pvalid_l[:, None]).astype(dtype)),
+                PART_AXIS,
+            )
+        else:
+            counts0 = jnp.zeros((1, 1), dtype)
 
         if use_pallas:
             from kafkabalancer_tpu.parallel.shard_kernel import (
@@ -247,7 +296,8 @@ def sharded_session(
             return (~done) & (n < budget) & (n < max_moves)
 
         def body(state):
-            loads, replicas, member, bcount, n, done, mp, mslot, msrc, mtgt = state
+            (loads, replicas, member, bcount, n, done, mp, mslot, msrc,
+             mtgt, counts) = state
 
             bvalid = (always_valid | (bcount > 0)) & universe_valid
             nb = jnp.sum(bvalid, dtype=jnp.int32).astype(dtype)
@@ -255,6 +305,7 @@ def sharded_session(
             # local per-target + per-pair winners over this shard's
             # partition rows; loads/bvalid are replicated so su/avg/rank
             # arithmetic is bit-identical on every shard
+            c_rows = counts[tid_l] if n_topics else None
             if use_pallas:
                 su, vals_t_l, p_t_l, slot_t_l, vals_p_l, p_p_l, slot_p_l, \
                     s_p, t_p = _score_pallas(
@@ -264,13 +315,13 @@ def sharded_session(
                 su, vals_t_l, p_t_l, slot_t_l = cost.factored_target_best(
                     loads, replicas, allowed, member, bvalid, w_l, ncur_l,
                     ntgt_l, ncons_l, pvalid_l, nb, min_replicas,
-                    allow_leader=allow_leader,
+                    allow_leader=allow_leader, c_rows=c_rows, lam=lam,
                 )
                 vals_p_l, p_p_l, slot_p_l, s_p, t_p, _live = (
                     cost.paired_best(
                         loads, replicas, allowed, member, bvalid, w_l,
                         ncur_l, ntgt_l, ncons_l, pvalid_l, min_replicas,
-                        allow_leader=allow_leader,
+                        allow_leader=allow_leader, c_rows=c_rows, lam=lam,
                     )
                 )
             s_t_l = replicas[
@@ -315,15 +366,31 @@ def sharded_session(
             # shard (mirrors scan.session body_batch; prefix_accept is
             # literally the same function) --------------------------------
             w_k = _applied_delta(p, slot)
+            if n_topics:
+                # per-candidate colocation constants from pass-START
+                # counts; tid/counts are replicated, p is the combined
+                # (replicated) winner — bit-identical on every shard
+                tid_k = tid[p]
+                sub_s, _ = cost.colo_terms(counts[tid_k, s_], lam)
+                _, add_t = cost.colo_terms(counts[tid_k, t], lam)
+                colo_d = add_t - sub_s
+            else:
+                tid_k = colo_d = None
             ok, pos, cnt = prefix_accept(
                 vals, p, s_, t, w_k, loads, avg, su,
                 min_unbalance, churn_gate, n, batch, budget, max_moves,
+                topic=tid_k, colo_d=colo_d,
             )
             oki = ok.astype(jnp.int32)
 
             delta = w_k * oki.astype(dtype)
             loads = loads.at[s_].add(-delta).at[t].add(delta)
             bcount = bcount.at[s_].add(-oki).at[t].add(oki)
+            if n_topics:
+                okd = oki.astype(dtype)
+                counts = (
+                    counts.at[tid_k, s_].add(-okd).at[tid_k, t].add(okd)
+                )
 
             # ---- owner-shard application --------------------------------
             mine = ok & (p >= off) & (p < off + P_l)
@@ -348,15 +415,15 @@ def sharded_session(
             n = n + cnt
             return (
                 loads, replicas, member, bcount, n, cnt == 0,
-                mp, mslot, msrc, mtgt,
+                mp, mslot, msrc, mtgt, counts,
             )
 
         state = (
             loads, replicas, member, bcount0, jnp.int32(0), jnp.bool_(False),
-            mp0, mp0, mp0, mp0,
+            mp0, mp0, mp0, mp0, counts0,
         )
         (loads, replicas, member, bcount, n, _done,
-         mp, mslot, msrc, mtgt) = lax.while_loop(cond, body, state)
+         mp, mslot, msrc, mtgt, _counts) = lax.while_loop(cond, body, state)
         bvalid = (always_valid | (bcount > 0)) & universe_valid
         final_su = cost.unbalance(loads, bvalid, jnp.sum(bvalid, dtype=jnp.int32).astype(dtype))
         return (
@@ -368,7 +435,7 @@ def sharded_session(
     return run(
         loads, replicas, member, allowed, weights, nrep_cur, nrep_tgt,
         ncons, pvalid, always_valid, universe_valid, min_replicas,
-        min_unbalance, budget, churn_gate,
+        min_unbalance, budget, churn_gate, tid, lam,
     )
 
 
@@ -406,6 +473,7 @@ def plan_sharded(
     churn_gate: "float | None" = None,
     engine: str = "xla",
     polish: bool = False,
+    anti_colocation: "float | None" = None,
 ):
     """Mesh-sharded analog of ``solvers.scan.plan`` — repairs settle
     host-side first, sharded move-session chunks re-enter like ``plan``.
@@ -434,17 +502,23 @@ def plan_sharded(
     sequentially and is single-device by design — [P, B] state is
     HBM-resident with no VMEM ceiling, so delegation changes speed at
     extreme scale, never capability or results (pinned identical to
-    ``plan`` by tests)."""
+    ``plan`` by tests).
+
+    ``anti_colocation=λ > 0`` runs the COMBINED objective sharded (see
+    ``sharded_session``): the [T, B] counts replicate like loads, each
+    shard scores its rows with the ±λ terms, and the polish tail (when
+    ``polish``) is the colocation-aware alternation. Follows ``plan``'s
+    convention exactly: the kwarg overrides; a cfg-derived penalty only
+    activates where it changes nothing for legacy callers (XLA engine,
+    batch > 1, no rebalance_leaders — otherwise it deactivates and the
+    session plans loads only). XLA shard engine required: an explicit
+    request with a pallas engine is overridden with a warning, like
+    ``plan``'s."""
     from kafkabalancer_tpu.balancer.steps import BalanceError
     from kafkabalancer_tpu.models.partition import empty_partition_list
     from kafkabalancer_tpu.ops import tensorize
     from kafkabalancer_tpu.ops.runtime import next_bucket
 
-    if getattr(cfg, "anti_colocation", 0.0):
-        raise ValueError(
-            "the sharded session has no colocation state; use "
-            "solvers.scan.plan(anti_colocation=...) single-device"
-        )
     from kafkabalancer_tpu.solvers.scan import (
         _cfg_broker_mask,
         _decode_packed,
@@ -453,7 +527,13 @@ def plan_sharded(
         _prep_from_dp,
         _settle_head,
         auto_chunk_moves,
+        resolve_anti_colocation,
         DEFAULT_CHURN_GATE,
+    )
+
+    anti_colocation, engine = resolve_anti_colocation(
+        cfg, anti_colocation, batch, engine,
+        what="sharded colocation session",
     )
 
     if cfg.rebalance_leaders:
@@ -496,6 +576,16 @@ def plan_sharded(
             _prep_from_dp(dp, dtype)
         )
         chunk = min(remaining, chunk_moves)
+        if anti_colocation:
+            # same topic-count bucketing as plan(): compiled programs
+            # survive topic-cardinality drift
+            tid_np = dp.topic_id
+            n_topics = next_bucket(max(1, len(dp.topics)), 64)
+            lam_np = np.asarray(anti_colocation, dtype)
+        else:
+            tid_np = np.zeros(dp.replicas.shape[0], np.int32)
+            n_topics = 0
+            lam_np = np.asarray(0.0, dtype)
         if multiproc:
             # build from the HOST arrays (the [P, B]/[P, R] state must
             # not round-trip through the default device before the
@@ -515,6 +605,7 @@ def plan_sharded(
                     np.int32(cfg.min_replicas_for_rebalancing),
                     np.asarray(cfg.min_unbalance, dtype),
                     np.int32(chunk), np.asarray(churn_gate, dtype),
+                    tid_np, lam_np,
                 ),
                 mesh,
             )
@@ -535,6 +626,8 @@ def plan_sharded(
                 jnp.asarray(cfg.min_unbalance, dtype),
                 jnp.int32(chunk),
                 jnp.asarray(churn_gate, dtype),
+                jnp.asarray(tid_np),
+                jnp.asarray(lam_np),
             )
         try:
             (_replicas, _loads, n, mp, mslot, _msrc, mtgt, _su) = (
@@ -545,6 +638,7 @@ def plan_sharded(
                     batch=max(1, batch),
                     mesh=mesh,
                     engine=engine,
+                    n_topics=n_topics,
                 )
             )
         except BalanceError:
@@ -588,11 +682,22 @@ def plan_sharded(
             dp, cfg.min_replicas_for_rebalancing
         )
         chunk = min(remaining, chunk_moves)
+        if anti_colocation:
+            # the polish tail stays combined-objective: the alternation's
+            # move/swap phases carry the colocation state (polish.py)
+            tid_np = dp.topic_id
+            n_topics = next_bucket(max(1, len(dp.topics)), 64)
+        else:
+            tid_np = None
+            n_topics = 0
         packed = _dispatch_chunk(
             dp, cfg, chunk, dtype, batch, "xla",
             polish=True, leader=False, all_allowed=all_allowed,
             churn_gate=churn_gate,
             ew=ew_np, ep=ep_, er=er_, evalid=evalid,
+            tid=tid_np,
+            lam=anti_colocation if anti_colocation else None,
+            n_topics=n_topics,
         )
         n = _decode_packed(packed, dp, opl, drop_superseded=True)
         remaining -= n

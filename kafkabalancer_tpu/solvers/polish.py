@@ -166,10 +166,22 @@ def _swap_loop(
     min_unbalance,
     budget,
     ML: int,
+    tid=None,
+    lam=None,
+    n_topics: int = 0,
 ):
     """Fused pair-swap loop (see module docstring). Mutates the carried
     state/logs; logs each swap as its two constituent moves. Returns the
-    updated ``(loads, replicas, member, n, mp, mslot, mtgt)``."""
+    updated ``(loads, replicas, member, n, mp, mslot, mtgt)``.
+
+    ``n_topics > 0`` (with ``tid [P]``/scalar ``lam``) scores swaps on the
+    COMBINED objective ``u + λ·Σ max(0, c-1)``: each candidate pair adds
+    the colocation delta of its two membership changes (zero when both
+    partitions share a topic — the counts cells cancel). Per-(topic,
+    broker) counts recompute from the live membership each iteration, and
+    exactness under batched commits holds because pairs are
+    broker-disjoint, so no two accepted swaps touch the same (topic,
+    broker) cell."""
     P, R = replicas.shape
     B = loads.shape[0]
     Nc = ew.shape[0]
@@ -196,6 +208,16 @@ def _swap_loop(
         F = jnp.where(bvalid, cost.overload_penalty(loads, avg), 0.0)
         su = jnp.sum(F)
         eps = jnp.maximum(min_unbalance, su * SWAP_REL_EPS)
+        if n_topics:
+            # per-(topic, broker) replica counts, fresh from the live
+            # membership (member mutates per iteration; recomputing is one
+            # [P, B] scatter, the same cost class as the bcount reduction
+            # above)
+            counts = (
+                jnp.zeros((n_topics, B), dtype)
+                .at[tid]
+                .add((member & pvalid[:, None]).astype(dtype))
+            )
 
         # hottest half paired with a rotation of the coldest half; the
         # halves are disjoint rank ranges, so pairs are broker-disjoint
@@ -256,6 +278,22 @@ def _swap_loop(
                 - F[holder % B]
                 - F[t_e]
             )
+            if n_topics:
+                # combined-objective swap delta: entry 1 (topic t1) moves
+                # hot -> cold, entry 2 (topic t2) cold -> hot. Same topic
+                # means both counts cells cancel exactly (net zero).
+                hb = holder % B
+                t1 = tid[ep]
+                t2 = t1[j2c]
+                sub1, _ = cost.colo_terms(counts[t1, hb], lam)
+                _, add1 = cost.colo_terms(counts[t1, t_e], lam)
+                sub2, _ = cost.colo_terms(counts[t2, t_e], lam)
+                _, add2 = cost.colo_terms(counts[t2, hb], lam)
+                delta = delta + jnp.where(
+                    t1 == t2,
+                    jnp.zeros_like(delta),
+                    add1 - sub1 + add2 - sub2,
+                )
             return jnp.where(feas1 & feas2, delta, jnp.inf), j2c
 
         sa, ja = cand_score(j_above, va)
@@ -458,6 +496,7 @@ def _leader_shuffle_loop(
     jax.jit,
     static_argnames=(
         "max_moves", "allow_leader", "batch", "engine", "all_allowed",
+        "n_topics",
     ),
 )
 def converge_session(
@@ -479,12 +518,15 @@ def converge_session(
     er,
     evalid,
     churn_gate=DEFAULT_CHURN_GATE,
+    tid=None,
+    lam=None,
     *,
     max_moves: int,
     allow_leader: bool,
     batch: int,
     engine: str = "xla",
     all_allowed: bool = False,
+    n_topics: int = 0,
 ):
     """Move phases and swap phases alternated on device until neither
     commits — one dispatch for the whole plan-to-convergence.
@@ -499,8 +541,22 @@ def converge_session(
     the int32 concatenation ``[move_p | move_slot | move_tgt | n]`` sized
     ``3 * (2 * max_moves) + 1`` (one device->host transfer decodes the
     whole plan).
+
+    ``n_topics > 0`` (with ``tid``/``lam``) runs every phase on the
+    COMBINED anti-colocation objective: the move phase is the colocation
+    session (scan.session with counts state, batch > 1 required), the
+    swap phase scores the ±λ terms per candidate pair, and the
+    leadership-shuffle phase needs no change at all — a leadership
+    transfer moves no membership, so colocation counts are invariant.
+    XLA engine only (the whole-session kernel has no colocation state).
     """
     from kafkabalancer_tpu.solvers.scan import session
+
+    if n_topics and engine != "xla":
+        raise ValueError(
+            "the colocation-aware polish session is XLA-only (the "
+            "whole-session kernel has no colocation state)"
+        )
 
     B = loads.shape[0]
     ML = 2 * max_moves  # phase buffers merge into double-size global logs
@@ -542,7 +598,9 @@ def converge_session(
             loads, replicas, member, allowed, weights, nrep_cur,
             nrep_tgt, ncons, pvalid, always_valid, universe_valid,
             min_replicas, min_unbalance, budget - n, churn_gate,
+            tid, lam,
             max_moves=max_moves, allow_leader=allow_leader, batch=batch,
+            n_topics=n_topics,
         )
         # merge the phase logs at offset n; entries past nm are -1 and get
         # overwritten by the next merge or ignored by the [:n] decode
@@ -558,7 +616,7 @@ def converge_session(
             ew=ew, ep=ep, er=er, evalid=evalid, allowed=allowed,
             pvalid=pvalid, always_valid=always_valid,
             universe_valid=universe_valid, min_unbalance=min_unbalance,
-            budget=budget, ML=ML,
+            budget=budget, ML=ML, tid=tid, lam=lam, n_topics=n_topics,
         )
 
         # --- leadership-shuffle phase (allow_leader only) ---------------

@@ -616,8 +616,10 @@ def session_packed(
             loads, replicas, allowed_dev, w, nrep_cur, nrep_tgt, nc,
             pvalid, always_valid, universe_valid, min_replicas, mu,
             budget, ew if ew is None else ew.astype(dtype), ep, er,
-            evalid, cg, max_moves=max_moves, allow_leader=allow_leader,
+            evalid, cg, tid, None if lam is None else lam.astype(dtype),
+            max_moves=max_moves, allow_leader=allow_leader,
             batch=batch, engine=engine, all_allowed=all_allowed,
+            n_topics=n_topics,
         )
     elif engine in ("pallas", "pallas-interpret"):
         from kafkabalancer_tpu.solvers.pallas_session import pallas_session
@@ -697,12 +699,9 @@ def _dispatch_chunk(
     )
 
 
-def all_allowed_of(dp) -> bool:
-    """True when the [P, B] allowed matrix is just the broker-validity
-    row broadcast (the default FillDefaults outcome) — the detection the
-    all-allowed session/kernel modes key on. ONE definition: plan,
-    _leader_plan, _prep_from_dp and parallel.shard_session all share it."""
-    return bool(dp.allowed[:, : dp.nb].all(axis=1)[: dp.np_].all())
+# the one shared all-allowed detection (ops/tensorize.py), re-exported
+# for the existing plan/_leader_plan/shard_session call sites
+from kafkabalancer_tpu.ops.tensorize import all_allowed_of  # noqa: E402
 
 
 def _prep_from_dp(dp, dtype, all_allowed=None, ew=None):
@@ -908,6 +907,55 @@ def _leader_plan(
     return opl
 
 
+def resolve_anti_colocation(
+    cfg: RebalanceConfig,
+    anti_colocation: "float | None",
+    batch: int,
+    engine: str,
+    what: str = "colocation session",
+) -> "Tuple[float, str]":
+    """The ONE definition of when an anti-colocation penalty activates,
+    shared by ``plan`` and ``parallel.shard_session.plan_sharded`` (two
+    hand-maintained copies would let the convention drift and silently
+    break their bit-parity contract). Returns ``(lam, engine)``.
+
+    The kwarg overrides; ``cfg.anti_colocation`` is the default — but a
+    cfg-derived penalty only ACTIVATES where it changes nothing for
+    legacy callers (a beam-config cfg reused for a load-only bulk
+    session must keep planning loads, not raise, and an explicit engine
+    request must stay honored). An EXPLICIT request validates hard:
+    ``batch > 1`` and no ``rebalance_leaders`` (the fused leader session
+    has no colocation state), and a non-XLA engine is overridden with a
+    visible warning (the kernels have no colocation state either).
+    """
+    explicit = anti_colocation is not None
+    if not explicit:
+        anti_colocation = getattr(cfg, "anti_colocation", 0.0) or 0.0
+        if anti_colocation and (
+            batch <= 1 or cfg.rebalance_leaders or engine != "xla"
+        ):
+            anti_colocation = 0.0
+    lam = max(0.0, anti_colocation)
+    if lam and batch <= 1:
+        raise ValueError("anti_colocation requires batch > 1")
+    if lam and cfg.rebalance_leaders:
+        raise ValueError(
+            "anti_colocation is not supported with rebalance_leaders "
+            "(the fused leader session has no colocation state)"
+        )
+    if lam and engine != "xla":
+        import warnings
+
+        warnings.warn(
+            f"anti_colocation runs the XLA {what}; explicit "
+            f"engine={engine!r} request is overridden",
+            UserWarning,
+            stacklevel=3,
+        )
+        engine = "xla"
+    return lam, engine
+
+
 def plan(
     pl: PartitionList,
     cfg: RebalanceConfig,
@@ -954,46 +1002,16 @@ def plan(
     the combined objective (no beam lookahead, no uphill sequences) at
     session speed — the bulk phase of the anti-colocation pipeline, with
     beam as the optional quality tail. Requires ``batch > 1``; forces
-    the XLA engine (the kernel has no colocation state); excludes
-    ``polish`` (swap/shuffle phases are colocation-blind and would undo
-    it).
+    the XLA engine (the kernel has no colocation state). Composes with
+    ``polish``: every polish phase scores the combined objective too
+    (swap candidates add their ±λ pair deltas; leadership shuffles move
+    no membership, so counts are invariant — solvers/polish.py).
     """
     if engine not in ENGINES:
         raise ValueError(f"unknown engine {engine!r}")
-    explicit_colo = anti_colocation is not None
-    if not explicit_colo:
-        # one source of truth with the beam solver's convention: the
-        # kwarg overrides, cfg.anti_colocation is the default — but a
-        # cfg-derived penalty only ACTIVATES where it changes nothing
-        # for legacy callers (a beam-config cfg reused for the bulk
-        # load-session pre-phase must keep planning loads, not raise)
-        anti_colocation = getattr(cfg, "anti_colocation", 0.0) or 0.0
-        if anti_colocation and (
-            polish
-            or batch <= 1
-            or cfg.rebalance_leaders
-            or engine != "xla"
-        ):
-            anti_colocation = 0.0
-    anti_colocation = max(0.0, anti_colocation)
-    if anti_colocation and polish:
-        raise ValueError(
-            "anti_colocation and polish are mutually exclusive (the "
-            "swap/leader-shuffle phases do not model colocation)"
-        )
-    if anti_colocation and batch <= 1:
-        raise ValueError("anti_colocation requires batch > 1")
-    if anti_colocation and cfg.rebalance_leaders:
-        raise ValueError(
-            "anti_colocation is not supported with rebalance_leaders "
-            "(the fused leader session has no colocation state)"
-        )
-    if anti_colocation:
-        # the whole-session kernel carries no colocation state; the XLA
-        # session is the colocation engine (an EXPLICIT pallas request
-        # is overridden — the CLI logs this; a cfg-derived penalty
-        # instead deactivates above, preserving the requested engine)
-        engine = "xla"
+    anti_colocation, engine = resolve_anti_colocation(
+        cfg, anti_colocation, batch, engine
+    )
     opl = empty_partition_list()
     if max_reassign <= 0:
         return opl

@@ -76,7 +76,7 @@ import jax.numpy as jnp  # noqa: E402
 from kafkabalancer_tpu.balancer import costmodel  # noqa: E402
 from kafkabalancer_tpu.balancer.steps import greedy_move, replace_replica  # noqa: E402
 from kafkabalancer_tpu.ops import cost, tensorize  # noqa: E402
-from kafkabalancer_tpu.ops.tensorize import DensePlan  # noqa: E402
+from kafkabalancer_tpu.ops.tensorize import DensePlan, all_allowed_of  # noqa: E402
 
 # Host tie-resolution budget: the oracle re-scan over window partitions
 # covers at most this many (slot x target) candidate evaluations; a wider
@@ -172,7 +172,16 @@ def _score_window(ints, floats, allowed, *, leaders: bool,
     The ``[P, B]`` membership mask is recomputed from the replica matrix
     on device and the allowed matrix is the validity-row broadcast in the
     default all-allowed case (``allowed=None``), so neither [P, B] input
-    is ever transferred. Output: ``[u_min, su, perpart_min...]``.
+    is ever transferred. Output: ``[u_min, su, relmax, wrel,
+    perpart_min...]`` — ``relmax``/``wrel`` (the largest |load/avg - 1|
+    over valid brokers and the largest weight/avg over eligible source
+    rows) feed the tier's error-bound window tolerance: the dominant
+    f32 error in a per-partition minimum is the CANCELLATION in
+    ``rel = load/avg - 1`` (absolute error ~eps32 per rel, so ~eps32·rel
+    per penalty term), which scales with rel, not with the objective —
+    near balance a tolerance proportional to ``su ~ B·rel²`` alone
+    underestimates it and the window could silently exclude the oracle
+    winner (r5 review finding).
     """
     P, W = ints.shape
     R = W - 3
@@ -222,7 +231,14 @@ def _score_window(ints, floats, allowed, *, leaders: bool,
     Cmin = jnp.min(jnp.where(tmask, C, jnp.inf), axis=1)
     perpart = su + Amin + Cmin
     u_min = jnp.min(perpart)
-    return jnp.concatenate([u_min.reshape(1), su.reshape(1), perpart])
+    # error-scale witnesses for the host-side window tolerance (docstring)
+    rel = loads / avg - 1.0
+    relmax = jnp.max(jnp.where(bvalid, jnp.abs(rel), 0.0))
+    wrel = jnp.max(jnp.where(pvalid, weights, 0.0)) / jnp.abs(avg)
+    return jnp.concatenate(
+        [u_min.reshape(1), su.reshape(1), relmax.reshape(1),
+         wrel.reshape(1), perpart]
+    )
 
 
 _score_window_jit = jax.jit(
@@ -251,7 +267,7 @@ def _pack_window_args(dp: DensePlan, loads_np, cfg: RebalanceConfig):
             [float(dp.nb), float(cfg.min_replicas_for_rebalancing)],
         ]
     )
-    all_allowed = bool(dp.allowed[:, : dp.nb].all(axis=1)[: dp.np_].all())
+    all_allowed = all_allowed_of(dp)
     return ints, floats64, None if all_allowed else dp.allowed, all_allowed
 
 
@@ -319,7 +335,8 @@ def find_best_move(
             )
         )
         u_min, su_dev = float(f_out[0]), float(f_out[1])
-        perpart = f_out[2:]
+        relmax, wrel = float(f_out[2]), float(f_out[3])
+        perpart = f_out[4:]
         if not np.isfinite(u_min):
             # no candidate, or NaN objective (zero loads) — but only the
             # f64 tier may conclude that: loads representable in f64 can
@@ -328,13 +345,32 @@ def find_best_move(
             if npdt is np.float64:
                 return None
             continue
+        # window tolerance = a sound bound on the tier's perpart error
+        # RELATIVE to the tier's own u_min (the common su error cancels
+        # in the comparison). Two regimes: objective-scaled rounding
+        # (~B·eps·max(|u_min|,|su|), the summation bound) plus the
+        # CANCELLATION term from rel = load/avg - 1 — each penalty
+        # evaluation carries absolute error ~eps·ρ·(1+ρ) with
+        # ρ = relmax + wrel bounding any perturbed |rel| the candidates
+        # reach, so four evaluations plus additions stay under
+        # ~32·eps·(1+ρ)². Near balance (ρ → 0) this floors the tolerance
+        # at ~32·eps instead of collapsing with su, the unsound corner
+        # the r4 round shipped (tol was exactly 0 at u_min == su == 0);
+        # the widened near-balance window costs host re-scan rows or an
+        # f64 retry, never correctness.
+        rho = 1.0 + (relmax + wrel if np.isfinite(relmax + wrel) else 0.0)
         if npdt is np.float32:
-            tol = (
-                4.0 * B * float(np.finfo(np.float32).eps)
-                * max(abs(u_min), abs(su_dev))
+            eps = float(np.finfo(np.float32).eps)
+            tol = eps * (
+                4.0 * B * max(abs(u_min), abs(su_dev)) + 32.0 * rho * rho
             )
         else:
-            tol = 1e-9 * max(1.0, abs(u_min), abs(su_dev)) + 1e-12
+            eps = float(np.finfo(np.float64).eps)
+            tol = (
+                1e-9 * max(1.0, abs(u_min), abs(su_dev))
+                + 64.0 * eps * rho * rho
+                + 1e-12
+            )
         cand = np.nonzero(perpart <= u_min + tol)[0]
         if len(cand) * R * nb <= MAX_WINDOW_CANDIDATES:
             rows = cand

@@ -230,7 +230,7 @@ def test_score_window_matches_score_moves_minima(leaders, dtype):
                 leaders=leaders, all_allowed=all_allowed,
             )
         )
-        u_min, su, perpart = float(out[0]), float(out[1]), out[2:]
+        u_min, su, perpart = float(out[0]), float(out[1]), out[4:]
 
         ref = tpu_solver.score_moves(
             loads.astype(npdt), dp.replicas, dp.allowed, dp.member,
@@ -267,3 +267,99 @@ def test_duplicate_topic_partition_parity():
         ]
     )
     assert_session_parity(pl, default_rebalance_config(), max_moves=6)
+
+
+def test_score_window_f32_tolerance_window_soundness():
+    """The f32 tier's window tolerance must be a SOUND bound (r5 review):
+    the f64 winner's f32 perpart must land inside ``u_min32 + tol`` on
+    adversarial regimes — deep near-balance (where the old su-scaled
+    tolerance collapses quadratically while the rel-cancellation error
+    shrinks only linearly) and mixed heavy/light weights. Also pins the
+    greedy parity end-to-end with min_unbalance=0 on those instances."""
+    import copy
+
+    import numpy as np
+
+    from kafkabalancer_tpu.balancer.steps import (
+        fill_defaults,
+        greedy_move,
+        validate_weights,
+    )
+    from kafkabalancer_tpu.models import Partition, PartitionList
+    from kafkabalancer_tpu.ops.tensorize import tensorize
+
+    def build(B, P, weight_of):
+        parts = []
+        for i in range(P):
+            a = 1 + (i % B)
+            b = 1 + ((a + B // 2 - 1) % B)
+            parts.append(
+                Partition(
+                    topic=f"t{i}", partition=0, replicas=[a, b],
+                    weight=weight_of(i), num_replicas=2,
+                    brokers=list(range(1, B + 1)), num_consumers=0,
+                )
+            )
+        pl = PartitionList(version=1, partitions=parts)
+        cfg = default_rebalance_config()
+        cfg.min_unbalance = 0.0
+        validate_weights(pl, cfg)
+        fill_defaults(pl, cfg)
+        return pl, cfg
+
+    rng = random.Random(7)
+    cases = [
+        # deep near-balance: exact even placement, ppm weight jitter
+        build(64, 12 * 64, lambda i: 100.0 * (1 + rng.uniform(-1e-6, 1e-6))),
+        # mixed heavy/light: light rows carry the only slack
+        build(32, 12 * 32,
+              lambda i: 1e-3 * (1 + rng.random()) if i % 7 == 0 else 50.0),
+    ]
+    for pl, cfg in cases:
+        dp = tensorize(pl, cfg)
+        loads_map = tpu_solver._oracle_loads(pl, cfg)
+        B = dp.bvalid.shape[0]
+        loads = np.zeros(B)
+        for bid, load in loads_map.items():
+            loads[dp.broker_index(bid)] = load
+        ints, f64, allowed_arg, all_allowed = tpu_solver._pack_window_args(
+            dp, loads, cfg
+        )
+        o32 = np.asarray(
+            tpu_solver._score_window_jit(
+                ints, f64.astype(np.float32), allowed_arg,
+                leaders=False, all_allowed=all_allowed,
+            )
+        )
+        o64 = np.asarray(
+            tpu_solver._score_window_jit(
+                ints, f64, allowed_arg, leaders=False,
+                all_allowed=all_allowed,
+            )
+        )
+        u32, su32, relmax, wrel = (float(x) for x in o32[:4])
+        pp32, pp64 = o32[4:], o64[4:]
+        assert np.isfinite(u32)
+        rho = 1.0 + relmax + wrel
+        eps = float(np.finfo(np.float32).eps)
+        tol = eps * (4.0 * B * max(abs(u32), abs(su32)) + 32.0 * rho * rho)
+        # the tolerance floor must survive a fully-degenerate objective
+        assert tol > 0
+        pstar = int(np.argmin(pp64))
+        assert pp32[pstar] <= u32 + tol, (pp32[pstar] - u32, tol)
+
+        # end-to-end: device path byte-matches greedy at min_unbalance=0
+        old_min = tpu_solver.MIN_DEVICE_CANDIDATES
+        tpu_solver.MIN_DEVICE_CANDIDATES = 0
+        try:
+            g = greedy_move(copy.deepcopy(pl), cfg, False)
+            t = tpu_solver.tpu_move_non_leaders(copy.deepcopy(pl), cfg)
+        finally:
+            tpu_solver.MIN_DEVICE_CANDIDATES = old_min
+        gs = None if g is None else [
+            (p.topic, p.partition, p.replicas) for p in g.partitions
+        ]
+        ts = None if t is None else [
+            (p.topic, p.partition, p.replicas) for p in t.partitions
+        ]
+        assert gs == ts
