@@ -68,7 +68,7 @@ def _flagship_inputs(fast: bool):
     n_parts = int(os.environ.get("BENCH_PARTITIONS", 1000 if fast else 10_000))
     n_brokers = int(os.environ.get("BENCH_BROKERS", 20 if fast else 100))
     batch = int(os.environ.get("BENCH_BATCH", "100"))
-    engine = os.environ.get("BENCH_ENGINE", "pallas")
+    engine = os.environ.get("BENCH_ENGINE", "auto")
     return n_parts, n_brokers, batch, engine
 
 

@@ -370,7 +370,7 @@ def config4b_beam_scale():
     # SAME move budget as beam — equal-footing (u, colocations) comparison
     pl_f = fresh()
     plan(pl_f, copy.deepcopy(cfg_g), budget, dtype=jnp.float32,
-         batch=128, engine=os.environ.get("BENCH_ENGINE", "pallas"))
+         batch=128, engine=os.environ.get("BENCH_ENGINE", "auto"))
     lam = cfg.anti_colocation
     obj_f = unbalance_of(pl_f) + lam * colocations(pl_f)
 
@@ -442,7 +442,7 @@ def config4b_beam_scale():
 
     def hybrid(pl):
         plan(pl, copy.deepcopy(cfg_g), 1 << 16, dtype=jnp.float32,
-             batch=128, engine=os.environ.get("BENCH_ENGINE", "pallas"))
+             batch=128, engine=os.environ.get("BENCH_ENGINE", "auto"))
         return beam_plan(pl, copy.deepcopy(cfg), budget, dtype=jnp.float32)
 
     hybrid(fresh())  # warm

@@ -193,9 +193,10 @@ def run(i, o, e, args: List[str]) -> int:
         )
         f_engine = f.string(
             "fused-engine",
-            "xla",
-            "Fused mode: device engine (xla, or pallas for the "
-            "whole-session TPU kernel)",
+            "auto",
+            "Fused mode: device engine (auto resolves per instance shape "
+            "from measured crossovers; xla forces the while_loop session; "
+            "pallas forces the whole-session TPU kernel)",
         )
         f_polish = f.bool(
             "fused-polish",

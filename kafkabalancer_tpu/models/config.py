@@ -19,7 +19,7 @@ from typing import List, Optional
 
 # fused-session device engines (solvers/scan.py plan()); lives here so the
 # CLI can validate the flag without importing the jax-heavy solver stack
-ENGINES = ("xla", "pallas", "pallas-interpret")
+ENGINES = ("auto", "xla", "pallas", "pallas-interpret")
 
 
 @dataclass

@@ -116,12 +116,16 @@ def tensorize(
     extra_brokers: Sequence[int] = (),
     min_bucket: int = 8,
     min_broker_bucket: int = 8,
+    min_replica_bucket: int = 2,
 ) -> DensePlan:
     """Encode ``pl`` (post-``fill_defaults``: weights, brokers, num_replicas
     populated) into a :class:`DensePlan`.
 
     ``extra_brokers`` extends the universe with IDs that appear in no replica
     list and no config — used by what-if sweeps that add brokers.
+    ``min_replica_bucket`` floors the replica-slot bucket — used by sweeps
+    that tensorize per-scenario repaired assignments and need every
+    scenario's arrays shape-aligned for stacking.
     """
     parts = list(pl.iter_partitions())
     ids = broker_universe(pl, cfg, extra_brokers)
@@ -134,7 +138,7 @@ def tensorize(
     rmax = max(rmax, max((p.num_replicas for p in parts), default=0))
 
     P = next_bucket(np_real, min_bucket)
-    R = next_bucket(rmax, 2)
+    R = next_bucket(rmax, max(2, min_replica_bucket))
     B = next_bucket(nb, min_broker_bucket)
 
     weights = np.zeros(P, dtype=np.float64)

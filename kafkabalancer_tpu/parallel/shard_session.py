@@ -471,7 +471,7 @@ def plan_sharded(
     batch: int = 128,
     chunk_moves: "int | None" = None,
     churn_gate: "float | None" = None,
-    engine: str = "xla",
+    engine: str = "auto",
     polish: bool = False,
     anti_colocation: "float | None" = None,
 ):
@@ -528,9 +528,14 @@ def plan_sharded(
         _settle_head,
         auto_chunk_moves,
         resolve_anti_colocation,
+        resolve_engine,
         DEFAULT_CHURN_GATE,
     )
 
+    # "auto" resolves like plan()'s (resolve_engine): the XLA shard body
+    # at every shape; the streaming Mosaic shard kernel stays the
+    # explicit engine="pallas" option (suite config 8 re-times it)
+    engine = resolve_engine(engine)
     anti_colocation, engine = resolve_anti_colocation(
         cfg, anti_colocation, batch, engine,
         what="sharded colocation session",

@@ -64,13 +64,18 @@ class SweepResult:
     """Outcome of one what-if scenario."""
 
     brokers: List[int]  # the scenario's broker set
-    feasible: bool  # False: a stranded replica had no legal target
-    completed: bool  # False: the budget truncated the drain — replicas
-    # remain on disallowed brokers even though targets existed
+    feasible: bool  # False: a stranded replica had no legal target, or a
+    # host repair step could not pick a replica (the CLI's exit-3 class)
+    completed: bool  # False: the budget truncated the drain/repairs —
+    # replicas remain on disallowed brokers or replica counts are still
+    # off-target even though legal targets existed
     n_evacuations: int  # disallowed-replica moves applied
     n_moves: int  # optimization moves applied
     unbalance: float  # final objective value
     replicas: List[List[int]]  # final assignment, row-aligned with input
+    n_repairs: int = 0  # host-side replica add/remove/move repairs applied
+    # per scenario on a non-repair-settled input (each consumed one unit
+    # of the reassignment budget, like a CLI loop iteration)
 
 
 def _evacuate(replicas, member, allowed_s, weights, nrep_cur, ncons, pvalid,
@@ -200,6 +205,7 @@ def _scenario_body(
     jax.jit,
     static_argnames=(
         "mesh", "max_moves", "max_evac", "allow_leader", "batch", "engine",
+        "per_scenario",
     ),
 )
 def _sweep_exec(
@@ -224,16 +230,35 @@ def _sweep_exec(
     allow_leader: bool,
     batch: int,
     engine: str = "xla",
+    per_scenario: bool = False,
 ):
     """Module-level jitted sweep executor: repeat sweeps with the same shape
     buckets and mesh reuse one compiled executable (a per-call shard_map
-    closure would retrace every invocation)."""
+    closure would retrace every invocation).
+
+    ``per_scenario=True`` (the non-repair-settled input path): the
+    replica/member state, replica counts and budget carry a leading
+    scenario axis — each scenario starts from its own host-repaired
+    assignment instead of one shared input. The settled common case keeps
+    the replicated layout (no S-fold transfer blow-up)."""
     rep = P()
+    sh = P(SWEEP_AXIS)
+    ps = sh if per_scenario else rep
 
     @partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(P(SWEEP_AXIS),) + (rep,) * 13,
+        in_specs=(
+            sh,   # scenario_mask
+            ps,   # replicas
+            ps,   # member
+            rep,  # allowed
+            rep,  # has_explicit
+            rep,  # weights
+            ps,   # nrep_cur (add/remove repairs change replica counts)
+            rep, rep, rep, rep, rep, rep,
+            ps,   # budget (repairs consumed a per-scenario share)
+        ),
         out_specs=(P(SWEEP_AXIS),) * 6,
         # scenario state mixes sweep-varying values with replicated plan
         # inputs inside lax.cond branches; skip the varying-mode check
@@ -242,16 +267,29 @@ def _sweep_exec(
     def run(mask_shard, replicas, member, allowed, has_explicit, weights,
             nrep_cur, nrep_tgt, ncons, pvalid, universe_valid, min_replicas,
             min_unbalance, budget):
-        def one(mask):
+        def one(args):
+            mask, reps_s, member_s, ncur_s, budget_s = args
             return _scenario_body(
-                replicas, member, allowed, has_explicit, mask, weights,
-                nrep_cur, nrep_tgt, ncons, pvalid, universe_valid,
-                min_replicas, min_unbalance, budget,
+                reps_s, member_s, allowed, has_explicit, mask, weights,
+                ncur_s, nrep_tgt, ncons, pvalid, universe_valid,
+                min_replicas, min_unbalance, budget_s,
                 max_moves=max_moves, max_evac=max_evac,
                 allow_leader=allow_leader, batch=batch, engine=engine,
             )
 
-        return lax.map(one, mask_shard)
+        if per_scenario:
+            items = (mask_shard, replicas, member, nrep_cur, budget)
+        else:
+            S_l = mask_shard.shape[0]
+
+            def bcast(v):
+                return jnp.broadcast_to(v, (S_l,) + v.shape)
+
+            items = (
+                mask_shard, bcast(replicas), bcast(member),
+                bcast(nrep_cur), bcast(budget),
+            )
+        return lax.map(one, items)
 
     out = run(
         scenario_mask, replicas, member, allowed, has_explicit, weights,
@@ -320,6 +358,7 @@ def sweep(
         mesh = make_mesh()
     n_sweep = mesh.shape[SWEEP_AXIS]
 
+    pl_input = pl
     pl = copy.deepcopy(pl)
     cfg = copy.deepcopy(cfg)
     has_explicit_l = [p.brokers is not None for p in pl.iter_partitions()]
@@ -335,28 +374,88 @@ def sweep(
             step(pl, cfg)
         except _s.BalanceError as exc:
             raise _s.BalanceError(f"{name}: {exc}") from None
-    for p in pl.iter_partitions():
-        if p.num_replicas != len(p.replicas):
-            # replica add/remove repairs are scenario-dependent (target
-            # choice follows the scenario broker set, steps.go:70-113) and
-            # run host-side; require a repair-settled input instead of
-            # silently returning structurally wrong assignments
-            raise _s.BalanceError(
-                f"sweep requires a repair-settled assignment, but partition "
-                f"{p} has {len(p.replicas)} replicas and num_replicas="
-                f"{p.num_replicas}; run the pipeline (or solvers.scan.plan) "
-                f"first"
-            )
+    settled = all(
+        p.num_replicas == len(p.replicas) for p in pl.iter_partitions()
+    )
+
+    # replica add/remove repairs are scenario-dependent (target choice
+    # follows the scenario broker set and the loads it implies,
+    # steps.go:70-113), so a non-settled input settles HOST-SIDE once per
+    # scenario — exactly the repairs a sequential CLI run with
+    # -broker-ids=<scenario> would apply — and each scenario's session
+    # then starts from its own repaired assignment (per_scenario layout).
+    # Each repair consumes one unit of the reassignment budget, like a
+    # CLI loop iteration (kafkabalancer.go:177-221).
+    scen_pls: "List | None" = None
+    scen_budget: "List[int] | None" = None
+    scen_feasible: "List[bool] | None" = None
+    if not settled:
+        from kafkabalancer_tpu.solvers.scan import _settle_head
+
+        scen_pls, scen_budget, scen_feasible = [], [], []
+        for sc in scenarios:
+            pl_s = copy.deepcopy(pl_input)
+            cfg_s = copy.deepcopy(cfg)
+            cfg_s.brokers = sorted(int(b) for b in sc)
+            try:
+                _repaired, left = _settle_head(
+                    pl_s, cfg_s, max_reassign,
+                    include_reassign_leaders=False,
+                )
+                ok = True
+            except _s.BalanceError:
+                # the CLI's exit-3 class ("unable to pick replica to
+                # add/remove/replace") — the scenario is infeasible, the
+                # row reports it instead of failing the whole sweep
+                left, ok = 0, False
+            scen_pls.append(pl_s)
+            scen_budget.append(left if ok else 0)
+            scen_feasible.append(ok)
 
     use_pallas = engine in ("pallas", "pallas-interpret")
     if use_pallas:
         from kafkabalancer_tpu.solvers.pallas_session import TILE_P
 
     extra = sorted({int(b) for sc in scenarios for b in sc})
-    dp = tensorize(
-        pl, cfg, extra_brokers=extra,
-        min_bucket=TILE_P if use_pallas else 8,
-    )
+    min_bucket = TILE_P if use_pallas else 8
+    if scen_pls is None:
+        dp = tensorize(pl, cfg, extra_brokers=extra, min_bucket=min_bucket)
+    else:
+        # ONE broker universe for the shared encoding and every
+        # per-scenario one: the shared universe (observed ∪ cfg.brokers
+        # ∪ scenarios — configured-but-empty brokers included, they are
+        # valid move targets) united with every post-repair replica
+        # holder (add-missing may target an explicit per-partition
+        # broker outside all of those). Passing the union as
+        # extra_brokers makes every tensorize produce identical sorted
+        # broker_ids, so the stacked scenario arrays index one dense
+        # space; the assertion below guards the invariant.
+        from kafkabalancer_tpu.ops.tensorize import broker_universe
+
+        union_extra = sorted(
+            {int(b) for b in broker_universe(pl, cfg, extra)}
+            | {b for spl in scen_pls for p in spl.iter_partitions()
+               for b in p.replicas}
+        )
+        dp = tensorize(
+            pl, cfg, extra_brokers=union_extra, min_bucket=min_bucket
+        )
+        scen_dps = [
+            tensorize(
+                spl, None, extra_brokers=union_extra,
+                min_bucket=min_bucket,
+                min_replica_bucket=dp.replicas.shape[1],
+            )
+            for spl in scen_pls
+        ]
+        for sdp in scen_dps:
+            if sdp.replicas.shape != dp.replicas.shape or not np.array_equal(
+                sdp.broker_ids, dp.broker_ids
+            ):
+                raise AssertionError(
+                    "per-scenario dense shapes diverged from the shared "
+                    "encoding; this is a bug"
+                )
     B = dp.bvalid.shape[0]
 
     S = len(scenarios)
@@ -375,23 +474,49 @@ def sweep(
     max_evac = int(dp.replicas.shape[0] * dp.replicas.shape[1])
     max_moves = next_bucket(min(max_reassign, 1 << 20), 128)
 
+    if scen_pls is None:
+        reps_arg = jnp.asarray(dp.replicas)
+        member_arg = jnp.asarray(dp.member)
+        ncur_arg = jnp.asarray(dp.nrep_cur)
+        budget_arg = jnp.int32(min(max_reassign, 2**31 - 1))
+        ncur_dec = [dp.nrep_cur] * S
+    else:
+        def stack(get):
+            rows = [get(sdp) for sdp in scen_dps]
+            rows += [rows[0]] * (S_pad - len(rows))  # pad rows: scenario 0
+            return np.stack(rows)
+
+        reps_arg = jnp.asarray(stack(lambda d: d.replicas))
+        member_arg = jnp.asarray(stack(lambda d: d.member))
+        ncur_np = stack(lambda d: d.nrep_cur)
+        ncur_arg = jnp.asarray(ncur_np)
+        budget_arg = jnp.asarray(
+            np.asarray(
+                [min(b, 2**31 - 1) for b in scen_budget]
+                + [0] * (S_pad - S),
+                dtype=np.int32,
+            )
+        )
+        ncur_dec = [ncur_np[i] for i in range(S)]
+
     packed = np.asarray(
         _sweep_exec(
             jnp.asarray(scenario_mask),
-            jnp.asarray(dp.replicas), jnp.asarray(dp.member),
+            reps_arg, member_arg,
             jnp.asarray(dp.allowed), jnp.asarray(has_explicit),
-            jnp.asarray(dp.weights, dtype), jnp.asarray(dp.nrep_cur),
+            jnp.asarray(dp.weights, dtype), ncur_arg,
             jnp.asarray(dp.nrep_tgt), jnp.asarray(dp.ncons, dtype),
             jnp.asarray(dp.pvalid), jnp.asarray(dp.bvalid),
             jnp.int32(cfg.min_replicas_for_rebalancing),
             jnp.asarray(cfg.min_unbalance, dtype),
-            jnp.int32(min(max_reassign, 2**31 - 1)),
+            budget_arg,
             mesh=mesh,
             max_moves=max_moves,
             max_evac=max_evac,
             allow_leader=cfg.allow_leader_rebalancing,
             batch=max(1, batch),
             engine=engine,
+            per_scenario=scen_pls is not None,
         )
     )
     P_pad, R_pad = dp.replicas.shape
@@ -405,15 +530,29 @@ def sweep(
 
     out: List[SweepResult] = []
     for i, sc in enumerate(scenarios):
+        feasible = bool(feasible_s[i])
+        completed = bool(completed_s[i])
+        n_repairs = 0
+        if scen_pls is not None:
+            feasible &= scen_feasible[i]
+            n_repairs = max_reassign - scen_budget[i] if scen_feasible[i] else 0
+            # a budget-truncated repair pass leaves replica counts
+            # off-target — structurally incomplete even with no
+            # stranded replicas
+            completed &= feasible and all(
+                p.num_replicas == len(p.replicas)
+                for p in scen_pls[i].iter_partitions()
+            )
         out.append(
             SweepResult(
                 brokers=sorted(int(b) for b in sc),
-                feasible=bool(feasible_s[i]),
-                completed=bool(completed_s[i]),
+                feasible=feasible,
+                completed=completed,
                 n_evacuations=int(n_evac_s[i]),
                 n_moves=int(n_moves_s[i]),
                 unbalance=float(su_s[i]),
-                replicas=dp.decode_replicas(replicas_s[i], dp.nrep_cur),
+                replicas=dp.decode_replicas(replicas_s[i], ncur_dec[i]),
+                n_repairs=n_repairs,
             )
         )
     return out

@@ -246,9 +246,81 @@ def test_sweep_contract_errors():
     with pytest.raises(ValueError, match="2\\^20"):
         sweep(pl, cfg, [[1, 2]], max_reassign=(1 << 20) + 1)
 
-    bad = wrap([P("a", 1, [1, 2], weight=1.0, num_replicas=3, brokers=[1, 2, 3])])
-    with pytest.raises(BalanceError, match="repair-settled"):
-        sweep(bad, cfg, [[1, 2, 3]])
+
+def test_sweep_unsettled_input_matches_sequential():
+    """VERDICT r4 missing #2: sweeps no longer reject non-repair-settled
+    input. A cluster mid-resize (under- AND over-replicated partitions)
+    sweeps directly: each scenario settles host-side with the SCENARIO's
+    broker set (the repairs a sequential -broker-ids=<scenario> CLI run
+    would apply, steps.go:70-113) before its fused session — final
+    assignments and objective match the per-scenario sequential pipeline
+    runs, and the repairs consume reassignment budget like CLI loop
+    iterations."""
+    from test_balancer import P, wrap
+
+    pl = wrap(
+        [
+            # under-replicated: wants a third replica (scenario-dependent
+            # target choice)
+            P("u", 1, [1, 2], weight=1.3, num_replicas=3),
+            P("u", 2, [2, 3], weight=0.7, num_replicas=3),
+            # over-replicated: must drop one
+            P("o", 1, [1, 2, 3], weight=1.1, num_replicas=2),
+            # settled background
+            P("s", 1, [3, 1], weight=0.9),
+            P("s", 2, [1, 3], weight=1.2),
+            P("s", 3, [2, 1], weight=0.8),
+        ]
+    )
+    cfg = default_rebalance_config()
+    observed = [1, 2, 3]
+    scenarios = [
+        observed,
+        observed + [4],       # resize onto a new broker
+        observed + [4, 5],
+        [2, 3, 4],            # drop broker 1 (evacuation + repairs)
+    ]
+    results = sweep(pl, cfg, scenarios, max_reassign=200)
+    for sc, res in zip(scenarios, results):
+        seq_pl, seq_n, seq_u = sequential_scenario(pl, cfg, sc)
+        if seq_pl is None:
+            assert not res.feasible
+            continue
+        assert res.feasible and res.completed, (sc, res)
+        assert res.n_repairs > 0  # the input genuinely needed repairs
+        assert res.unbalance == pytest.approx(seq_u, rel=1e-9, abs=1e-12)
+        # weighted instance, no exact ties: identical final assignment
+        assert res.replicas == [p.replicas for p in seq_pl.partitions], sc
+
+    # a budget that only covers part of the repairs: structurally
+    # incomplete, reported as such (repairs consumed the whole budget)
+    bounded = sweep(pl, cfg, scenarios[:1], max_reassign=2)[0]
+    assert bounded.feasible and not bounded.completed
+    assert bounded.n_repairs == 2 and bounded.n_moves == 0
+
+
+def test_sweep_unsettled_with_configured_empty_broker():
+    """r5 review regression: cfg.brokers naming a broker that holds no
+    replicas and appears in no scenario must not desync the per-scenario
+    broker universe from the shared encoding (the configured broker is a
+    valid move target in every universe, steps.go:150-155)."""
+    from test_balancer import P, wrap
+
+    pl = wrap(
+        [
+            P("u", 1, [1, 2], weight=1.2, num_replicas=3),  # unsettled
+            P("s", 1, [2, 3], weight=0.8),
+            P("s", 2, [3, 1], weight=1.0),
+        ]
+    )
+    cfg = default_rebalance_config()
+    cfg.brokers = [1, 2, 3, 9]  # broker 9: configured, empty, unscoped
+    results = sweep(pl, cfg, [[1, 2, 3]], max_reassign=100)
+    assert results[0].feasible and results[0].completed
+    assert results[0].n_repairs > 0
+    seq_pl, _n, seq_u = sequential_scenario(pl, cfg, [1, 2, 3])
+    assert results[0].unbalance == pytest.approx(seq_u, rel=1e-9, abs=1e-12)
+    assert results[0].replicas == [p.replicas for p in seq_pl.partitions]
 
 
 def test_sweep_evacuations_consume_budget():
