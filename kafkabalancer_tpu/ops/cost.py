@@ -366,6 +366,7 @@ def factored_target_best(
     c_rows=None,
     lam=None,
     exclude_p=None,
+    exclude_src=None,
     top2: bool = False,
 ):
     """Best candidate per TARGET broker via the factorized rank-1 objective.
@@ -378,6 +379,14 @@ def factored_target_best(
     (equivalent to a second call with ``exclude_p=p``, pinned by
     tests) — and extends the return to ``(su, vals, p, slot, vals2, p2,
     slot2)``.
+
+    ``exclude_src=(p, b)`` (optional, scalars) bars partition ``p``'s
+    replica currently sitting ON broker ``b`` from being a move SOURCE
+    (follower and leader passes both) — the beam search's
+    immediate-reversal bar: re-moving the replica a sequence just placed
+    is always dominated by the direct move, and barring only that
+    replica (not the whole partition) keeps forced-adjacent sequences
+    like "move q off β, then move p's OTHER replica onto β" reachable.
 
     The move objective factorizes as ``u = su + A[source] + C[target]``
     (move_candidate_scores docstring), so per-target minimization needs
@@ -437,8 +446,19 @@ def factored_target_best(
     else:
         colo_sub = colo_add = None
 
+    if exclude_src is not None:
+        ex_p, ex_b = exclude_src
+        src_bar = (
+            (jnp.arange(P, dtype=jnp.int32)[:, None] == ex_p)
+            & (jnp.arange(B, dtype=jnp.int32)[None, :] == ex_b)
+        )
+    else:
+        src_bar = None
+
     # follower pass (member brokers minus the leader, delta = w)
     srcmask_f = member & ~lead_oh & eligible[:, None]
+    if src_bar is not None:
+        srcmask_f = srcmask_f & ~src_bar
     A_f = overload_penalty(loads[None, :] - w, avg) - F[None, :]
     if colo_sub is not None:
         A_f = A_f - colo_sub
@@ -482,8 +502,11 @@ def factored_target_best(
         A_l_pb = overload_penalty(loads[None, :] - wl[:, None], avg) - F[None, :]
         if colo_sub is not None:
             A_l_pb = A_l_pb - colo_sub
+        lead_src = lead_oh & ok_l[:, None]
+        if src_bar is not None:
+            lead_src = lead_src & ~src_bar
         A_l = jnp.min(
-            jnp.where(lead_oh & ok_l[:, None], A_l_pb, jnp.inf), axis=1
+            jnp.where(lead_src, A_l_pb, jnp.inf), axis=1
         )
         C_l = overload_penalty(loads[None, :] + wl[:, None], avg) - F[None, :]
         if colo_add is not None:

@@ -267,8 +267,7 @@ def _sweep_exec(
     def run(mask_shard, replicas, member, allowed, has_explicit, weights,
             nrep_cur, nrep_tgt, ncons, pvalid, universe_valid, min_replicas,
             min_unbalance, budget):
-        def one(args):
-            mask, reps_s, member_s, ncur_s, budget_s = args
+        def body(mask, reps_s, member_s, ncur_s, budget_s):
             return _scenario_body(
                 reps_s, member_s, allowed, has_explicit, mask, weights,
                 ncur_s, nrep_tgt, ncons, pvalid, universe_valid,
@@ -278,18 +277,18 @@ def _sweep_exec(
             )
 
         if per_scenario:
-            items = (mask_shard, replicas, member, nrep_cur, budget)
-        else:
-            S_l = mask_shard.shape[0]
-
-            def bcast(v):
-                return jnp.broadcast_to(v, (S_l,) + v.shape)
-
-            items = (
-                mask_shard, bcast(replicas), bcast(member),
-                bcast(nrep_cur), bcast(budget),
+            return lax.map(
+                lambda a: body(*a),
+                (mask_shard, replicas, member, nrep_cur, budget),
             )
-        return lax.map(one, items)
+        # settled path: the shared state stays CLOSED OVER (replicated) —
+        # stacking it as lax.map xs would materialize S_l device copies
+        # of the [P, B]/[P, R] state (lax.map lowers to scan, whose xs
+        # are real buffers), hundreds of MB at the kernel-ceiling scale
+        return lax.map(
+            lambda mask: body(mask, replicas, member, nrep_cur, budget),
+            mask_shard,
+        )
 
     out = run(
         scenario_mask, replicas, member, allowed, has_explicit, weights,

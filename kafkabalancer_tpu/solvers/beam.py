@@ -84,7 +84,8 @@ def _scan_factory(
             u = u + colo
         return u
 
-    def expand(loads, replicas, member, counts, bcount, colo, alive):
+    def expand(loads, replicas, member, counts, bcount, colo, alive,
+               last_p, last_t):
         """Per-TARGET best candidate of one beam via the shared factorized
         scorer (ops/cost.py factored_target_best); the frontier takes the
         top-W of the W×B per-target bests. Restricting to one candidate per
@@ -92,7 +93,26 @@ def _scan_factory(
         immediately at later depths anyway; the global best candidate is
         always included. ``vals`` are ABSOLUTE objective values including
         the beam's accumulated colocation cost, so cross-beam frontier
-        ranking is unbiased."""
+        ranking is unbiased.
+
+        ``(last_p, last_t)`` bar the beam's own IMMEDIATE RE-MOVE: the
+        replica the previous depth just placed on broker ``last_t`` may
+        not be a source this depth (exclude_src in the scorer). On
+        uphill plateaus the reversal is otherwise every beam's
+        best-scoring child (it returns to the sequence's start value,
+        beating any true continuation), so the frontier floods with undo
+        moves and the search oscillates without ever completing a
+        compound sequence — the rotation-locked workloads
+        (utils/synth.py rotation_locked_cluster) made this observable:
+        beam found NOTHING unless the width exceeded the number of
+        simultaneously-open cycles. Any immediately-consecutive re-move
+        of the same replica is dominated by the direct move (one depth
+        shorter, same final state, same legality — allowed sets are
+        static and only this beam's own move touched the replica), so
+        the bar never loses a best sequence; crucially it bars only THAT
+        replica, so forced-adjacent sequences that move a partition's
+        OTHER replica onto a just-vacated broker stay reachable (r5
+        review)."""
         bvalid = (always_valid | (bcount > 0)) & universe_valid
         nb = jnp.sum(bvalid, dtype=jnp.int32).astype(dtype)
 
@@ -123,6 +143,7 @@ def _scan_factory(
                     nrep_cur, nrep_tgt, ncons, pvalid, nb, min_replicas,
                     allow_leader=allow_leader,
                     c_rows=c_rows, lam=lam, top2=True,
+                    exclude_src=(last_p, last_t),
                 )
             )
             vals = jnp.stack([vals, vals2])  # [C=2, B]
@@ -134,6 +155,7 @@ def _scan_factory(
                 nrep_tgt, ncons, pvalid, nb, min_replicas,
                 allow_leader=allow_leader,
                 c_rows=c_rows, lam=lam,
+                exclude_src=(last_p, last_t),
             )
             vals = vals[None, :]  # [C=1, B]
             p = p[None, :]
@@ -216,11 +238,14 @@ def _scan_factory(
 
         def depth_step(carry, _):
             (loads_b, replicas_b, member_b, counts_b, bcount_b, colo_b,
-             alive, best) = carry
+             alive, last_p, last_t, best) = carry
 
+            # bar each beam's immediate re-move: the replica the previous
+            # depth placed on last_t may not be a source this depth (see
+            # expand docstring); (-1, -1) bars nothing
             vals, cp, cslot = jax.vmap(expand)(
                 loads_b, replicas_b, member_b, counts_b, bcount_b, colo_b,
-                alive,
+                alive, last_p, last_t,
             )  # each [W, C, B] (C = 2 with sibling expansion)
 
             C = vals.shape[1]
@@ -252,6 +277,10 @@ def _scan_factory(
             colo_b = colo_b[parent]
             if n_topics:
                 counts_b = counts_b[parent]
+            # the applied move's (partition, target) — next depth bars
+            # re-moving the replica it placed there
+            last_p = jnp.where(ok, p_sel, -1)
+            last_t = jnp.where(ok, t_sel, -1)
             (loads_b, replicas_b, member_b, counts_b, bcount_b, colo_b) = (
                 jax.vmap(apply_move_masked)(
                     loads_b, replicas_b, member_b, counts_b, bcount_b,
@@ -287,7 +316,7 @@ def _scan_factory(
             )
             carry = (
                 loads_b, replicas_b, member_b, counts_b, bcount_b, colo_b,
-                alive, best,
+                alive, last_p, last_t, best,
             )
             return carry, (parent, p_sel, slot_sel, t_sel)
 
@@ -295,11 +324,12 @@ def _scan_factory(
             su0, jnp.int32(-1), jnp.int32(-1), jnp.int32(0),
             loads, replicas, member,
         )
+        no_last = jnp.full(W, -1, jnp.int32)
         carry0 = (
             loads_b, replicas_b, member_b, counts_b, bcount_b, colo_b,
-            alive, best0,
+            alive, no_last, no_last, best0,
         )
-        (_, _, _, _, _, _, _, best), logs = lax.scan(
+        (_, _, _, _, _, _, _, _, _, best), logs = lax.scan(
             depth_step, carry0, None, length=D
         )
         (best_u, best_beam, best_depth, _,

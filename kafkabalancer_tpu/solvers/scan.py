@@ -191,13 +191,161 @@ def prefix_accept(
     return ok, pos, cnt
 
 
-# whole-session kernel capacity: partition-bucket x broker-bucket cells
-# that still fit the v5e scoped-VMEM budget with the transposed compact
-# layout. All-allowed sessions carry no [P, B] matrix at all (128k x 256
-# verified on hardware); restricted sessions keep the int8 allowed matrix
-# resident (64k x 128 verified).
+# whole-session kernel capacity PRIOR: partition-bucket x broker-bucket
+# cells that fit the TPU v5e scoped-VMEM budget with the transposed
+# compact layout (128k x 256 all-allowed and 64k x 128 restricted, both
+# hardware-verified). These are one chip generation's calibration, NOT
+# the gate itself: :func:`pallas_session_fits` decides from a persistent
+# per-device-kind verdict cache, populated by compile probes (when the
+# prior rejects) and by observed VMEM OOM fallbacks at dispatch (when
+# the prior admits but the chip disagrees) — so on a different TPU the
+# real budget wins over the literals either way.
 PALLAS_VMEM_CELLS = 131072 * 256
 PALLAS_VMEM_CELLS_RESTRICTED = 65536 * 128
+
+_gate_mem: dict = {}
+
+
+def _gate_cache_path():
+    from kafkabalancer_tpu.ops import aot
+
+    d = aot.aot_dir()
+    import os
+
+    return None if d is None else os.path.join(d, "pallas_gate.json")
+
+
+def _gate_key(
+    P: int, B: int, R: int, all_allowed: bool, allow_leader: bool
+) -> str:
+    # allow_leader changes the kernel's traced program (the leader
+    # scoring pass) and thus its VMEM footprint — one mode's verdict
+    # must not be reused for the other (r5 review)
+    dev = jax.devices()[0]
+    kind = getattr(dev, "device_kind", dev.platform)
+    mode = "aa" if all_allowed else "restricted"
+    lead = "lead" if allow_leader else "nolead"
+    return f"{kind}|{P}x{B}x{R}|{mode}|{lead}"
+
+
+def _gate_load() -> dict:
+    path = _gate_cache_path()
+    if not _gate_mem and path:
+        import json
+        import os
+
+        try:
+            if os.path.exists(path):
+                with open(path) as f:
+                    _gate_mem.update(json.load(f))
+        except Exception:
+            pass  # unreadable cache = empty cache
+    return _gate_mem
+
+
+def _gate_record(key: str, fits: bool) -> None:
+    _gate_load()[key] = bool(fits)
+    path = _gate_cache_path()
+    if path:
+        import json
+        import os
+
+        try:
+            # re-read and MERGE before writing: a long-running process
+            # holding a stale in-memory copy must not clobber verdicts
+            # other processes persisted since (each verdict costs a
+            # compile probe or a dispatch OOM to rediscover)
+            if os.path.exists(path):
+                with open(path) as f:
+                    on_disk = json.load(f)
+                for k, v in on_disk.items():
+                    _gate_mem.setdefault(k, v)
+            with open(path, "w") as f:
+                json.dump(_gate_mem, f, sort_keys=True)
+        except Exception:
+            pass
+
+
+def _is_vmem_oom(exc: BaseException) -> bool:
+    msg = f"{type(exc).__name__}: {exc}".lower()
+    return (
+        "vmem" in msg
+        or "resource_exhausted" in msg
+        or "resource exhausted" in msg
+        or "out of memory" in msg
+    )
+
+
+def pallas_session_fits(dp, dtype, all_allowed: bool, allow_leader: bool) -> bool:
+    """Does the whole-session kernel fit THIS device at ``dp``'s buckets?
+
+    Decision ladder (r4 verdict #7 — the gate must derive from the
+    device, not from one chip's literals):
+
+    1. a cached verdict for (device kind, P, B, R, mode) wins;
+    2. if the cell-count prior ADMITS the shape, admit — a wrong admit
+       self-corrects: the dispatch's VMEM OOM is caught by ``plan``,
+       recorded as a lasting "doesn't fit" verdict, and the chunk falls
+       back to the XLA session;
+    3. if the prior REJECTS, run a one-shot compile probe of the kernel
+       at the real bucketed shapes (lower+compile, no execution): a
+       bigger-VMEM chip earns its larger ceiling, a Mosaic VMEM error
+       confirms the rejection. Either verdict is cached persistently
+       (and the successful probe's executable lands in the jax compile
+       cache, so the real dispatch does not recompile).
+    """
+    P, R = dp.replicas.shape
+    B = dp.bvalid.shape[0]
+    key = _gate_key(P, B, R, all_allowed, allow_leader)
+    cache = _gate_load()
+    if key in cache:
+        return cache[key]
+    prior = P * max(B, 128) <= (
+        PALLAS_VMEM_CELLS if all_allowed else PALLAS_VMEM_CELLS_RESTRICTED
+    )
+    if prior:
+        return True
+    if jax.devices()[0].platform.lower() not in ("tpu", "axon"):
+        return False  # no hardware to probe; the prior's no stands
+    from kafkabalancer_tpu.solvers.pallas_session import pallas_session
+
+    f32 = jnp.float32
+    sds = jax.ShapeDtypeStruct
+    args = (
+        sds((B,), f32),                                 # loads
+        sds((P, R), jnp.int32),                         # replicas
+        None,                                           # member (unused)
+        None if all_allowed else sds((P, B), bool),     # allowed
+        sds((P,), f32),                                 # weights
+        sds((P,), jnp.int32),                           # nrep_cur
+        sds((P,), jnp.int32),                           # nrep_tgt
+        sds((P,), f32),                                 # ncons
+        sds((P,), bool),                                # pvalid
+        sds((B,), bool),                                # always_valid
+        sds((B,), bool),                                # universe_valid
+        sds((), jnp.int32),                             # min_replicas
+        sds((), f32),                                   # min_unbalance
+        sds((), jnp.int32),                             # budget
+        sds((), jnp.int32),                             # batch
+        sds((), f32),                                   # churn_gate
+    )
+    try:
+        jax.jit(
+            partial(
+                pallas_session,
+                max_moves=8192,
+                allow_leader=allow_leader,
+                interpret=False,
+                all_allowed=all_allowed,
+            )
+        ).lower(*args).compile()
+        fits = True
+    except Exception as exc:
+        if not _is_vmem_oom(exc):
+            return False  # unrelated failure: trust the prior, no verdict
+        fits = False
+    _gate_record(key, fits)
+    return fits
 
 
 @partial(
@@ -1093,13 +1241,13 @@ def plan(
         # (detected by value, before the capacity gate — the all-allowed
         # kernel mode stores no [P, B] matrix and has a far higher ceiling)
         all_allowed = all_allowed_of(dp)
-        if engine == "pallas" and (
-            dp.replicas.shape[0] * max(dp.bvalid.shape[0], 128)
-            > (PALLAS_VMEM_CELLS if all_allowed else PALLAS_VMEM_CELLS_RESTRICTED)
+        if engine == "pallas" and not pallas_session_fits(
+            dp, dtype, all_allowed, cfg.allow_leader_rebalancing
         ):
-            # past the empirical scoped-VMEM ceiling Mosaic compilation
-            # OOMs, so fall back to the XLA while_loop session — same
-            # algorithm, HBM-resident state
+            # past this device's scoped-VMEM ceiling (cached verdict /
+            # prior / compile probe) Mosaic compilation OOMs, so fall
+            # back to the XLA while_loop session — same algorithm,
+            # HBM-resident state
             engine = "xla"
             use_pallas = False
             dp = tensorize(pl, cfg)
@@ -1138,6 +1286,22 @@ def plan(
         except BalanceError:
             raise
         except Exception as exc:
+            if engine == "pallas" and _is_vmem_oom(exc):
+                # the prior admitted a shape THIS chip cannot hold:
+                # record the lasting verdict (future plans skip straight
+                # to XLA) and fall back for this one — same algorithm,
+                # HBM-resident state
+                _gate_record(
+                    _gate_key(
+                        dp.replicas.shape[0], dp.bvalid.shape[0],
+                        dp.replicas.shape[1], all_allowed,
+                        cfg.allow_leader_rebalancing,
+                    ),
+                    False,
+                )
+                engine = "xla"
+                use_pallas = False
+                continue
             if engine in ("pallas", "pallas-interpret"):
                 # compiled Mosaic kernels need a TPU backend; surface a
                 # planning failure (CLI exit 3) instead of a raw traceback

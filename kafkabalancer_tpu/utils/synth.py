@@ -99,3 +99,60 @@ def synth_cluster(
             )
         )
     return PartitionList(version=1, partitions=parts)
+
+
+def rotation_locked_cluster(
+    n_groups: int, weight: float = 1.0
+) -> PartitionList:
+    """Anti-colocation instances whose only improvements are 3-move
+    ROTATIONS — the workload class where beam search's uphill sequences
+    are provably necessary (benchmarks/RESULTS.md round-5 beam note).
+
+    Each group owns three brokers (x, y, z) and three topics (A, B, C),
+    six rf=2 partitions arranged so that per group (weights all equal,
+    every broker's load exactly 6w, num_consumers 0):
+
+    - three colocations are RESOLVABLE only by the follower rotation
+      ``A2f: x->y, B2f: y->z, C2f: z->x`` (restricted broker lists allow
+      exactly one foreign target per movable follower; the other three
+      partitions are frozen — their only allowed targets are already
+      members);
+    - each rotation step alone is UPHILL for the combined objective
+      (perfect load balance means any single move costs
+      pen(5w)+pen(7w)-2*pen(6w) = 1/24 in rel^2 units; pick
+      λ < 1/24 ≈ 0.0417 — e.g. 0.015 — so no single follower move and
+      no broker-disjoint PAIR SWAP improves: the swap partners the
+      polish phase would need are blocked by membership or the
+      restricted lists);
+    - the full 3-cycle returns every load to 6w and removes 3
+      colocations: net -3λ, reachable ONLY through sequence-level
+      acceptance of uphill prefixes (beam depth >= 3).
+
+    Groups are independent and identical, so the certified gap between
+    the greedy-session+polish floor and beam's floor is exactly
+    3λ·n_groups.
+    """
+    parts = []
+    for g in range(n_groups):
+        x, y, z = 3 * g + 1, 3 * g + 2, 3 * g + 3
+        A, B, C = f"rotA{g}", f"rotB{g}", f"rotC{g}"
+
+        def part(topic, pid, leader, follower, allowed):
+            parts.append(
+                Partition(
+                    topic=topic,
+                    partition=pid,
+                    replicas=[leader, follower],
+                    weight=weight,
+                    brokers=sorted(allowed),
+                    num_consumers=0,
+                )
+            )
+
+        part(A, 1, x, z, [x, z])        # frozen
+        part(A, 2, z, x, [z, x, y])     # movable follower x -> y
+        part(B, 1, y, x, [y, x])        # frozen
+        part(B, 2, x, y, [x, y, z])     # movable follower y -> z
+        part(C, 1, z, y, [z, y])        # frozen
+        part(C, 2, y, z, [y, z, x])     # movable follower z -> x
+    return PartitionList(version=1, partitions=parts)

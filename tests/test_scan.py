@@ -358,6 +358,72 @@ def test_pallas_vmem_gate_falls_back_to_xla():
     assert len(opl) == 3
 
 
+def test_pallas_gate_derives_from_device_not_literals(monkeypatch):
+    """r4 verdict #7: the VMEM gate is a device-derived verdict ladder,
+    not the one-chip literals. With the literals effectively DELETED
+    (zeroed), a cached per-device verdict still routes the kernel; a
+    cached negative verdict overrides even huge literals; and a VMEM
+    OOM at dispatch records a lasting negative verdict and falls back
+    to XLA within the same plan() call."""
+    import kafkabalancer_tpu.solvers.scan as scan
+    from kafkabalancer_tpu.utils.synth import synth_cluster
+
+    monkeypatch.setattr(scan, "_gate_cache_path", lambda: None)
+    monkeypatch.setattr(scan, "_gate_mem", {})
+
+    def fresh():
+        pl = synth_cluster(60, 8, rf=2, seed=5, weighted=True)
+        cfg = default_rebalance_config()
+        cfg.min_unbalance = 0.0
+        return pl, cfg
+
+    from kafkabalancer_tpu.ops import tensorize as tz
+    from kafkabalancer_tpu.solvers.pallas_session import TILE_P
+
+    pl0, cfg0 = fresh()
+    dp = tz(pl0, cfg0, min_bucket=TILE_P)
+    P, R = dp.replicas.shape
+    B = dp.bvalid.shape[0]
+    key = scan._gate_key(P, B, R, True, False)
+
+    # literals deleted + positive cached verdict: the kernel is routed
+    # (observable on CPU as the pallas BalanceError instead of fallback)
+    monkeypatch.setattr(scan, "PALLAS_VMEM_CELLS", 0)
+    monkeypatch.setattr(scan, "PALLAS_VMEM_CELLS_RESTRICTED", 0)
+    scan._gate_mem[key] = True
+    pl, cfg = fresh()
+    with pytest.raises(scan.BalanceError, match="pallas engine failed"):
+        scan.plan(pl, cfg, 3, batch=8, engine="pallas")
+
+    # negative cached verdict overrides even infinite literals
+    monkeypatch.setattr(scan, "PALLAS_VMEM_CELLS", 1 << 60)
+    monkeypatch.setattr(scan, "PALLAS_VMEM_CELLS_RESTRICTED", 1 << 60)
+    scan._gate_mem[key] = False
+    pl, cfg = fresh()
+    opl = scan.plan(pl, cfg, 3, batch=8, engine="pallas")
+    assert len(opl) == 3  # fell back to the XLA session cleanly
+
+    # a VMEM OOM at dispatch: verdict recorded, SAME call falls back
+    scan._gate_mem.clear()
+    real_dispatch = scan._dispatch_chunk
+    oomed = []
+
+    def oom_once(dp_, cfg_, chunk, dtype, batch, engine, **kw):
+        if engine == "pallas" and not oomed:
+            oomed.append(True)
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: Ran out of memory in scoped vmem"
+            )
+        return real_dispatch(dp_, cfg_, chunk, dtype, batch, engine, **kw)
+
+    monkeypatch.setattr(scan, "_dispatch_chunk", oom_once)
+    pl, cfg = fresh()
+    opl = scan.plan(pl, cfg, 3, batch=8, engine="pallas")
+    assert len(opl) == 3
+    assert oomed  # the kernel path was attempted first
+    assert scan._gate_mem.get(key) is False  # lasting verdict recorded
+
+
 @pytest.mark.parametrize("polish", [False, True])
 def test_plan_chunk_reentry_equivalent_quality(polish):
     """Sessions that exhaust a device chunk re-enter with the mutated
