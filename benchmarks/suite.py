@@ -698,9 +698,11 @@ def config8_beyond_ceiling():
     cfg.min_unbalance = 0.0
     cfg.allow_leader_rebalancing = True
 
+    def fresh_n(n):
+        return synth_cluster(n, n_brokers, rf=3, seed=42, weighted=True)
+
     def fresh():
-        return synth_cluster(n_parts, n_brokers, rf=3, seed=42,
-                             weighted=True)
+        return fresh_n(n_parts)
 
     ndev = len(jax.devices())
     mesh = make_mesh(ndev, shape=(1, ndev))
@@ -710,12 +712,37 @@ def config8_beyond_ceiling():
     pl_t = fresh()
     tt, opl = timed(plan_sharded, pl_t, copy.deepcopy(cfg), budget, mesh,
                     batch=n_brokers // 2, engine="pallas", polish=True)
+    # shard-ENGINE cross-check, like config 7's single-chip one — but at
+    # QUARTER scale: the shard_map-wrapped XLA session CRASHES the v5e
+    # TPU worker outright at >= 131072 x 256 buckets (r5, reproduced;
+    # the worker dies, no catchable exception; the single-chip XLA
+    # session is fine at 262144 x 256, so it is the shard_map lowering),
+    # which is why plan_sharded's engine="auto" picks the streaming
+    # Mosaic kernel on TPU — it owns the sharded path by SURVIVAL, not
+    # just speed. The quarter-scale A/B (65536-bucket, both engines
+    # healthy) keeps the speed comparison live.
+    n_half = n_parts // 4
+    plan_sharded(fresh_n(n_half), copy.deepcopy(cfg), budget, mesh,
+                 batch=n_brokers // 2, engine="xla", polish=True)  # warm
+    pl_x = fresh_n(n_half)
+    tx, _oplx = timed(plan_sharded, pl_x, copy.deepcopy(cfg), budget, mesh,
+                      batch=n_brokers // 2, engine="xla", polish=True)
+    plan_sharded(fresh_n(n_half), copy.deepcopy(cfg), budget, mesh,
+                 batch=n_brokers // 2, engine="pallas", polish=True)  # warm
+    pl_k = fresh_n(n_half)
+    tk, _oplk = timed(plan_sharded, pl_k, copy.deepcopy(cfg), budget, mesh,
+                      batch=n_brokers // 2, engine="pallas", polish=True)
     row(
         f"8: beyond-ceiling {n_parts // 1000}k/{n_brokers} shard+polish",
         None, None, tt, unbalance_of(pl_t),
         f"{len(opl)} moves to convergence on a {ndev}-device mesh "
         f"(u={unbalance_of(pl_t):.2e}; single-chip kernel cap is "
-        f"128k x 256)",
+        f"128k x 256; the shard_map-wrapped XLA body crashes the worker "
+        f"at >= 131072-buckets — the streaming kernel owns the sharded "
+        f"path by survival, and engine=auto picks it on TPU); "
+        f"quarter-scale ({n_half // 1000}k) shard-engine cross-check: "
+        f"pallas {tk:.2f}s (u={unbalance_of(pl_k):.2e}) vs xla {tx:.2f}s "
+        f"(u={unbalance_of(pl_x):.2e})",
     )
 
 

@@ -444,6 +444,13 @@ def sharded_session(
 # replicates
 _PSHARD_ARGS = (1, 2, 3)
 
+# bucket-cell threshold at which the shard_map-wrapped XLA session kills
+# the v5e TPU worker (r5, reproduced: 131072 x 256 and 262144 x 256
+# crash; 65536 x 256 is healthy; the single-chip session survives all of
+# them, so plan_sharded delegates there when this engine/scale combination
+# is requested on a TPU mesh)
+SHARD_XLA_CRASH_CELLS = 131072 * 256
+
 
 def _globalize(args, mesh: Mesh):
     """Promote host-resident session inputs to global arrays for a mesh
@@ -526,20 +533,89 @@ def plan_sharded(
         _pack_log,
         _prep_from_dp,
         _settle_head,
+        anti_colocation_requested,
         auto_chunk_moves,
         resolve_anti_colocation,
         resolve_engine,
         DEFAULT_CHURN_GATE,
     )
 
-    # "auto" resolves like plan()'s (resolve_engine): the XLA shard body
-    # at every shape; the streaming Mosaic shard kernel stays the
-    # explicit engine="pallas" option (suite config 8 re-times it)
-    engine = resolve_engine(engine)
+    on_tpu = next(iter(mesh.devices.flat)).platform.lower() in (
+        "tpu", "axon",
+    )
+    if engine == "auto":
+        # the SHARDED auto rule differs from plan()'s (which is XLA at
+        # every single-chip shape): on TPU meshes the shard_map-wrapped
+        # XLA session CRASHES the v5e worker outright at
+        # >= 131072 x 256 buckets (r5, reproduced repeatedly; the
+        # single-chip session is fine at 262144 x 256, so this is
+        # specific to the shard_map lowering) and is ~8x slower than
+        # the kernel even where both survive (suite config 8
+        # cross-check). So sharded auto picks the streaming Mosaic
+        # shard kernel on a TPU mesh — except when an anti-colocation
+        # penalty would activate (the kernel has no colocation state;
+        # the big-bucket colocation hazard is delegated below) or the
+        # caller explicitly asked for a non-f32 dtype (the kernel is
+        # float32 by construction; the previous auto honored f64)
+        lam_would, _ = anti_colocation_requested(
+            cfg, anti_colocation, batch
+        )
+        wants_f64 = dtype is not None and dtype != jnp.float32
+        engine = (
+            "xla" if (lam_would > 0 or wants_f64 or not on_tpu)
+            else "pallas"
+        )
+    else:
+        engine = resolve_engine(engine)
     anti_colocation, engine = resolve_anti_colocation(
         cfg, anti_colocation, batch, engine,
         what="sharded colocation session",
     )
+    if engine == "xla" and on_tpu and not cfg.rebalance_leaders:
+        # crash-bucket guard: the XLA shard body is the only
+        # colocation-capable (and only f64) shard engine, but at
+        # >= 131072 x 256 buckets it kills the v5e worker with no
+        # catchable exception — no graceful fallback is possible after
+        # dispatch, so the route is decided HERE. The single-chip
+        # session handles those buckets (measured at 262144 x 256) and
+        # every capability in play (colocation, polish, f64), so
+        # delegate to plan() with a visible warning.
+        from kafkabalancer_tpu.ops.tensorize import broker_universe
+
+        S_axis = mesh.shape[PART_AXIS]
+        P_bucket = next_bucket(
+            max(1, len(pl.partitions or [])), 8 * S_axis
+        )
+        B_bucket = max(
+            next_bucket(max(1, len(broker_universe(pl, cfg))), 8), 128
+        )
+        if P_bucket * B_bucket >= SHARD_XLA_CRASH_CELLS:
+            import warnings
+
+            from kafkabalancer_tpu.solvers.scan import plan
+
+            warnings.warn(
+                f"the shard_map XLA session crashes the TPU worker at "
+                f"{P_bucket} x {B_bucket} buckets; delegating to the "
+                f"single-chip session (same capabilities, survives "
+                f"this scale)",
+                UserWarning,
+                stacklevel=2,
+            )
+            return plan(
+                pl, cfg, max_reassign,
+                # None would mean f64 under global x64 — which ALSO
+                # exceeds the chip at these buckets (measured: the f64
+                # delegated run crashed where f32 converges in ~13 s).
+                # The delegated run keeps the sharded path's throughput
+                # precision; an EXPLICIT f64 request passes through
+                # (it resolved to this engine precisely because the
+                # caller pinned the dtype).
+                dtype=dtype if dtype is not None else jnp.float32,
+                batch=batch,
+                chunk_moves=chunk_moves, engine="xla", polish=polish,
+                anti_colocation=anti_colocation if anti_colocation else None,
+            )
 
     if cfg.rebalance_leaders:
         from kafkabalancer_tpu.solvers.scan import plan

@@ -1085,12 +1085,35 @@ def resolve_engine(engine: str) -> str:
     shapes and beats it increasingly past ~10k partitions (the
     prefix-exact batched commits removed the per-iteration dispatch
     overhead that was the kernel's founding premise), so ``auto``
-    resolves to ``"xla"`` at EVERY single-chip shape. The kernel remains
-    an explicitly-requested alternative (``engine="pallas"``, re-timed
-    every round by suite config 7) and the ceiling-free streaming shard
-    body (parallel/shard_kernel.py), where VMEM residency still earns
-    its keep."""
+    resolves to ``"xla"`` at EVERY single-chip shape — verified up to
+    the 262144 x 256 bucket (160k x 250 converges in ~48 s cold). The
+    kernel remains an explicitly-requested alternative
+    (``engine="pallas"``, re-timed every round by suite config 7) and
+    the ceiling-free streaming shard body (parallel/shard_kernel.py),
+    where it is not merely faster but the only engine that SURVIVES:
+    the shard_map-wrapped XLA session crashes the v5e worker at
+    >= 131072 x 256 buckets, so ``plan_sharded`` has its own auto rule
+    (kernel-on-TPU; see parallel/shard_session.py)."""
     return "xla" if engine == "auto" else engine
+
+
+def anti_colocation_requested(
+    cfg: RebalanceConfig,
+    anti_colocation: "float | None",
+    batch: int,
+) -> "Tuple[float, bool]":
+    """The engine-independent half of the activation convention: the
+    penalty that WOULD activate under an XLA engine, plus whether it was
+    an explicit request. ``plan_sharded``'s auto rule needs exactly this
+    question BEFORE an engine exists (its answer decides the engine), so
+    it lives here rather than being hand-duplicated (r5 review).
+    Returns ``(lam, explicit)``."""
+    if anti_colocation is None:
+        lam = getattr(cfg, "anti_colocation", 0.0) or 0.0
+        if lam and (batch <= 1 or cfg.rebalance_leaders):
+            lam = 0.0
+        return max(0.0, lam), False
+    return max(0.0, anti_colocation), True
 
 
 def resolve_anti_colocation(
@@ -1114,14 +1137,10 @@ def resolve_anti_colocation(
     has no colocation state), and a non-XLA engine is overridden with a
     visible warning (the kernels have no colocation state either).
     """
-    explicit = anti_colocation is not None
-    if not explicit:
-        anti_colocation = getattr(cfg, "anti_colocation", 0.0) or 0.0
-        if anti_colocation and (
-            batch <= 1 or cfg.rebalance_leaders or engine != "xla"
-        ):
-            anti_colocation = 0.0
-    lam = max(0.0, anti_colocation)
+    lam, explicit = anti_colocation_requested(cfg, anti_colocation, batch)
+    if not explicit and lam and engine != "xla":
+        # cfg-derived: an explicit engine request stays honored
+        lam = 0.0
     if lam and batch <= 1:
         raise ValueError("anti_colocation requires batch > 1")
     if lam and cfg.rebalance_leaders:

@@ -797,3 +797,70 @@ def test_plan_sharded_cfg_colocation_convention():
     with pytest.warns(UserWarning, match="overridden"):
         plan_sharded(pl_c, cfg_c, 500, mesh, batch=8,
                      engine="pallas-interpret", anti_colocation=0.001)
+
+
+def test_plan_sharded_auto_engine_rule(monkeypatch):
+    """plan_sharded's engine="auto" rule (r5): off-TPU it resolves to
+    the XLA shard body; on TPU it picks the streaming Mosaic kernel —
+    the shard_map-wrapped XLA session crashes the v5e worker at
+    >= 131072 x 256 buckets (measured, reproduced), so the kernel owns
+    the sharded path by survival — EXCEPT when an anti-colocation
+    penalty activates (the kernel has no colocation state)."""
+    import jax as _jax
+
+    import kafkabalancer_tpu.parallel.shard_session as ss
+    from kafkabalancer_tpu.utils.synth import synth_cluster
+
+    captured = []
+    real = ss.sharded_session
+
+    def spy(*args, **kw):
+        captured.append(kw.get("engine"))
+        return real(*args, **kw)
+
+    monkeypatch.setattr(ss, "sharded_session", spy)
+
+    mesh = make_mesh(2, shape=(1, 2))
+
+    def fresh():
+        pl = synth_cluster(60, 8, rf=2, seed=5, weighted=True,
+                           zipf_topics=True)
+        cfg = default_rebalance_config()
+        cfg.min_unbalance = 1e-7
+        return pl, cfg
+
+    # off-TPU (the CPU test platform): auto -> xla
+    pl, cfg = fresh()
+    ss.plan_sharded(pl, cfg, 50, mesh, batch=4)
+    assert captured[-1] == "xla"
+
+    # mocked TPU mesh platform: auto -> the streaming kernel... which
+    # cannot actually run on CPU, so assert the RESOLUTION via the
+    # error path. The discriminator is the MESH's devices (a virtual
+    # CPU mesh on a TPU host must resolve xla), so mock the mesh.
+    class FakeDev:
+        platform = "tpu"
+        process_index = 0
+
+    class FakeFlat:
+        flat = [FakeDev()]
+
+    class FakeMesh:
+        devices = FakeFlat()
+        shape = dict(mesh.shape)
+
+    pl, cfg = fresh()
+    with pytest.raises(Exception, match="pallas"):
+        ss.plan_sharded(pl, cfg, 50, FakeMesh(), batch=4)
+
+    # mocked TPU mesh + activating colocation: auto -> xla (kernel has
+    # no colocation state); runs on the REAL mesh (no mock leaks: the
+    # FakeMesh was scoped to the call above)
+    pl, cfg = fresh()
+    ss.plan_sharded(pl, cfg, 50, mesh, batch=4, anti_colocation=0.001)
+    assert captured[-1] == "xla"
+
+    # explicit f64 request: auto honors the precision (kernel is f32)
+    pl, cfg = fresh()
+    ss.plan_sharded(pl, cfg, 50, mesh, batch=4, dtype=jnp.float64)
+    assert captured[-1] == "xla"
