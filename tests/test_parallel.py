@@ -864,3 +864,64 @@ def test_plan_sharded_auto_engine_rule(monkeypatch):
     pl, cfg = fresh()
     ss.plan_sharded(pl, cfg, 50, mesh, batch=4, dtype=jnp.float64)
     assert captured[-1] == "xla"
+
+
+def test_plan_sharded_crash_bucket_delegates(monkeypatch):
+    """The crash-bucket guard (r5): an explicit XLA shard request on a
+    TPU mesh at >= 131072 x 256 buckets must DELEGATE to the single-chip
+    session with a warning — the shard_map XLA body kills the TPU worker
+    there with no catchable exception, so the route is decided before
+    dispatch. Pure-CPU test: the mesh platform is mocked, plan() is
+    captured, and no device work runs."""
+    import kafkabalancer_tpu.solvers.scan as scan_mod
+    import kafkabalancer_tpu.parallel.shard_session as ss
+    from kafkabalancer_tpu.models import Partition, PartitionList
+
+    # 140k partitions -> P bucket 262144 (> the 131072-bucket threshold
+    # with B bucket 256); replicas spread over 250 brokers
+    parts = [
+        Partition(
+            topic=f"t{i // 64}", partition=i % 64,
+            replicas=[1 + (i % 250), 1 + ((i + 97) % 250)],
+            weight=1.0,
+        )
+        for i in range(140_000)
+    ]
+    pl = PartitionList(version=1, partitions=parts)
+    cfg = default_rebalance_config()
+
+    captured = {}
+
+    def fake_plan(pl_, cfg_, budget, **kw):
+        captured.update(kw, budget=budget)
+        from kafkabalancer_tpu.models.partition import empty_partition_list
+
+        return empty_partition_list()
+
+    monkeypatch.setattr(scan_mod, "plan", fake_plan)
+
+    class FakeDev:
+        platform = "tpu"
+        process_index = 0
+
+    class FakeFlat:
+        flat = [FakeDev()]
+
+    class FakeMesh:
+        devices = FakeFlat()
+        shape = {"sweep": 1, "part": 1}
+
+    with pytest.warns(UserWarning, match="delegating"):
+        ss.plan_sharded(pl, cfg, 1000, FakeMesh(), batch=8, engine="xla")
+    assert captured["engine"] == "xla"
+    assert captured["budget"] == 1000
+    # the delegated run defaults to f32 (plain f64 also exceeds the
+    # chip at crash buckets); an explicit dtype passes through
+    assert captured["dtype"] == jnp.float32
+    captured.clear()
+    with pytest.warns(UserWarning, match="delegating"):
+        ss.plan_sharded(
+            pl, cfg, 1000, FakeMesh(), batch=8, engine="xla",
+            dtype=jnp.float64,
+        )
+    assert captured["dtype"] == jnp.float64
