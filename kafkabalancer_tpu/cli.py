@@ -299,9 +299,13 @@ def run(i, o, e, args: List[str]) -> int:
                 log("-anti-colocation with -fused requires -fused-batch>1")
                 usage()
                 return 3
-            if f_engine.value.startswith("pallas"):
-                # not an error (plan() runs the XLA colocation session),
-                # but the engine request is overridden — say so
+            if f_engine.value.startswith("pallas") and not f_shard.value:
+                # not an error (plan() runs the XLA colocation session;
+                # the single-chip whole-session kernel has no colocation
+                # state), but the engine request is overridden — say so.
+                # -fused-shard is different: the streaming shard kernel
+                # carries the colocation objective (r5), so the request
+                # stands there.
                 log(
                     "-anti-colocation runs the XLA colocation session; "
                     f"-fused-engine={f_engine.value} is ignored"

@@ -33,7 +33,17 @@ pair column, +inf where no feasible candidate; with ``allow_leader``
 False the leader refs are dead but still written every grid step (the
 Mosaic constraint below). Pinned by tests/test_parallel.py: the
 pallas-interpret sharded session's move log is bit-identical to the XLA
-sharded session's.
+sharded session's (with and without the colocation mode). On REAL
+hardware, f32 reduction-order ties can resolve differently between the
+engines with equivalent final quality (same colocation counts,
+same-decade unbalance floors — measured numbers in
+benchmarks/RESULTS.md), the same caveat class as the whole-session
+kernel.
+
+``with_colo`` (r5) adds the anti-colocation objective: per-(row,
+broker) same-topic counts stream as one more gridded input and the ±λ
+terms land in both passes' A/C exactly as cost.factored_target_best /
+cost.paired_best apply them.
 """
 
 from __future__ import annotations
@@ -60,27 +70,33 @@ SHARD_TILE_P = 256
 
 
 def _kernel(
-    replicas_ref,  # [T, R] i32 dense broker indices (-1 pad)
-    cols_ref,      # [T, 5] f32: w | ncur | ntgt | ncons | pvalid
-    member_ref,    # [T, B] bool
-    allowed_ref,   # [T, B] bool
-    loads_ref,     # [1, B] f32
-    F_ref,         # [1, B] f32 (bvalid-masked penalty terms)
-    bvalid_ref,    # [1, B] bool
-    scal_ref,      # [1, 2] f32: avg | min_replicas
-    ssel_ref,      # [B, B2] f32 hot-broker one-hot columns (pair_frame)
-    tsel_ref,      # [B, B2] f32 cold-broker one-hot columns
-    vf_ref,        # [1, B] f32 out: best follower A*+C per target
-    pf_ref,        # [1, B] i32 out: its LOCAL partition row
-    vl_ref,        # [1, B] f32 out: best leader A+C per target
-    pl_ref,        # [1, B] i32 out: its LOCAL partition row
-    vpf_ref,       # [1, B2] f32 out: best follower A+C per broker pair
-    ppf_ref,       # [1, B2] i32 out: its LOCAL partition row
-    vpl_ref,       # [1, B2] f32 out: best leader A+C per broker pair
-    ppl_ref,       # [1, B2] i32 out: its LOCAL partition row
-    *,
+    *refs,
     allow_leader: bool,
+    with_colo: bool,
 ):
+    """Gridded scoring kernel. Positional refs, in order:
+
+    replicas [T, R] i32 | cols [T, 5] f32 (w | ncur | ntgt | ncons |
+    pvalid) | member [T, B] bool | allowed [T, B] bool |
+    [crows [T, B] f32 — only when ``with_colo``: per-(row, broker)
+    same-topic replica counts] | loads [1, B] f32 | F [1, B] f32 |
+    bvalid [1, B] bool | scal [1, 3] f32 (avg | min_replicas | lam) |
+    ssel/tsel [B, B2] f32 one-hot pair columns; then the eight outputs
+    (vf/pf/vl/pl per target, vpf/ppf/vpl/ppl per pair).
+
+    ``with_colo`` adds the anti-colocation ±λ terms exactly as
+    cost.factored_target_best/paired_best do (colo_sub into A,
+    colo_add into C, both passes, both slot classes) — the extra
+    [T, B] input streams only when the objective needs it.
+    """
+    replicas_ref, cols_ref, member_ref, allowed_ref = refs[:4]
+    i = 4
+    crows_ref = refs[i] if with_colo else None
+    i += 1 if with_colo else 0
+    loads_ref, F_ref, bvalid_ref, scal_ref, ssel_ref, tsel_ref = refs[i:i + 6]
+    (vf_ref, pf_ref, vl_ref, pl_ref,
+     vpf_ref, ppf_ref, vpl_ref, ppl_ref) = refs[i + 6:]
+
     ti = pl.program_id(0)
     T, B = member_ref.shape[0], member_ref.shape[1]
     B2 = ssel_ref.shape[1]
@@ -104,6 +120,18 @@ def _kernel(
     F = F_ref[...]
     avg = scal_ref[0, 0]
     minrep = scal_ref[0, 1]
+    if with_colo:
+        lam = scal_ref[0, 2]
+        crows = crows_ref[...]
+        # cost.colo_terms, kernel form (literal-free comparisons)
+        colo_sub = (
+            crows >= jnp.full((1, 1), 2.0, f32)
+        ).astype(f32) * lam
+        colo_add = (
+            crows >= jnp.full((1, 1), 1.0, f32)
+        ).astype(f32) * lam
+    else:
+        colo_sub = colo_add = None
 
     iota_b = lax.broadcasted_iota(i32, (T, B), 1)
     row_iota = lax.broadcasted_iota(i32, (T, B), 0)
@@ -150,9 +178,13 @@ def _kernel(
     # --- follower pass (member brokers minus the leader, delta = w) -----
     srcmask = member & ~lead_oh & eligible
     A0 = cost.overload_penalty(loads - w, avg) - F
+    if with_colo:
+        A0 = A0 - colo_sub
     A = jnp.where(srcmask, A0, inf)
     A_star = jnp.min(A, axis=1, keepdims=True)  # [T, 1]
     C = cost.overload_penalty(loads + w, avg) - F
+    if with_colo:
+        C = C + colo_add
     V = jnp.where(tmask & jnp.isfinite(A_star), A_star + C, inf)
     vmin = jnp.min(V, axis=0, keepdims=True)  # [1, B]
     arg = jnp.min(
@@ -185,10 +217,14 @@ def _kernel(
         wl = w * (ncur + ncons)
         ok_l = (ncur >= jnp.ones((1, 1), f32)) & eligible
         A_l0 = cost.overload_penalty(loads - wl, avg) - F
+        if with_colo:
+            A_l0 = A_l0 - colo_sub
         A_l = jnp.min(
             jnp.where(lead_oh & ok_l, A_l0, inf), axis=1, keepdims=True
         )
         C_l = cost.overload_penalty(loads + wl, avg) - F
+        if with_colo:
+            C_l = C_l + colo_add
         V_l = jnp.where(tmask & jnp.isfinite(A_l), A_l + C_l, inf)
         vmin_l = jnp.min(V_l, axis=0, keepdims=True)
         arg_l = jnp.min(
@@ -233,9 +269,10 @@ def shard_score(
     loads,     # [1, B] f32
     F,         # [1, B] f32
     bvalid,    # [1, B] bool
-    scal,      # [1, 2] f32: avg | min_replicas
+    scal,      # [1, 3] f32: avg | min_replicas | lam
     ssel,      # [B, B2] f32 hot one-hot columns (cost.pair_frame)
     tsel,      # [B, B2] f32 cold one-hot columns
+    c_rows=None,  # [P_l, B] f32 same-topic counts (colocation mode)
     *,
     allow_leader: bool,
     interpret: bool = False,
@@ -245,7 +282,9 @@ def shard_score(
     vals_pl [B2], p_pl [B2])`` — raw ``A+C`` minima (no ``su`` offset)
     with LOCAL winner rows, per target and per broker pair; the caller
     does the leader merges and slot recovery (shared with the XLA
-    engine)."""
+    engine). ``c_rows`` (with ``scal``'s λ) switches on the
+    anti-colocation ±λ terms — the [P_l, B] counts stream as one more
+    gridded input only in that mode."""
     P_l, R = replicas.shape
     B = member.shape[1]
     B2 = ssel.shape[1]
@@ -253,6 +292,7 @@ def shard_score(
     if P_l % T:
         raise ValueError(f"shard rows {P_l} not a multiple of tile {T}")
     grid = (P_l // T,)
+    with_colo = c_rows is not None
 
     # index maps cast to int32 explicitly: under global x64 the grid
     # indices trace as 64-bit and Mosaic fails to legalize the whole
@@ -263,21 +303,28 @@ def shard_score(
     def const_map(i):
         return (jnp.int32(0), jnp.int32(0))
 
+    in_specs = [
+        pl.BlockSpec((T, R), tile_map),
+        pl.BlockSpec((T, 5), tile_map),
+        pl.BlockSpec((T, B), tile_map),
+        pl.BlockSpec((T, B), tile_map),
+        *([pl.BlockSpec((T, B), tile_map)] if with_colo else []),
+        pl.BlockSpec((1, B), const_map),
+        pl.BlockSpec((1, B), const_map),
+        pl.BlockSpec((1, B), const_map),
+        pl.BlockSpec((1, 3), const_map),
+        pl.BlockSpec((B, B2), const_map),
+        pl.BlockSpec((B, B2), const_map),
+    ]
+    inputs = (
+        replicas, cols, member, allowed,
+        *((c_rows,) if with_colo else ()),
+        loads, F, bvalid, scal, ssel, tsel,
+    )
     out = pl.pallas_call(
-        partial(_kernel, allow_leader=allow_leader),
+        partial(_kernel, allow_leader=allow_leader, with_colo=with_colo),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((T, R), tile_map),
-            pl.BlockSpec((T, 5), tile_map),
-            pl.BlockSpec((T, B), tile_map),
-            pl.BlockSpec((T, B), tile_map),
-            pl.BlockSpec((1, B), const_map),
-            pl.BlockSpec((1, B), const_map),
-            pl.BlockSpec((1, B), const_map),
-            pl.BlockSpec((1, 2), const_map),
-            pl.BlockSpec((B, B2), const_map),
-            pl.BlockSpec((B, B2), const_map),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, B), const_map),
             pl.BlockSpec((1, B), const_map),
@@ -299,7 +346,7 @@ def shard_score(
             jax.ShapeDtypeStruct((1, B2), jnp.int32),
         ],
         interpret=interpret,
-    )(replicas, cols, member, allowed, loads, F, bvalid, scal, ssel, tsel)
+    )(*inputs)
     vf, pf, vl, pl_, vpf, ppf, vpl, ppl = out
     return (
         vf[0], pf[0], vl[0], pl_[0],

@@ -116,8 +116,10 @@ def sharded_session(
     the candidate values), and ``prefix_accept``'s (topic, broker)
     first-claims carry the exactness argument verbatim — so move logs
     stay bit-identical to the single-device colocation session at the
-    same dtype. XLA shard engine only (the scoring kernel has no
-    colocation state).
+    same dtype. BOTH shard engines carry it: the streaming kernel
+    takes the per-row counts as one more gridded input (r5,
+    parallel/shard_kernel.py ``with_colo``) with move logs
+    bit-identical to the XLA shard engine at float32.
     """
     P, R = replicas.shape
     B = loads.shape[0]
@@ -134,11 +136,6 @@ def sharded_session(
         raise ValueError("the pallas shard engine is float32 only")
     if engine not in ("xla", "pallas", "pallas-interpret"):
         raise ValueError(f"unknown shard engine {engine!r}")
-    if n_topics and use_pallas:
-        raise ValueError(
-            "the pallas shard engine has no colocation state; use the "
-            "xla shard engine with anti_colocation"
-        )
     if n_topics and batch <= 1:
         raise ValueError(
             "the sharded anti-colocation session requires batch > 1 "
@@ -222,14 +219,16 @@ def sharded_session(
             slot_iota_r = jnp.arange(R)[None, :]
             iota_bb = jnp.arange(B, dtype=jnp.int32)[:, None]
 
-        def _score_pallas(loads, replicas, member, bvalid, nb):
+        def _score_pallas(loads, replicas, member, bvalid, nb,
+                          c_rows=None):
             """Kernel-backed analog of the XLA branch's
             ``factored_target_best`` + ``paired_best`` calls: same
             avg/F/su/rank arithmetic, the fused kernel for the [P_l, B] +
             [P_l, B2] passes, and the shared leader merges + winner-only
             slot recovery OUTSIDE the kernel (cost.pair_frame /
             cost.pair_finish are literally the same functions the XLA
-            engine uses)."""
+            engine uses). ``c_rows`` switches on the kernel's
+            anti-colocation ±λ terms."""
             avg = jnp.sum(jnp.where(bvalid, loads, 0.0)) / nb
             F = jnp.where(bvalid, cost.overload_penalty(loads, avg), 0.0)
             su = jnp.sum(F)
@@ -243,20 +242,28 @@ def sharded_session(
                 loads.reshape(1, B),
                 F.reshape(1, B),
                 bvalid.reshape(1, B),
-                jnp.stack([avg, min_replicas.astype(dtype)]).reshape(1, 2),
+                jnp.stack(
+                    [avg, min_replicas.astype(dtype), lam.astype(dtype)]
+                ).reshape(1, 3),
                 s_oh.astype(dtype),
                 t_oh.astype(dtype),
+                None if c_rows is None else c_rows.astype(dtype),
                 allow_leader=allow_leader,
                 interpret=(engine == "pallas-interpret"),
             )
             # follower slot recovery for the [B] winners — mirrors
-            # cost.factored_target_best's slot_of (ascending-slot ties)
+            # cost.factored_target_best's slot_of (ascending-slot ties),
+            # including the colocation source term the winner was
+            # scored with
             rowsA = (
                 cost.overload_penalty(
                     loads[None, :] - w_l[p_f][:, None], avg
                 )
                 - F[None, :]
             )  # [B, B]
+            if c_rows is not None:
+                sub_w, _ = cost.colo_terms(c_rows[p_f], lam)
+                rowsA = rowsA - sub_w
             rp = replicas[p_f]  # [B, R]
             slot_vals = rowsA[iota_bb, jnp.clip(rp, 0)]
             valids = (slot_iota_r >= 1) & (
@@ -309,7 +316,7 @@ def sharded_session(
             if use_pallas:
                 su, vals_t_l, p_t_l, slot_t_l, vals_p_l, p_p_l, slot_p_l, \
                     s_p, t_p = _score_pallas(
-                        loads, replicas, member, bvalid, nb
+                        loads, replicas, member, bvalid, nb, c_rows=c_rows
                     )
             else:
                 su, vals_t_l, p_t_l, slot_t_l = cost.factored_target_best(
@@ -514,13 +521,13 @@ def plan_sharded(
     ``anti_colocation=λ > 0`` runs the COMBINED objective sharded (see
     ``sharded_session``): the [T, B] counts replicate like loads, each
     shard scores its rows with the ±λ terms, and the polish tail (when
-    ``polish``) is the colocation-aware alternation. Follows ``plan``'s
-    convention exactly: the kwarg overrides; a cfg-derived penalty only
-    activates where it changes nothing for legacy callers (XLA engine,
-    batch > 1, no rebalance_leaders — otherwise it deactivates and the
-    session plans loads only). XLA shard engine required: an explicit
-    request with a pallas engine is overridden with a warning, like
-    ``plan``'s."""
+    ``polish``) is the colocation-aware alternation. The kwarg
+    overrides; a cfg-derived penalty activates unless ``batch <= 1`` or
+    ``rebalance_leaders`` (the shared ``anti_colocation_requested``
+    predicate). Unlike ``plan()`` (whose whole-session kernel has no
+    colocation state), BOTH shard engines carry the objective since r5
+    — the streaming kernel streams the per-row counts — so no engine is
+    overridden and ``auto`` keeps the kernel on TPU meshes."""
     from kafkabalancer_tpu.balancer.steps import BalanceError
     from kafkabalancer_tpu.models.partition import empty_partition_list
     from kafkabalancer_tpu.ops import tensorize
@@ -535,7 +542,6 @@ def plan_sharded(
         _settle_head,
         anti_colocation_requested,
         auto_chunk_moves,
-        resolve_anti_colocation,
         resolve_engine,
         DEFAULT_CHURN_GATE,
     )
@@ -552,25 +558,31 @@ def plan_sharded(
         # specific to the shard_map lowering) and is ~8x slower than
         # the kernel even where both survive (suite config 8
         # cross-check). So sharded auto picks the streaming Mosaic
-        # shard kernel on a TPU mesh — except when an anti-colocation
-        # penalty would activate (the kernel has no colocation state;
-        # the big-bucket colocation hazard is delegated below) or the
-        # caller explicitly asked for a non-f32 dtype (the kernel is
-        # float32 by construction; the previous auto honored f64)
-        lam_would, _ = anti_colocation_requested(
-            cfg, anti_colocation, batch
-        )
+        # shard kernel on a TPU mesh — including for the combined
+        # anti-colocation objective (the kernel carries it since r5) —
+        # unless the caller explicitly asked for a non-f32 dtype (the
+        # kernel is float32 by construction; the previous auto honored
+        # f64).
         wants_f64 = dtype is not None and dtype != jnp.float32
-        engine = (
-            "xla" if (lam_would > 0 or wants_f64 or not on_tpu)
-            else "pallas"
-        )
+        engine = "xla" if (wants_f64 or not on_tpu) else "pallas"
     else:
         engine = resolve_engine(engine)
-    anti_colocation, engine = resolve_anti_colocation(
-        cfg, anti_colocation, batch, engine,
-        what="sharded colocation session",
+    # the sharded path's colocation activation is ENGINE-INDEPENDENT
+    # (both shard engines carry the objective since r5), so it uses the
+    # shared request predicate directly — no engine override, no
+    # warning; the validations mirror resolve_anti_colocation's (only
+    # an explicit request can reach them: a cfg-derived penalty
+    # deactivates on batch<=1/rebalance_leaders inside the predicate)
+    anti_colocation, _colo_explicit = anti_colocation_requested(
+        cfg, anti_colocation, batch
     )
+    if anti_colocation and batch <= 1:
+        raise ValueError("anti_colocation requires batch > 1")
+    if anti_colocation and cfg.rebalance_leaders:
+        raise ValueError(
+            "anti_colocation is not supported with rebalance_leaders "
+            "(the fused leader session has no colocation state)"
+        )
     if engine == "xla" and on_tpu and not cfg.rebalance_leaders:
         # crash-bucket guard: the XLA shard body is the only
         # colocation-capable (and only f64) shard engine, but at
@@ -614,7 +626,10 @@ def plan_sharded(
                 dtype=dtype if dtype is not None else jnp.float32,
                 batch=batch,
                 chunk_moves=chunk_moves, engine="xla", polish=polish,
-                anti_colocation=anti_colocation if anti_colocation else None,
+                # the RESOLVED penalty, verbatim — a 0.0 here may be an
+                # explicit caller disable that must not let plan()
+                # re-derive (and re-activate) cfg.anti_colocation
+                anti_colocation=anti_colocation,
             )
 
     if cfg.rebalance_leaders:

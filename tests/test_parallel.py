@@ -757,12 +757,14 @@ def test_sharded_colocation_polish_reaches_floor():
 
 
 def test_plan_sharded_cfg_colocation_convention():
-    """ADVICE r4 #2: a cfg-derived anti_colocation must NOT raise in
-    plan_sharded — it activates only where it changes nothing for legacy
-    callers (mirrors plan()'s convention). With the xla engine and
-    batch > 1 it activates; with a pallas engine it deactivates and the
-    sharded session plans loads only; an EXPLICIT request with a pallas
-    engine is overridden with a warning."""
+    """ADVICE r4 #2 + the r5 kernel-colocation update: a cfg-derived
+    anti_colocation must NOT raise in plan_sharded, and since BOTH shard
+    engines now carry the combined objective, activation is
+    engine-independent (the shared anti_colocation_requested predicate:
+    active unless batch<=1 or rebalance_leaders) — no engine override,
+    no warning."""
+    import warnings as _warnings
+
     from kafkabalancer_tpu.parallel.shard_session import plan_sharded
     from kafkabalancer_tpu.utils.synth import synth_cluster
 
@@ -778,25 +780,72 @@ def test_plan_sharded_cfg_colocation_convention():
         cfg.anti_colocation = 0.001
         return pl, cfg
 
-    # cfg-derived + pallas engine: deactivates, plans loads only, no
-    # raise (the legacy bulk-phase reuse ADVICE r4 #2 called out)
+    # cfg-derived + the streaming kernel (interpret off-TPU): ACTIVATES
+    # (the r5 kernel carries the ±λ terms), no raise, no warning
     pl_a, cfg_a = fresh()
-    opl = plan_sharded(pl_a, cfg_a, 500, mesh, batch=8,
-                       engine="pallas-interpret")
-    assert len(opl) > 0
+    c0 = _colo_count_pl(pl_a)
+    with _warnings.catch_warnings():
+        _warnings.simplefilter("error")
+        plan_sharded(pl_a, cfg_a, 20000, mesh, batch=8,
+                     engine="pallas-interpret")
+    assert _colo_count_pl(pl_a) < c0
 
-    # cfg-derived + xla engine: activates (colocations drop)
+    # cfg-derived + xla engine: activates identically
     pl_b, cfg_b = fresh()
-    c0 = _colo_count_pl(pl_b)
     plan_sharded(pl_b, cfg_b, 20000, mesh, batch=8)
     assert _colo_count_pl(pl_b) < c0
 
-    # explicit + pallas engine: overridden with a warning
+    # cfg-derived + batch=1: deactivates (plans loads only, no raise)
     pl_c, cfg_c = fresh()
-    cfg_c.anti_colocation = 0.0
-    with pytest.warns(UserWarning, match="overridden"):
-        plan_sharded(pl_c, cfg_c, 500, mesh, batch=8,
-                     engine="pallas-interpret", anti_colocation=0.001)
+    opl = plan_sharded(pl_c, cfg_c, 500, mesh, batch=1)
+    assert len(opl) > 0
+    # explicit + batch=1: hard error (mirrors plan())
+    pl_d, cfg_d = fresh()
+    cfg_d.anti_colocation = 0.0
+    with pytest.raises(ValueError, match="batch"):
+        plan_sharded(pl_d, cfg_d, 500, mesh, batch=1,
+                     anti_colocation=0.001)
+
+
+def test_sharded_colocation_kernel_bit_matches_xla():
+    """The streaming shard kernel's anti-colocation mode (r5,
+    shard_kernel.py with_colo): move logs bit-identical to the XLA
+    shard engine at float32 on a zipf-topic instance — same ±λ terms in
+    both passes, same slot recovery including the colocation source
+    term."""
+    from kafkabalancer_tpu.parallel.shard_session import plan_sharded
+    from kafkabalancer_tpu.utils.synth import synth_cluster
+
+    lam = 0.001
+    mesh = make_mesh(8, shape=(1, 8))
+
+    def fresh():
+        pl = synth_cluster(400, 16, rf=3, seed=5, weighted=True,
+                           zipf_topics=True)
+        cfg = default_rebalance_config()
+        cfg.allow_leader_rebalancing = True
+        cfg.min_unbalance = 1e-9
+        return pl, cfg
+
+    pl_k, cfg_k = fresh()
+    opl_k = plan_sharded(pl_k, cfg_k, 20000, mesh, batch=16,
+                         engine="pallas-interpret", anti_colocation=lam)
+    pl_x, cfg_x = fresh()
+    opl_x = plan_sharded(pl_x, cfg_x, 20000, mesh, batch=16,
+                         dtype=jnp.float32, engine="xla",
+                         anti_colocation=lam)
+    mk = [
+        (p.topic, p.partition, tuple(p.replicas))
+        for p in (opl_k.partitions or [])
+    ]
+    mx = [
+        (p.topic, p.partition, tuple(p.replicas))
+        for p in (opl_x.partitions or [])
+    ]
+    assert mk == mx
+    assert pl_k == pl_x
+    assert mk  # the session actually planned moves
+    assert _colo_count_pl(pl_k) < 1018  # colocations actually dropped
 
 
 def test_plan_sharded_auto_engine_rule(monkeypatch):
@@ -804,8 +853,9 @@ def test_plan_sharded_auto_engine_rule(monkeypatch):
     the XLA shard body; on TPU it picks the streaming Mosaic kernel —
     the shard_map-wrapped XLA session crashes the v5e worker at
     >= 131072 x 256 buckets (measured, reproduced), so the kernel owns
-    the sharded path by survival — EXCEPT when an anti-colocation
-    penalty activates (the kernel has no colocation state)."""
+    the sharded path by survival — INCLUDING with an activating
+    anti-colocation penalty (the kernel carries the combined objective
+    since late r5); only an explicit non-f32 dtype forces XLA."""
     import jax as _jax
 
     import kafkabalancer_tpu.parallel.shard_session as ss
@@ -853,9 +903,15 @@ def test_plan_sharded_auto_engine_rule(monkeypatch):
     with pytest.raises(Exception, match="pallas"):
         ss.plan_sharded(pl, cfg, 50, FakeMesh(), batch=4)
 
-    # mocked TPU mesh + activating colocation: auto -> xla (kernel has
-    # no colocation state); runs on the REAL mesh (no mock leaks: the
-    # FakeMesh was scoped to the call above)
+    # mocked TPU mesh + activating colocation: STILL the kernel (it
+    # carries the combined objective since late r5)
+    pl, cfg = fresh()
+    with pytest.raises(Exception, match="pallas"):
+        ss.plan_sharded(pl, cfg, 50, FakeMesh(), batch=4,
+                        anti_colocation=0.001)
+
+    # off-TPU + activating colocation: xla (the platform, not the
+    # objective, decides)
     pl, cfg = fresh()
     ss.plan_sharded(pl, cfg, 50, mesh, batch=4, anti_colocation=0.001)
     assert captured[-1] == "xla"
