@@ -10,7 +10,12 @@ two orthogonal mesh axes instead:
 - ``part`` — partition sharding: the ``[P, R, B]`` candidate tensor of a
   single solve is split over devices, each scoring its partition shard,
   with an ``all_gather`` argmin combine that preserves the solver's
-  candidate-order tie-break (:mod:`kafkabalancer_tpu.parallel.shard_move`).
+  candidate-order tie-break (:mod:`kafkabalancer_tpu.parallel.shard_move`);
+  the whole CONVERGE session also runs sharded
+  (:mod:`kafkabalancer_tpu.parallel.shard_session` ``plan_sharded`` — CLI
+  ``-fused-shard``), with the streaming Mosaic scoring kernel
+  (:mod:`kafkabalancer_tpu.parallel.shard_kernel`) carrying both the load
+  and the combined anti-colocation objectives.
 
 Collectives ride the ICI mesh; host code only dispatches and decodes.
 """
@@ -19,3 +24,5 @@ from kafkabalancer_tpu.parallel.mesh import make_mesh
 from kafkabalancer_tpu.parallel.distributed import initialize, is_multi_host
 
 __all__ = ["make_mesh", "initialize", "is_multi_host"]
+# plan_sharded / sweep import jax at module load; reach them via their
+# submodules so this index keeps the lazy-import contract of the package
