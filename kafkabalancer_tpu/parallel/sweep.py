@@ -298,7 +298,18 @@ def _sweep_exec(
     replicas_s, feasible_s, completed_s, n_evac_s, n_moves_s, su_s = out
     # pack every output into ONE int32 array (f32 objective bitcast): on a
     # remote-attached TPU each separate device->host fetch pays a full
-    # relay round trip (~0.1 s), which dominated the warm sweep wall-clock
+    # relay round trip (~0.1 s), which dominated the warm sweep wall-clock.
+    # 64-BIT objectives cannot ride the pack on TPU: the f64->int32
+    # bitcast lowers through a u64 the backend's X64 rewriting does not
+    # implement (measured failure; plain f64 outputs work fine), so the
+    # f64 parity mode returns the objective as its own output — one extra
+    # fetch on a path that is about exactness, not wall-clock.
+    wide = jnp.dtype(su_s.dtype).itemsize == 8
+    tail = (
+        []
+        if wide
+        else [lax.bitcast_convert_type(su_s, jnp.int32).reshape(-1)]
+    )
     packed = jnp.concatenate(
         [
             replicas_s.astype(jnp.int32).reshape(-1),
@@ -306,17 +317,20 @@ def _sweep_exec(
             completed_s.astype(jnp.int32),
             n_evac_s.astype(jnp.int32),
             n_moves_s.astype(jnp.int32),
-            # objective packed at its native precision (1 int32 word for
-            # f32, 2 for f64 — the CPU parity tests compare f64 exactly)
-            lax.bitcast_convert_type(su_s, jnp.int32).reshape(-1),
         ]
+        + tail
     )
     # replicate across the mesh so every process of a multi-host runtime
     # holds the full result (scenario shards live on their owning process
     # otherwise, and a host-side fetch of a non-addressable array raises)
-    return jax.lax.with_sharding_constraint(
-        packed, jax.sharding.NamedSharding(mesh, P())
+    rep_sharding = jax.sharding.NamedSharding(mesh, P())
+    packed = jax.lax.with_sharding_constraint(packed, rep_sharding)
+    su_out = (
+        jax.lax.with_sharding_constraint(su_s, rep_sharding)
+        if wide
+        else None
     )
+    return packed, su_out
 
 
 def sweep(
@@ -498,34 +512,36 @@ def sweep(
         )
         ncur_dec = [ncur_np[i] for i in range(S)]
 
-    packed = np.asarray(
-        _sweep_exec(
-            jnp.asarray(scenario_mask),
-            reps_arg, member_arg,
-            jnp.asarray(dp.allowed), jnp.asarray(has_explicit),
-            jnp.asarray(dp.weights, dtype), ncur_arg,
-            jnp.asarray(dp.nrep_tgt), jnp.asarray(dp.ncons, dtype),
-            jnp.asarray(dp.pvalid), jnp.asarray(dp.bvalid),
-            jnp.int32(cfg.min_replicas_for_rebalancing),
-            jnp.asarray(cfg.min_unbalance, dtype),
-            budget_arg,
-            mesh=mesh,
-            max_moves=max_moves,
-            max_evac=max_evac,
-            allow_leader=cfg.allow_leader_rebalancing,
-            batch=max(1, batch),
-            engine=engine,
-            per_scenario=scen_pls is not None,
-        )
+    packed_dev, su_dev = _sweep_exec(
+        jnp.asarray(scenario_mask),
+        reps_arg, member_arg,
+        jnp.asarray(dp.allowed), jnp.asarray(has_explicit),
+        jnp.asarray(dp.weights, dtype), ncur_arg,
+        jnp.asarray(dp.nrep_tgt), jnp.asarray(dp.ncons, dtype),
+        jnp.asarray(dp.pvalid), jnp.asarray(dp.bvalid),
+        jnp.int32(cfg.min_replicas_for_rebalancing),
+        jnp.asarray(cfg.min_unbalance, dtype),
+        budget_arg,
+        mesh=mesh,
+        max_moves=max_moves,
+        max_evac=max_evac,
+        allow_leader=cfg.allow_leader_rebalancing,
+        batch=max(1, batch),
+        engine=engine,
+        per_scenario=scen_pls is not None,
     )
+    packed = np.asarray(packed_dev)
     P_pad, R_pad = dp.replicas.shape
     nrep = S_pad * P_pad * R_pad
     replicas_s = packed[:nrep].reshape(S_pad, P_pad, R_pad)
     scalars = packed[nrep : nrep + 4 * S_pad].reshape(4, S_pad)
     feasible_s, completed_s, n_evac_s, n_moves_s = scalars
-    su_s = np.ascontiguousarray(packed[nrep + 4 * S_pad :]).view(
-        np.dtype(dtype)
-    )
+    if su_dev is not None:  # 64-bit parity mode: separate fetch
+        su_s = np.asarray(su_dev)
+    else:
+        su_s = np.ascontiguousarray(packed[nrep + 4 * S_pad :]).view(
+            np.dtype(dtype)
+        )
 
     out: List[SweepResult] = []
     for i, sc in enumerate(scenarios):
