@@ -1,0 +1,148 @@
+"""Child process for the hardware f64-COVERAGE test (tests/test_pallas_tpu.py
+pattern).
+
+Round 5 found a latent hardware bug in a path no benchmark exercises:
+the f64 what-if sweep failed to COMPILE on the TPU backend (the
+f64->int32 objective bitcast lowers through a u64 the backend's X64
+rewriting does not implement) because the suite always measures f32 —
+the f64 parity mode existed only on the CPU test mesh. This worker runs
+a compact instance of every f64 device path on the real chip so that
+class of backend-specific f64 lowering failure turns into a failing
+test, not a user-facing crash:
+
+- the batch=1 reference-parity session and the batched+polish session
+  (solvers/scan.py, solvers/polish.py),
+- the fused -rebalance-leader session (solvers/leader.py),
+- the single-move window scorer's f64 tier (solvers/tpu.py — the
+  retry tier the f32 window falls back to, normally dormant),
+- the what-if sweep (parallel/sweep.py — the objective rides a
+  separate output in 64-bit mode, the r5 fix),
+- the sharded XLA session at a small bucket (parallel/shard_session.py
+  — f64 requests resolve auto to the XLA shard body, which is healthy
+  below the documented 131072x256 crash buckets).
+
+Exit codes: 0 = all paths ran, 77 = no TPU here (parent skips),
+anything else = real failure. Prints one JSON line.
+"""
+
+import copy
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+NO_TPU = 77
+
+
+def main() -> int:
+    try:
+        import jax
+
+        devs = jax.devices()
+    except Exception as exc:
+        print(json.dumps({"skip": f"backend init failed: {exc!r}"}))
+        return NO_TPU
+    platform = devs[0].platform.lower()
+    if "tpu" not in platform and "axon" not in platform:
+        print(json.dumps({"skip": f"platform is {platform!r}, not tpu"}))
+        return NO_TPU
+
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    from kafkabalancer_tpu.balancer.costmodel import (
+        get_bl,
+        get_broker_load,
+        get_unbalance_bl,
+    )
+    from kafkabalancer_tpu.balancer.steps import fill_defaults, validate_weights
+    from kafkabalancer_tpu.models import default_rebalance_config
+    from kafkabalancer_tpu.ops.tensorize import tensorize
+    from kafkabalancer_tpu.parallel.mesh import make_mesh
+    from kafkabalancer_tpu.parallel.shard_session import plan_sharded
+    from kafkabalancer_tpu.parallel.sweep import sweep
+    from kafkabalancer_tpu.solvers import tpu as tpu_solver
+    from kafkabalancer_tpu.solvers.scan import plan
+    from kafkabalancer_tpu.utils.synth import synth_cluster
+
+    out = {}
+
+    def uof(pl):
+        return get_unbalance_bl(get_bl(get_broker_load(pl)))
+
+    # batch=1 reference-parity session
+    pl = synth_cluster(300, 16, rf=3, seed=31, weighted=True)
+    cfg = default_rebalance_config()
+    cfg.min_unbalance = 1e-7
+    opl = plan(pl, copy.deepcopy(cfg), 1000, dtype=jnp.float64, batch=1)
+    assert len(opl) > 0
+    out["batch1_u"] = uof(pl)
+
+    # batched + polish (move/swap/shuffle alternation)
+    pl2 = synth_cluster(300, 16, rf=3, seed=31, weighted=True)
+    cfg2 = default_rebalance_config()
+    cfg2.min_unbalance = 0.0
+    cfg2.allow_leader_rebalancing = True
+    opl2 = plan(pl2, copy.deepcopy(cfg2), 3000, dtype=jnp.float64,
+                batch=8, polish=True)
+    assert len(opl2) > 0
+    # non-strict: this is a compile-coverage worker, not an optimality
+    # test — two independently-planned runs may tie at a shared optimum
+    out["polish_u"] = uof(pl2)
+    assert out["polish_u"] <= out["batch1_u"]
+
+    # fused -rebalance-leader Balance loop
+    pl3 = synth_cluster(200, 12, rf=3, seed=77, weighted=True)
+    cfg3 = default_rebalance_config()
+    cfg3.rebalance_leaders = True
+    cfg3.min_unbalance = 1e-6
+    opl3 = plan(pl3, copy.deepcopy(cfg3), 300, dtype=jnp.float64, batch=4)
+    out["leader_moves"] = len(opl3)
+
+    # the single-move window scorer's f64 tier, invoked directly (the
+    # f32 tier rarely overflows, so the retry tier is normally dormant)
+    pl4 = synth_cluster(2000, 40, rf=3, seed=3, weighted=True)
+    cfg4 = default_rebalance_config()
+    validate_weights(pl4, cfg4)
+    fill_defaults(pl4, cfg4)
+    dp = tensorize(pl4, cfg4)
+    loads_map = tpu_solver._oracle_loads(pl4, cfg4)
+    loads = np.zeros(dp.bvalid.shape[0])
+    for bid, load in loads_map.items():
+        loads[dp.broker_index(bid)] = load
+    ints, f64, allowed_arg, all_allowed = tpu_solver._pack_window_args(
+        dp, loads, cfg4
+    )
+    tier = np.asarray(
+        tpu_solver._score_window_jit(
+            ints, f64, allowed_arg, leaders=False, all_allowed=all_allowed
+        )
+    )
+    assert np.isfinite(tier[0])
+    out["window_f64_umin"] = float(tier[0])
+
+    # the what-if sweep (64-bit objective rides a separate output)
+    pl5 = synth_cluster(300, 16, rf=3, seed=9, weighted=True)
+    observed = sorted({b for p in pl5.partitions for b in p.replicas})
+    res = sweep(pl5, default_rebalance_config(),
+                [observed, observed + [99]], max_reassign=500)
+    assert all(r.feasible for r in res)
+    out["sweep_u"] = [r.unbalance for r in res]
+
+    # the sharded XLA body at a small bucket (f64 resolves auto to it)
+    pl6 = synth_cluster(300, 16, rf=3, seed=31, weighted=True)
+    cfg6 = default_rebalance_config()
+    cfg6.min_unbalance = 1e-7
+    opl6 = plan_sharded(pl6, cfg6, 1000, make_mesh(1, shape=(1, 1)),
+                        batch=8, dtype=jnp.float64)
+    assert len(opl6) > 0
+    out["shard_u"] = uof(pl6)
+
+    print(json.dumps({"ok": True, **out}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -105,3 +105,18 @@ def test_pallas_hardware_ceilings():
     assert pal["unbalance"] == pytest.approx(
         xla["unbalance"], rel=0.05, abs=1e-6
     ), out
+
+
+_F64_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           "f64_tpu_worker.py")
+
+
+def test_f64_paths_on_hardware():
+    """Every f64 device path compiles and runs on the REAL chip
+    (tests/f64_tpu_worker.py): the r5 sweep failure showed a whole class
+    of backend-specific f64 lowering bugs (the u64 bitcast rewrite) can
+    hide behind an f32-only benchmark surface — this worker keeps the
+    parity-mode dtype covered on hardware every round."""
+    # ~6 distinct cold f64 compiles (f64 is software-emulated, ~2x
+    # executable size); the sibling tests budget 600s/cold compile
+    _run_hw_worker(_F64_WORKER, timeout=3000)
