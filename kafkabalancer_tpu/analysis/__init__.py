@@ -1,0 +1,59 @@
+"""JAX-aware static analysis for the kafkabalancer-tpu codebase.
+
+An AST-based linter with project-specific rules for the classic JAX
+failure modes that pytest cannot see until they cost a benchmark round
+(silent recompiles, host sync points in scan loops, dtype drift like the
+f64 parity-mode incident fixed in ``f7a8e0f``), plus a strict-annotation
+coverage check backing the ``mypy --strict`` gate where mypy is not
+installed. Pure stdlib — importing this package never imports jax.
+
+Run it::
+
+    python -m kafkabalancer_tpu.analysis kafkabalancer_tpu/
+    python -m kafkabalancer_tpu.analysis --annotations \\
+        kafkabalancer_tpu/models kafkabalancer_tpu/ops \\
+        kafkabalancer_tpu/codecs
+
+Rules (``docs/static-analysis.md`` has the full story):
+
+- **R1** no ``float()``/``int()``/``bool()``/``.item()`` coercion of
+  traced arrays inside traced code;
+- **R2** every ``jax.jit`` site declares ``static_argnames`` /
+  ``donate_argnums`` explicitly;
+- **R3** no host numpy / ``device_get`` / ``block_until_ready`` inside
+  traced code (solver inner loops);
+- **R4** float dtype literals route through the central dtype policy
+  (``models/config.py``);
+- **R5** no boolean-mask indexing on traced values.
+
+Suppress a finding inline with ``# jaxlint: disable=R2 — reason``;
+grandfather a set of findings with ``--write-baseline`` /
+``--baseline``.
+"""
+
+from kafkabalancer_tpu.analysis.context import Finding, ModuleContext
+from kafkabalancer_tpu.analysis.jaxlint import (
+    format_human,
+    format_json,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    main,
+    subtract_baseline,
+    write_baseline,
+)
+from kafkabalancer_tpu.analysis.rules import ALL_RULES
+
+__all__ = [
+    "ALL_RULES",
+    "Finding",
+    "ModuleContext",
+    "format_human",
+    "format_json",
+    "lint_paths",
+    "lint_source",
+    "load_baseline",
+    "main",
+    "subtract_baseline",
+    "write_baseline",
+]

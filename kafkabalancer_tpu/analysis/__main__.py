@@ -1,0 +1,7 @@
+"""``python -m kafkabalancer_tpu.analysis`` — the jaxlint entry point."""
+
+import sys
+
+from kafkabalancer_tpu.analysis.jaxlint import main
+
+sys.exit(main())

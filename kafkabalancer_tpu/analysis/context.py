@@ -1,0 +1,339 @@
+"""Shared AST machinery for the JAX-aware linter.
+
+Three facilities every rule builds on:
+
+- **Import-alias resolution**: ``import jax.numpy as jnp`` /
+  ``from jax import lax`` / ``from functools import partial`` are mapped
+  back to canonical dotted names, so a rule asks "does this expression
+  resolve to ``jax.jit``?" instead of pattern-matching local spellings.
+- **Trace-context analysis**: the set of function definitions whose bodies
+  execute under a JAX trace — ``@jax.jit``-decorated functions (including
+  the ``@partial(jax.jit, ...)`` idiom), functions passed by name to
+  ``jax.jit`` or to the ``lax`` control-flow combinators
+  (``scan``/``while_loop``/``fori_loop``/``cond``/``switch``), Pallas
+  kernels handed to ``pallas_call``, every function lexically nested
+  inside one of those, and (one fixpoint pass) module-level functions
+  CALLED by a traced function in the same module. The propagation is
+  module-local by design: cross-module tracing (e.g. ``ops/cost.py``
+  helpers dispatched from ``solvers/scan.py``) is covered by running the
+  linter over the whole package, where the callee module's own traced
+  entry points mark them.
+- **Suppression parsing**: ``# jaxlint: disable=R2`` (comma list or
+  ``all``) on the finding's line or the line above suppresses it;
+  ``# jaxlint: skip-file`` in the first ten lines skips the module.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+# canonical names whose call wraps/compiles a function for tracing
+JIT_NAMES: Tuple[str, ...] = (
+    "jax.jit",
+    "jax.pmap",
+    "jax.vmap",
+    "jax.named_call",
+)
+
+# canonical names that receive a function argument and trace it
+TRACING_CONSUMERS: Tuple[str, ...] = JIT_NAMES + (
+    "jax.lax.scan",
+    "jax.lax.while_loop",
+    "jax.lax.fori_loop",
+    "jax.lax.cond",
+    "jax.lax.switch",
+    "jax.lax.map",
+    "jax.lax.associative_scan",
+    "jax.checkpoint",
+    "jax.remat",
+    "jax.grad",
+    "jax.value_and_grad",
+    "jax.shard_map",
+    "jax.experimental.shard_map.shard_map",
+    # the project's version-compat rebind of shard_map
+    "kafkabalancer_tpu.parallel.mesh.shard_map",
+    "jax.experimental.pallas.pallas_call",
+)
+
+_SUPPRESS_RE = re.compile(r"#\s*jaxlint:\s*disable=([A-Za-z0-9_,\s]+)")
+_SKIP_FILE_RE = re.compile(r"#\s*jaxlint:\s*skip-file")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One linter finding; ``snippet`` (the stripped source line) is the
+    line-number-independent half of the baseline fingerprint.
+
+    ``end_line`` spans the flagged construct (a multi-line call flagged
+    at its head still honours a suppression comment on any of its
+    lines); 0 means "same as line"."""
+
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    snippet: str
+    end_line: int = 0
+
+    def fingerprint(self) -> Tuple[str, str, str]:
+        return (self.rule, self.path.replace("\\", "/"), self.snippet)
+
+
+def parse_module(source: str, path: str) -> "Finding | ModuleContext":
+    """Parse one module; a ``Finding`` (rule ``E0``) on syntax error.
+
+    The ONE definition of the syntax-error contract, shared by the lint
+    driver and the annotation checker."""
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as exc:
+        return Finding(
+            rule="E0",
+            path=path,
+            line=exc.lineno or 0,
+            col=exc.offset or 0,
+            message=f"syntax error: {exc.msg}",
+            snippet="",
+        )
+    return ModuleContext(path, source, tree)
+
+
+class ModuleContext:
+    """Everything the rules need to know about one parsed module."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module) -> None:
+        self.path = path
+        self.source = source
+        self.lines: List[str] = source.splitlines()
+        self.tree = tree
+        self.aliases: Dict[str, str] = {}
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        self.suppressions: Dict[int, Set[str]] = {}
+        self.skip_file = False
+        self._build_parents()
+        self._build_aliases()
+        self._build_suppressions()
+        # the trace-context fixpoint is the expensive half of the
+        # analysis and the annotation checker never needs it — computed
+        # lazily on first access
+        self._traced: Optional[Set[ast.AST]] = None
+
+    @property
+    def traced(self) -> Set[ast.AST]:
+        if self._traced is None:
+            self._traced = self._find_traced_functions()
+        return self._traced
+
+    # ---- construction ---------------------------------------------------
+
+    def _build_parents(self) -> None:
+        for node in ast.walk(self.tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+
+    def _build_aliases(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.aliases[a.asname] = a.name
+                    else:
+                        root = a.name.split(".", 1)[0]
+                        self.aliases.setdefault(root, root)
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                if node.level:
+                    continue  # relative imports: not a jax/numpy source
+                for a in node.names:
+                    local = a.asname or a.name
+                    self.aliases[local] = f"{node.module}.{a.name}"
+
+    def _build_suppressions(self) -> None:
+        """Directives live in COMMENT tokens only — a docstring quoting
+        '# jaxlint: disable=…' must not register a live suppression."""
+        try:
+            tokens = list(
+                tokenize.generate_tokens(io.StringIO(self.source).readline)
+            )
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            return  # ast.parse succeeded, so this is effectively dead
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            lineno = tok.start[0]
+            if lineno <= 10 and _SKIP_FILE_RE.search(tok.string):
+                self.skip_file = True
+            m = _SUPPRESS_RE.search(tok.string)
+            if m:
+                # commas or whitespace both separate rule ids, so
+                # "disable=R1 R2" suppresses what it reads as saying
+                rules = {
+                    r.upper()
+                    for r in re.split(r"[,\s]+", m.group(1))
+                    if r
+                }
+                self.suppressions[lineno] = rules
+
+    # ---- name resolution ------------------------------------------------
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Canonical dotted name of a Name/Attribute chain, or None."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        base = self.aliases.get(node.id)
+        if base is None:
+            return None
+        parts.append(base)
+        return ".".join(reversed(parts))
+
+    def resolves_to(self, node: ast.AST, *names: str) -> bool:
+        resolved = self.resolve(node)
+        return resolved is not None and resolved in names
+
+    # ---- trace-context analysis -----------------------------------------
+
+    def _is_jit_wrapper(self, call: ast.Call) -> bool:
+        """True for ``partial(<tracing consumer>, ...)`` — the
+        ``@partial(jax.jit, ...)`` / ``@partial(shard_map, ...)`` idioms."""
+        if not self.resolves_to(call.func, "functools.partial"):
+            return False
+        return any(
+            self.resolve(a) in TRACING_CONSUMERS for a in call.args
+        )
+
+    def _decorator_traces(self, dec: ast.AST) -> bool:
+        if isinstance(dec, ast.Call):
+            if self.resolve(dec.func) in TRACING_CONSUMERS:
+                return True
+            return self._is_jit_wrapper(dec)
+        return self.resolve(dec) in TRACING_CONSUMERS
+
+    def _find_traced_functions(self) -> Set[ast.AST]:
+        defs: Dict[str, List[ast.AST]] = {}
+        traced: Set[ast.AST] = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs.setdefault(node.name, []).append(node)
+                if any(self._decorator_traces(d) for d in node.decorator_list):
+                    traced.add(node)
+
+        # functions passed by (bare) name to a tracing consumer
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if self.resolve(node.func) not in TRACING_CONSUMERS:
+                continue
+            for arg in node.args:
+                if isinstance(arg, ast.Name) and arg.id in defs:
+                    traced.update(defs[arg.id])
+                elif isinstance(arg, ast.Lambda):
+                    traced.add(arg)
+                elif (
+                    isinstance(arg, ast.Call)
+                    and self.resolves_to(arg.func, "functools.partial")
+                    and arg.args
+                    and isinstance(arg.args[0], ast.Name)
+                    and arg.args[0].id in defs
+                ):
+                    traced.update(defs[arg.args[0].id])
+
+        # lexical nesting: a def inside a traced def traces with it;
+        # then one module-local call-graph fixpoint — a module-level
+        # function CALLED from traced code is traced too
+        def under_traced(node: ast.AST) -> bool:
+            cur = self.parents.get(node)
+            while cur is not None:
+                if cur in traced:
+                    return True
+                cur = self.parents.get(cur)
+            return False
+
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(self.tree):
+                if (
+                    isinstance(
+                        node, (ast.FunctionDef, ast.AsyncFunctionDef)
+                    )
+                    and node not in traced
+                    and under_traced(node)
+                ):
+                    traced.add(node)
+                    changed = True
+            for fn in list(traced):
+                for call in ast.walk(fn):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    if not isinstance(call.func, ast.Name):
+                        continue
+                    for cand in defs.get(call.func.id, ()):
+                        # only module-level defs propagate by name —
+                        # a local name may be rebound arbitrarily
+                        if cand not in traced and isinstance(
+                            self.parents.get(cand), ast.Module
+                        ):
+                            traced.add(cand)
+                            changed = True
+        return traced
+
+    def in_traced_context(self, node: ast.AST) -> bool:
+        """Is ``node`` lexically inside a traced function definition?"""
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if cur in self.traced:
+                return True
+            cur = self.parents.get(cur)
+        return False
+
+    def traced_functions(self) -> Iterator[ast.AST]:
+        return iter(self.traced)
+
+    # ---- findings -------------------------------------------------------
+
+    def snippet_at(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(
+        self, rule: str, node: ast.AST, message: str, span: bool = True
+    ) -> Finding:
+        """``span=False`` pins the suppression window to the anchor line
+        only — used for findings anchored on large constructs (a whole
+        FunctionDef) where honouring interior comments would let an
+        unrelated disable deep in the body exempt the enclosing
+        finding."""
+        line = getattr(node, "lineno", 0)
+        col = getattr(node, "col_offset", 0)
+        return Finding(
+            rule=rule,
+            path=self.path,
+            line=line,
+            col=col,
+            message=message,
+            snippet=self.snippet_at(line),
+            end_line=(getattr(node, "end_lineno", None) or line)
+            if span
+            else line,
+        )
+
+    def suppressed(self, f: Finding) -> bool:
+        """A disable comment on the line above the construct or on ANY
+        of its lines suppresses — multi-line calls flagged at their head
+        stay suppressible at the literal's line and vice versa."""
+        last = max(f.end_line, f.line)
+        for line in range(f.line - 1, last + 1):
+            rules = self.suppressions.get(line)
+            if rules and (f.rule.upper() in rules or "ALL" in rules):
+                return True
+        return False

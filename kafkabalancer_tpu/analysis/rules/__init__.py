@@ -1,0 +1,33 @@
+"""Rule registry for the JAX-aware linter.
+
+Each rule module exposes ``RULE_ID`` (``"R1"``…), ``TITLE`` (one line),
+and ``check(ctx: ModuleContext) -> Iterator[Finding]``. Registration is
+explicit — a rule the registry doesn't name does not run — so the gate's
+behaviour is reviewable in one place.
+"""
+
+from __future__ import annotations
+
+from types import ModuleType
+from typing import Dict
+
+from kafkabalancer_tpu.analysis.rules import (
+    r1_traced_coercion,
+    r2_jit_statics,
+    r3_host_sync,
+    r4_dtype_policy,
+    r5_bool_indexing,
+)
+
+ALL_RULES: Dict[str, ModuleType] = {
+    mod.RULE_ID: mod
+    for mod in (
+        r1_traced_coercion,
+        r2_jit_statics,
+        r3_host_sync,
+        r4_dtype_policy,
+        r5_bool_indexing,
+    )
+}
+
+__all__ = ["ALL_RULES"]

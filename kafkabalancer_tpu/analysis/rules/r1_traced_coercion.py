@@ -1,0 +1,94 @@
+"""R1 — no host coercion of traced arrays inside traced code.
+
+``float(x)`` / ``int(x)`` / ``bool(x)`` / ``complex(x)`` / ``x.item()``
+on a traced array inside a ``@jit``-decorated function (or a
+``lax.scan``/``while_loop`` body) either raises a
+``ConcretizationTypeError`` at trace time or — worse, when the value
+happens to be weakly concrete — silently bakes a Python constant into
+the compiled program, so every new runtime value recompiles.
+
+Static-safe arguments are exempt: literals, ``len(...)``, and
+shape/ndim/size/dtype attribute chains are Python values at trace time
+by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from kafkabalancer_tpu.analysis.context import Finding, ModuleContext
+
+RULE_ID = "R1"
+TITLE = (
+    "no float()/int()/bool()/.item() coercion of traced arrays in "
+    "traced code"
+)
+
+_COERCERS = ("float", "int", "bool", "complex")
+_ITEM_METHODS = ("item", "tolist")
+_STATIC_ATTRS = ("shape", "ndim", "size", "dtype")
+
+
+def _static_safe(ctx: ModuleContext, node: ast.AST) -> bool:
+    """Expressions that are plain Python values under a jax trace."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, ast.Attribute) and node.attr in _STATIC_ATTRS:
+        return True
+    if isinstance(node, ast.Subscript):
+        # x.shape[0] and friends
+        return _static_safe(ctx, node.value)
+    if isinstance(node, ast.Call):
+        if isinstance(node.func, ast.Name) and node.func.id == "len":
+            return True
+        if isinstance(node.func, ast.Name) and node.func.id in _COERCERS:
+            return all(_static_safe(ctx, a) for a in node.args)
+        return False
+    if isinstance(node, ast.BinOp):
+        return _static_safe(ctx, node.left) and _static_safe(ctx, node.right)
+    if isinstance(node, ast.UnaryOp):
+        return _static_safe(ctx, node.operand)
+    if isinstance(node, ast.IfExp):
+        return all(
+            _static_safe(ctx, n) for n in (node.test, node.body, node.orelse)
+        )
+    return False
+
+
+def check(ctx: ModuleContext) -> Iterator[Finding]:
+    seen = set()
+    for fn in ctx.traced_functions():
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call) or id(node) in seen:
+                continue
+            seen.add(id(node))
+            if (
+                isinstance(node.func, ast.Name)
+                and node.func.id in _COERCERS
+                and node.args
+                and not node.keywords
+                and not _static_safe(ctx, node.args[0])
+            ):
+                yield ctx.finding(
+                    RULE_ID,
+                    node,
+                    f"{node.func.id}() on a (potentially) traced value "
+                    "inside traced code forces host concretization — "
+                    "recompile per value or ConcretizationTypeError; keep "
+                    "it an array (jnp ops / lax.cond) or hoist to the "
+                    "host caller",
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _ITEM_METHODS
+                and not node.args
+                and not node.keywords
+            ):
+                yield ctx.finding(
+                    RULE_ID,
+                    node,
+                    f".{node.func.attr}() inside traced code is a "
+                    "device->host sync + concretization; return the array "
+                    "and materialize outside the jit boundary",
+                )

@@ -1,0 +1,82 @@
+"""R2 — every ``jax.jit`` site declares its static/donated arguments.
+
+An undeclared ``jax.jit`` retraces whenever a Python-value argument
+changes and silently double-buffers donatable inputs. Requiring an
+explicit ``static_argnames=`` / ``static_argnums=`` / ``donate_argnums=``
+/ ``donate_argnames=`` (an empty tuple is a fine, explicit "none") makes
+the recompile surface reviewable at the call site. Intentionally-dynamic
+wrappers are suppressed inline (``# jaxlint: disable=R2``).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from kafkabalancer_tpu.analysis.context import (
+    Finding,
+    ModuleContext,
+)
+
+RULE_ID = "R2"
+TITLE = "jax.jit call sites declare static_argnames/donate_argnums"
+
+_DECL_KEYWORDS = (
+    "static_argnames",
+    "static_argnums",
+    "donate_argnums",
+    "donate_argnames",
+)
+
+_MSG = (
+    "jax.jit without an explicit static_argnames/static_argnums/"
+    "donate_argnums declaration — declare them (an empty tuple is an "
+    "explicit 'no statics') so the recompile surface is visible, or "
+    "suppress with a reason"
+)
+
+
+def _declares(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg is None:  # **kwargs splat: assume the dict declares
+            return True
+        if kw.arg in _DECL_KEYWORDS:
+            return True
+    return False
+
+
+def check(ctx: ModuleContext) -> Iterator[Finding]:
+    jit_calls_ok = set()
+    # partial(jax.jit, ...) wrappers: the partial's keywords count
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and ctx.resolves_to(
+            node.func, "functools.partial"
+        ):
+            for a in node.args:
+                if ctx.resolve(a) == "jax.jit" and _declares(node):
+                    jit_calls_ok.add(id(a))
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            if ctx.resolve(node.func) == "jax.jit" and not _declares(node):
+                yield ctx.finding(RULE_ID, node, _MSG)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                # bare @jax.jit (an Attribute/Name, not a Call)
+                if not isinstance(dec, ast.Call) and (
+                    ctx.resolve(dec) == "jax.jit"
+                ):
+                    yield ctx.finding(RULE_ID, dec, _MSG)
+
+    # a bare `jax.jit` reference handed to partial() WITHOUT declaring
+    # keywords is the same hole one indirection later
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and ctx.resolves_to(
+            node.func, "functools.partial"
+        ):
+            for a in node.args:
+                if (
+                    ctx.resolve(a) == "jax.jit"
+                    and id(a) not in jit_calls_ok
+                ):
+                    yield ctx.finding(RULE_ID, a, _MSG)

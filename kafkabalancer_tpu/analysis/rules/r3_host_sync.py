@@ -1,0 +1,79 @@
+"""R3 — no host numpy / device sync inside traced code.
+
+``np.*`` inside a jitted function or a ``lax`` loop body concretizes its
+operands (trace error at best, a silent host constant at worst), and
+``jax.device_get`` / ``block_until_ready`` are host round-trips that a
+traced program cannot express — their presence means the function was
+written expecting eager semantics. Solver inner loops
+(``solvers/scan.py``, ``solvers/beam.py``, ``parallel/shard_*.py``) are
+where these cost a benchmark round; the rule runs wherever a traced
+context exists.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from kafkabalancer_tpu.analysis.context import Finding, ModuleContext
+
+RULE_ID = "R3"
+TITLE = "no host numpy / device_get / block_until_ready in traced code"
+
+_SYNC_CALLS = (
+    "jax.device_get",
+    "jax.block_until_ready",
+    "jax.device_put",
+)
+_SYNC_METHODS = ("block_until_ready", "copy_to_host_async")
+
+# numpy attributes that are plain Python values / metadata factories —
+# harmless (and idiomatic) under a trace: np.inf masks, np.dtype keys,
+# eps lookups. Everything else numpy COMPUTES on the host.
+_NUMPY_CALL_ALLOWLIST = (
+    "numpy.dtype",
+    "numpy.finfo",
+    "numpy.iinfo",
+)
+
+
+def check(ctx: ModuleContext) -> Iterator[Finding]:
+    seen = set()
+    for fn in ctx.traced_functions():
+        for node in ast.walk(fn):
+            if id(node) in seen or not isinstance(node, ast.Call):
+                continue
+            seen.add(id(node))
+            resolved = ctx.resolve(node.func)
+            if (
+                resolved is not None
+                and resolved.startswith("numpy.")
+                and resolved not in _NUMPY_CALL_ALLOWLIST
+            ):
+                yield ctx.finding(
+                    RULE_ID,
+                    node,
+                    f"host numpy call ({resolved}) inside traced "
+                    "code concretizes traced values — use jax.numpy, "
+                    "or hoist the host math out of the traced "
+                    "function",
+                )
+            elif resolved in _SYNC_CALLS:
+                yield ctx.finding(
+                    RULE_ID,
+                    node,
+                    f"{resolved} inside traced code is a host<->device "
+                    "sync point a compiled program cannot express; "
+                    "move it outside the jit/scan boundary",
+                )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr in _SYNC_METHODS
+            ):
+                yield ctx.finding(
+                    RULE_ID,
+                    node,
+                    f".{node.func.attr}() inside traced code is a "
+                    "host sync point; materialize results outside "
+                    "the traced function",
+                )

@@ -1,0 +1,58 @@
+"""R5 — no boolean-mask indexing on traced values.
+
+``x[x > 0]`` has a data-dependent output shape; under a trace it raises
+``NonConcreteBooleanIndexError`` — or, when the mask happens to be
+concrete at trace time, silently freezes one iteration's selection into
+the compiled program. Traced code expresses selection with ``jnp.where``
+(same-shape blend) or masked reductions instead. The rule flags
+subscripts whose index is a comparison / boolean combination — the
+spellings that are unambiguously masks in source form.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from kafkabalancer_tpu.analysis.context import Finding, ModuleContext
+
+RULE_ID = "R5"
+TITLE = "no boolean-mask indexing on traced values (use jnp.where)"
+
+_MSG = (
+    "boolean-mask indexing on a traced value has a data-dependent "
+    "shape (NonConcreteBooleanIndexError under jit); use jnp.where / "
+    "a masked reduction, or jnp.nonzero(..., size=...) for a bounded "
+    "selection"
+)
+
+
+def _is_mask_expr(node: ast.AST) -> bool:
+    if isinstance(node, ast.Compare):
+        return True
+    if isinstance(node, ast.BoolOp):
+        return any(_is_mask_expr(v) for v in node.values)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Invert):
+        return _is_mask_expr(node.operand)
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.Not):
+        return _is_mask_expr(node.operand)
+    if isinstance(node, ast.BinOp) and isinstance(
+        node.op, (ast.BitAnd, ast.BitOr, ast.BitXor)
+    ):
+        return _is_mask_expr(node.left) or _is_mask_expr(node.right)
+    return False
+
+
+def check(ctx: ModuleContext) -> Iterator[Finding]:
+    seen = set()
+    for fn in ctx.traced_functions():
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Subscript) or id(node) in seen:
+                continue
+            seen.add(id(node))
+            idx = node.slice
+            elements = (
+                idx.elts if isinstance(idx, ast.Tuple) else (idx,)
+            )
+            if any(_is_mask_expr(e) for e in elements):
+                yield ctx.finding(RULE_ID, node, _MSG)

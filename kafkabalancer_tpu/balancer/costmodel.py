@@ -45,7 +45,10 @@ def get_broker_load(pl: PartitionList) -> Dict[int, float]:
 def get_bl(loads: Dict[int, float]) -> BrokerLoadList:
     """Map -> list sorted by (load, ID) (utils.go:107-117); the sort fixes the
     float accumulation order of the objective."""
-    return [[bid, load] for bid, load in sorted(loads.items(), key=lambda kv: (kv[1], kv[0]))]
+    return [
+        [bid, load]
+        for bid, load in sorted(loads.items(), key=lambda kv: (kv[1], kv[0]))
+    ]
 
 
 def _ieee_div(x: float, y: float) -> float:

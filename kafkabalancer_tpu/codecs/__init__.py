@@ -1,12 +1,21 @@
-from kafkabalancer_tpu.codecs.readers import (  # noqa: F401
+from kafkabalancer_tpu.codecs.readers import (
     CodecError,
     get_partition_list_from_reader,
 )
-from kafkabalancer_tpu.codecs.writer import (  # noqa: F401
+from kafkabalancer_tpu.codecs.writer import (
     filter_partition_list,
     write_partition_list,
 )
-from kafkabalancer_tpu.codecs.zookeeper import (  # noqa: F401
+from kafkabalancer_tpu.codecs.zookeeper import (
     get_partition_list_from_zookeeper,
     parse_zk_connection_string,
 )
+
+__all__ = [
+    "CodecError",
+    "filter_partition_list",
+    "get_partition_list_from_reader",
+    "get_partition_list_from_zookeeper",
+    "parse_zk_connection_string",
+    "write_partition_list",
+]

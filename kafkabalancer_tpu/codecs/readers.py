@@ -18,7 +18,7 @@ from __future__ import annotations
 import io
 import json
 import re
-from typing import List, Optional
+from typing import List, Optional, TextIO, Union
 
 from kafkabalancer_tpu.models import Partition, PartitionList
 
@@ -69,7 +69,9 @@ def _partition_from_obj(obj: object) -> Partition:
         if "num_consumers" in obj:
             p.num_consumers = _require_int(obj["num_consumers"], "num_consumers")
     except TypeError as exc:
-        raise CodecError(f"failed parsing json: invalid value for field {exc}") from None
+        raise CodecError(
+            f"failed parsing json: invalid value for field {exc}"
+        ) from None
     return p
 
 
@@ -93,7 +95,9 @@ def _require_int_list(v: object, name: str) -> List[int]:
 
 
 def get_partition_list_from_reader(
-    stream, is_json: bool, topics: Optional[List[str]] = None
+    stream: Union[TextIO, str, bytes],
+    is_json: bool,
+    topics: Optional[List[str]] = None,
 ) -> PartitionList:
     """Parse a partition list from a text stream or string.
 

@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import json
 import math
-from typing import List
+from typing import List, TextIO
 
 from kafkabalancer_tpu.codecs.readers import CodecError
 from kafkabalancer_tpu.models import Partition, PartitionList
@@ -132,7 +132,7 @@ def encode_partition_list(pl: PartitionList) -> str:
     return f'{{"version":{pl.version},"partitions":{body}}}\n'
 
 
-def write_partition_list(out, pl: PartitionList) -> None:
+def write_partition_list(out: TextIO, pl: PartitionList) -> None:
     """Reference ``WritePartitionList`` (codecs.go:84-93); raises CodecError
     with the reference's message prefix on write failure (exit code 4)."""
     data = encode_partition_list(pl)

@@ -1,8 +1,15 @@
-from kafkabalancer_tpu.models.partition import (  # noqa: F401
-    Partition,
-    PartitionList,
-)
-from kafkabalancer_tpu.models.config import (  # noqa: F401
+from kafkabalancer_tpu.models.config import (
     RebalanceConfig,
     default_rebalance_config,
 )
+from kafkabalancer_tpu.models.partition import (
+    Partition,
+    PartitionList,
+)
+
+__all__ = [
+    "Partition",
+    "PartitionList",
+    "RebalanceConfig",
+    "default_rebalance_config",
+]

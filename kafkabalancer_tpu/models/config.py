@@ -15,11 +15,56 @@ not by any balancing step (kafkabalancer.go:212-220).
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List, Optional
+from typing import Any, List, Optional, Tuple
 
 # fused-session device engines (solvers/scan.py plan()); lives here so the
 # CLI can validate the flag without importing the jax-heavy solver stack
-ENGINES = ("auto", "xla", "pallas", "pallas-interpret")
+ENGINES: Tuple[str, ...] = ("auto", "xla", "pallas", "pallas-interpret")
+
+
+# --- central dtype policy ------------------------------------------------
+#
+# Every float-precision decision in the package routes through these three
+# accessors; bare ``jnp.float64``/``jnp.float32``/``np.float64`` literals
+# elsewhere are a lint error (analysis rule R4). The policy exists because
+# precision decisions scattered as literals drift: the f64 parity-mode
+# incident (commit f7a8e0f) was exactly a path that assumed 64-bit weak
+# scalars where a Mosaic kernel only lowers 32-bit. jax/numpy are imported
+# lazily so the greedy CLI path keeps its no-JAX-import startup contract.
+
+# Host-side (numpy) float dtype for the oracle-parity arrays: the greedy
+# oracle is float64 math, so tensorized weights/consumer counts carry f64
+# on the host regardless of the device compute dtype. The string form is
+# accepted by every numpy constructor and needs no numpy import here.
+HOST_FLOAT_DTYPE = "float64"
+
+
+def default_dtype() -> Any:
+    """The device compute dtype the solver stack defaults to.
+
+    float64 when the process-global x64 flag is up (oracle-parity mode,
+    see :func:`kafkabalancer_tpu.ops.runtime.ensure_x64`), else float32 —
+    THE one definition of "what precision do sessions run at when the
+    caller didn't pin one"; previously copied as a literal conditional in
+    four solver modules.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+
+def kernel_dtype() -> Any:
+    """The Mosaic/Pallas kernel float dtype: float32, by construction.
+
+    TPU kernels lower 32-bit only (64-bit weak scalars fail inside Mosaic
+    under global x64 — the f7a8e0f incident); every kernel, kernel-probe
+    shape, and kernel-input cast must take its dtype from here so the
+    constraint is visible as policy, not folklore.
+    """
+    import jax.numpy as jnp
+
+    return jnp.float32
 
 
 @dataclass

@@ -24,7 +24,7 @@ Conventions preserved from the reference:
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import List, Optional
+from typing import Iterator, List, Optional
 
 
 def _fmt_replicas(replicas: List[int]) -> str:
@@ -65,7 +65,8 @@ class Partition:
 
     def __str__(self) -> str:
         # Matches Go's Stringer: "Partition(%s,%d,%+v)" (kafkabalancer.go:64-66)
-        return f"Partition({self.topic},{self.partition},{_fmt_replicas(self.replicas)})"
+        reps = _fmt_replicas(self.replicas)
+        return f"Partition({self.topic},{self.partition},{reps})"
 
 
 @dataclass
@@ -80,7 +81,7 @@ class PartitionList:
     version: int = 0
     partitions: Optional[List[Partition]] = None
 
-    def iter_partitions(self):
+    def iter_partitions(self) -> Iterator[Partition]:
         return iter(self.partitions or ())
 
     def __len__(self) -> int:

@@ -33,6 +33,10 @@ import os
 import tempfile
 from typing import Any, Dict, Optional, Tuple
 
+# a jit-wrapped callable (has .lower()); typed Any because jax's stage
+# types are not stable across the versions this repo supports
+JitWrapped = Any
+
 import numpy as np
 
 _SALT_MODULES = (
@@ -107,6 +111,27 @@ def aot_dir() -> Optional[str]:
     return os.path.join(cache, "aot")
 
 
+_exec_devices_kwarg: Optional[bool] = None
+
+
+def _supports_execution_devices(fn: Any) -> bool:
+    """Version-static probe, cached once: whether this jax's
+    ``deserialize_and_load`` accepts ``execution_devices=``. Never
+    raises — a probe failure inside try_load's corrupt-entry handler
+    would delete valid cache blobs."""
+    global _exec_devices_kwarg
+    if _exec_devices_kwarg is None:
+        import inspect
+
+        try:
+            _exec_devices_kwarg = (
+                "execution_devices" in inspect.signature(fn).parameters
+            )
+        except (ValueError, TypeError):
+            _exec_devices_kwarg = False
+    return _exec_devices_kwarg
+
+
 def _leaf_sig(x: Any) -> str:
     if x is None:
         return "None"
@@ -137,8 +162,11 @@ def aot_key(name: str, args: Tuple, statics: Dict[str, Any]) -> str:
 
 
 def try_load(
-    name: str, args: Tuple, statics: Dict[str, Any], out_leaves: int = 1
-):
+    name: str,
+    args: Tuple,
+    statics: Dict[str, Any],
+    out_leaves: int = 1,
+) -> Optional[Any]:
     """Deserialize a stored executable for this call, or None.
 
     The pytree defs ``serialize`` hands back are deliberately NOT stored:
@@ -173,11 +201,14 @@ def try_load(
         out_tree = jax.tree_util.tree_flatten(skel)[1]
         # the stored executables are single-device programs; restrict
         # execution to device 0 (the default would hand a multi-device
-        # backend's full device list over and demand N-sharded args)
-        compiled = deserialize_and_load(
-            blob, in_tree, out_tree,
-            execution_devices=jax.devices()[:1],
-        )
+        # backend's full device list over and demand N-sharded args).
+        # execution_devices= only exists on newer jax — older versions
+        # replay the devices recorded at serialize time, which is the
+        # same single-device restriction
+        kwargs: Dict[str, Any] = {}
+        if _supports_execution_devices(deserialize_and_load):
+            kwargs["execution_devices"] = jax.devices()[:1]
+        compiled = deserialize_and_load(blob, in_tree, out_tree, **kwargs)
         _loaded[key] = compiled  # repeat chunks skip re-deserialization
         dt = time.perf_counter() - t0
         stats.setdefault(name, {})
@@ -194,7 +225,7 @@ def try_load(
 
 
 def maybe_save(
-    name: str, fn, args: Tuple, statics: Dict[str, Any]
+    name: str, fn: JitWrapped, args: Tuple, statics: Dict[str, Any]
 ) -> Optional[str]:
     """Compile ``fn`` for ``args`` AOT and store the executable if absent.
 
@@ -237,9 +268,12 @@ def maybe_save(
 
 
 def call_or_compile(
-    name: str, fn, args: Tuple, statics: Dict[str, Any],
+    name: str,
+    fn: JitWrapped,
+    args: Tuple,
+    statics: Dict[str, Any],
     out_leaves: int = 1,
-):
+) -> Any:
     """The one AOT dispatch policy: stored executable if loadable, else
     the jit path plus a best-effort store write. Shared by every AOT call
     site so fixes to the flow (pruning, memoization, fallback) live in

@@ -24,12 +24,24 @@ sort to the end of the ranking.
 
 from __future__ import annotations
 
+from typing import Optional, Tuple, Union
+
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+Array = jax.Array
+# scalars crossing the jit boundary arrive as python numbers or arrays
+Scalar = Union[Array, float, int]
 
-def broker_loads(replicas, weights, nrep_cur, ncons, num_brokers: int):
+
+def broker_loads(
+    replicas: Array,
+    weights: Array,
+    nrep_cur: Array,
+    ncons: Array,
+    num_brokers: int,
+) -> Array:
     """Per-broker load vector ``[B]`` (utils.go:92-105).
 
     ``replicas``: [P, R] dense broker indices (-1 pad); ``weights``: [P];
@@ -51,7 +63,7 @@ def broker_loads(replicas, weights, nrep_cur, ncons, num_brokers: int):
     )
 
 
-def overload_penalty(loads, avg):
+def overload_penalty(loads: Array, avg: Scalar) -> Array:
     """Per-broker objective term: ``rel²`` if overloaded else ``rel²/2``
     (utils.go:134-143).
 
@@ -65,7 +77,7 @@ def overload_penalty(loads, avg):
     )
 
 
-def unbalance(loads, bvalid, nb):
+def unbalance(loads: Array, bvalid: Array, nb: Scalar) -> Array:
     """The scalar objective over the valid brokers (utils.go:119-147).
 
     ``nb`` is the real broker count (padded entries excluded). NaN/inf
@@ -78,21 +90,21 @@ def unbalance(loads, bvalid, nb):
 
 
 def move_candidate_scores(
-    loads,
-    replicas,
-    allowed_rank,
-    member_rank,
-    bvalid,
-    bvalid_rank,
-    perm,
-    rank_of,
-    weights,
-    nrep_cur,
-    nrep_tgt,
-    pvalid,
-    nb,
-    min_replicas,
-):
+    loads: Array,
+    replicas: Array,
+    allowed_rank: Array,
+    member_rank: Array,
+    bvalid: Array,
+    bvalid_rank: Array,
+    perm: Array,
+    rank_of: Array,
+    weights: Array,
+    nrep_cur: Array,
+    nrep_tgt: Array,
+    pvalid: Array,
+    nb: Scalar,
+    min_replicas: Scalar,
+) -> Tuple[Array, Array]:
     """Rank-1 what-if scores for every ``(partition, replica slot, target)``
     move candidate — the shared core of the tpu and scan solvers.
 
@@ -147,7 +159,7 @@ def move_candidate_scores(
     return jnp.where(mask, u, jnp.inf), su
 
 
-def colo_terms(c, lam):
+def colo_terms(c: Array, lam: Scalar) -> Tuple[Array, Array]:
     """The anti-colocation delta rule, ONE definition for every scorer
     and for the sequential-delta gate (scan.prefix_accept's ``colo_d``):
     removing a replica from a broker holding ``c >= 2`` same-topic
@@ -160,22 +172,22 @@ def colo_terms(c, lam):
 
 
 def paired_best(
-    loads,
-    replicas,
-    allowed,
-    member,
-    bvalid,
-    weights,
-    nrep_cur,
-    nrep_tgt,
-    ncons,
-    pvalid,
-    min_replicas,
+    loads: Array,
+    replicas: Array,
+    allowed: Array,
+    member: Array,
+    bvalid: Array,
+    weights: Array,
+    nrep_cur: Array,
+    nrep_tgt: Array,
+    ncons: Array,
+    pvalid: Array,
+    min_replicas: Scalar,
     *,
     allow_leader: bool,
-    c_rows=None,
-    lam=None,
-):
+    c_rows: Optional[Array] = None,
+    lam: Optional[Scalar] = None,
+) -> Tuple[Array, Array, Array, Array, Array, Array]:
     """Best candidate per hot/cold broker-rank PAIR.
 
     The per-target selection (:func:`factored_target_best`) degenerates
@@ -230,7 +242,7 @@ def paired_best(
     s_sel = s_onehot.astype(dtype)
     t_sel = t_onehot.astype(dtype)
 
-    def cols(values, mask, sel):
+    def cols(values: Array, mask: Array, sel: Array) -> Array:
         # masked one-hot column selection: zero the masked entries BEFORE
         # the contraction (0 * masked-out is exact; inf would poison it)
         v = jnp.dot(jnp.where(mask, values, 0.0), sel)
@@ -276,7 +288,9 @@ def paired_best(
     return su + vals, p, slot, s_i, t_i, live
 
 
-def pair_frame(loads, bvalid):
+def pair_frame(
+    loads: Array, bvalid: Array
+) -> Tuple[Array, Array, Array, Array, Array]:
     """Hot/cold rank-pairing frame shared by :func:`paired_best` and the
     sharded scoring kernel's host side (parallel/shard_kernel.py): pair
     ``i`` moves OFF the broker at ascending-(load, ID) rank ``nb-1-i``
@@ -299,9 +313,17 @@ def pair_frame(loads, bvalid):
 
 
 def pair_finish(
-    replicas, nrep_cur, s_i, live, vals_f, p_f, vals_l, p_l,
-    *, allow_leader: bool,
-):
+    replicas: Array,
+    nrep_cur: Array,
+    s_i: Array,
+    live: Array,
+    vals_f: Array,
+    p_f: Array,
+    vals_l: Optional[Array],
+    p_l: Optional[Array],
+    *,
+    allow_leader: bool,
+) -> Tuple[Array, Array, Array]:
     """Pair-winner epilogue shared by :func:`paired_best` and the sharded
     kernel path: recover the (unique) follower slot holding the pair's
     hot broker on the winner partition, merge the leader winners
@@ -326,7 +348,7 @@ def pair_finish(
     return jnp.where(live, vals, jnp.inf), p, slot
 
 
-def rank_brokers(loads, bvalid):
+def rank_brokers(loads: Array, bvalid: Array) -> Tuple[Array, Array, Array]:
     """Ascending (load, broker-index) ranking of the valid brokers
     (utils.go:14-28, utils.go:107-117).
 
@@ -349,26 +371,26 @@ def rank_brokers(loads, bvalid):
 
 
 def factored_target_best(
-    loads,
-    replicas,
-    allowed,
-    member,
-    bvalid,
-    weights,
-    nrep_cur,
-    nrep_tgt,
-    ncons,
-    pvalid,
-    nb,
-    min_replicas,
+    loads: Array,
+    replicas: Array,
+    allowed: Array,
+    member: Array,
+    bvalid: Array,
+    weights: Array,
+    nrep_cur: Array,
+    nrep_tgt: Array,
+    ncons: Array,
+    pvalid: Array,
+    nb: Scalar,
+    min_replicas: Scalar,
     *,
     allow_leader: bool,
-    c_rows=None,
-    lam=None,
-    exclude_p=None,
-    exclude_src=None,
+    c_rows: Optional[Array] = None,
+    lam: Optional[Scalar] = None,
+    exclude_p: Optional[Array] = None,
+    exclude_src: Optional[Tuple[Array, Array]] = None,
     top2: bool = False,
-):
+) -> Tuple[Array, ...]:
     """Best candidate per TARGET broker via the factorized rank-1 objective.
 
     ``exclude_p [B]`` (optional) bars one partition row per target — used
@@ -473,7 +495,7 @@ def factored_target_best(
     p = lax.argmin(V, 0, jnp.int32)  # [B]
     vals = jnp.min(V, axis=0)
 
-    def slot_of(p_win):
+    def slot_of(p_win: Array) -> Array:
         """Source slot recovery for the [B] winner partitions ONLY: a
         [P]-wide argmin over the minor broker axis was the single most
         expensive op at beam scale (~45% of a depth step); gathering the

@@ -29,6 +29,7 @@ from typing import Iterable, List, Optional, Sequence
 import numpy as np
 
 from kafkabalancer_tpu.models import Partition, PartitionList, RebalanceConfig
+from kafkabalancer_tpu.models.config import HOST_FLOAT_DTYPE
 from kafkabalancer_tpu.ops.runtime import next_bucket
 
 
@@ -69,7 +70,9 @@ class DensePlan:
             raise KeyError(f"broker {broker_id} not in dense universe")
         return idx
 
-    def decode_replicas(self, replicas: np.ndarray, nrep_cur: np.ndarray) -> List[List[int]]:
+    def decode_replicas(
+        self, replicas: np.ndarray, nrep_cur: np.ndarray
+    ) -> List[List[int]]:
         """Dense replica matrix → per-partition broker-ID lists (real rows)."""
         out: List[List[int]] = []
         for p in range(self.np_):
@@ -141,11 +144,11 @@ def tensorize(
     R = next_bucket(rmax, max(2, min_replica_bucket))
     B = next_bucket(nb, min_broker_bucket)
 
-    weights = np.zeros(P, dtype=np.float64)
+    weights = np.zeros(P, dtype=HOST_FLOAT_DTYPE)
     replicas = np.full((P, R), -1, dtype=np.int32)
     nrep_cur = np.zeros(P, dtype=np.int32)
     nrep_tgt = np.zeros(P, dtype=np.int32)
-    ncons = np.zeros(P, dtype=np.float64)
+    ncons = np.zeros(P, dtype=HOST_FLOAT_DTYPE)
     allowed = np.zeros((P, B), dtype=bool)
     member = np.zeros((P, B), dtype=bool)
     pvalid = np.zeros(P, dtype=bool)

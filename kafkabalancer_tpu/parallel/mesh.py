@@ -15,6 +15,31 @@ import jax
 import numpy as np
 from jax.sharding import Mesh
 
+# jax moved shard_map out of experimental and renamed check_rep= to
+# check_vma= over the supported version range — and the two changes did
+# NOT ship in the same release. Every call site routes through this ONE
+# compat binding, written against the NEW spelling; the kwarg question
+# is decided by signature, not by where the symbol lives.
+try:
+    _shard_map_impl = jax.shard_map
+except AttributeError:  # pragma: no cover - version-dependent branch
+    from jax.experimental.shard_map import shard_map as _shard_map_impl
+
+try:
+    import inspect
+
+    _HAS_CHECK_VMA = (
+        "check_vma" in inspect.signature(_shard_map_impl).parameters
+    )
+except (ValueError, TypeError):  # pragma: no cover - exotic wrappers
+    _HAS_CHECK_VMA = True  # assume the current API
+
+
+def shard_map(*args, **kwargs):  # type: ignore[no-untyped-def]
+    if not _HAS_CHECK_VMA and "check_vma" in kwargs:
+        kwargs["check_rep"] = kwargs.pop("check_vma")
+    return _shard_map_impl(*args, **kwargs)
+
 SWEEP_AXIS = "sweep"
 PART_AXIS = "part"
 

@@ -59,6 +59,7 @@ import jax.numpy as jnp  # noqa: E402
 from jax import lax  # noqa: E402
 from jax.experimental import pallas as pl  # noqa: E402
 
+from kafkabalancer_tpu.models.config import kernel_dtype  # noqa: E402
 from kafkabalancer_tpu.ops import cost  # noqa: E402
 
 # rows streamed per grid step. MUST stay a power of two: per-shard row
@@ -100,7 +101,7 @@ def _kernel(
     ti = pl.program_id(0)
     T, B = member_ref.shape[0], member_ref.shape[1]
     B2 = ssel_ref.shape[1]
-    f32 = jnp.float32
+    f32 = kernel_dtype()
     i32 = jnp.int32
 
     reps = replicas_ref[...]
@@ -336,13 +337,13 @@ def shard_score(
             pl.BlockSpec((1, B2), const_map),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((1, B), jnp.float32),
+            jax.ShapeDtypeStruct((1, B), kernel_dtype()),
             jax.ShapeDtypeStruct((1, B), jnp.int32),
-            jax.ShapeDtypeStruct((1, B), jnp.float32),
+            jax.ShapeDtypeStruct((1, B), kernel_dtype()),
             jax.ShapeDtypeStruct((1, B), jnp.int32),
-            jax.ShapeDtypeStruct((1, B2), jnp.float32),
+            jax.ShapeDtypeStruct((1, B2), kernel_dtype()),
             jax.ShapeDtypeStruct((1, B2), jnp.int32),
-            jax.ShapeDtypeStruct((1, B2), jnp.float32),
+            jax.ShapeDtypeStruct((1, B2), kernel_dtype()),
             jax.ShapeDtypeStruct((1, B2), jnp.int32),
         ],
         interpret=interpret,
@@ -358,7 +359,7 @@ def pack_cols(weights, nrep_cur, nrep_tgt, ncons, pvalid):
     """Pack the session-static per-partition vectors into the kernel's
     single gridded ``[P_l, 5]`` f32 input (all values are exact in f32:
     weights are f32 inputs, counts are small ints)."""
-    f32 = jnp.float32
+    f32 = kernel_dtype()
     return jnp.stack(
         [
             weights.astype(f32),

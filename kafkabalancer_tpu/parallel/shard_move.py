@@ -29,7 +29,7 @@ from jax import lax  # noqa: E402
 from jax.sharding import Mesh  # noqa: E402
 from jax.sharding import PartitionSpec as P  # noqa: E402
 
-from kafkabalancer_tpu.parallel.mesh import PART_AXIS  # noqa: E402
+from kafkabalancer_tpu.parallel.mesh import PART_AXIS, shard_map  # noqa: E402
 from kafkabalancer_tpu.solvers.tpu import score_moves  # noqa: E402
 
 
@@ -72,7 +72,7 @@ def sharded_score_moves(
     pshard = P(PART_AXIS)
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(
             rep, pshard, pshard, pshard, pshard, pshard, pshard, pshard,

@@ -54,8 +54,12 @@ from jax import lax  # noqa: E402
 from jax.sharding import Mesh  # noqa: E402
 from jax.sharding import PartitionSpec as PS  # noqa: E402
 
+from kafkabalancer_tpu.models.config import (  # noqa: E402
+    default_dtype,
+    kernel_dtype,
+)
 from kafkabalancer_tpu.ops import cost  # noqa: E402
-from kafkabalancer_tpu.parallel.mesh import PART_AXIS  # noqa: E402
+from kafkabalancer_tpu.parallel.mesh import PART_AXIS, shard_map  # noqa: E402
 from kafkabalancer_tpu.solvers.scan import prefix_accept  # noqa: E402
 
 
@@ -132,7 +136,7 @@ def sharded_session(
     P_l = P // S
     dtype = loads.dtype
     use_pallas = engine in ("pallas", "pallas-interpret")
-    if use_pallas and dtype != jnp.float32:
+    if use_pallas and dtype != kernel_dtype():
         raise ValueError("the pallas shard engine is float32 only")
     if engine not in ("xla", "pallas", "pallas-interpret"):
         raise ValueError(f"unknown shard engine {engine!r}")
@@ -151,7 +155,7 @@ def sharded_session(
     pshard = PS(PART_AXIS)
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(
             rep,      # loads
@@ -432,7 +436,9 @@ def sharded_session(
         (loads, replicas, member, bcount, n, _done,
          mp, mslot, msrc, mtgt, _counts) = lax.while_loop(cond, body, state)
         bvalid = (always_valid | (bcount > 0)) & universe_valid
-        final_su = cost.unbalance(loads, bvalid, jnp.sum(bvalid, dtype=jnp.int32).astype(dtype))
+        final_su = cost.unbalance(
+            loads, bvalid, jnp.sum(bvalid, dtype=jnp.int32).astype(dtype)
+        )
         return (
             replicas, loads, n,
             mp[:max_moves], mslot[:max_moves], msrc[:max_moves],
@@ -563,7 +569,7 @@ def plan_sharded(
         # unless the caller explicitly asked for a non-f32 dtype (the
         # kernel is float32 by construction; the previous auto honored
         # f64).
-        wants_f64 = dtype is not None and dtype != jnp.float32
+        wants_f64 = dtype is not None and dtype != kernel_dtype()
         engine = "xla" if (wants_f64 or not on_tpu) else "pallas"
     else:
         engine = resolve_engine(engine)
@@ -623,7 +629,7 @@ def plan_sharded(
                 # precision; an EXPLICIT f64 request passes through
                 # (it resolved to this engine precisely because the
                 # caller pinned the dtype).
-                dtype=dtype if dtype is not None else jnp.float32,
+                dtype=dtype if dtype is not None else kernel_dtype(),
                 batch=batch,
                 chunk_moves=chunk_moves, engine="xla", polish=polish,
                 # the RESOLVED penalty, verbatim — a 0.0 here may be an
@@ -645,9 +651,9 @@ def plan_sharded(
     repaired, budget = _settle_head(pl, cfg, max_reassign)
     opl.append(*repaired)
     if engine in ("pallas", "pallas-interpret"):
-        dtype = jnp.float32  # the Mosaic kernel is 32-bit by construction
+        dtype = kernel_dtype()  # the Mosaic kernel is 32-bit by construction
     elif dtype is None:
-        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        dtype = default_dtype()
     if chunk_moves is None:
         chunk_moves = auto_chunk_moves(len(pl.partitions or []))
     chunk_moves = max(1, min(chunk_moves, 1 << 20))

@@ -42,6 +42,7 @@ from functools import partial
 from typing import List, Optional, Sequence
 
 from kafkabalancer_tpu.models import PartitionList, RebalanceConfig
+from kafkabalancer_tpu.models.config import default_dtype, kernel_dtype
 from kafkabalancer_tpu.ops.runtime import ensure_x64, next_bucket
 
 ensure_x64()
@@ -55,7 +56,11 @@ from jax.sharding import PartitionSpec as P  # noqa: E402
 
 from kafkabalancer_tpu.balancer import steps as _s  # noqa: E402
 from kafkabalancer_tpu.ops import cost, tensorize  # noqa: E402
-from kafkabalancer_tpu.parallel.mesh import SWEEP_AXIS, make_mesh  # noqa: E402
+from kafkabalancer_tpu.parallel.mesh import (  # noqa: E402
+    SWEEP_AXIS,
+    make_mesh,
+    shard_map,
+)
 from kafkabalancer_tpu.solvers.scan import session  # noqa: E402
 
 
@@ -246,7 +251,7 @@ def _sweep_exec(
     ps = sh if per_scenario else rep
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(
             sh,   # scenario_mask
@@ -479,9 +484,9 @@ def sweep(
             scenario_mask[i, dp.broker_index(int(bid))] = True
 
     if dtype is None:
-        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        dtype = default_dtype()
     if use_pallas:
-        dtype = jnp.float32  # the kernel is float32-only
+        dtype = kernel_dtype()  # the kernel is float32-only
 
     has_explicit = np.asarray(has_explicit_l + [False] * (dp.pvalid.shape[0] - dp.np_))
     max_evac = int(dp.replicas.shape[0] * dp.replicas.shape[1])

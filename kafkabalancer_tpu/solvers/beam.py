@@ -35,9 +35,10 @@ sequence improves or the reassignment budget runs out.
 from __future__ import annotations
 
 from functools import partial
-from typing import List, Optional
+from typing import Optional
 
 from kafkabalancer_tpu.models import PartitionList, RebalanceConfig
+from kafkabalancer_tpu.models.config import default_dtype
 from kafkabalancer_tpu.models.partition import empty_partition_list
 from kafkabalancer_tpu.ops.runtime import ensure_x64, next_bucket
 
@@ -79,7 +80,9 @@ def _scan_factory(
         via the per-broker replica counts (no [P, B] reduction) and the
         colocation total as the tracked scalar (no [T, B] reduction)."""
         bvalid = (always_valid | (bcount > 0)) & universe_valid
-        u = cost.unbalance(loads, bvalid, jnp.sum(bvalid, dtype=jnp.int32).astype(dtype))
+        u = cost.unbalance(
+            loads, bvalid, jnp.sum(bvalid, dtype=jnp.int32).astype(dtype)
+        )
         if n_topics:
             u = u + colo
         return u
@@ -267,9 +270,19 @@ def _scan_factory(
             # The big boolean member tensor routes through a one-hot
             # matmul (exact for 0/1 payloads): the W-row select hits the
             # MXU at ~2x the throughput of the general gather lowering
-            sel = jax.nn.one_hot(parent, W, dtype=jnp.bfloat16)  # [W, W]
+            # bf16 is NOT a precision decision: each output element sums
+            # exactly one 0/1 payload, exact in any matmul dtype
+            sel = jax.nn.one_hot(  # jaxlint: disable=R4 — exact 0/1 select
+                parent, W, dtype=jnp.bfloat16
+            )  # [W, W]
             member_b = (
-                (sel @ member_b.reshape(W, -1).astype(jnp.bfloat16)) > 0.5
+                (
+                    sel
+                    @ member_b.reshape(W, -1).astype(
+                        jnp.bfloat16  # jaxlint: disable=R4 — exact 0/1 select
+                    )
+                )
+                > 0.5
             ).reshape(W, P, B)
             loads_b = loads_b[parent]
             replicas_b = replicas_b[parent]
@@ -517,7 +530,7 @@ def _device_setup(pl, cfg, dtype):
 
     dp = tensorize(pl, cfg)
     if dtype is None:
-        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        dtype = default_dtype()
     _, (loads, w_dev, nc_dev, allowed_dev, _ew) = _prep_from_dp(dp, dtype)
     lam = float(cfg.anti_colocation)
     n_topics = next_bucket(len(dp.topics), 2) if lam > 0 else 0

@@ -70,6 +70,7 @@ from jax import lax  # noqa: E402
 from jax.experimental import pallas as pl  # noqa: E402
 from jax.experimental.pallas import tpu as pltpu  # noqa: E402
 
+from kafkabalancer_tpu.models.config import kernel_dtype  # noqa: E402
 from kafkabalancer_tpu.ops.cost import overload_penalty as _pen  # noqa: E402
 from kafkabalancer_tpu.solvers.scan import DEFAULT_CHURN_GATE  # noqa: E402
 
@@ -112,7 +113,7 @@ def _kernel(
     allow_leader: bool,
     all_allowed: bool,
 ):
-    f32 = jnp.float32
+    f32 = kernel_dtype()
 
     # ---- initialize mutable state from the inputs -----------------------
     # State lives TRANSPOSED ([R, P] replicas, [5, P] columns): the
@@ -165,7 +166,7 @@ def _kernel(
 
     def init_tile(ti, _):
         bcount_ref[:] = bcount_ref[:] + jnp.sum(
-            _member_tile(ti * TILE_P).astype(jnp.float32), axis=0,
+            _member_tile(ti * TILE_P).astype(kernel_dtype()), axis=0,
             keepdims=True,
         ).astype(jnp.int32)
         return _
@@ -293,7 +294,11 @@ def _kernel(
             srcmask = (
                 (slotf_ref[:] >= 0.5) & (slotf_ref[:] < nrc) & elig
             )  # [T, R]
-            A = jnp.where(srcmask, _pen(loads_s - w_t, avg) - F_s, jnp.full_like(loads_s, BIG))
+            A = jnp.where(
+                srcmask,
+                _pen(loads_s - w_t, avg) - F_s,
+                jnp.full_like(loads_s, BIG),
+            )
             astar = jnp.min(A, axis=1, keepdims=True)  # [T, 1]
             rstar = lax.argmin(A, axis=1, index_dtype=jnp.int32)  # [T]
             C = _pen(loads.reshape(1, B) + w_t, avg) - F.reshape(1, B)
@@ -719,7 +724,7 @@ def pallas_session(
         raise ValueError(f"max_moves {max_moves} not a multiple of 128")
     ML = max_moves
 
-    f32 = jnp.float32
+    f32 = kernel_dtype()
     i32 = jnp.int32
     i8 = jnp.int8
 
@@ -783,7 +788,7 @@ def pallas_session(
 
 
 def _call(kernel, P, R, B, ML, smem, vmem, interpret=False):
-    f32 = jnp.float32
+    f32 = kernel_dtype()
     i32 = jnp.int32
     i8 = jnp.int8
     return pl.pallas_call(

@@ -42,7 +42,11 @@ from functools import partial
 from typing import List, Tuple
 
 from kafkabalancer_tpu.models import Partition, PartitionList, RebalanceConfig
-from kafkabalancer_tpu.models.config import ENGINES
+from kafkabalancer_tpu.models.config import (
+    ENGINES,
+    default_dtype,
+    kernel_dtype,
+)
 from kafkabalancer_tpu.models.partition import empty_partition_list
 from kafkabalancer_tpu.ops.runtime import ensure_x64
 
@@ -309,7 +313,7 @@ def pallas_session_fits(dp, dtype, all_allowed: bool, allow_leader: bool) -> boo
         return False  # no hardware to probe; the prior's no stands
     from kafkabalancer_tpu.solvers.pallas_session import pallas_session
 
-    f32 = jnp.float32
+    f32 = kernel_dtype()
     sds = jax.ShapeDtypeStruct
     args = (
         sds((B,), f32),                                 # loads
@@ -330,7 +334,7 @@ def pallas_session_fits(dp, dtype, all_allowed: bool, allow_leader: bool) -> boo
         sds((), f32),                                   # churn_gate
     )
     try:
-        jax.jit(
+        jax.jit(  # jaxlint: disable=R2 — compile probe; statics bound via partial
             partial(
                 pallas_session,
                 max_moves=8192,
@@ -628,7 +632,9 @@ def session(
         lax.while_loop(cond, body_batch if batch > 1 else body, state)
     )
     bvalid = (always_valid | (bcount > 0)) & universe_valid
-    final_su = cost.unbalance(loads, bvalid, jnp.sum(bvalid, dtype=jnp.int32).astype(dtype))
+    final_su = cost.unbalance(
+        loads, bvalid, jnp.sum(bvalid, dtype=jnp.int32).astype(dtype)
+    )
     # drop the batched path's trash slot
     return (
         replicas, loads, n,
@@ -676,7 +682,7 @@ def _device_prep(
     return loads, w, nc, allowed_dev, ew_c
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=())
 def _pack_log(mp, mslot, mtgt, n):
     """Device-side packing of the move log + count into one transfer."""
     return jnp.concatenate([mp, mslot, mtgt, n.astype(jnp.int32).reshape(1)])
@@ -787,7 +793,7 @@ def session_packed(
         _replicas, _loads, n, mp, mslot, _msrc, mtgt = pallas_session(
             loads, replicas, None, allowed_dev, w, nrep_cur, nrep_tgt,
             nc, pvalid, always_valid, universe_valid, min_replicas, mu,
-            budget, jnp.int32(max(1, batch)), cg.astype(jnp.float32),
+            budget, jnp.int32(max(1, batch)), cg.astype(kernel_dtype()),
             max_moves=max_moves, allow_leader=allow_leader,
             interpret=(engine == "pallas-interpret"),
             all_allowed=all_allowed,
@@ -1047,7 +1053,7 @@ def _leader_plan(
     )
     opl.append(*repaired)
     if dtype is None:
-        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        dtype = default_dtype()
     chunk_moves = max(1, min(chunk_moves, 1 << 20))
 
     remaining = budget
@@ -1238,7 +1244,7 @@ def plan(
     repaired, budget = _settle_head(pl, cfg, max_reassign)
     opl.append(*repaired)
     if dtype is None:
-        dtype = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        dtype = default_dtype()
 
     # sessions chunk at ``chunk_moves`` per device dispatch (bounding the
     # wall-clock of any single device call — long-running dispatches can
@@ -1250,7 +1256,7 @@ def plan(
     if use_pallas:
         from kafkabalancer_tpu.solvers.pallas_session import TILE_P
 
-        dtype = jnp.float32
+        dtype = kernel_dtype()
 
     remaining = budget
     while remaining > 0:

@@ -8,7 +8,6 @@ multi-candidate AddMissingReplicas case pinning the descending (most-loaded
 first) scan, and a MoveDisallowedReplicas case (untested in the reference).
 """
 
-import dataclasses
 
 import pytest
 
@@ -273,8 +272,6 @@ def test_distribute_leaders_swap():
     """ReassignLeaders hands leadership from the heaviest broker to the
     globally least-loaded broker; when the target is already a follower the
     positions swap in place (steps.go:278 -> utils.go:181-188)."""
-    from kafkabalancer_tpu.models import RebalanceConfig
-
     cfg = default_rebalance_config()
     cfg.rebalance_leaders = True
     pl = wrap(

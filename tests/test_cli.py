@@ -12,7 +12,6 @@ import io
 import json
 import os
 
-import pytest
 
 from kafkabalancer_tpu.cli import run
 
@@ -285,7 +284,10 @@ class TestEmptyReplicasEncoding:
         assert rv == 0
         assert "did not compare" in err
         obj = json.loads(out)
-        by_key = {(p["topic"], p["partition"]): p["replicas"] for p in obj["partitions"]}
+        by_key = {
+            (p["topic"], p["partition"]): p["replicas"]
+            for p in obj["partitions"]
+        }
         # the second (non-comparing) move rebalanced foo1,2 off broker 1
         assert by_key[("foo1", 2)] != [1, 2]
 

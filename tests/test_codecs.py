@@ -48,7 +48,10 @@ class TestParsingJSON:
         assert pl.partitions[0] == Partition(topic="foo1", partition=2, replicas=[1, 2])
 
     def test_wrong_version(self):
-        with pytest.raises(CodecError, match="wrong partition list version: expected 1, got 2"):
+        with pytest.raises(
+            CodecError,
+            match="wrong partition list version: expected 1, got 2",
+        ):
             get_partition_list_from_reader('{"version":2,"partitions":[]}', True, [])
 
     def test_malformed(self):
@@ -155,8 +158,12 @@ class TestParsingText:
     def test_describe_output(self):
         pl = get_partition_list_from_reader(TEXT_FIXTURE, False, [])
         assert len(pl) == 9
-        assert pl.partitions[0] == Partition(topic="test", partition=0, replicas=[2, 0, 1])
-        assert pl.partitions[8] == Partition(topic="test", partition=8, replicas=[1, 2, 0])
+        assert pl.partitions[0] == Partition(
+            topic="test", partition=0, replicas=[2, 0, 1]
+        )
+        assert pl.partitions[8] == Partition(
+            topic="test", partition=8, replicas=[1, 2, 0]
+        )
 
     def test_topic_filter(self):
         with pytest.raises(CodecError, match="empty partition list"):
@@ -197,7 +204,9 @@ class TestZkConnString:
         assert nodes == [("localhost", 2282)]
         assert chroot == ""
 
-    @pytest.mark.parametrize("bad", [".", "", "host", "host:", "host:x", ":2181", "h:0"])
+    @pytest.mark.parametrize(
+        "bad", [".", "", "host", "host:", "host:x", ":2181", "h:0"]
+    )
     def test_invalid(self, bad):
         with pytest.raises(ValueError):
             parse_zk_connection_string(bad)

@@ -13,6 +13,8 @@ import socket
 import subprocess
 import sys
 
+import pytest
+
 WORKER = os.path.join(os.path.dirname(__file__), "distributed_worker.py")
 
 
@@ -46,6 +48,12 @@ def test_two_process_distributed_scoring():
     for p in procs:
         out, _ = p.communicate(timeout=300)
         outs.append(out)
+    if any(
+        "Multiprocess computations aren't implemented" in out for out in outs
+    ):
+        pytest.skip(
+            "this jaxlib's CPU backend lacks multiprocess collectives"
+        )
     for i, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"worker {i} failed:\n{out}"
         assert f"DIST_OK proc={i} processes=2 global_devices=4" in out, out

@@ -5,6 +5,8 @@ import os
 import subprocess
 import sys
 
+import pytest
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 EXAMPLE = os.path.join(REPO, "examples", "multihost_sweep.py")
 FIXTURE = os.path.join(REPO, "tests", "data", "test.json")
@@ -22,6 +24,12 @@ def test_multihost_sweep_local_demo():
         timeout=420,
         env=dict(os.environ),
     )
+    if "Multiprocess computations aren't implemented" in (
+        proc.stderr + proc.stdout
+    ):
+        pytest.skip(
+            "this jaxlib's CPU backend lacks multiprocess collectives"
+        )
     assert proc.returncode == 0, proc.stderr[-2000:]
     out = proc.stdout
     # rank 0 printed the ranked table exactly once (replicated results)

@@ -1,0 +1,39 @@
+"""The pre-merge gate itself, as a (slow) test.
+
+Shells out to ``scripts/gate.sh --no-tests`` — the static stages only
+(jaxlint, annotation coverage, mypy/ruff when installed). The tier-1
+pytest stage is skipped because THIS test runs inside that suite's
+``slow``-marked complement; the full gate is what CI / a pre-merge hook
+runs directly.
+"""
+
+import os
+import subprocess
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+GATE = os.path.join(REPO, "scripts", "gate.sh")
+
+
+@pytest.mark.slow
+def test_gate_static_stages_pass():
+    proc = subprocess.run(
+        ["bash", GATE, "--no-tests"],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "GATE PASS" in proc.stdout
+
+
+@pytest.mark.slow
+def test_gate_rejects_unknown_flags():
+    proc = subprocess.run(
+        ["bash", GATE, "--bogus"],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+    assert proc.returncode == 2

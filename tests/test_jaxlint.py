@@ -1,0 +1,589 @@
+"""Tests for the JAX-aware linter (kafkabalancer_tpu/analysis/).
+
+Each rule R1–R5 gets at least one FAILING and one PASSING fixture
+(ISSUE acceptance criterion), plus coverage of the machinery the gate
+depends on: trace-context detection (decorated, lax-combinator bodies,
+nested defs, module-local call-graph propagation), inline suppressions,
+the baseline file, JSON output, the annotation-coverage checker, and —
+the contract the whole subsystem exists for — the shipped tree being
+clean under the gate.
+
+Pure stdlib under test: none of this imports jax.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+from kafkabalancer_tpu.analysis import (
+    ALL_RULES,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    subtract_baseline,
+    write_baseline,
+)
+from kafkabalancer_tpu.analysis.annotations import check_paths
+from kafkabalancer_tpu.analysis.jaxlint import format_json, main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PACKAGE = os.path.join(REPO, "kafkabalancer_tpu")
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------- R1
+
+
+R1_FAIL = """
+import jax
+
+@jax.jit  # jaxlint: disable=R2
+def f(x):
+    return float(x) + 1
+"""
+
+R1_FAIL_ITEM_IN_SCAN = """
+from jax import lax
+
+def body(carry, x):
+    return carry + x.item(), None
+
+def outer(xs):
+    return lax.scan(body, 0.0, xs)
+"""
+
+R1_PASS_STATIC_SHAPE = """
+import jax
+
+@jax.jit  # jaxlint: disable=R2
+def f(x):
+    n = int(x.shape[0])
+    m = float(len(x.shape))
+    return x * n * m
+"""
+
+R1_PASS_HOST = """
+def decode(packed):
+    return int(packed[-1]), float(packed[0])
+"""
+
+
+def test_r1_flags_traced_coercion():
+    assert rules_of(lint_source(R1_FAIL)) == ["R1"]
+
+
+def test_r1_flags_item_in_scan_body():
+    assert rules_of(lint_source(R1_FAIL_ITEM_IN_SCAN)) == ["R1"]
+
+
+def test_r1_passes_static_shape_coercion():
+    assert lint_source(R1_PASS_STATIC_SHAPE) == []
+
+
+def test_r1_passes_host_code():
+    assert lint_source(R1_PASS_HOST) == []
+
+
+# ---------------------------------------------------------------- R2
+
+
+R2_FAIL_BARE_DECORATOR = """
+import jax
+
+@jax.jit
+def f(x):
+    return x
+"""
+
+R2_FAIL_CALL = """
+import jax
+
+def f(x):
+    return x
+
+g = jax.jit(f)
+"""
+
+R2_PASS_PARTIAL = """
+import jax
+from functools import partial
+
+@partial(jax.jit, static_argnames=("n",))
+def f(x, n):
+    return x
+
+@partial(jax.jit, static_argnames=())
+def g(x):
+    return x
+
+h = jax.jit(g, donate_argnums=(0,))
+"""
+
+
+def test_r2_flags_bare_decorator():
+    assert rules_of(lint_source(R2_FAIL_BARE_DECORATOR)) == ["R2"]
+
+
+def test_r2_flags_undeclared_call():
+    assert rules_of(lint_source(R2_FAIL_CALL)) == ["R2"]
+
+
+def test_r2_passes_declared_sites():
+    assert lint_source(R2_PASS_PARTIAL) == []
+
+
+# ---------------------------------------------------------------- R3
+
+
+R3_FAIL_NUMPY = """
+import jax
+import numpy as np
+
+@jax.jit  # jaxlint: disable=R2
+def f(x):
+    return np.sum(x)
+"""
+
+R3_FAIL_SYNC_IN_LOOP_BODY = """
+import jax
+from jax import lax
+
+def body(carry, x):
+    jax.block_until_ready(carry)
+    return carry, x
+
+def outer(xs):
+    return lax.scan(body, 0.0, xs)
+"""
+
+R3_PASS_HOST_NUMPY = """
+import numpy as np
+
+def decode(packed):
+    return np.asarray(packed)
+"""
+
+R3_PASS_NP_CONSTANTS = """
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+@jax.jit  # jaxlint: disable=R2
+def f(x):
+    return jnp.where(x > 0, x, np.inf)
+"""
+
+
+def test_r3_flags_numpy_in_jit():
+    assert rules_of(lint_source(R3_FAIL_NUMPY)) == ["R3"]
+
+
+def test_r3_flags_sync_in_scan_body():
+    assert rules_of(lint_source(R3_FAIL_SYNC_IN_LOOP_BODY)) == ["R3"]
+
+
+def test_r3_passes_host_numpy():
+    assert lint_source(R3_PASS_HOST_NUMPY) == []
+
+
+def test_r3_passes_numpy_scalar_constants():
+    assert lint_source(R3_PASS_NP_CONSTANTS) == []
+
+
+def test_r3_callgraph_propagation():
+    src = """
+import jax
+import numpy as np
+
+def helper(y):
+    return np.asarray(y)
+
+@jax.jit  # jaxlint: disable=R2
+def f(x):
+    return helper(x)
+"""
+    fs = lint_source(src)
+    assert rules_of(fs) == ["R3"]
+    assert "helper" not in fs[0].snippet or "np.asarray" in fs[0].snippet
+
+
+# ---------------------------------------------------------------- R4
+
+
+R4_FAIL_ATTR = """
+import jax.numpy as jnp
+
+def f(x):
+    return x.astype(jnp.float64)
+"""
+
+R4_FAIL_STRING = """
+import numpy as np
+
+def f(x):
+    return np.zeros(3, dtype="float32")
+"""
+
+R4_PASS_POLICY = """
+from kafkabalancer_tpu.models.config import HOST_FLOAT_DTYPE, default_dtype
+import numpy as np
+
+def f(x):
+    return np.zeros(3, dtype=HOST_FLOAT_DTYPE).astype(default_dtype())
+"""
+
+R4_PASS_INT_DTYPES = """
+import jax.numpy as jnp
+
+def f(x):
+    return x.astype(jnp.int32)
+"""
+
+
+def test_r4_flags_float_dtype_attribute():
+    assert rules_of(lint_source(R4_FAIL_ATTR)) == ["R4"]
+
+
+def test_r4_flags_float_dtype_string():
+    assert rules_of(lint_source(R4_FAIL_STRING)) == ["R4"]
+
+
+def test_r4_flags_positional_dtype_string():
+    src = """
+import numpy as np
+
+def f(x):
+    return np.zeros(3, "float64"), x.astype("float32")
+"""
+    fs = lint_source(src)
+    assert [f.rule for f in fs] == ["R4", "R4"]
+
+
+def test_r4_passes_policy_routing():
+    assert lint_source(R4_PASS_POLICY) == []
+
+
+def test_r4_passes_integer_dtypes():
+    assert lint_source(R4_PASS_INT_DTYPES) == []
+
+
+def test_r4_ignores_non_dtype_string_uses():
+    src = """
+import logging
+
+def f(s, log):
+    log.warning("float32")
+    return s.startswith("float64")
+"""
+    assert lint_source(src) == []
+
+
+def test_r4_flags_from_import_spelling():
+    src = """
+from numpy import float64
+import numpy as np
+
+def f():
+    return np.zeros(3, float64)
+"""
+    assert rules_of(lint_source(src)) == ["R4"]
+
+
+def test_r4_exempts_the_policy_module():
+    src = "import jax.numpy as jnp\nDTYPE = jnp.float64\n"
+    assert lint_source(src, path="kafkabalancer_tpu/models/config.py") == []
+    assert rules_of(lint_source(src, path="other.py")) == ["R4"]
+
+
+# ---------------------------------------------------------------- R5
+
+
+R5_FAIL = """
+import jax
+
+@jax.jit  # jaxlint: disable=R2
+def f(x):
+    return x[x > 0]
+"""
+
+R5_FAIL_COMPOUND = """
+import jax
+
+@jax.jit  # jaxlint: disable=R2
+def f(x, m):
+    return x[(x > 0) & (x < m)]
+"""
+
+R5_PASS_WHERE = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit  # jaxlint: disable=R2
+def f(x):
+    return jnp.where(x > 0, x, 0.0)
+"""
+
+R5_PASS_HOST = """
+def f(x):
+    return x[x > 0]
+"""
+
+
+def test_r5_flags_boolean_mask_indexing():
+    assert rules_of(lint_source(R5_FAIL)) == ["R5"]
+
+
+def test_r5_flags_compound_masks():
+    assert rules_of(lint_source(R5_FAIL_COMPOUND)) == ["R5"]
+
+
+def test_r5_passes_where():
+    assert lint_source(R5_PASS_WHERE) == []
+
+
+def test_r5_passes_host_mask_indexing():
+    assert lint_source(R5_PASS_HOST) == []
+
+
+# ------------------------------------------------------- machinery
+
+
+def test_shard_map_decorated_body_is_traced():
+    """The @partial(shard_map, ...) idiom — the spelling of the three
+    sharded compute bodies in parallel/ — is a traced context for
+    R1/R3/R5."""
+    src = """
+from functools import partial
+import numpy as np
+from kafkabalancer_tpu.parallel.mesh import shard_map
+
+@partial(shard_map, mesh=None, in_specs=(), out_specs=())
+def body(x):
+    return np.sum(x) + float(x), x[x > 0]
+"""
+    assert rules_of(lint_source(src)) == ["R1", "R3", "R5"]
+
+
+def test_nested_defs_inherit_trace_context():
+    src = """
+import jax
+
+@jax.jit  # jaxlint: disable=R2
+def f(x):
+    def inner(y):
+        return float(y)
+    return inner(x)
+"""
+    assert rules_of(lint_source(src)) == ["R1"]
+
+
+def test_inline_suppression_with_reason():
+    src = """
+import jax
+
+@jax.jit  # jaxlint: disable=R2 — wrapper is retrace-free by design
+def f(x):
+    return x
+"""
+    assert lint_source(src) == []
+
+
+def test_suppression_is_rule_specific():
+    src = """
+import jax
+
+@jax.jit  # jaxlint: disable=R5
+def f(x):
+    return x
+"""
+    assert rules_of(lint_source(src)) == ["R2"]
+
+
+def test_suppression_covers_multiline_calls():
+    """A disable on the call head suppresses findings anchored anywhere
+    in the call — keyword and positional dtype spellings behave the
+    same."""
+    src = """
+import numpy as np
+
+def f():
+    a = np.zeros(  # jaxlint: disable=R4
+        3,
+        dtype="float64",
+    )
+    b = np.zeros(  # jaxlint: disable=R4
+        3,
+        "float64",
+    )
+    return a, b
+"""
+    assert lint_source(src) == []
+
+
+def test_suppression_accepts_space_separated_rules():
+    src = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit  # jaxlint: disable=R2 R4
+def f(x):
+    return x.astype(jnp.float64)
+"""
+    # R2 suppressed on the decorator line; R4 anchors inside the body
+    # on its own line, so only it reports
+    assert rules_of(lint_source(src)) == ["R4"]
+    src_ok = src.replace(
+        "return x.astype(jnp.float64)",
+        "return x.astype(jnp.float64)  # jaxlint: disable=R4 R1",
+    )
+    assert lint_source(src_ok) == []
+
+
+def test_directives_in_string_literals_are_inert():
+    """Only COMMENT tokens carry directives: a docstring quoting
+    '# jaxlint: skip-file' or 'disable=all' must not disable linting."""
+    src = (
+        '"""Docs: put # jaxlint: skip-file at the top to skip."""\n'
+        "import jax\n"
+        "\n"
+        'HELP = "# jaxlint: disable=all"\n'
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    return x\n"
+    )
+    assert rules_of(lint_source(src)) == ["R2"]
+
+
+def test_skip_file_pragma():
+    src = (
+        "# jaxlint: skip-file\nimport jax\n\n"
+        "@jax.jit\ndef f(x):\n    return float(x)\n"
+    )
+    assert lint_source(src) == []
+
+
+def test_select_subset_of_rules():
+    fs = lint_source(R2_FAIL_BARE_DECORATOR, rules=("R5",))
+    assert fs == []
+
+
+def test_baseline_roundtrip(tmp_path):
+    fs = lint_source(R2_FAIL_BARE_DECORATOR, path="mod.py")
+    assert len(fs) == 1
+    path = str(tmp_path / "baseline.json")
+    write_baseline(fs, path)
+    baseline = load_baseline(path)
+    assert subtract_baseline(fs, baseline) == []
+    # a NEW finding on top of the grandfathered one still reports
+    fs2 = fs + lint_source(R4_FAIL_ATTR, path="mod2.py")
+    left = subtract_baseline(fs2, baseline)
+    assert rules_of(left) == ["R4"]
+
+
+def test_json_output_schema():
+    fs = lint_source(R4_FAIL_ATTR, path="mod.py")
+    data = json.loads(format_json(fs))
+    assert data["count"] == 1
+    (entry,) = data["findings"]
+    assert entry["rule"] == "R4"
+    assert entry["path"] == "mod.py"
+    assert entry["line"] > 0 and entry["message"]
+
+
+def test_cli_exit_codes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("import jax\n\n@jax.jit\ndef f(x):\n    return x\n")
+    good = tmp_path / "good.py"
+    good.write_text("def f(x):\n    return x\n")
+    assert main([str(good)]) == 0
+    assert main([str(bad)]) == 1
+    assert main(["--select", "NOPE", str(bad)]) == 2
+
+
+def test_registry_covers_r1_to_r5():
+    assert sorted(ALL_RULES) == ["R1", "R2", "R3", "R4", "R5"]
+
+
+def test_syntax_error_reported_not_raised(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n")
+    fs = lint_paths([str(p)])
+    assert len(fs) == 1 and fs[0].rule == "E0"
+
+
+# ------------------------------------------- annotation coverage
+
+
+def test_annotation_checker_flags_and_passes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def f(x):\n    return x\n")
+    good = tmp_path / "good.py"
+    good.write_text(
+        "class C:\n"
+        "    def m(self, x: int) -> int:\n"
+        "        return x\n"
+        "def f(x: int, *args: int, **kw: int) -> int:\n"
+        "    return x\n"
+    )
+    assert [f.rule for f in check_paths([str(bad)])] == ["ANN"]
+    assert check_paths([str(good)]) == []
+
+
+def test_annotation_checker_suppressible(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_text("def f(x):  # jaxlint: disable=ANN\n    return x\n")
+    assert check_paths([str(p)]) == []
+
+
+def test_annotation_finding_not_suppressed_by_interior_comments(tmp_path):
+    """A disable buried in the body (e.g. silencing R4 on one line) must
+    not exempt the enclosing function from the typing floor."""
+    p = tmp_path / "mod.py"
+    p.write_text(
+        "def f(x):\n"
+        "    y = 1  # jaxlint: disable=all\n"
+        "    return x + y\n"
+    )
+    assert [f.rule for f in check_paths([str(p)])] == ["ANN"]
+
+
+def test_unreadable_file_is_exit_2_not_findings(tmp_path):
+    p = tmp_path / "mod.py"
+    p.write_bytes(b"\xff\xfe invalid utf8 \xff")
+    assert main([str(p)]) == 2
+    assert main(["--annotations", str(p)]) == 2
+
+
+# ------------------------------------------------- the real tree
+
+
+def test_shipped_package_is_clean_under_the_gate():
+    """The merged tree lints clean: the acceptance criterion that
+    ``python -m kafkabalancer_tpu.analysis kafkabalancer_tpu/`` exits 0."""
+    findings = lint_paths([PACKAGE])
+    assert findings == [], "\n".join(
+        f"{f.path}:{f.line}: [{f.rule}] {f.message}" for f in findings
+    )
+
+
+def test_shipped_typed_subpackages_have_full_annotation_coverage():
+    paths = [os.path.join(PACKAGE, d) for d in ("models", "ops", "codecs")]
+    findings = check_paths(paths)
+    assert findings == [], "\n".join(
+        f"{f.path}:{f.line}: {f.message}" for f in findings
+    )
+
+
+def test_module_entry_point_exits_zero_on_package():
+    proc = subprocess.run(
+        [sys.executable, "-m", "kafkabalancer_tpu.analysis", PACKAGE],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stdout

@@ -10,7 +10,6 @@ that the hardware path is then checked against by bench runs."""
 import copy
 import random
 
-import numpy as np
 import pytest
 
 from helpers import random_partition_list
@@ -49,8 +48,14 @@ def test_pallas_session_matches_xla_batch(allow_leader):
     opl_p = plan(
         pl_p, copy.deepcopy(cfg), 40, batch=16, engine="pallas-interpret",
     )
-    moves_x = [(p.topic, p.partition, tuple(p.replicas)) for p in (opl_x.partitions or [])]
-    moves_p = [(p.topic, p.partition, tuple(p.replicas)) for p in (opl_p.partitions or [])]
+    moves_x = [
+        (p.topic, p.partition, tuple(p.replicas))
+        for p in (opl_x.partitions or [])
+    ]
+    moves_p = [
+        (p.topic, p.partition, tuple(p.replicas))
+        for p in (opl_p.partitions or [])
+    ]
     assert moves_x == moves_p
     assert pl_x == pl_p
 
@@ -106,8 +111,14 @@ def test_pallas_multi_tile_parity(allow_leader):
     opl_p = plan(
         pl_p, copy.deepcopy(cfg), 25, batch=10, engine="pallas-interpret",
     )
-    moves_x = [(p.topic, p.partition, tuple(p.replicas)) for p in (opl_x.partitions or [])]
-    moves_p = [(p.topic, p.partition, tuple(p.replicas)) for p in (opl_p.partitions or [])]
+    moves_x = [
+        (p.topic, p.partition, tuple(p.replicas))
+        for p in (opl_x.partitions or [])
+    ]
+    moves_p = [
+        (p.topic, p.partition, tuple(p.replicas))
+        for p in (opl_p.partitions or [])
+    ]
     assert moves_x == moves_p
     assert pl_x == pl_p
 

@@ -8,7 +8,6 @@
 import copy
 import random
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -23,7 +22,7 @@ from kafkabalancer_tpu.balancer.costmodel import (
 )
 from kafkabalancer_tpu.cli import apply_assignment
 from kafkabalancer_tpu.models import default_rebalance_config
-from kafkabalancer_tpu.ops import cost, tensorize
+from kafkabalancer_tpu.ops import tensorize
 from kafkabalancer_tpu.parallel.mesh import balanced_factors, make_mesh
 from kafkabalancer_tpu.parallel.shard_move import sharded_score_moves
 from kafkabalancer_tpu.parallel.sweep import best_scenario, sweep
@@ -856,8 +855,6 @@ def test_plan_sharded_auto_engine_rule(monkeypatch):
     the sharded path by survival — INCLUDING with an activating
     anti-colocation penalty (the kernel carries the combined objective
     since late r5); only an explicit non-f32 dtype forces XLA."""
-    import jax as _jax
-
     import kafkabalancer_tpu.parallel.shard_session as ss
     from kafkabalancer_tpu.utils.synth import synth_cluster
 

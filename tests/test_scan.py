@@ -550,7 +550,6 @@ def test_paired_best_brute_force():
     import jax.numpy as jnp
     import numpy as np
 
-    from kafkabalancer_tpu.models import RebalanceConfig
     from kafkabalancer_tpu.ops import cost, tensorize
     from kafkabalancer_tpu.solvers.scan import _settle_head
 

@@ -200,7 +200,6 @@ def test_score_window_matches_score_moves_minima(leaders, dtype):
     ``score_moves`` in the same dtype, for both precision tiers."""
     import numpy as np
 
-    from kafkabalancer_tpu.balancer import costmodel
     from kafkabalancer_tpu.balancer.steps import fill_defaults
     from kafkabalancer_tpu.ops.tensorize import tensorize
 
