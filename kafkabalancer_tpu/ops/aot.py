@@ -1,4 +1,4 @@
-"""AOT executable store: fresh processes skip tracing AND compilation.
+"""AOT executable store v2: fresh processes skip tracing AND compilation.
 
 The deployment model is the reference's — a stateless CLI run once per
 move by an outer supervision loop (its README.md:21-33), so per-process
@@ -11,33 +11,74 @@ session program at the 16k-partition bucket), the pallas module import
 This module persists the *compiled executable itself*
 (``jax.experimental.serialize_executable``): the next process with the
 same instance bucket deserializes and jumps straight to load + execute —
-no tracing, no lowering, no pallas import. Measured on the bench TPU at
-the 10k x 100 flagship: 6.2 s → 4.8 s per fresh-process plan, with the
-remainder dominated by shipping the ~33 MB executable to the accelerator
-(an attach-transport cost a locally-attached device pays in tens of
-milliseconds; see bench.py's relay accounting).
+no tracing, no lowering, no pallas import.
+
+Store v2 layout (``aot/`` sibling of the persistent compile cache):
+
+- ``manifest.json`` — versioned index ``{"version": 2, "entries":
+  {key: {name, shards, codec, raw_bytes, stored_bytes, sig, created,
+  last_used}}}``. A manifest whose version differs is IGNORED (treated
+  as an empty store), never migrated in place — an old process must not
+  misread a new layout, and vice versa.
+- ``<key>.sNN.bin`` — the serialized executable, split into fixed-size
+  shards, each independently compressed (zstd when importable, zlib
+  otherwise; ``KAFKABALANCER_TPU_AOT_CODEC=raw`` stores uncompressed
+  shards that are mmap'd straight out of page cache). Compression cuts
+  the dominant cold cost — shipping/reading a ~32 MB executable — to a
+  few MB of I/O plus a fast inflate.
+- legacy v1 blobs (bare ``<key>.bin``, raw, no manifest entry) are still
+  loadable so a cache written by an older build keeps serving hits.
+
+Write path: saves triggered by the dispatch path run on a background
+thread (``save_async``) so the serialize+compress+write never sits on
+the planning critical path; ``flush_saves`` joins them (bounded at
+interpreter exit). All writes are atomic (tmp + rename), then the
+manifest is read-merged-written; a crash mid-save leaves at worst
+orphaned shards that a later corrupt-load prunes. After every save the
+store is evicted LRU (``last_used`` from the manifest) down to the
+``KAFKABALANCER_TPU_AOT_CAP_MB`` size cap (default 512).
+
+Read path: ``try_load`` is corruption-tolerant by contract — a missing
+shard, truncated blob, stale jax, or undecodable manifest entry drops
+the entry and returns None, and the caller recompiles; it never raises.
+``prefetch`` begins the load on a background thread keyed by *predicted*
+dummy args (same shapes/dtypes — the executable does not depend on
+values), so a CLI process overlaps blob read + deserialize with input
+parsing and pipeline work; ``call_or_compile`` joins the in-flight load
+and, while waiting, pre-stages the real input arrays onto the device so
+the first execution does not pay a second transfer/layout pass
+(``exec1`` previously re-uploaded every input inside the timed
+dispatch). The staged buffers are dropped right after the first call so
+the device allocator can reuse them.
 
 Keys cover the jax version, backend platform + device kind + device
 count, every argument's shape/dtype (None args included), the static
 kwargs, and an md5 of the solver sources — any drift silently falls back
-to the ordinary jit path. Entries are written best-effort, atomically,
-into an ``aot/`` sibling of the persistent compile cache; corrupt or
-stale entries are removed on load failure. ``KAFKABALANCER_TPU_NO_AOT=1``
-disables both load and save.
+to the ordinary jit path. ``KAFKABALANCER_TPU_NO_AOT=1`` disables both
+load and save; ``KAFKABALANCER_TPU_AOT_SYNC_SAVE=1`` forces saves inline
+(tests, prewarm).
 """
 
 from __future__ import annotations
 
 import hashlib
+import json
+import mmap
 import os
 import tempfile
-from typing import Any, Dict, Optional, Tuple
+import threading
+import time
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 # a jit-wrapped callable (has .lower()); typed Any because jax's stage
 # types are not stable across the versions this repo supports
 JitWrapped = Any
 
 import numpy as np
+
+STORE_VERSION = 2
+_MANIFEST = "manifest.json"
 
 _SALT_MODULES = (
     "kafkabalancer_tpu.ops.cost",
@@ -52,15 +93,41 @@ _SALT_MODULES = (
 _source_salt: Optional[str] = None
 _loaded: Dict[str, Any] = {}
 # per-name phase timings of the LAST dispatch (load/exec/jit seconds,
-# blob MB) — bench.py's cold children read these to attribute the
-# stateless per-invocation cost between relay transport and compute
+# blob MB, prefetch/staged markers) — bench.py's cold children read these
+# to attribute the stateless per-invocation cost between transport,
+# store I/O and compute
 stats: Dict[str, Dict[str, float]] = {}
+
+# in-flight background loads (prefetch) and writes (save_async)
+_inflight: Dict[str, threading.Thread] = {}
+_inflight_lock = threading.Lock()
+_pending_saves: List[threading.Thread] = []
+_manifest_lock = threading.Lock()
+_atexit_registered: set = set()
+
+
+def _register_atexit(fn: Callable[..., None], timeout: float) -> None:
+    """Register a bounded exit-time join exactly once per function —
+    background loaders/writers sit inside native XLA calls, and
+    interpreter teardown mid-call can corrupt the CLI's exit-code
+    contract (see cli.py's warm-thread comment)."""
+    if fn.__name__ not in _atexit_registered:
+        _atexit_registered.add(fn.__name__)
+        import atexit
+
+        atexit.register(fn, timeout)
 
 
 def _disabled() -> bool:
     return os.environ.get("KAFKABALANCER_TPU_NO_AOT", "").lower() in (
         "1", "true", "yes", "on",
     )
+
+
+def _sync_saves() -> bool:
+    return os.environ.get(
+        "KAFKABALANCER_TPU_AOT_SYNC_SAVE", ""
+    ).lower() in ("1", "true", "yes", "on")
 
 
 def _log_enabled() -> bool:
@@ -100,13 +167,10 @@ def aot_dir() -> Optional[str]:
     that skip the compile cache (CPU-pinned tests/CI) skip this store."""
     if _disabled():
         return None
-    try:
-        import jax
+    from kafkabalancer_tpu.ops.runtime import configured_cache_dir
 
-        cache = getattr(jax.config, "jax_compilation_cache_dir", None)
-    except Exception:
-        return None
-    if not cache:
+    cache = configured_cache_dir()
+    if cache is None:
         return None
     return os.path.join(cache, "aot")
 
@@ -139,8 +203,9 @@ def _leaf_sig(x: Any) -> str:
     return f"{a.dtype.str}{a.shape}"
 
 
-def aot_key(name: str, args: Tuple, statics: Dict[str, Any]) -> str:
-    """Stable content key for one (function, arg-shapes, statics) combo."""
+def _key_parts(name: str, args: Tuple, statics: Dict[str, Any]) -> List[str]:
+    """The content-key component list (human-readable; md5'd by
+    :func:`aot_key`, stored verbatim as the manifest entry's ``sig``)."""
     import jax
 
     dev = jax.devices()[0]
@@ -158,7 +223,345 @@ def aot_key(name: str, args: Tuple, statics: Dict[str, Any]) -> str:
         if isinstance(v, type):  # dtype classes (jnp.float32 etc.)
             v = np.dtype(v).str
         parts.append(f"{k}={v}")
-    return hashlib.md5("|".join(parts).encode()).hexdigest()
+    return parts
+
+
+def aot_key(name: str, args: Tuple, statics: Dict[str, Any]) -> str:
+    """Stable content key for one (function, arg-shapes, statics) combo."""
+    return hashlib.md5("|".join(_key_parts(name, args, statics)).encode()).hexdigest()
+
+
+# --- store v2: codecs, shards, manifest ----------------------------------
+
+_zstd_mod: Any = False  # False = unprobed, None = unavailable
+
+
+def _zstd() -> Any:
+    global _zstd_mod
+    if _zstd_mod is False:
+        try:
+            import zstandard
+
+            _zstd_mod = zstandard
+        except ImportError:
+            _zstd_mod = None
+    return _zstd_mod
+
+
+def _codec() -> str:
+    forced = os.environ.get("KAFKABALANCER_TPU_AOT_CODEC", "").lower()
+    if forced in ("zstd", "gzip", "raw"):
+        if forced == "zstd" and _zstd() is None:
+            return "gzip"  # documented fallback when zstd is absent
+        return forced
+    return "zstd" if _zstd() is not None else "gzip"
+
+
+def _compress(codec: str, b: bytes) -> bytes:
+    if codec == "zstd":
+        return _zstd().ZstdCompressor(level=3).compress(b)
+    if codec == "gzip":
+        # level 1: the read path decompresses orders of magnitude faster
+        # than the relay/disk ships the uncompressed executable anyway
+        return zlib.compress(b, 1)
+    return b
+
+
+def _decompress(codec: str, b: bytes) -> bytes:
+    if codec == "zstd":
+        return _zstd().ZstdDecompressor().decompress(b)
+    if codec == "gzip":
+        return zlib.decompress(b)
+    return b
+
+
+def _shard_bytes() -> int:
+    try:
+        mb = float(os.environ.get("KAFKABALANCER_TPU_AOT_SHARD_MB", "8"))
+    except ValueError:
+        mb = 8.0
+    return max(1, int(mb * 1e6))
+
+
+def _cap_bytes() -> int:
+    try:
+        mb = float(os.environ.get("KAFKABALANCER_TPU_AOT_CAP_MB", "512"))
+    except ValueError:
+        mb = 512.0
+    return max(0, int(mb * 1e6))
+
+
+# (path, mtime_ns, entries) of the last parse: the dispatch path reads
+# the manifest several times per chunk (existence check, blob read, LRU
+# touch) and re-parsing JSON on the hot path is waste; the mtime check
+# keeps cross-process writers visible
+_manifest_cache: "Tuple[str, int, Dict[str, Any]] | None" = None
+
+
+def _manifest_read(d: str) -> Dict[str, Any]:
+    """Manifest entries, or {} on absence, corruption, or a version
+    mismatch (a different store version is IGNORED, never migrated)."""
+    global _manifest_cache
+    path = os.path.join(d, _MANIFEST)
+    try:
+        mtime = os.stat(path).st_mtime_ns
+    except OSError:
+        return {}
+    cached = _manifest_cache
+    if cached is not None and cached[0] == path and cached[1] == mtime:
+        return dict(cached[2])  # shallow copy: callers mutate their view
+    try:
+        with open(path) as f:
+            obj = json.load(f)
+        if not isinstance(obj, dict) or obj.get("version") != STORE_VERSION:
+            entries: Dict[str, Any] = {}
+        else:
+            raw = obj.get("entries")
+            entries = raw if isinstance(raw, dict) else {}
+        _manifest_cache = (path, mtime, entries)
+        return dict(entries)
+    except Exception:
+        return {}
+
+
+def _manifest_update(
+    d: str, mutate: Callable[[Dict[str, Any]], None]
+) -> Dict[str, Any]:
+    """Read-merge-write under the in-process lock (cross-process races
+    are last-writer-wins on a freshly re-read manifest — a lost entry
+    costs one redundant recompile later, never correctness)."""
+    global _manifest_cache
+    with _manifest_lock:
+        entries = _manifest_read(d)
+        mutate(entries)
+        payload = json.dumps(
+            {"version": STORE_VERSION, "entries": entries}, sort_keys=True
+        )
+        path = os.path.join(d, _MANIFEST)
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(payload)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+        # refresh the cache from what was just written: two writes
+        # within one filesystem-timestamp tick would otherwise leave the
+        # pre-write snapshot keyed by an identical mtime, and the next
+        # read-modify-write would resurrect it (dropping this update)
+        try:
+            _manifest_cache = (path, os.stat(path).st_mtime_ns, dict(entries))
+        except OSError:
+            _manifest_cache = None
+        return entries
+
+
+def _drop_entry(d: str, key: str, entry: Optional[Dict[str, Any]] = None) -> None:
+    """Remove a corrupt/evicted entry: shard files first, manifest last."""
+    if entry is None:
+        entry = _manifest_read(d).get(key)
+    for shard in (entry or {}).get("shards", []):
+        try:
+            os.remove(os.path.join(d, shard))
+        except OSError:
+            pass
+    try:
+        os.remove(os.path.join(d, key + ".bin"))  # legacy v1 blob
+    except OSError:
+        pass
+    try:
+        _manifest_update(d, lambda e: e.pop(key, None))
+    except Exception:
+        pass
+
+
+# unreferenced files younger than this are left alone: they may be a
+# concurrent process's write-in-flight, not a crash orphan
+_ORPHAN_AGE_S = 3600.0
+
+
+def _evict_to_cap(d: str, keep_key: Optional[str] = None) -> None:
+    """LRU-evict until the stored bytes fit the size cap; the
+    just-written ``keep_key`` is exempt.
+
+    The accounting covers the whole directory, not just the manifest:
+    legacy v1 ``<key>.bin`` blobs (no manifest entry, evicted by mtime
+    alongside the LRU order) and crash-orphaned ``.tmp``/shard files
+    (unreferenced by any entry; deleted outright once older than
+    ``_ORPHAN_AGE_S`` — younger ones may be another process's write in
+    flight) would otherwise grow the store unbounded and invisibly."""
+    cap = _cap_bytes()
+    entries = _manifest_read(d)
+    referenced = {s for e in entries.values() for s in e.get("shards", [])}
+    total = sum(int(e.get("stored_bytes", 0)) for e in entries.values())
+    now = time.time()
+    # (last-used, evict-thunk, size) for every reclaimable unit
+    victims = []
+    for k, e in entries.items():
+        if k != keep_key:
+            victims.append((
+                float(e.get("last_used", 0.0)),
+                lambda k=k, e=e: _drop_entry(d, k, e),
+                int(e.get("stored_bytes", 0)),
+            ))
+    try:
+        listing = os.listdir(d)
+    except OSError:
+        listing = []
+    for fname in listing:
+        if fname == _MANIFEST or fname in referenced:
+            continue
+        if keep_key and fname.startswith(keep_key):
+            continue
+        path = os.path.join(d, fname)
+        try:
+            st = os.stat(path)
+        except OSError:
+            continue
+        legacy = fname.endswith(".bin") and ".s" not in fname
+        if legacy:
+            # still servable (v1 load path): counts toward the cap and
+            # competes in the LRU order by mtime
+            total += st.st_size
+            victims.append((
+                st.st_mtime,
+                lambda p=path: os.remove(p),
+                st.st_size,
+            ))
+        elif now - st.st_mtime > _ORPHAN_AGE_S:
+            # unreferenced shard/tmp no loader will ever read: reclaim
+            try:
+                os.remove(path)
+                _log(f"sweep orphan {fname}")
+            except OSError:
+                pass
+    if total <= cap:
+        return
+    for _ts, evict, size in sorted(victims, key=lambda v: v[0]):
+        if total <= cap:
+            break
+        try:
+            evict()
+            total -= size
+            _log(f"evict {size / 1e6:.1f}MB")
+        except Exception:
+            pass
+
+
+def _entry_exists(d: str, key: str) -> bool:
+    if key in _manifest_read(d):
+        return True
+    return os.path.exists(os.path.join(d, key + ".bin"))  # legacy v1
+
+
+def _read_blob(d: str, key: str) -> Optional[bytes]:
+    """Reassemble the serialized executable from its shards (mmap'd out
+    of page cache) or the legacy v1 blob; None when absent. Raises on a
+    corrupt entry — try_load's handler prunes it."""
+    entry = _manifest_read(d).get(key)
+    if entry is None:
+        legacy = os.path.join(d, key + ".bin")
+        if not os.path.exists(legacy):
+            return None
+        with open(legacy, "rb") as f:
+            return f.read()
+    codec = entry.get("codec", "raw")
+    if codec == "zstd" and _zstd() is None:
+        # a reader without the zstandard module must treat the entry as
+        # a MISS, not corruption: the blob is valid for capable readers
+        # (e.g. prewarm ran on a fuller image), and raising here would
+        # send try_load's handler off to delete it
+        _log(f"skip {key}: zstd entry, no zstandard module")
+        return None
+    if codec not in ("zstd", "gzip", "raw"):
+        _log(f"skip {key}: unknown codec {codec!r}")  # future store ver
+        return None
+    pieces: List[bytes] = []
+    for shard in entry["shards"]:
+        with open(os.path.join(d, shard), "rb") as f:
+            size = os.fstat(f.fileno()).st_size
+            if size == 0:
+                raise OSError(f"empty shard {shard}")
+            with mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ) as mm:
+                pieces.append(_decompress(codec, mm[:]))
+    blob = b"".join(pieces)
+    if len(blob) != int(entry.get("raw_bytes", len(blob))):
+        raise OSError(f"blob size mismatch for {key}")
+    # LRU bookkeeping, best-effort (the eviction order feeds on this)
+    try:
+        def touch(e: Dict[str, Any]) -> None:
+            if key in e:
+                e[key]["last_used"] = time.time()
+
+        _manifest_update(d, touch)
+    except Exception:
+        pass
+    return blob
+
+
+def _write_blob(
+    d: str, key: str, name: str, sig: List[str], blob: bytes
+) -> str:
+    """Shard + compress + atomically write ``blob``; returns the first
+    shard's path. The manifest entry lands only after every shard is in
+    place, so readers never see a partial entry."""
+    os.makedirs(d, exist_ok=True)
+    codec = _codec()
+    step = _shard_bytes()
+    shards: List[str] = []
+    stored = 0
+    try:
+        for i in range(0, max(1, len(blob)), step):
+            shard_name = f"{key}.s{i // step:02d}.bin"
+            payload = _compress(codec, blob[i : i + step])
+            fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    f.write(payload)
+                os.replace(tmp, os.path.join(d, shard_name))
+            except BaseException:
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+                raise
+            shards.append(shard_name)
+            stored += len(payload)
+        now = time.time()
+
+        def put(e: Dict[str, Any]) -> None:
+            e[key] = {
+                "name": name,
+                "shards": shards,
+                "codec": codec,
+                "raw_bytes": len(blob),
+                "stored_bytes": stored,
+                "sig": sig,
+                "created": now,
+                "last_used": now,
+            }
+
+        _manifest_update(d, put)
+    except BaseException:
+        for shard_name in shards:
+            try:
+                os.remove(os.path.join(d, shard_name))
+            except OSError:
+                pass
+        raise
+    _evict_to_cap(d, keep_key=key)
+    _log(
+        f"save {name} {len(blob) / 1e6:.1f}MB -> {stored / 1e6:.1f}MB "
+        f"({codec}, {len(shards)} shard{'s' if len(shards) != 1 else ''})"
+    )
+    return os.path.join(d, shards[0])
+
+
+# --- load / save / dispatch ----------------------------------------------
 
 
 def try_load(
@@ -166,6 +569,7 @@ def try_load(
     args: Tuple,
     statics: Dict[str, Any],
     out_leaves: int = 1,
+    key: Optional[str] = None,
 ) -> Optional[Any]:
     """Deserialize a stored executable for this call, or None.
 
@@ -173,29 +577,35 @@ def try_load(
     they are reconstructed from the very args the caller is about to pass
     plus ``out_leaves`` (1 = a single output array, n = a flat n-tuple),
     so a mismatch is impossible by construction. Any failure — missing
-    entry, stale jax/runtime, relay hiccup — removes the entry when
-    corrupt and falls back to the jit path.
+    entry, corrupt shard, stale jax/runtime, relay hiccup — removes the
+    entry when corrupt and falls back to the jit path. Joins an in-flight
+    :func:`prefetch` of the same key instead of re-reading the blob.
     """
     d = aot_dir()
     if d is None:
         return None
-    key = aot_key(name, args, statics)
+    if key is None:  # callers on the dispatch path pass it precomputed
+        key = aot_key(name, args, statics)
+    # snapshot under the lock: prefetch() registers AND starts the
+    # thread while holding it, so a thread observed here is guaranteed
+    # started — an unlocked read could catch the insert-before-start
+    # window and Thread.join would raise on the unstarted thread
+    with _inflight_lock:
+        th = _inflight.get(key)
+    if th is not None and th is not threading.current_thread():
+        th.join()
     if key in _loaded:
         return _loaded[key]
-    path = os.path.join(d, key + ".bin")
-    if not os.path.exists(path):
-        return None
     try:
-        import time
-
         import jax
         from jax.experimental.serialize_executable import (
             deserialize_and_load,
         )
 
         t0 = time.perf_counter()
-        with open(path, "rb") as f:
-            blob = f.read()
+        blob = _read_blob(d, key)
+        if blob is None:
+            return None
         in_tree = jax.tree_util.tree_flatten((args, {}))[1]
         skel = 0 if out_leaves == 1 else (0,) * out_leaves
         out_tree = jax.tree_util.tree_flatten(skel)[1]
@@ -211,16 +621,96 @@ def try_load(
         compiled = deserialize_and_load(blob, in_tree, out_tree, **kwargs)
         _loaded[key] = compiled  # repeat chunks skip re-deserialization
         dt = time.perf_counter() - t0
-        stats.setdefault(name, {})
-        stats[name]["load_s"] = dt
-        stats[name]["blob_mb"] = len(blob) / 1e6
+        st = stats.setdefault(name, {})
+        st["load_s"] = dt
+        st["blob_mb"] = len(blob) / 1e6
         _log(f"load {name} {len(blob) / 1e6:.1f}MB {dt:.2f}s")
         return compiled
     except Exception:
-        try:
-            os.remove(path)
-        except OSError:
-            pass
+        _drop_entry(d, key)
+        return None
+
+
+def prefetch(
+    name: str,
+    args: Tuple,
+    statics: Dict[str, Any],
+    out_leaves: int = 1,
+) -> Optional[str]:
+    """Begin loading the stored executable for this call on a background
+    thread; returns the key when a load is resident/in flight, else None.
+
+    ``args`` may be shape/dtype-matched DUMMIES (e.g. zeros) — the
+    executable depends on signatures, not values — which is what lets the
+    CLI prefetch from a parsed-but-not-yet-tensorized input. Dummy values
+    are used for KEYING ONLY and are never staged or executed. A
+    mispredicted signature is harmless: the key misses and the dispatch
+    path loads (or compiles) as if no prefetch happened.
+    """
+    d = aot_dir()
+    if d is None:
+        return None
+    key = aot_key(name, args, statics)
+    if key in _loaded:
+        return key
+    with _inflight_lock:
+        if key in _inflight:
+            return key
+        if not _entry_exists(d, key):
+            return None
+
+        def body() -> None:
+            try:
+                t0 = time.perf_counter()
+                if try_load(
+                    name, args, statics, out_leaves=out_leaves, key=key
+                ) is not None:
+                    st = stats.setdefault(name, {})
+                    st["prefetch"] = 1.0
+                    st["prefetch_s"] = time.perf_counter() - t0
+            finally:
+                _inflight.pop(key, None)
+
+        t = threading.Thread(
+            target=body, daemon=True, name=f"aot-prefetch-{name}"
+        )
+        _inflight[key] = t
+        # started INSIDE the lock: a dispatch thread that reads
+        # _inflight must never observe (and try to join) an unstarted
+        # thread — Thread.join raises on those. Like save_async, the
+        # loader (native deserialize inside) must not be torn down
+        # mid-call by interpreter finalization: joined bounded at exit.
+        _register_atexit(flush_prefetches, 30.0)
+        t.start()
+    return key
+
+
+def flush_prefetches(timeout: Optional[float] = None) -> None:
+    """Join in-flight prefetch threads (tests and orderly shutdown)."""
+    with _inflight_lock:  # started-thread guarantee, see try_load
+        pending = list(_inflight.values())
+    for th in pending:
+        if th is not threading.current_thread():
+            th.join(timeout)
+
+
+def _stage_args(args: Tuple) -> Optional[Tuple]:
+    """Asynchronously ship the real input arrays to device 0 — called
+    BEFORE the blob read/deserialize so the transfer overlaps store I/O
+    and the first execution stops paying a second transfer/layout pass.
+    The caller drops the staged tuple right after the first call, which
+    is the donation this path can honor post-compile (donation proper is
+    baked at serialize time; these executables are serialized without it
+    because the tiered window scorer re-uses its host args across
+    precision tiers)."""
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        return tuple(
+            None if a is None else jax.device_put(a, dev) for a in args
+        )
+    except Exception:
         return None
 
 
@@ -232,32 +722,22 @@ def maybe_save(
     One-time cost per bucket (the AOT ``lower().compile()`` path keys the
     persistent compile cache differently from the jit call path, so this
     pays a real compile once); every later fresh process skips tracing
-    entirely. Best-effort: returns the path written, else None.
+    entirely. Best-effort and synchronous: returns the first shard path
+    written, else None. The dispatch path schedules this off the critical
+    path via :func:`save_async`.
     """
     d = aot_dir()
     if d is None:
         return None
-    key = aot_key(name, args, statics)
-    path = os.path.join(d, key + ".bin")
-    if os.path.exists(path):
-        return None
     try:
+        key = aot_key(name, args, statics)
+        if _entry_exists(d, key):
+            return None
         from jax.experimental.serialize_executable import serialize
 
         compiled = fn.lower(*args, **statics).compile()
         blob, _in_tree, _out_tree = serialize(compiled)
-        os.makedirs(d, exist_ok=True)
-        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
-        try:
-            with os.fdopen(fd, "wb") as f:
-                f.write(blob)
-            os.replace(tmp, path)
-        except BaseException:
-            try:
-                os.remove(tmp)
-            except OSError:
-                pass
-            raise
+        path = _write_blob(d, key, name, _key_parts(name, args, statics), blob)
         # memoize: the just-compiled executable serves this process's
         # next chunk directly — without this, chunk 2 would re-read and
         # re-ship the multi-MB blob the device already has resident
@@ -265,6 +745,42 @@ def maybe_save(
         return path
     except Exception:
         return None
+
+
+def save_async(
+    name: str, fn: JitWrapped, args: Tuple, statics: Dict[str, Any]
+) -> None:
+    """Schedule :func:`maybe_save` on a background thread — the
+    serialize+compress+write must not sit on the planning critical path.
+    ``KAFKABALANCER_TPU_AOT_SYNC_SAVE=1`` runs it inline instead (tests,
+    prewarm). Joined bounded at exit: a half-written entry is recoverable
+    (corrupt-load prune) but wastes the compile that produced it."""
+    if aot_dir() is None:
+        return
+    if _sync_saves():
+        maybe_save(name, fn, args, statics)
+        return
+    t = threading.Thread(
+        target=maybe_save,
+        args=(name, fn, args, statics),
+        daemon=True,
+        name=f"aot-save-{name}",
+    )
+    # start BEFORE publishing (prefetch's started-thread guarantee): a
+    # concurrent flush_saves joining an appended-but-unstarted thread
+    # would raise; a flush that misses this not-yet-published thread
+    # just leaves a best-effort save to finish on its own
+    _register_atexit(flush_saves, 60.0)
+    t.start()
+    _pending_saves.append(t)
+
+
+def flush_saves(timeout: Optional[float] = None) -> None:
+    """Join pending async saves (tests; bounded at interpreter exit)."""
+    while _pending_saves:
+        t = _pending_saves.pop()
+        if t is not threading.current_thread():
+            t.join(timeout)
 
 
 def call_or_compile(
@@ -275,18 +791,30 @@ def call_or_compile(
     out_leaves: int = 1,
 ) -> Any:
     """The one AOT dispatch policy: stored executable if loadable, else
-    the jit path plus a best-effort store write. Shared by every AOT call
-    site so fixes to the flow (pruning, memoization, fallback) live in
-    one place."""
-    import time
-
-    compiled = try_load(name, args, statics, out_leaves=out_leaves)
+    the jit path plus a best-effort async store write. Shared by every
+    AOT call site so fixes to the flow (pruning, staging, memoization,
+    fallback) live in one place."""
+    staged = None
+    key = None
+    d = aot_dir()
+    if d is not None:
+        key = aot_key(name, args, statics)
+        if (
+            key in _loaded
+            or key in _inflight
+            or _entry_exists(d, key)
+        ):
+            # a load is resident, in flight, or about to happen: start
+            # shipping the REAL inputs now so the transfer overlaps the
+            # blob read + deserialize (and the prefetch join below)
+            staged = _stage_args(args)
+    compiled = try_load(name, args, statics, out_leaves=out_leaves, key=key)
     if compiled is not None:
         try:
             import jax
 
             t0 = time.perf_counter()
-            out = compiled(*args)
+            out = compiled(*(staged if staged is not None else args))
             # materialize INSIDE the fallback scope: a stale/raced entry
             # can fail asynchronously, surfacing only at transfer time
             jax.block_until_ready(out)
@@ -294,15 +822,21 @@ def call_or_compile(
             st = stats.setdefault(name, {})
             st.setdefault("exec1_s", dt)
             st["exec_s"] = dt
+            if staged is not None:
+                st["staged"] = 1.0
             _log(f"exec {name} {dt:.2f}s")
             return out
         except Exception:
             pass  # raced/stale entry — fall back to the jit path
+        finally:
+            del staged  # free the pre-staged device buffers either way
+    # load miss (corrupt/raced/undeserializable entry): drop the staged
+    # device copies BEFORE the trace+compile+execute below — a duplicate
+    # of every input must not sit on the device through a fresh compile
+    staged = None
     t0 = time.perf_counter()
     out = fn(*args, **statics)
     stats.setdefault(name, {})["jit_s"] = time.perf_counter() - t0
     _log(f"jit-path {name} {stats[name]['jit_s']:.2f}s")
-    t0 = time.perf_counter()
-    if maybe_save(name, fn, args, statics) is not None:
-        _log(f"save {name} {time.perf_counter() - t0:.2f}s")
+    save_async(name, fn, args, statics)
     return out

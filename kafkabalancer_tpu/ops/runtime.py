@@ -12,27 +12,34 @@ alone (solver parity then degrades to float32 tolerances).
 from __future__ import annotations
 
 import os
+import threading
 
 _configured = False
+_configure_lock = threading.Lock()
 
 
 def ensure_x64() -> None:
-    """Enable JAX x64 once, before the first trace of any solver function."""
-    global _configured
-    if _configured:
-        return
-    _configured = True
-    ensure_persistent_cache()
-    if os.environ.get("KAFKABALANCER_TPU_NO_X64", "").lower() in (
-        "1",
-        "true",
-        "yes",
-        "on",
-    ):
-        return
-    import jax
+    """Enable JAX x64 once, before the first trace of any solver function.
 
-    jax.config.update("jax_enable_x64", True)
+    Lock-protected, completed-then-marked: the CLI's warm thread
+    (ops/coldstart.py) races solver imports on the main thread, and a
+    flag set before the work finishes would let the loser proceed to
+    trace (or read default_dtype) against a half-configured jax."""
+    global _configured
+    with _configure_lock:
+        if _configured:
+            return
+        ensure_persistent_cache()
+        if os.environ.get("KAFKABALANCER_TPU_NO_X64", "").lower() not in (
+            "1",
+            "true",
+            "yes",
+            "on",
+        ):
+            import jax
+
+            jax.config.update("jax_enable_x64", True)
+        _configured = True
 
 
 def ensure_persistent_cache(path: "str | None" = None) -> "str | None":
@@ -100,6 +107,21 @@ def ensure_persistent_cache(path: "str | None" = None) -> "str | None":
         return None
     except Exception as exc:
         return repr(exc)
+
+
+def configured_cache_dir() -> "str | None":
+    """The live persistent-compile-cache directory, or None when no
+    cache is configured (or jax is unimportable). THE one read of the
+    jax config both the AOT store root (ops/aot.py ``aot_dir``) and the
+    prewarm reporting derive from — never raises, so it is safe inside
+    corrupt-entry fallback paths."""
+    try:
+        import jax
+
+        cache = getattr(jax.config, "jax_compilation_cache_dir", None)
+    except Exception:
+        return None
+    return cache or None
 
 
 def next_bucket(n: int, minimum: int = 8) -> int:

@@ -470,7 +470,11 @@ def sweep(
             if sdp.replicas.shape != dp.replicas.shape or not np.array_equal(
                 sdp.broker_ids, dp.broker_ids
             ):
-                raise AssertionError(
+                # BalanceError, not AssertionError: the CLI maps it to
+                # the exit-3 planning-failure contract — an invariant
+                # violation must fail like every other planning failure,
+                # not as a raw traceback (ADVICE r5)
+                raise _s.BalanceError(
                     "per-scenario dense shapes diverged from the shared "
                     "encoding; this is a bug"
                 )

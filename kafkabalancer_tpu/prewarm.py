@@ -1,0 +1,255 @@
+"""``python -m kafkabalancer_tpu.prewarm`` — populate the AOT store.
+
+The deployment unit is a stateless CLI process per move (the reference's
+README.md:21-33): every fresh invocation that MISSES the AOT executable
+store (ops/aot.py) pays jit tracing + lowering + compilation before its
+first device call. This subcommand turns fleet cold starts into cache
+hits by AOT-compiling and storing, ahead of time, the executables the
+bucketed shape grid will ask for — run it once per software roll (the
+store keys include a source-content salt, so any solver edit invalidates
+every entry) or whenever a new instance scale enters the fleet.
+
+The arguments are assembled by the SAME helpers the live dispatch uses
+(``solvers.scan.packed_call`` for the fused session,
+``solvers.tpu._pack_window_args`` for the per-move window scorer), so a
+prewarmed key is by construction the key a real invocation computes for
+the same shape bucket, statics and jax/device identity.
+
+Typical fleet workflow::
+
+    # once, on a machine attached to the production device kind:
+    python -m kafkabalancer_tpu.prewarm -shapes 10000x100,50000x200 \
+        -batch 100 -polish -allow-leader -verify
+    # then every fresh `-solver=tpu` / `-fused` CLI process cold-starts
+    # on a store hit (ops/coldstart.py overlaps the load with parsing)
+
+Prints one JSON summary line on stdout (per-entry detail on stderr);
+exit 0 on success, 2 when no AOT store is configured, 1 on a shape-grid
+argument error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Dict, List, Tuple
+
+
+def _parse_shapes(spec: str) -> List[Tuple[int, int]]:
+    shapes = []
+    for tok in spec.split(","):
+        tok = tok.strip().lower()
+        if not tok:
+            continue
+        p, _, b = tok.partition("x")
+        shapes.append((int(p), int(b)))
+    if not shapes:
+        raise ValueError("empty shape grid")
+    return shapes
+
+
+def _programs_for_shape(
+    n_parts: int,
+    n_brokers: int,
+    ns: argparse.Namespace,
+    dtype: Any,
+) -> List[Tuple[str, Any, Tuple, Dict[str, Any]]]:
+    """``(name, jit_fn, args, statics)`` for every program this shape's
+    invocations dispatch — built through the live call-assembly seams."""
+    import numpy as np
+
+    from kafkabalancer_tpu.models import default_rebalance_config
+    from kafkabalancer_tpu.models.config import HOST_FLOAT_DTYPE
+    from kafkabalancer_tpu.ops.tensorize import all_allowed_of, tensorize
+    from kafkabalancer_tpu.solvers import scan, tpu
+    from kafkabalancer_tpu.utils.synth import synth_cluster
+
+    cfg = default_rebalance_config()
+    cfg.allow_leader_rebalancing = ns.allow_leader
+    cfg.min_unbalance = 0.0
+    pl = synth_cluster(n_parts, n_brokers, rf=ns.rf, seed=42, weighted=True)
+    # validations + defaults only (budget 0 skips repairs): the synthetic
+    # instance is already consistent, and prewarm must not plan anything
+    scan._settle_head(pl, cfg, 0)
+    dp = tensorize(pl, cfg)
+    all_allowed = all_allowed_of(dp)
+    out: List[Tuple[str, Any, Tuple, Dict[str, Any]]] = []
+
+    if ns.single_move:
+        loads_map = tpu._oracle_loads(pl, cfg)
+        loads_np = np.zeros(dp.bvalid.shape[0], dtype=HOST_FLOAT_DTYPE)
+        for bid, load in loads_map.items():
+            loads_np[dp.broker_index(bid)] = load
+        ints, floats64, allowed_arg, aa = tpu._pack_window_args(
+            dp, loads_np, cfg
+        )
+        leader_modes = (True, False) if ns.allow_leader else (False,)
+        # both precision tiers: f32 is every fresh process's first
+        # dispatch, f64 is the tie-window-overflow retry
+        for npdt in (np.float32, np.float64):  # jaxlint: disable=R4 — tier ladder
+            for leaders in leader_modes:
+                out.append((
+                    "score_window",
+                    tpu._score_window_jit,
+                    (ints, floats64.astype(npdt), allowed_arg),
+                    dict(leaders=leaders, all_allowed=aa),
+                ))
+
+    if ns.fused:
+        if ns.polish:
+            from kafkabalancer_tpu.solvers.polish import entry_table
+
+            ew, ep, er, evalid = entry_table(
+                dp, cfg.min_replicas_for_rebalancing
+            )
+        else:
+            ew = ep = er = evalid = None
+        chunk = min(
+            ns.max_reassign,
+            max(1, min(scan.auto_chunk_moves(len(pl.partitions or [])), 1 << 20)),
+        )
+        args, statics = scan.packed_call(
+            dp, cfg, chunk, dtype, max(1, ns.batch), "xla",
+            polish=ns.polish, leader=False, all_allowed=all_allowed,
+            churn_gate=scan.DEFAULT_CHURN_GATE,
+            ew=ew, ep=ep, er=er, evalid=evalid,
+        )
+        out.append(("session_packed", scan.session_packed, args, statics))
+    return out
+
+
+def run(argv: List[str]) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m kafkabalancer_tpu.prewarm",
+        description="AOT-compile and store the executables for a shape "
+        "grid so fleet cold starts hit the AOT store.",
+    )
+    # single-dash long options to match the CLI's Go-style flag surface
+    ap.add_argument(
+        "-shapes", default="10000x100",
+        help="comma-separated PARTITIONSxBROKERS grid (default %(default)s)",
+    )
+    ap.add_argument("-rf", type=int, default=3, help="replication factor")
+    ap.add_argument(
+        "-max-reassign", dest="max_reassign", type=int, default=1 << 19,
+        help="session budget the fused program is sized for",
+    )
+    ap.add_argument("-batch", type=int, default=100)
+    ap.add_argument("-polish", action="store_true")
+    ap.add_argument("-allow-leader", dest="allow_leader", action="store_true")
+    ap.add_argument(
+        "-dtype", choices=("default", "f32", "f64"), default="default",
+        help="fused-session compute dtype (default: the solver default)",
+    )
+    ap.add_argument(
+        "-no-single-move", dest="single_move", action="store_false",
+        help="skip the per-move window-scorer programs",
+    )
+    ap.add_argument(
+        "-no-fused", dest="fused", action="store_false",
+        help="skip the fused session program",
+    )
+    ap.add_argument(
+        "-cache-dir", dest="cache_dir", default=None,
+        help="persistent compile cache dir (default: the runtime default)",
+    )
+    ap.add_argument(
+        "-verify", action="store_true",
+        help="reload every written entry from the store afterwards",
+    )
+    ns = ap.parse_args(argv)
+    try:
+        shapes = _parse_shapes(ns.shapes)
+    except ValueError as exc:
+        print(f"bad -shapes: {exc}", file=sys.stderr)
+        return 1
+
+    from kafkabalancer_tpu.ops.runtime import ensure_persistent_cache, ensure_x64
+
+    err = ensure_persistent_cache(ns.cache_dir)
+    if err:
+        print(f"persistent compile cache unavailable: {err}", file=sys.stderr)
+    ensure_x64()
+
+    from kafkabalancer_tpu.models.config import default_dtype
+    from kafkabalancer_tpu.ops import aot
+
+    d = aot.aot_dir()
+    if d is None:
+        print(
+            "no AOT store: configure a persistent compile cache "
+            "(-cache-dir, JAX_COMPILATION_CACHE_DIR) and unset "
+            "KAFKABALANCER_TPU_NO_AOT",
+            file=sys.stderr,
+        )
+        return 2
+
+    if ns.dtype == "default":
+        dtype = default_dtype()
+    else:
+        import jax.numpy as jnp
+
+        # explicit operator request, the prewarm analog of bench's
+        # BENCH_* dtype override
+        dtype = jnp.float32 if ns.dtype == "f32" else jnp.float64  # jaxlint: disable=R4
+
+    written = skipped = failed = verified = 0
+    keys: List[Dict[str, Any]] = []
+    for n_parts, n_brokers in shapes:
+        for name, fn, args, statics in _programs_for_shape(
+            n_parts, n_brokers, ns, dtype
+        ):
+            key = aot.aot_key(name, args, statics)
+            detail = {
+                "name": name, "key": key,
+                "shape": f"{n_parts}x{n_brokers}",
+                "statics": {
+                    k: str(v) for k, v in sorted(statics.items())
+                },
+            }
+            if aot._entry_exists(d, key):
+                skipped += 1
+                detail["status"] = "hit"
+            else:
+                path = aot.maybe_save(name, fn, args, statics)
+                if path is None:
+                    failed += 1
+                    detail["status"] = "failed"
+                else:
+                    written += 1
+                    detail["status"] = "written"
+            if ns.verify and detail["status"] != "failed":
+                aot._loaded.pop(key, None)
+                ok = aot.try_load(name, args, statics) is not None
+                detail["verified"] = ok
+                verified += int(ok)
+            keys.append(detail)
+            print(
+                f"prewarm {detail['shape']} {name}: {detail['status']}"
+                + (f" verified={detail.get('verified')}" if ns.verify else ""),
+                file=sys.stderr,
+            )
+
+    print(
+        json.dumps(
+            {
+                "aot_dir": d,
+                "entries": len(keys),
+                "written": written,
+                "hit": skipped,
+                "failed": failed,
+                **({"verified": verified} if ns.verify else {}),
+                "keys": keys,
+            }
+        )
+    )
+    return 0 if failed == 0 else 1
+
+
+def main() -> None:
+    sys.exit(run(sys.argv[1:]))
+
+
+if __name__ == "__main__":
+    main()

@@ -220,16 +220,26 @@ def _gate_cache_path():
 
 
 def _gate_key(
-    P: int, B: int, R: int, all_allowed: bool, allow_leader: bool
+    P: int,
+    B: int,
+    R: int,
+    all_allowed: bool,
+    allow_leader: bool,
+    max_moves: int,
 ) -> str:
     # allow_leader changes the kernel's traced program (the leader
     # scoring pass) and thus its VMEM footprint — one mode's verdict
-    # must not be reused for the other (r5 review)
+    # must not be reused for the other (r5 review). max_moves (already a
+    # power-of-two bucket) sizes the kernel's move-log buffers the same
+    # way: a verdict earned at one buffer size must not admit (and OOM)
+    # or ban a different one (ADVICE r5 — a probe-admitted shape could
+    # OOM at a larger move log and the resulting ban stuck to every
+    # max_moves).
     dev = jax.devices()[0]
     kind = getattr(dev, "device_kind", dev.platform)
     mode = "aa" if all_allowed else "restricted"
     lead = "lead" if allow_leader else "nolead"
-    return f"{kind}|{P}x{B}x{R}|{mode}|{lead}"
+    return f"{kind}|{P}x{B}x{R}|mm{max_moves}|{mode}|{lead}"
 
 
 def _gate_load() -> dict:
@@ -271,6 +281,12 @@ def _gate_record(key: str, fits: bool) -> None:
 
 
 def _is_vmem_oom(exc: BaseException) -> bool:
+    """Broad OOM match — the ONE-SHOT fallback trigger. Deliberately
+    loose (HBM exhaustion, device contention, allocator noise all
+    qualify): any of these makes falling back to the XLA session for
+    this chunk the right move. NOT sufficient for a persistent verdict —
+    see :func:`_is_scoped_vmem_oom` (ADVICE r5: a transient HBM OOM must
+    not permanently ban a shape that fits the kernel's VMEM budget)."""
     msg = f"{type(exc).__name__}: {exc}".lower()
     return (
         "vmem" in msg
@@ -280,27 +296,45 @@ def _is_vmem_oom(exc: BaseException) -> bool:
     )
 
 
-def pallas_session_fits(dp, dtype, all_allowed: bool, allow_leader: bool) -> bool:
-    """Does the whole-session kernel fit THIS device at ``dp``'s buckets?
+def _is_scoped_vmem_oom(exc: BaseException) -> bool:
+    """Narrow match — the PERSISTENT-verdict trigger: only the Mosaic /
+    scoped-VMEM signatures that mean the kernel itself exceeds this
+    chip's VMEM budget (a deterministic property of the (shape, program)
+    pair, safe to cache forever for this device kind)."""
+    msg = f"{type(exc).__name__}: {exc}".lower()
+    return "vmem" in msg or "mosaic" in msg
+
+
+def pallas_session_fits(
+    dp, dtype, all_allowed: bool, allow_leader: bool, max_moves: int
+) -> bool:
+    """Does the whole-session kernel fit THIS device at ``dp``'s buckets
+    with a ``max_moves``-sized move log?
 
     Decision ladder (r4 verdict #7 — the gate must derive from the
     device, not from one chip's literals):
 
-    1. a cached verdict for (device kind, P, B, R, mode) wins;
+    1. a cached verdict for (device kind, P, B, R, max_moves, mode)
+       wins;
     2. if the cell-count prior ADMITS the shape, admit — a wrong admit
-       self-corrects: the dispatch's VMEM OOM is caught by ``plan``,
-       recorded as a lasting "doesn't fit" verdict, and the chunk falls
-       back to the XLA session;
+       self-corrects: a scoped-VMEM/Mosaic OOM at dispatch is caught by
+       ``plan``, recorded as a lasting "doesn't fit" verdict for this
+       exact key, and the chunk falls back to the XLA session (broader
+       OOMs — transient HBM exhaustion, device contention — fall back
+       for the chunk WITHOUT a lasting ban, ADVICE r5);
     3. if the prior REJECTS, run a one-shot compile probe of the kernel
-       at the real bucketed shapes (lower+compile, no execution): a
-       bigger-VMEM chip earns its larger ceiling, a Mosaic VMEM error
-       confirms the rejection. Either verdict is cached persistently
-       (and the successful probe's executable lands in the jax compile
-       cache, so the real dispatch does not recompile).
+       at the real bucketed shapes INCLUDING the real ``max_moves``
+       (lower+compile, no execution — a fixed probe size previously let
+       a probe-admitted shape OOM at a larger move-log buffer): a
+       bigger-VMEM chip earns its larger ceiling, a Mosaic/scoped-VMEM
+       error confirms the rejection. Only those two outcomes are cached
+       persistently (an unrelated probe failure yields a no-verdict
+       False; the successful probe's executable lands in the jax
+       compile cache, so the real dispatch does not recompile).
     """
     P, R = dp.replicas.shape
     B = dp.bvalid.shape[0]
-    key = _gate_key(P, B, R, all_allowed, allow_leader)
+    key = _gate_key(P, B, R, all_allowed, allow_leader, max_moves)
     cache = _gate_load()
     if key in cache:
         return cache[key]
@@ -337,7 +371,7 @@ def pallas_session_fits(dp, dtype, all_allowed: bool, allow_leader: bool) -> boo
         jax.jit(  # jaxlint: disable=R2 — compile probe; statics bound via partial
             partial(
                 pallas_session,
-                max_moves=8192,
+                max_moves=max_moves,
                 allow_leader=allow_leader,
                 interpret=False,
                 all_allowed=all_allowed,
@@ -345,8 +379,10 @@ def pallas_session_fits(dp, dtype, all_allowed: bool, allow_leader: bool) -> boo
         ).lower(*args).compile()
         fits = True
     except Exception as exc:
-        if not _is_vmem_oom(exc):
-            return False  # unrelated failure: trust the prior, no verdict
+        if not _is_scoped_vmem_oom(exc):
+            # unrelated/transient failure (including a broad HBM OOM):
+            # trust the prior for this call, persist NO verdict
+            return False
         fits = False
     _gate_record(key, fits)
     return fits
@@ -810,14 +846,17 @@ def session_packed(
     return _pack_log(mp, mslot, mtgt, n)
 
 
-def _dispatch_chunk(
+def packed_call(
     dp, cfg: RebalanceConfig, chunk: int, dtype, batch: int, engine: str,
     polish: bool, leader: bool, all_allowed: bool, churn_gate: float,
     ew=None, ep=None, er=None, evalid=None,
     tid=None, lam=None, n_topics: int = 0,
-) -> "np.ndarray":
-    """Host wrapper assembling :func:`session_packed`'s arguments from a
-    DensePlan — the one call site shared by ``plan`` and ``_leader_plan``.
+):
+    """Assemble :func:`session_packed`'s ``(args, statics)`` from a
+    DensePlan — shared by :func:`_dispatch_chunk` (the live dispatch)
+    and ``kafkabalancer_tpu.prewarm`` (which AOT-compiles the same
+    signatures for the shape grid without dispatching), so the prewarmed
+    store keys cannot drift from what a real invocation asks for.
 
     Args stay raw numpy (jit transfers them at dispatch) so the AOT
     executable store (ops/aot.py) can key, load, and call the stored
@@ -825,8 +864,6 @@ def _dispatch_chunk(
     hit a fresh process skips tracing, lowering, the pallas import, and
     the compile-cache machinery entirely.
     """
-    from kafkabalancer_tpu.ops import aot
-
     npdt = np.dtype(dtype)
     args = (
         dp.replicas,
@@ -860,6 +897,15 @@ def _dispatch_chunk(
         leader=leader,
         n_topics=n_topics,
     )
+    return args, statics
+
+
+def _dispatch_chunk(dp, cfg: RebalanceConfig, chunk: int, *a, **kw) -> "np.ndarray":
+    """One chunk through the AOT dispatch policy (see :func:`packed_call`
+    for the argument assembly and the raw-numpy contract)."""
+    from kafkabalancer_tpu.ops import aot
+
+    args, statics = packed_call(dp, cfg, chunk, *a, **kw)
     return np.asarray(
         aot.call_or_compile("session_packed", session_packed, args, statics)
     )
@@ -1266,17 +1312,18 @@ def plan(
         # (detected by value, before the capacity gate — the all-allowed
         # kernel mode stores no [P, B] matrix and has a far higher ceiling)
         all_allowed = all_allowed_of(dp)
+        chunk = min(remaining, chunk_moves)
         if engine == "pallas" and not pallas_session_fits(
-            dp, dtype, all_allowed, cfg.allow_leader_rebalancing
+            dp, dtype, all_allowed, cfg.allow_leader_rebalancing,
+            next_bucket(chunk, 128),
         ):
             # past this device's scoped-VMEM ceiling (cached verdict /
-            # prior / compile probe) Mosaic compilation OOMs, so fall
-            # back to the XLA while_loop session — same algorithm,
-            # HBM-resident state
+            # prior / compile probe, at the dispatch's own move-log
+            # bucket) Mosaic compilation OOMs, so fall back to the XLA
+            # while_loop session — same algorithm, HBM-resident state
             engine = "xla"
             use_pallas = False
             dp = tensorize(pl, cfg)
-        chunk = min(remaining, chunk_moves)
         if polish:
             from kafkabalancer_tpu.solvers.polish import entry_table
 
@@ -1312,18 +1359,24 @@ def plan(
             raise
         except Exception as exc:
             if engine == "pallas" and _is_vmem_oom(exc):
-                # the prior admitted a shape THIS chip cannot hold:
-                # record the lasting verdict (future plans skip straight
-                # to XLA) and fall back for this one — same algorithm,
-                # HBM-resident state
-                _gate_record(
-                    _gate_key(
-                        dp.replicas.shape[0], dp.bvalid.shape[0],
-                        dp.replicas.shape[1], all_allowed,
-                        cfg.allow_leader_rebalancing,
-                    ),
-                    False,
-                )
+                # fall back to the XLA session for this chunk — same
+                # algorithm, HBM-resident state. A LASTING verdict is
+                # recorded only for the scoped-VMEM/Mosaic signatures
+                # (the prior admitted a shape THIS chip's kernel budget
+                # cannot hold — deterministic, so future plans skip
+                # straight to XLA); transient OOM flavors (HBM
+                # exhaustion, device contention) stay one-shot and the
+                # next plan() retries the kernel (ADVICE r5)
+                if _is_scoped_vmem_oom(exc):
+                    _gate_record(
+                        _gate_key(
+                            dp.replicas.shape[0], dp.bvalid.shape[0],
+                            dp.replicas.shape[1], all_allowed,
+                            cfg.allow_leader_rebalancing,
+                            next_bucket(chunk, 128),
+                        ),
+                        False,
+                    )
                 engine = "xla"
                 use_pallas = False
                 continue

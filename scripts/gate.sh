@@ -2,13 +2,17 @@
 # Pre-merge correctness gate for kafkabalancer-tpu.
 #
 # Runs, in order:
-#   1. jaxlint          — the project's JAX-aware linter (rules R1-R5)
+#   1. jaxlint          — the project's JAX-aware linter (rules R1-R5),
+#                         over the package AND bench.py
 #   2. annotation floor — strict-annotation coverage of the typed
 #                         subpackages (models/, ops/, codecs/); the
 #                         dependency-free half of the typing gate
 #   3. mypy --strict    — on the same subpackages, when mypy is installed
 #   4. ruff check       — when ruff is installed
-#   5. tier-1 tests     — the ROADMAP.md verify suite (skip: --no-tests)
+#   5. cold-start smoke — fresh single-move CLI subprocess against a
+#                         temp AOT store, cache-cold then cache-warm
+#                         (docs/cold-start.md)
+#   6. tier-1 tests     — the ROADMAP.md verify suite (skip: --no-tests)
 #
 # Exit 0 only when every stage that ran passed. Optional tools that are
 # not installed SKIP with a notice instead of failing: the gate must be
@@ -33,7 +37,9 @@ fail=0
 step() { printf '\n== %s\n' "$1"; }
 
 step "jaxlint (R1-R5)"
-"$PYTHON" -m kafkabalancer_tpu.analysis kafkabalancer_tpu/ || fail=1
+# bench.py rides along: it is outside the package tree but carries the
+# same jax-dtype/dispatch idioms the rules police
+"$PYTHON" -m kafkabalancer_tpu.analysis kafkabalancer_tpu/ bench.py || fail=1
 
 step "annotation coverage (mypy --strict floor)"
 "$PYTHON" -m kafkabalancer_tpu.analysis --annotations \
@@ -54,6 +60,33 @@ if command -v ruff >/dev/null 2>&1; then
 else
   echo "ruff not installed — skipped"
 fi
+
+step "cold-start smoke (fresh CLI, temp AOT store)"
+# The stateless deployment unit end to end, twice against one throwaway
+# store: the first subprocess is cache-COLD (jit path + async store
+# write), the second cache-WARM (store hit / clean fallback). Both must
+# exit 0 — this is the stage that catches a cold-path regression (a
+# prefetch crash, a corrupt-store crash, a store write that poisons the
+# next invocation) before merge. Sync saves so run 1's write has landed
+# before run 2 reads it.
+smoke_tmp=$(mktemp -d)
+cold_smoke() {
+  JAX_PLATFORMS=cpu JAX_COMPILATION_CACHE_DIR="$smoke_tmp" \
+  KAFKABALANCER_TPU_AOT_SYNC_SAVE=1 \
+  "$PYTHON" -m kafkabalancer_tpu -input-json -input tests/data/test.json \
+    -fused -fused-batch=4 -max-reassign=4 >/dev/null
+}
+if cold_smoke; then
+  echo "cache-cold invocation: OK"
+  if cold_smoke; then
+    echo "cache-warm invocation: OK"
+  else
+    echo "cache-warm invocation FAILED"; fail=1
+  fi
+else
+  echo "cache-cold invocation FAILED"; fail=1
+fi
+rm -rf "$smoke_tmp"
 
 if [ "$run_tests" = 1 ]; then
   step "tier-1 tests"

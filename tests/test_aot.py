@@ -1,6 +1,7 @@
 """AOT executable store (ops/aot.py): save/load round trip, keying, and
 fallback behavior — on the CPU backend with a temp cache dir."""
 
+import json
 import os
 
 import numpy as np
@@ -18,6 +19,9 @@ def cache_dir(tmp_path, monkeypatch):
     old = getattr(jax.config, "jax_compilation_cache_dir", None)
     jax.config.update("jax_compilation_cache_dir", str(tmp_path))
     yield str(tmp_path)
+    # no background writer may outlive its temp store
+    aot.flush_saves(30.0)
+    aot.flush_prefetches(30.0)
     jax.config.update("jax_compilation_cache_dir", old)
     aot._loaded.clear()
 
@@ -101,3 +105,232 @@ def test_no_cache_dir_disables(monkeypatch):
         assert aot.try_load("x", (np.zeros(1),), {}) is None
     finally:
         jax.config.update("jax_compilation_cache_dir", old)
+
+
+# --- store v2 ------------------------------------------------------------
+
+
+def _store_one(cache_dir, name="v", n=6.0):
+    fn = jax.jit(lambda a: a * 2)
+    args = (np.arange(n),)
+    path = aot.maybe_save(name, fn, args, {})
+    assert path is not None
+    return fn, args, aot.aot_key(name, args, {})
+
+
+def test_v2_manifest_and_shards(cache_dir):
+    """Saves write compressed shard files plus a versioned manifest
+    entry whose metadata (codec, sizes, sig) matches the blob."""
+    _fn, _args, key = _store_one(cache_dir)
+    d = aot.aot_dir()
+    entries = aot._manifest_read(d)
+    assert key in entries
+    e = entries[key]
+    assert e["name"] == "v"
+    assert e["codec"] in ("zstd", "gzip", "raw")
+    assert e["raw_bytes"] > 0 and e["stored_bytes"] > 0
+    for shard in e["shards"]:
+        assert os.path.exists(os.path.join(d, shard))
+    # the manifest carries the human-readable key parts
+    assert e["sig"][0] == "v"
+
+
+def test_v2_multi_shard_roundtrip(cache_dir, monkeypatch):
+    """A blob larger than the shard size splits into several shards and
+    reassembles to a working executable."""
+    monkeypatch.setenv("KAFKABALANCER_TPU_AOT_SHARD_MB", "0.001")  # 1 kB
+    fn, args, key = _store_one(cache_dir, name="ms")
+    d = aot.aot_dir()
+    e = aot._manifest_read(d)[key]
+    assert len(e["shards"]) > 1
+    aot._loaded.clear()
+    compiled = aot.try_load("ms", args, {})
+    assert compiled is not None
+    np.testing.assert_array_equal(
+        np.asarray(compiled(*args)), np.asarray(fn(*args))
+    )
+
+
+def test_truncated_shard_recompiles_cleanly(cache_dir):
+    """Corrupt/truncated blob => the entry is dropped and the dispatch
+    falls back to a clean recompile — never a crash."""
+    fn, args, key = _store_one(cache_dir, name="tr")
+    d = aot.aot_dir()
+    shard = aot._manifest_read(d)[key]["shards"][0]
+    with open(os.path.join(d, shard), "wb") as f:
+        f.write(b"\x1f\x8b garbage")
+    aot._loaded.clear()
+    assert aot.try_load("tr", args, {}) is None  # pruned, no crash
+    assert key not in aot._manifest_read(d)
+    assert not os.path.exists(os.path.join(d, shard))
+    # the dispatch path recompiles cleanly after the prune
+    out = aot.call_or_compile("tr", fn, args, {})
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(fn(*args)))
+
+
+def test_manifest_version_mismatch_ignored(cache_dir):
+    """A manifest from a different store version is IGNORED (empty
+    store), not migrated and not crashed on; a save then rewrites it at
+    the current version."""
+    d = os.path.join(cache_dir, "aot")
+    os.makedirs(d, exist_ok=True)
+    with open(os.path.join(d, "manifest.json"), "w") as f:
+        json.dump({"version": 99, "entries": {"bogus": {}}}, f)
+    assert aot._manifest_read(d) == {}
+    fn = jax.jit(lambda a: a + 3)
+    args = (np.zeros(4),)
+    assert aot.try_load("vm", args, {}) is None
+    assert aot.maybe_save("vm", fn, args, {}) is not None
+    with open(os.path.join(d, "manifest.json")) as f:
+        obj = json.load(f)
+    assert obj["version"] == aot.STORE_VERSION
+    assert "bogus" not in obj["entries"]
+
+
+def test_legacy_v1_blob_still_loads(cache_dir):
+    """A bare v1 ``<key>.bin`` (raw serialized executable, no manifest)
+    written by an older build keeps serving hits."""
+    from jax.experimental.serialize_executable import serialize
+
+    fn = jax.jit(lambda a: a - 1)
+    args = (np.arange(5.0),)
+    blob, _, _ = serialize(fn.lower(*args).compile())
+    d = os.path.join(cache_dir, "aot")
+    os.makedirs(d, exist_ok=True)
+    key = aot.aot_key("l1", args, {})
+    with open(os.path.join(d, key + ".bin"), "wb") as f:
+        f.write(blob)
+    compiled = aot.try_load("l1", args, {})
+    assert compiled is not None
+    np.testing.assert_array_equal(
+        np.asarray(compiled(*args)), np.asarray(fn(*args))
+    )
+
+
+def test_eviction_honors_size_cap(cache_dir, monkeypatch):
+    """With a cap smaller than two entries, saving the second evicts the
+    least-recently-used first entry (manifest entry AND shard files)."""
+    monkeypatch.setenv("KAFKABALANCER_TPU_AOT_CAP_MB", "0.004")  # 4 kB
+    d = aot.aot_dir()
+    fn1, args1, k1 = _store_one(cache_dir, name="e1", n=6.0)
+    assert k1 in aot._manifest_read(d)
+    # make e1 strictly older than e2's write
+    def backdate(e):
+        e[k1]["last_used"] = 1.0
+
+    aot._manifest_update(d, backdate)
+    fn2, args2, k2 = _store_one(cache_dir, name="e2", n=7.0)
+    entries = aot._manifest_read(d)
+    assert k2 in entries  # the just-written entry is exempt
+    assert k1 not in entries  # LRU victim
+    assert not any(f.startswith(k1) for f in os.listdir(d) if f.endswith(".bin"))
+
+
+def test_eviction_counts_legacy_blobs_and_sweeps_orphans(cache_dir, monkeypatch):
+    """The cap accounting covers the whole directory: legacy v1 blobs
+    count toward (and are evictable under) the cap by mtime, and
+    crash-orphaned tmp/shard files older than the age gate are swept."""
+    monkeypatch.setenv("KAFKABALANCER_TPU_AOT_CAP_MB", "0.004")  # 4 kB
+    d = os.path.join(cache_dir, "aot")
+    os.makedirs(d, exist_ok=True)
+    legacy = os.path.join(d, "f" * 32 + ".bin")
+    with open(legacy, "wb") as f:
+        f.write(b"x" * 5000)
+    os.utime(legacy, (1.0, 1.0))  # ancient: first in the LRU order
+    old_orphan = os.path.join(d, "e" * 32 + ".s03.bin")  # no manifest entry
+    with open(old_orphan, "wb") as f:
+        f.write(b"y" * 100)
+    os.utime(old_orphan, (1.0, 1.0))
+    fresh_orphan = os.path.join(d, "ab12cd.tmp")  # maybe a write in flight
+    with open(fresh_orphan, "wb") as f:
+        f.write(b"z")
+    _fn, _args, key = _store_one(cache_dir, name="lv")  # save runs eviction
+    assert not os.path.exists(legacy)  # counted, oldest, evicted
+    assert not os.path.exists(old_orphan)  # unreferenced + old: swept
+    assert os.path.exists(fresh_orphan)  # young: left for its writer
+    assert key in aot._manifest_read(aot.aot_dir())  # new entry exempt
+
+
+def test_async_save_lands_and_loads(cache_dir, monkeypatch):
+    """save_async writes off the critical path; after flush_saves the
+    entry is loadable from a cold in-process state."""
+    monkeypatch.delenv("KAFKABALANCER_TPU_AOT_SYNC_SAVE", raising=False)
+    fn = jax.jit(lambda a: a * 5)
+    args = (np.arange(4.0),)
+    aot.save_async("as", fn, args, {})
+    aot.flush_saves(60.0)
+    key = aot.aot_key("as", args, {})
+    assert key in aot._manifest_read(aot.aot_dir())
+    aot._loaded.clear()
+    compiled = aot.try_load("as", args, {})
+    assert compiled is not None
+    np.testing.assert_array_equal(
+        np.asarray(compiled(*args)), np.asarray(fn(*args))
+    )
+
+
+def test_prefetch_by_dummy_signature(cache_dir):
+    """prefetch keyed by shape/dtype-matched dummy args loads the stored
+    executable in the background; the real dispatch then executes with
+    real values (dummies are never staged or executed)."""
+    fn, args, key = _store_one(cache_dir, name="pf", n=9.0)
+    aot._loaded.clear()
+    aot.stats.clear()
+    assert aot.prefetch("pf", (np.zeros(9),), {}) == key
+    aot.flush_prefetches(60.0)
+    assert key in aot._loaded
+    assert aot.stats["pf"].get("prefetch") == 1.0
+    out = aot.call_or_compile("pf", fn, args, {})
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(fn(*args)))
+    # an unknown signature is not prefetchable: no entry, no thread
+    assert aot.prefetch("pf", (np.zeros(10),), {}) is None
+
+
+def test_codec_fallback_chain(cache_dir, monkeypatch):
+    """KAFKABALANCER_TPU_AOT_CODEC selects the codec; zstd degrades to
+    gzip when the module is absent; raw stores uncompressed."""
+    monkeypatch.setenv("KAFKABALANCER_TPU_AOT_CODEC", "raw")
+    fn, args, key = _store_one(cache_dir, name="cr")
+    e = aot._manifest_read(aot.aot_dir())[key]
+    assert e["codec"] == "raw" and e["stored_bytes"] == e["raw_bytes"]
+    monkeypatch.setenv("KAFKABALANCER_TPU_AOT_CODEC", "zstd")
+    # this container has no zstandard module: documented gzip fallback
+    if aot._zstd() is None:
+        assert aot._codec() == "gzip"
+
+
+def test_zstd_entry_without_module_is_miss_not_corruption(cache_dir, monkeypatch):
+    """A reader without the zstandard module must treat a zstd-coded
+    entry as a MISS (recompile path), never as corruption: the blob is
+    valid for capable readers (prewarm may run on a fuller image) and
+    must not be deleted."""
+    fn, args, key = _store_one(cache_dir, name="zr")
+    d = aot.aot_dir()
+
+    def force_zstd(e):
+        e[key]["codec"] = "zstd"
+
+    aot._manifest_update(d, force_zstd)
+    shard = aot._manifest_read(d)[key]["shards"][0]
+    monkeypatch.setattr(aot, "_zstd_mod", None)  # simulate absent module
+    aot._loaded.clear()
+    assert aot.try_load("zr", args, {}) is None  # miss, not a crash
+    assert key in aot._manifest_read(d)  # entry preserved
+    assert os.path.exists(os.path.join(d, shard))  # shards preserved
+
+
+def test_manifest_cache_tracks_rapid_writes(cache_dir):
+    """Two manifest writes inside one filesystem-timestamp tick: the
+    in-process cache must reflect the LAST write (a stale snapshot keyed
+    by an identical mtime would resurrect the pre-write entry set on the
+    next read-modify-write, orphaning the newer entry's shards)."""
+    d = os.path.join(cache_dir, "aot")
+    os.makedirs(d, exist_ok=True)
+    aot._manifest_update(d, lambda e: e.update(k1={"shards": []}))
+    aot._manifest_update(d, lambda e: e.update(k2={"shards": []}))
+    with open(os.path.join(d, "manifest.json")) as f:
+        on_disk = json.load(f)["entries"]
+    assert set(on_disk) >= {"k1", "k2"}
+    assert set(aot._manifest_read(d)) == set(on_disk)
+    cached = aot._manifest_cache
+    assert cached is not None and set(cached[2]) == set(on_disk)

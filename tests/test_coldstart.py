@@ -1,0 +1,276 @@
+"""Cold-invocation contract: deferred backend init, AOT prefetch
+prediction, and the prewarm subcommand (ops/coldstart.py, prewarm.py).
+
+The load-bearing pins:
+
+- error-path exits (argument errors -> exit 2/3, input failures ->
+  exit 1/2) must never import jax — a fresh process paying backend init
+  just to print a usage error was the r5 cold-path finding;
+- the background prefetch's PREDICTED signature must hit the exact store
+  entry a real dispatch writes (predictor drift = silent cold-path
+  regression, not an error — only this test makes it loud).
+"""
+
+import io
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import jax
+
+from kafkabalancer_tpu.ops import aot
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "data", "test.json")
+
+
+@pytest.fixture
+def cache_dir(tmp_path, monkeypatch):
+    monkeypatch.delenv("KAFKABALANCER_TPU_NO_AOT", raising=False)
+    monkeypatch.setenv("KAFKABALANCER_TPU_AOT_SYNC_SAVE", "1")
+    old = getattr(jax.config, "jax_compilation_cache_dir", None)
+    jax.config.update("jax_compilation_cache_dir", str(tmp_path))
+    yield str(tmp_path)
+    aot.flush_saves(30.0)
+    aot.flush_prefetches(30.0)
+    jax.config.update("jax_compilation_cache_dir", old)
+    aot._loaded.clear()
+    aot.stats.clear()
+
+
+def _run_cli(args, stdin=""):
+    from kafkabalancer_tpu.cli import run
+
+    out, err = io.StringIO(), io.StringIO()
+    rv = run(io.StringIO(stdin), out, err, ["kafkabalancer"] + args)
+    return rv, out.getvalue(), err.getvalue()
+
+
+# --- error paths must not pay backend init -------------------------------
+
+
+def _assert_no_jax_subprocess(args, stdin, want_rc):
+    """Run the CLI in a FRESH interpreter and assert both the exit code
+    and that jax was never imported on the way out."""
+    code = (
+        "import io, sys\n"
+        "from kafkabalancer_tpu.cli import run\n"
+        f"rc = run(io.StringIO({stdin!r}), io.StringIO(), io.StringIO(),\n"
+        f"         ['kafkabalancer'] + {args!r})\n"
+        f"assert rc == {want_rc}, f'exit {{rc}} != {want_rc}'\n"
+        "bad = [m for m in sys.modules if m == 'jax' or m.startswith('jax.')]\n"
+        "assert not bad, f'jax imported on an error exit: {bad[:3]}'\n"
+        "assert 'kafkabalancer_tpu.solvers.scan' not in sys.modules\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+
+def test_exit2_codec_error_skips_backend_init():
+    """A get-partition-list failure (exit 2) with a device solver
+    selected exits WITHOUT initializing the JAX backend: the warmup/
+    prefetch thread starts only after the input parses."""
+    _assert_no_jax_subprocess(
+        ["-input-json", "-solver=tpu", "-max-reassign=1"], "::malformed::", 2
+    )
+
+
+def test_exit3_flag_errors_skip_backend_init():
+    """Argument errors (exit 3) never import jax, for every device
+    backend spelling."""
+    _assert_no_jax_subprocess(
+        ["-input-json", "-solver=tpu", "-max-reassign=-1"], "", 3
+    )
+    _assert_no_jax_subprocess(
+        ["-input-json", "-fused", "-fused-engine=bogus"], "", 3
+    )
+    _assert_no_jax_subprocess(["-input-json", "-fused-shard"], "", 3)
+
+
+def test_exit1_input_open_failure_skips_backend_init():
+    _assert_no_jax_subprocess(
+        ["-input-json", "-solver=tpu", "-input=/nonexistent/x.json"], "", 1
+    )
+
+
+# --- prefetch prediction pins --------------------------------------------
+
+
+def test_hints_predict_tensorize_buckets():
+    """prefetch_hints' jax-free bucket arithmetic matches what tensorize
+    actually produces for the parsed fixture."""
+    from kafkabalancer_tpu.codecs import get_partition_list_from_reader
+    from kafkabalancer_tpu.models import default_rebalance_config
+    from kafkabalancer_tpu.ops.coldstart import prefetch_hints
+    from kafkabalancer_tpu.ops.tensorize import all_allowed_of, tensorize
+    from kafkabalancer_tpu.solvers.scan import _settle_head
+
+    with open(FIXTURE) as fh:
+        pl = get_partition_list_from_reader(fh, True, [])
+    hints = prefetch_hints(pl, None)
+    cfg = default_rebalance_config()
+    _settle_head(pl, cfg, 0)
+    dp = tensorize(pl, cfg)
+    assert hints["P"] == dp.replicas.shape[0]
+    assert hints["R"] == dp.replicas.shape[1]
+    assert hints["B"] == dp.bvalid.shape[0]
+    assert hints["nb"] == dp.nb
+    assert hints["all_allowed"] == all_allowed_of(dp)
+
+
+@pytest.mark.parametrize(
+    "flags,kwargs",
+    [
+        (
+            ["-fused", "-fused-batch=4", "-max-reassign=4"],
+            dict(batch=4, polish=False, allow_leader=False, max_reassign=4),
+        ),
+        (
+            ["-fused", "-fused-batch=4", "-fused-polish", "-allow-leader",
+             "-max-reassign=8"],
+            dict(batch=4, polish=True, allow_leader=True, max_reassign=8),
+        ),
+    ],
+)
+def test_fused_prefetch_prediction_hits_stored_entry(cache_dir, monkeypatch, flags, kwargs):
+    """Predictor pin: a real -fused CLI run stores its session
+    executable; the coldstart prediction from the raw parsed input must
+    compute EXACTLY that entry's key. Pinned at the key level because
+    XLA:CPU cannot deserialize the while_loop session program ("Symbols
+    not found" — a backend limitation; TPU deserializes it, BENCH_r05's
+    aot_load_s, and the CPU-deserializable window scorer carries the
+    end-to-end load pin in the test below)."""
+    from kafkabalancer_tpu.codecs import get_partition_list_from_reader
+    from kafkabalancer_tpu.ops import coldstart
+
+    rv, _out, err = _run_cli(
+        ["-input-json", "-input", FIXTURE] + flags,
+    )
+    assert rv == 0, err
+    aot.flush_saves(60.0)
+    entries = aot._manifest_read(aot.aot_dir())
+    keys = [k for k, e in entries.items() if e["name"] == "session_packed"]
+    assert len(keys) == 1, entries
+
+    predicted = []
+    monkeypatch.setattr(
+        aot, "prefetch",
+        lambda name, args, statics, out_leaves=1: predicted.append(
+            aot.aot_key(name, args, statics)
+        ),
+    )
+    with open(FIXTURE) as fh:
+        pl = get_partition_list_from_reader(fh, True, [])
+    coldstart.warm_and_prefetch(
+        coldstart.prefetch_hints(pl, None),
+        solver="greedy",
+        fused=True,
+        shard=False,
+        engine="auto",
+        rebalance_leaders=False,
+        anti_colocation=0.0,
+        min_replicas=2,
+        **kwargs,
+    )
+    assert predicted == keys  # the predicted key IS the stored key
+
+
+def test_window_prefetch_prediction_hits_stored_entry(cache_dir, monkeypatch):
+    """Same pin for the -solver=tpu per-move window scorer: store via a
+    forced-device find_best_move, then predict-and-prefetch."""
+    import numpy as np
+
+    from kafkabalancer_tpu.codecs import get_partition_list_from_reader
+    from kafkabalancer_tpu.ops.coldstart import prefetch_hints, warm_and_prefetch
+    from kafkabalancer_tpu.solvers import tpu
+
+    # the fixture is tiny; drop the host-scan routing floor so the CLI
+    # path actually dispatches (and stores) the device scorer
+    monkeypatch.setattr(tpu, "MIN_DEVICE_CANDIDATES", 0)
+    import kafkabalancer_tpu.ops.coldstart as coldstart
+
+    rv, _out, err = _run_cli(
+        ["-input-json", "-input", FIXTURE, "-solver=tpu", "-max-reassign=1"],
+    )
+    assert rv == 0, err
+    aot.flush_saves(60.0)
+    entries = aot._manifest_read(aot.aot_dir())
+    # the f32 tier of the follower pass is the first dispatch
+    f32_keys = [
+        k for k, e in entries.items()
+        if e["name"] == "score_window" and "<f4" in "".join(e["sig"])
+        and "leaders=False" in "".join(e["sig"])
+    ]
+    assert len(f32_keys) == 1, entries
+
+    aot._loaded.clear()
+    aot.stats.clear()
+    with open(FIXTURE) as fh:
+        pl = get_partition_list_from_reader(fh, True, [])
+    hints = prefetch_hints(pl, None)
+    coldstart._prefetch_window(hints, allow_leader=False)
+    aot.flush_prefetches(60.0)
+    assert f32_keys[0] in aot._loaded
+    assert aot.stats["score_window"].get("prefetch") == 1.0
+
+
+def test_cli_second_run_cold_path_smoke(cache_dir):
+    """Cache-cold then cache-warm -fused CLI invocations both exit 0 and
+    produce identical plans (the gate.sh cold-start smoke, in-process)."""
+    args = ["-input-json", "-input", FIXTURE, "-fused", "-fused-batch=4",
+            "-max-reassign=4"]
+    rv1, out1, err1 = _run_cli(args)
+    assert rv1 == 0, err1
+    aot.flush_saves(60.0)
+    aot._loaded.clear()
+    rv2, out2, err2 = _run_cli(args)
+    assert rv2 == 0, err2
+    assert out1 == out2
+
+
+# --- prewarm -------------------------------------------------------------
+
+
+def test_prewarm_populates_expected_keys(cache_dir, capsys):
+    """prewarm writes the window-scorer tiers and the fused session
+    program for the shape grid; -verify reloads each; a second run is
+    all hits."""
+    from kafkabalancer_tpu import prewarm
+
+    argv = [
+        "-shapes", "24x4", "-rf", "2", "-max-reassign", "8",
+        "-batch", "4", "-verify",
+    ]
+    rc = prewarm.run(argv)
+    assert rc == 0
+    summary = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    names = [k["name"] for k in summary["keys"]]
+    # two score_window precision tiers + one fused session
+    assert names.count("score_window") == 2
+    assert names.count("session_packed") == 1
+    assert summary["written"] == 3 and summary["failed"] == 0
+    assert summary["verified"] == 3
+    entries = aot._manifest_read(aot.aot_dir())
+    assert {e["name"] for e in entries.values()} == {
+        "score_window", "session_packed",
+    }
+    # idempotent: the second run hits every key
+    rc = prewarm.run(argv)
+    assert rc == 0
+    summary2 = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert summary2["written"] == 0 and summary2["hit"] == 3
+
+
+def test_prewarm_without_store_exits_2(monkeypatch, capsys):
+    monkeypatch.setenv("KAFKABALANCER_TPU_NO_AOT", "1")
+    from kafkabalancer_tpu import prewarm
+
+    assert prewarm.run(["-shapes", "8x2"]) == 2

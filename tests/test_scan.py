@@ -384,7 +384,10 @@ def test_pallas_gate_derives_from_device_not_literals(monkeypatch):
     dp = tz(pl0, cfg0, min_bucket=TILE_P)
     P, R = dp.replicas.shape
     B = dp.bvalid.shape[0]
-    key = scan._gate_key(P, B, R, True, False)
+    # plan(pl, cfg, 3, ...) dispatches chunk=3 -> max_moves bucket 128;
+    # the gate key carries it (a verdict at one move-log size must not
+    # admit or ban another, ADVICE r5)
+    key = scan._gate_key(P, B, R, True, False, 128)
 
     # literals deleted + positive cached verdict: the kernel is routed
     # (observable on CPU as the pallas BalanceError instead of fallback)
@@ -403,7 +406,9 @@ def test_pallas_gate_derives_from_device_not_literals(monkeypatch):
     opl = scan.plan(pl, cfg, 3, batch=8, engine="pallas")
     assert len(opl) == 3  # fell back to the XLA session cleanly
 
-    # a VMEM OOM at dispatch: verdict recorded, SAME call falls back
+    # a SCOPED-VMEM OOM at dispatch: lasting verdict recorded, SAME call
+    # falls back (the narrow Mosaic/vmem signature is deterministic —
+    # the kernel's budget, not device weather)
     scan._gate_mem.clear()
     real_dispatch = scan._dispatch_chunk
     oomed = []
@@ -422,6 +427,102 @@ def test_pallas_gate_derives_from_device_not_literals(monkeypatch):
     assert len(opl) == 3
     assert oomed  # the kernel path was attempted first
     assert scan._gate_mem.get(key) is False  # lasting verdict recorded
+
+
+def test_dispatch_hbm_oom_is_one_shot_fallback(monkeypatch):
+    """ADVICE r5: a BROAD dispatch-time OOM (transient HBM exhaustion,
+    device contention — no scoped-VMEM/Mosaic signature) falls back to
+    the XLA session for the chunk but records NO lasting verdict, so the
+    next plan() retries the kernel instead of being permanently banned."""
+    import kafkabalancer_tpu.solvers.scan as scan
+    from kafkabalancer_tpu.utils.synth import synth_cluster
+
+    monkeypatch.setattr(scan, "_gate_cache_path", lambda: None)
+    monkeypatch.setattr(scan, "_gate_mem", {})
+    # huge literals: the prior admits, no compile probe runs
+    monkeypatch.setattr(scan, "PALLAS_VMEM_CELLS", 1 << 60)
+    monkeypatch.setattr(scan, "PALLAS_VMEM_CELLS_RESTRICTED", 1 << 60)
+
+    real_dispatch = scan._dispatch_chunk
+    attempts = []
+
+    def oom_hbm(dp_, cfg_, chunk, dtype, batch, engine, **kw):
+        if engine == "pallas":
+            attempts.append(True)
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: Out of memory allocating 1234 "
+                "bytes in HBM"
+            )
+        return real_dispatch(dp_, cfg_, chunk, dtype, batch, engine, **kw)
+
+    monkeypatch.setattr(scan, "_dispatch_chunk", oom_hbm)
+
+    def fresh():
+        pl = synth_cluster(60, 8, rf=2, seed=5, weighted=True)
+        cfg = default_rebalance_config()
+        cfg.min_unbalance = 0.0
+        return pl, cfg
+
+    pl, cfg = fresh()
+    opl = scan.plan(pl, cfg, 3, batch=8, engine="pallas")
+    assert len(opl) == 3  # fell back to XLA within the same call
+    assert len(attempts) == 1
+    assert scan._gate_mem == {}  # NO lasting ban
+    # a second plan() attempts the kernel again (one-shot semantics)
+    pl, cfg = fresh()
+    opl = scan.plan(pl, cfg, 3, batch=8, engine="pallas")
+    assert len(opl) == 3
+    assert len(attempts) == 2
+
+
+def test_probe_persists_only_scoped_vmem_verdicts(monkeypatch):
+    """The compile probe persists a negative verdict only for the
+    scoped-VMEM/Mosaic signatures; an unrelated (or broad-OOM) probe
+    failure rejects for this call WITHOUT a cached ban."""
+    import kafkabalancer_tpu.solvers.scan as scan
+
+    monkeypatch.setattr(scan, "_gate_cache_path", lambda: None)
+    monkeypatch.setattr(scan, "_gate_mem", {})
+    # zero literals force the probe; a fake TPU device gets past the
+    # no-hardware early-out (the probe itself is stubbed below)
+    monkeypatch.setattr(scan, "PALLAS_VMEM_CELLS", 0)
+    monkeypatch.setattr(scan, "PALLAS_VMEM_CELLS_RESTRICTED", 0)
+
+    class _FakeDev:
+        platform = "tpu"
+        device_kind = "fake-tpu"
+
+    monkeypatch.setattr(scan.jax, "devices", lambda *a, **kw: [_FakeDev()])
+
+    from kafkabalancer_tpu.models import default_rebalance_config
+    from kafkabalancer_tpu.ops.tensorize import tensorize
+    from kafkabalancer_tpu.utils.synth import synth_cluster
+
+    pl = synth_cluster(60, 8, rf=2, seed=5, weighted=True)
+    cfg = default_rebalance_config()
+    scan._settle_head(pl, cfg, 0)
+    dp = tensorize(pl, cfg)
+
+    import kafkabalancer_tpu.solvers.pallas_session as ps
+
+    calls = []
+
+    def boom(*a, **kw):
+        raise RuntimeError(calls[-1])
+
+    monkeypatch.setattr(ps, "pallas_session", boom)
+
+    # broad OOM text without vmem/mosaic: rejected, nothing cached
+    calls.append("RESOURCE_EXHAUSTED: out of memory in HBM")
+    assert scan.pallas_session_fits(dp, None, True, False, 128) is False
+    assert scan._gate_mem == {}
+
+    # scoped-VMEM signature: rejected AND cached
+    calls.append("Mosaic failed: scoped vmem limit exceeded")
+    assert scan.pallas_session_fits(dp, None, True, False, 128) is False
+    P, R = dp.replicas.shape
+    B = dp.bvalid.shape[0]
+    assert scan._gate_mem.get(scan._gate_key(P, B, R, True, False, 128)) is False
 
 
 @pytest.mark.parametrize("polish", [False, True])
