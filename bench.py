@@ -198,9 +198,12 @@ def cold_child() -> None:
         rts.append(time.perf_counter() - t0)
     rts.sort()
 
-    from kafkabalancer_tpu.ops import aot
+    # attribution via the telemetry registry (kafkabalancer_tpu/obs) —
+    # the same store the CLI's -metrics-json exporter serializes; the
+    # legacy aot.stats alias is a read-only view of exactly this
+    from kafkabalancer_tpu.obs import metrics
 
-    session_stats = aot.stats.get("session_packed", {})
+    session_stats = metrics.phase_get("session_packed")
     print(
         json.dumps(
             {
@@ -233,6 +236,7 @@ def cold_single_child() -> None:
     reads cluster state, it doesn't synthesize it — but parse is
     included)."""
     import io
+    import tempfile
 
     t_start = time.perf_counter()
     fast = os.environ.get("BENCH_FAST") == "1"
@@ -258,17 +262,31 @@ def cold_single_child() -> None:
     src = buf.getvalue()
     t_setup = time.perf_counter() - t_start
 
+    # the cold/warm/prefetch attribution rides the CLI's own
+    # -metrics-json exporter (the library seam the outer loop uses)
+    # instead of this process reaching into module globals
+    fd, metrics_path = tempfile.mkstemp(suffix=".metrics.json")
+    os.close(fd)
     out, err = io.StringIO(), io.StringIO()
     t0 = time.perf_counter()
     rc = cli.run(
         io.StringIO(src), out, err,
-        ["kafkabalancer", "-input-json", "-solver=tpu", "-max-reassign=1"],
+        ["kafkabalancer", "-input-json", "-solver=tpu", "-max-reassign=1",
+         f"-metrics-json={metrics_path}"],
     )
     t_run = time.perf_counter() - t0
 
-    from kafkabalancer_tpu.ops import aot
-
-    sw = aot.stats.get("score_window", {})
+    try:
+        with open(metrics_path) as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        payload = {}
+    finally:
+        try:
+            os.remove(metrics_path)
+        except OSError:
+            pass
+    sw = payload.get("phases", {}).get("score_window", {})
     print(
         json.dumps(
             {

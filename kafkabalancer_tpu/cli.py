@@ -32,6 +32,7 @@ import sys
 import time
 from typing import List, Optional
 
+from kafkabalancer_tpu import obs
 from kafkabalancer_tpu.balancer import BalanceError, balance
 from kafkabalancer_tpu.codecs import (
     CodecError,
@@ -92,10 +93,84 @@ def apply_assignment(pl: PartitionList, changed: Partition) -> Partition:
     raise BalanceError(f"changed partition {changed} not in input list")
 
 
+class _TelemetryFlags:
+    """Export targets from the ``-stats``/``-metrics-json``/``-trace``
+    flag trio, filled by ``_run_impl`` once flags parse so the exporter
+    tail in :func:`run` can fire on EVERY exit path (error exits
+    included — those are the invocations an operator debugs)."""
+
+    __slots__ = ("stats", "metrics_path", "trace_path")
+
+    def __init__(self) -> None:
+        self.stats = False
+        self.metrics_path = ""
+        self.trace_path = ""
+
+    def any(self) -> bool:
+        return bool(self.stats or self.metrics_path or self.trace_path)
+
+
+def _export_telemetry(
+    tel: _TelemetryFlags, rc: int, o, be: BufferingWriter, logger: Logger
+) -> None:
+    """The exporter tail; a telemetry failure is logged, never masks
+    ``rc`` (the exit-code contract outranks observability)."""
+    if not tel.any():
+        return
+    from kafkabalancer_tpu.obs import export as obs_export
+
+    if tel.stats:
+        try:
+            be.write(
+                obs_export.render_stats(obs.REGISTRY, obs.tracer, rc=rc)
+            )
+        except Exception as exc:
+            logger.printf(f"failed rendering -stats summary: {exc}")
+    if tel.metrics_path:
+        try:
+            obs_export.write_metrics_json(
+                tel.metrics_path,
+                obs_export.metrics_payload(obs.REGISTRY, obs.tracer, rc=rc),
+                o,
+            )
+        except Exception as exc:
+            logger.printf(
+                f"failed writing metrics JSON to {tel.metrics_path}: {exc}"
+            )
+    if tel.trace_path:
+        try:
+            obs_export.write_trace(tel.trace_path, obs.tracer)
+        except Exception as exc:
+            logger.printf(f"failed writing trace to {tel.trace_path}: {exc}")
+
+
 def run(i, o, e, args: List[str]) -> int:
-    """Testable CLI body; reference ``run`` (kafkabalancer.go:72-242)."""
+    """Testable CLI body; reference ``run`` (kafkabalancer.go:72-242).
+    Wraps :func:`_run_impl` with the telemetry lifecycle: fresh
+    registry/tracer in, exporters out on every exit path."""
     be = BufferingWriter(e)
     logger = Logger(be)
+    tel = _TelemetryFlags()
+    obs.begin_invocation()
+    rc = -1  # sentinel: an uncaught exception exports rc=-1
+    try:
+        rc = _run_impl(i, o, be, logger, tel, args)
+        return rc
+    finally:
+        try:
+            _export_telemetry(tel, rc, o, be, logger)
+        except Exception as exc:
+            # the per-exporter failures are logged inside; this guards
+            # the shared head (the obs.export import) — a telemetry
+            # failure must neither mask rc nor skip the stderr flush
+            logger.printf(f"telemetry export failed: {exc}")
+        be.close()
+
+
+def _run_impl(
+    i, o, be: BufferingWriter, logger: Logger, tel: _TelemetryFlags,
+    args: List[str],
+) -> int:
     log = logger.printf
     profiler = None
     jaxprof = None
@@ -223,6 +298,30 @@ def run(i, o, e, args: List[str]) -> int:
             "Write a JAX/XLA device trace to this directory (profiling "
             "counterpart of -pprof for the TPU backends)",
         )
+        f_pprof_path = f.string(
+            "pprof-path",
+            "cpu.pprof",
+            "Write the -pprof CPU profile to this path",
+        )
+        f_stats = f.bool(
+            "stats",
+            False,
+            "Print an invocation telemetry summary (lifecycle spans, "
+            "phase timings, counters) to stderr",
+        )
+        f_metrics = f.string(
+            "metrics-json",
+            "",
+            "Write one line of schema-versioned invocation metrics JSON "
+            "to this path ('-' = stdout, after the plan)",
+        )
+        f_trace = f.string(
+            "trace",
+            "",
+            "Write a Chrome trace-event / Perfetto JSON host timeline to "
+            "this path (one track per thread; overlay with the "
+            "-jax-profile device trace)",
+        )
         f_help = f.bool("help", False, "Display usage")
 
         def usage():
@@ -235,6 +334,16 @@ def run(i, o, e, args: List[str]) -> int:
         # (the reference ignores Parse's return value, kafkabalancer.go:98).
         f.parse(args[1:] if args else [])
 
+        # the telemetry flag trio is known now; tracing stays a no-op
+        # (and writes no files) unless one of the three asked for it —
+        # all jax-free (obs/), so the error-exit-without-importing-jax
+        # guarantee below holds with every flag combination
+        tel.stats = bool(f_stats.value)
+        tel.metrics_path = f_metrics.value
+        tel.trace_path = f_trace.value
+        if tel.any():
+            obs.enable_tracing()
+
         if f_pprof.value:
             import cProfile
 
@@ -246,70 +355,71 @@ def run(i, o, e, args: List[str]) -> int:
             usage()
             return 0
 
-        brokers: Optional[List[int]] = None
-        if f_brokers.value != "auto":
-            brokers = []
-            for broker in f_brokers.value.split(","):
-                try:
-                    brokers.append(go_atoi(broker))
-                except ValueError:
+        with obs.span("validate_flags"):
+            brokers: Optional[List[int]] = None
+            if f_brokers.value != "auto":
+                brokers = []
+                for broker in f_brokers.value.split(","):
+                    try:
+                        brokers.append(go_atoi(broker))
+                    except ValueError:
+                        log(
+                            'failed parsing broker list "%s": strconv.Atoi: '
+                            'parsing "%s": invalid syntax'
+                            % (f_brokers.value, broker)
+                        )
+                        usage()
+                        return 3
+
+            if f_max.value < 0:
+                log('invalid number of max reassignments "%d"' % f_max.value)
+                usage()
+                return 3
+
+            if f_input.value != "" and f_zk.value != "":
+                log("can't specify both -input and -from-zk")
+                usage()
+                return 3
+
+            if f_shard.value and not f_fused.value:
+                log("-fused-shard requires -fused")
+                usage()
+                return 3
+
+            if f_fused.value and f_engine.value not in ENGINES:
+                # validated HERE, before the device-warmup thread below: a
+                # flag-error exit must not pay (or hang on) backend attach
+                log(f"unknown fused engine {f_engine.value!r}")
+                usage()
+                return 3
+
+            if f_fused.value and f_anti_coloc.value > 0:
+                # the colocation session's own constraints, surfaced as flag
+                # validation instead of a planning failure (-fused-polish and
+                # -fused-shard both compose: the polish alternation and the
+                # sharded session carry the colocation state)
+                if f_rebalance_leader.value:
                     log(
-                        'failed parsing broker list "%s": strconv.Atoi: '
-                        'parsing "%s": invalid syntax'
-                        % (f_brokers.value, broker)
+                        "-anti-colocation with -fused excludes "
+                        "-rebalance-leader"
                     )
                     usage()
                     return 3
-
-        if f_max.value < 0:
-            log('invalid number of max reassignments "%d"' % f_max.value)
-            usage()
-            return 3
-
-        if f_input.value != "" and f_zk.value != "":
-            log("can't specify both -input and -from-zk")
-            usage()
-            return 3
-
-        if f_shard.value and not f_fused.value:
-            log("-fused-shard requires -fused")
-            usage()
-            return 3
-
-        if f_fused.value and f_engine.value not in ENGINES:
-            # validated HERE, before the device-warmup thread below: a
-            # flag-error exit must not pay (or hang on) backend attach
-            log(f"unknown fused engine {f_engine.value!r}")
-            usage()
-            return 3
-
-        if f_fused.value and f_anti_coloc.value > 0:
-            # the colocation session's own constraints, surfaced as flag
-            # validation instead of a planning failure (-fused-polish and
-            # -fused-shard both compose: the polish alternation and the
-            # sharded session carry the colocation state)
-            if f_rebalance_leader.value:
-                log(
-                    "-anti-colocation with -fused excludes "
-                    "-rebalance-leader"
-                )
-                usage()
-                return 3
-            if f_batch.value <= 1:
-                log("-anti-colocation with -fused requires -fused-batch>1")
-                usage()
-                return 3
-            if f_engine.value.startswith("pallas") and not f_shard.value:
-                # not an error (plan() runs the XLA colocation session;
-                # the single-chip whole-session kernel has no colocation
-                # state), but the engine request is overridden — say so.
-                # -fused-shard is different: the streaming shard kernel
-                # carries the colocation objective (r5), so the request
-                # stands there.
-                log(
-                    "-anti-colocation runs the XLA colocation session; "
-                    f"-fused-engine={f_engine.value} is ignored"
-                )
+                if f_batch.value <= 1:
+                    log("-anti-colocation with -fused requires -fused-batch>1")
+                    usage()
+                    return 3
+                if f_engine.value.startswith("pallas") and not f_shard.value:
+                    # not an error (plan() runs the XLA colocation session;
+                    # the single-chip whole-session kernel has no colocation
+                    # state), but the engine request is overridden — say so.
+                    # -fused-shard is different: the streaming shard kernel
+                    # carries the colocation objective (r5), so the request
+                    # stands there.
+                    log(
+                        "-anti-colocation runs the XLA colocation session; "
+                        f"-fused-engine={f_engine.value} is ignored"
+                    )
 
         in_stream = i
         close_input = False
@@ -324,14 +434,22 @@ def run(i, o, e, args: List[str]) -> int:
         topics = [t for t in f_topics.value.split(",") if len(t) >= 1]
 
         try:
-            try:
-                if f_zk.value != "":
-                    pl = get_partition_list_from_zookeeper(f_zk.value, topics)
-                else:
-                    pl = get_partition_list_from_reader(in_stream, f_json.value, topics)
-            except CodecError as exc:
-                log(f"failed getting partition list: {exc}")
-                return 2
+            with obs.span(
+                "parse_input",
+                source="zookeeper" if f_zk.value != "" else "reader",
+            ):
+                try:
+                    if f_zk.value != "":
+                        pl = get_partition_list_from_zookeeper(
+                            f_zk.value, topics
+                        )
+                    else:
+                        pl = get_partition_list_from_reader(
+                            in_stream, f_json.value, topics
+                        )
+                except CodecError as exc:
+                    log(f"failed getting partition list: {exc}")
+                    return 2
         finally:
             if close_input:
                 in_stream.close()
@@ -374,26 +492,31 @@ def run(i, o, e, args: List[str]) -> int:
                 warm_and_prefetch,
             )
 
-            hints = prefetch_hints(pl, brokers)
-            _warm = threading.Thread(
-                target=warm_and_prefetch,
-                args=(hints,),
-                kwargs=dict(
-                    solver=f_solver.value,
-                    fused=f_fused.value,
-                    shard=f_shard.value,
-                    batch=f_batch.value,
-                    engine=f_engine.value,
-                    polish=f_polish.value,
-                    rebalance_leaders=f_rebalance_leader.value,
-                    allow_leader=f_allow_leader.value,
-                    anti_colocation=max(0.0, f_anti_coloc.value),
-                    max_reassign=f_max.value,
-                    min_replicas=f_min_replicas.value,
-                ),
-                daemon=True,
-            )
-            _warm.start()
+            # the launch span is also the warm thread's trace PARENT:
+            # the background warmup/prefetch work renders on its own
+            # thread track but stays linked to the invocation site
+            with obs.span("warm_thread_launch") as _launch_sp:
+                hints = prefetch_hints(pl, brokers)
+                _warm = threading.Thread(
+                    target=warm_and_prefetch,
+                    args=(hints,),
+                    kwargs=dict(
+                        solver=f_solver.value,
+                        fused=f_fused.value,
+                        shard=f_shard.value,
+                        batch=f_batch.value,
+                        engine=f_engine.value,
+                        polish=f_polish.value,
+                        rebalance_leaders=f_rebalance_leader.value,
+                        allow_leader=f_allow_leader.value,
+                        anti_colocation=max(0.0, f_anti_coloc.value),
+                        max_reassign=f_max.value,
+                        min_replicas=f_min_replicas.value,
+                        trace_parent=_launch_sp,
+                    ),
+                    daemon=True,
+                )
+                _warm.start()
             atexit.register(_warm.join, 30.0)
 
         # complete_partition is deliberately NOT copied into cfg: the
@@ -469,23 +592,31 @@ def run(i, o, e, args: List[str]) -> int:
                     ndev = len(jax.devices())
                     # every device on the part axis: one session, S shards
                     mesh = make_mesh(ndev, shape=(1, ndev))
-                    opl = plan_sharded(
-                        pl, cfg, r, mesh,
-                        batch=max(1, f_batch.value),
-                        engine=f_engine.value,
+                    with obs.span(
+                        "plan", mode="fused-shard", engine=f_engine.value,
                         polish=f_polish.value,
-                        anti_colocation=max(0.0, f_anti_coloc.value),
-                    )
+                    ):
+                        opl = plan_sharded(
+                            pl, cfg, r, mesh,
+                            batch=max(1, f_batch.value),
+                            engine=f_engine.value,
+                            polish=f_polish.value,
+                            anti_colocation=max(0.0, f_anti_coloc.value),
+                        )
                 else:
                     from kafkabalancer_tpu.solvers.scan import plan
 
-                    opl = plan(
-                        pl, cfg, r,
-                        batch=max(1, f_batch.value),
-                        engine=f_engine.value,
+                    with obs.span(
+                        "plan", mode="fused", engine=f_engine.value,
                         polish=f_polish.value,
-                        anti_colocation=max(0.0, f_anti_coloc.value),
-                    )
+                    ):
+                        opl = plan(
+                            pl, cfg, r,
+                            batch=max(1, f_batch.value),
+                            engine=f_engine.value,
+                            polish=f_polish.value,
+                            anti_colocation=max(0.0, f_anti_coloc.value),
+                        )
             except BalanceError as exc:
                 log(f"failed optimizing distribution: {exc}")
                 return 3
@@ -505,47 +636,64 @@ def run(i, o, e, args: List[str]) -> int:
                 log(f"Forcing complete of Partition: {c_partition}")
                 r = 1
 
-        while r > 0:
-            try:
-                ppl = balance(pl, cfg, log=log)
-            except BalanceError as exc:
-                log(f"failed optimizing distribution: {exc}")
-                return 3
+        # ONE span for the whole per-move loop (not one per iteration: a
+        # -max-reassign in the hundreds of thousands must not materialize
+        # that many span records); per-move progress rides as counters.
+        # Skipped when the fused branch already planned (r == 0) so a
+        # -fused run exports exactly one "plan" span — except fused
+        # complete-partition mode (r == 1), where the loop genuinely
+        # continues the plan per-move
+        with (
+            obs.span("plan", mode="per-move", solver=f_solver.value)
+            if r > 0
+            else obs.NOOP_SPAN
+        ):
+            while r > 0:
+                try:
+                    ppl = balance(pl, cfg, log=log)
+                except BalanceError as exc:
+                    log(f"failed optimizing distribution: {exc}")
+                    return 3
 
-            if len(ppl) == 0:
-                break
-
-            # Apply every accepted change to the live list first: in the
-            # reference the change is already applied (through slice
-            # aliasing) before the loop inspects it, so even a move that
-            # fails the complete-partition comparison below is visible in
-            # -full-output (kafkabalancer.go:193-207 + SURVEY.md §2.2).
-            lives = [apply_assignment(pl, changed) for changed in ppl.partitions]
-
-            if not completing:
-                opl.append(*lives)
-            else:
-                stop = False
-                for changed, live in zip(ppl.partitions, lives):
-                    if c_partition.compare(changed):
-                        opl.append(live)
-                    else:
-                        log(f"Partition {changed} did not compare.")
-                        stop = True
-                        break
-                if stop:
+                obs.metrics.count("cli.balance_calls")
+                if len(ppl) == 0:
                     break
 
-            r -= 1
-            # when the budget is exhausted, keep granting one extra iteration
-            # as long as each next move still targets the same topic+partition
-            # (complete-partition mode, kafkabalancer.go:212-220)
-            if r == 0 and f_complete.value:
-                r = 1
+                # Apply every accepted change to the live list first: in the
+                # reference the change is already applied (through slice
+                # aliasing) before the loop inspects it, so even a move that
+                # fails the complete-partition comparison below is visible in
+                # -full-output (kafkabalancer.go:193-207 + SURVEY.md §2.2).
+                lives = [
+                    apply_assignment(pl, changed) for changed in ppl.partitions
+                ]
+                obs.metrics.count("cli.moves", len(lives))
+
                 if not completing:
-                    c_partition = ppl.partitions[-1]
-                    completing = True
-                    log(f"Forcing complete of Partition: {c_partition}")
+                    opl.append(*lives)
+                else:
+                    stop = False
+                    for changed, live in zip(ppl.partitions, lives):
+                        if c_partition.compare(changed):
+                            opl.append(live)
+                        else:
+                            log(f"Partition {changed} did not compare.")
+                            stop = True
+                            break
+                    if stop:
+                        break
+
+                r -= 1
+                # when the budget is exhausted, keep granting one extra
+                # iteration as long as each next move still targets the same
+                # topic+partition (complete-partition mode,
+                # kafkabalancer.go:212-220)
+                if r == 0 and f_complete.value:
+                    r = 1
+                    if not completing:
+                        c_partition = ppl.partitions[-1]
+                        completing = True
+                        log(f"Forcing complete of Partition: {c_partition}")
 
         if jaxprof is not None:
             jaxprof.profiler.stop_trace()
@@ -553,19 +701,21 @@ def run(i, o, e, args: List[str]) -> int:
 
         be.flush(True)
 
-        if f_full.value:
-            opl = pl
+        with obs.span("emit", full=f_full.value, unique=f_unique.value):
+            if f_full.value:
+                opl = pl
 
-        if f_unique.value:
-            opl = filter_partition_list(opl)
+            if f_unique.value:
+                opl = filter_partition_list(opl)
 
-        log("Writing %d changes." % len(opl))
+            log("Writing %d changes." % len(opl))
+            obs.metrics.count("cli.changes_written", len(opl))
 
-        try:
-            write_partition_list(o, opl)
-        except CodecError as exc:
-            log(f"failed writing partition list: {exc}")
-            return 4
+            try:
+                write_partition_list(o, opl)
+            except CodecError as exc:
+                log(f"failed writing partition list: {exc}")
+                return 4
 
         return 0
     finally:
@@ -582,12 +732,16 @@ def run(i, o, e, args: List[str]) -> int:
 
             try:
                 write_pprof(
-                    profiler, "cpu.pprof",
+                    profiler, f_pprof_path.value,
                     duration_ns=time.perf_counter_ns() - prof_t0,
                 )
-            except OSError:
-                pass
-        be.close()
+            except OSError as exc:
+                # a failed profile write must not fail the plan, but it
+                # must not vanish either (it used to be swallowed)
+                logger.printf(
+                    "failed writing cpu profile to "
+                    f"{f_pprof_path.value}: {exc}"
+                )
 
 
 def main() -> None:

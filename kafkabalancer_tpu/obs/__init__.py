@@ -1,0 +1,70 @@
+"""kafkabalancer_tpu.obs — span-based invocation telemetry.
+
+The planner is a stateless CLI re-invoked per move by an outer automation
+loop, so production debugging happens one opaque invocation at a time.
+This package makes an invocation observable end to end:
+
+- ``obs.tracer`` / ``obs.span`` (obs/trace.py) — nested + cross-thread
+  lifecycle spans with a no-op fast path; disabled until the CLI's
+  ``-stats``/``-metrics-json``/``-trace`` flag trio asks for them;
+- ``obs.metrics`` (obs/metrics.py) — the always-on thread-safe registry
+  that absorbed ``ops.aot.stats``, the coldstart prefetch markers, the
+  pallas gate verdicts and the solver/session counters;
+- obs/export.py — the ``-stats`` human summary, the schema-versioned
+  single-line metrics JSON, and the Chrome trace-event / Perfetto
+  timeline.
+
+HARD CONSTRAINT: nothing under this package imports jax (directly or
+transitively beyond the package ``__init__``'s model/codec layer) — the
+error-exit-without-importing-jax guarantee pinned by
+tests/test_coldstart.py must survive every telemetry flag.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from kafkabalancer_tpu.obs.metrics import (  # noqa: F401
+    REGISTRY,
+    SCHEMA,
+    SCHEMA_VERSION,
+    MetricsRegistry,
+    PhasesView,
+)
+from kafkabalancer_tpu.obs.trace import (  # noqa: F401
+    NOOP_SPAN,
+    TRACER,
+    Span,
+    SpanLike,
+    Tracer,
+)
+
+# NOTE: ``obs.metrics`` is the SUBMODULE (bound by the import above),
+# which aliases the registry's methods at module level — do not rebind
+# it to REGISTRY here, or module-style imports silently yield the
+# instance instead of the module. Pass REGISTRY where a
+# ``MetricsRegistry`` object is expected.
+tracer = TRACER
+
+
+def begin_invocation(enabled: bool = False) -> None:
+    """Reset the process-global registry + tracer for a fresh invocation
+    (the CLI calls this at the top of every ``run``)."""
+    REGISTRY.reset()
+    TRACER.reset(enabled=enabled)
+
+
+def enable_tracing() -> None:
+    TRACER.enable()
+
+
+def span(
+    name: str, parent: Optional[SpanLike] = None, **attrs: Any
+) -> SpanLike:
+    """Convenience for ``obs.tracer.span`` — the one call instrumented
+    modules use."""
+    return TRACER.span(name, parent=parent, **attrs)
+
+
+def current_span() -> Optional[Span]:
+    return TRACER.current()

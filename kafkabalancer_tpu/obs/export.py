@@ -1,0 +1,175 @@
+"""Telemetry exporters: ``-stats``, ``-metrics-json``, ``-trace``.
+
+Three renderings of one invocation's tracer + registry state:
+
+- :func:`render_stats` — a human summary (span tree with per-thread
+  attribution, phase timings, counters) written through the CLI's
+  buffered stderr logger;
+- :func:`metrics_payload` / :func:`write_metrics_json` — ONE line of
+  schema-versioned JSON (``kafkabalancer-tpu.metrics/1``) for the outer
+  automation loop and for bench.py, replacing stdout scraping. ``-``
+  writes to stdout AFTER the plan, so the plan contract is untouched
+  and the metrics line is the last line;
+- :func:`chrome_trace` / :func:`write_trace` — Chrome trace-event JSON
+  (the format Perfetto and chrome://tracing load): one ``X`` complete
+  event per span, one track per thread (``M`` thread_name metadata),
+  loadable alongside the ``-jax-profile`` device trace
+  (docs/observability.md shows the overlay workflow).
+
+Exporters run on EVERY exit path — the exit-3/exit-4 error invocations
+are exactly the ones an outer-loop operator needs telemetry from — and
+never raise past their callers' logging.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Set, TextIO
+
+from kafkabalancer_tpu.obs.metrics import SCHEMA, MetricsRegistry
+from kafkabalancer_tpu.obs.trace import Tracer
+
+
+def metrics_payload(
+    registry: MetricsRegistry, tracer: Tracer, rc: Optional[int] = None
+) -> Dict[str, Any]:
+    """The schema-versioned metrics document for one invocation."""
+    snap = registry.snapshot()
+    return {
+        "schema": SCHEMA,
+        "rc": rc,
+        "ts_epoch": round(tracer.epoch, 3),
+        "pid": os.getpid(),
+        "spans": tracer.snapshot(),
+        "counters": snap["counters"],
+        "gauges": snap["gauges"],
+        "phases": snap["phases"],
+        "events": snap["events"],
+        "events_dropped": snap["events_dropped"],
+    }
+
+
+def metrics_line(payload: Dict[str, Any]) -> str:
+    """``payload`` as one newline-free JSON line (non-JSON values — dtype
+    objects in gauge slots, say — degrade to ``str`` instead of failing
+    the export)."""
+    return json.dumps(
+        payload, sort_keys=True, separators=(",", ":"), default=str
+    )
+
+
+def write_metrics_json(
+    path: str, payload: Dict[str, Any], stdout: TextIO
+) -> None:
+    """Write the single-line payload to ``path``, or to ``stdout`` when
+    ``path`` is ``-`` (after the plan — the caller sequences that)."""
+    line = metrics_line(payload) + "\n"
+    if path == "-":
+        stdout.write(line)
+    else:
+        with open(path, "w") as f:
+            f.write(line)
+
+
+def chrome_trace(tracer: Tracer) -> Dict[str, Any]:
+    """Chrome trace-event / Perfetto JSON: ``X`` complete events on one
+    track per thread, start-ordered (monotonic ``ts``), with parent span
+    ids and unfinished markers carried in ``args``."""
+    pid = os.getpid()
+    events: List[Dict[str, Any]] = [
+        {
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": "kafkabalancer-tpu"},
+        },
+    ]
+    named: Set[int] = set()
+    for sp in tracer.snapshot():
+        tid = int(sp["tid"])
+        if tid not in named:
+            named.add(tid)
+            events.append({
+                "ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+                "args": {"name": str(sp["thread"])},
+            })
+        args: Dict[str, Any] = dict(sp.get("attrs", {}))
+        if sp["parent"] is not None:
+            args["parent_sid"] = sp["parent"]
+        if not sp["done"]:
+            args["unfinished"] = True
+        ev: Dict[str, Any] = {
+            "ph": "X", "name": sp["name"], "pid": pid, "tid": tid,
+            "ts": sp["start_us"], "dur": sp["dur_us"],
+        }
+        if args:
+            ev["args"] = args
+        events.append(ev)
+    return {
+        "displayTimeUnit": "ms",
+        "traceEvents": events,
+        "otherData": {"schema": SCHEMA, "ts_epoch": tracer.epoch},
+    }
+
+
+def write_trace(path: str, tracer: Tracer) -> None:
+    with open(path, "w") as f:
+        json.dump(chrome_trace(tracer), f, default=str)
+
+
+def render_stats(
+    registry: MetricsRegistry, tracer: Tracer, rc: Optional[int] = None
+) -> str:
+    """Human telemetry summary: the span tree (indent = nesting, thread
+    named when off the main thread), then phases, counters, gauges, and
+    an event tail."""
+    spans = tracer.snapshot()
+    by_parent: Dict[Optional[int], List[Dict[str, Any]]] = {}
+    for sp in spans:
+        by_parent.setdefault(sp["parent"], []).append(sp)
+    lines: List[str] = [
+        "-- invocation telemetry" + (f" (rc={rc})" if rc is not None else "")
+    ]
+    seen: Set[int] = set()
+
+    def walk(psid: Optional[int], depth: int) -> None:
+        for sp in by_parent.get(psid, []):
+            seen.add(int(sp["sid"]))
+            flag = "" if sp["done"] else " (in flight)"
+            thread = (
+                "" if sp["thread"] == "MainThread" else f" [{sp['thread']}]"
+            )
+            lines.append(
+                f"  {'  ' * depth}{sp['name']}: "
+                f"{sp['dur_us'] / 1e3:.1f} ms{thread}{flag}"
+            )
+            walk(int(sp["sid"]), depth + 1)
+
+    walk(None, 0)
+    for sp in spans:  # orphans (parent from a pre-reset invocation)
+        if int(sp["sid"]) not in seen:
+            lines.append(f"  {sp['name']}: {sp['dur_us'] / 1e3:.1f} ms [orphan]")
+    snap = registry.snapshot()
+    for g in sorted(snap["phases"]):
+        kv = " ".join(
+            f"{k}={snap['phases'][g][k]:.4g}"
+            for k in sorted(snap["phases"][g])
+        )
+        lines.append(f"  phase {g}: {kv}")
+    for name in sorted(snap["counters"]):
+        lines.append(f"  counter {name}: {snap['counters'][name]:g}")
+    for name in sorted(snap["gauges"]):
+        lines.append(f"  gauge {name}: {snap['gauges'][name]}")
+    n_ev = len(snap["events"])
+    if n_ev:
+        shown = snap["events"][-5:]
+        lines.append(
+            f"  events: {n_ev}"
+            + (f" (+{snap['events_dropped']} dropped)"
+               if snap["events_dropped"] else "")
+        )
+        for ev in shown:
+            detail = " ".join(
+                f"{k}={v}" for k, v in ev.items() if k not in ("kind", "t")
+            )
+            lines.append(f"    {ev['kind']}: {detail}")
+    return "\n".join(lines) + "\n"

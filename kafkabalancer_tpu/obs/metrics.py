@@ -1,0 +1,160 @@
+"""Unified, thread-safe metrics registry for invocation telemetry.
+
+One typed store behind one lock absorbs what used to be scattered:
+``ops.aot``'s module-global ``stats`` dict (mutated from the prefetch
+thread AND the main thread), the coldstart prefetch markers, the pallas
+gate verdicts, and the solver/session counters. Four metric families:
+
+- **counters** — monotone floats (``aot.loads``, ``solver.chunks``,
+  ``solver.moves_committed``...), added under the lock;
+- **gauges** — last-write-wins values (cache dir, gate verdicts);
+- **phases** — per-program ``{key: float}`` timing groups. This is the
+  shape ``ops.aot.stats`` always had (``load_s``/``blob_mb``/``exec1_s``/
+  ``prefetch``/``staged`` per program name); :class:`PhasesView` keeps
+  that name alive as a read-only alias;
+- **events** — a bounded append-only log of discrete happenings
+  (evictions, corrupt-entry drops, pallas gate verdicts, kernel
+  fallbacks) with wall-clock stamps.
+
+The registry is ALWAYS on (its cost is the dict writes the old bare
+``stats`` dict already paid, now lock-protected); only the tracer
+(obs/trace.py) has an on/off switch. Zero jax imports by design.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Mapping
+
+SCHEMA_VERSION = 1
+SCHEMA = f"kafkabalancer-tpu.metrics/{SCHEMA_VERSION}"
+
+# events are a diagnostic log, not a firehose: past the cap new events
+# are counted as dropped instead of growing the registry unbounded
+# (a long prewarm sweep or a pathological eviction storm must not turn
+# the metrics payload into the artifact being debugged)
+_MAX_EVENTS = 1024
+
+
+class MetricsRegistry:
+    """Lock-protected counters / gauges / phase-timings / events."""
+
+    def __init__(self) -> None:
+        self._lock = threading.RLock()
+        self._counters: Dict[str, float] = {}
+        self._gauges: Dict[str, Any] = {}
+        self._phases: Dict[str, Dict[str, float]] = {}
+        self._events: List[Dict[str, Any]] = []
+        self._dropped_events = 0
+
+    # -- writers ---------------------------------------------------------
+    def count(self, name: str, delta: float = 1.0) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0.0) + delta
+
+    def gauge(self, name: str, value: Any) -> None:
+        with self._lock:
+            self._gauges[name] = value
+
+    def phase_set(self, group: str, key: str, value: float) -> None:
+        with self._lock:
+            self._phases.setdefault(group, {})[key] = float(value)
+
+    def phase_setdefault(self, group: str, key: str, value: float) -> float:
+        with self._lock:
+            return self._phases.setdefault(group, {}).setdefault(
+                key, float(value)
+            )
+
+    def event(self, kind: str, **fields: Any) -> None:
+        with self._lock:
+            if len(self._events) >= _MAX_EVENTS:
+                self._dropped_events += 1
+                return
+            self._events.append({"kind": kind, "t": time.time(), **fields})
+
+    # -- readers ---------------------------------------------------------
+    def phase_get(self, group: str) -> Dict[str, float]:
+        """Copy of one phase group ({} when absent) — the library seam
+        bench.py's cold children read their attribution through."""
+        with self._lock:
+            return dict(self._phases.get(group, {}))
+
+    def counter_get(self, name: str) -> float:
+        with self._lock:
+            return self._counters.get(name, 0.0)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Deep-enough copy of everything for the exporters."""
+        with self._lock:
+            return {
+                "counters": dict(self._counters),
+                "gauges": dict(self._gauges),
+                "phases": {g: dict(kv) for g, kv in self._phases.items()},
+                "events": [dict(ev) for ev in self._events],
+                "events_dropped": self._dropped_events,
+            }
+
+    # -- lifecycle -------------------------------------------------------
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._phases.clear()
+            self._events.clear()
+            self._dropped_events = 0
+
+    def reset_phases(self) -> None:
+        with self._lock:
+            self._phases.clear()
+
+
+class PhasesView(Mapping[str, Dict[str, float]]):
+    """Read-only Mapping over the registry's phase groups — the
+    backward-compatible ``ops.aot.stats`` alias.
+
+    Lookups return COPIES (mutating one changes nothing); there is no
+    item assignment — writes go through the registry's typed API. The
+    one mutator kept is :meth:`clear` (delegating to
+    ``reset_phases``), because the test/bench idiom ``aot.stats.clear()``
+    is a between-measurements reset, not a data write.
+    """
+
+    def __init__(self, registry: MetricsRegistry) -> None:
+        self._registry = registry
+
+    def __getitem__(self, group: str) -> Dict[str, float]:
+        with self._registry._lock:
+            return dict(self._registry._phases[group])
+
+    def __iter__(self) -> Iterator[str]:
+        with self._registry._lock:
+            return iter(list(self._registry._phases))
+
+    def __len__(self) -> int:
+        with self._registry._lock:
+            return len(self._registry._phases)
+
+    def clear(self) -> None:
+        self._registry.reset_phases()
+
+
+REGISTRY = MetricsRegistry()
+
+# module-level aliases onto the process registry, so the idiomatic call
+# sites (``obs.metrics.count(...)``) and module-style imports
+# (``from kafkabalancer_tpu.obs import metrics``) hit the same store —
+# without shadowing this module behind a registry attribute on the
+# package (``import kafkabalancer_tpu.obs.metrics`` must yield a module
+# that still carries SCHEMA / PhasesView)
+count = REGISTRY.count
+gauge = REGISTRY.gauge
+phase_set = REGISTRY.phase_set
+phase_setdefault = REGISTRY.phase_setdefault
+event = REGISTRY.event
+phase_get = REGISTRY.phase_get
+counter_get = REGISTRY.counter_get
+snapshot = REGISTRY.snapshot
+reset = REGISTRY.reset
+reset_phases = REGISTRY.reset_phases

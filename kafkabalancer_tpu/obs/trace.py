@@ -1,0 +1,205 @@
+"""Invocation tracer: nested and cross-thread spans, no-op when disabled.
+
+The deployment unit is a stateless CLI process per move (the reference's
+README.md:21-33), so "where did this invocation's milliseconds go" is a
+question about ONE process lifecycle: parse -> flag validation -> the
+background warmup/AOT-prefetch thread -> tensorize -> compile-or-load ->
+device execute -> emit. This tracer records that lifecycle as spans:
+
+- **nested** within a thread via a thread-local stack (``span()`` parents
+  to the innermost open span);
+- **cross-thread** via an explicit ``parent=`` handle — the spawner
+  captures ``current()`` (or the launch span itself) and hands it to the
+  thread body, so background warmup/prefetch/save work renders on its own
+  Perfetto track while staying linked to the invocation that started it;
+- **disabled by default** with a no-op fast path: ``span()`` returns a
+  shared singleton and records nothing until ``enable()`` — the CLI
+  enables only when one of ``-stats``/``-metrics-json``/``-trace`` is
+  requested, so the default invocation pays one boolean check per site.
+
+Zero jax imports by design (and by test): the error-exit-without-
+importing-jax guarantee pinned by tests/test_coldstart.py must hold with
+every telemetry flag enabled.
+
+Spans are registered at START (under the id lock, so list order is
+start-ordered and timestamps are monotone in it); an export that runs
+while background threads are still working reports those spans as
+in-flight (``done: false``) instead of losing them.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from types import TracebackType
+from typing import Any, Dict, List, Optional, Type, Union
+
+
+class Span:
+    """One timed region; a context manager created by :meth:`Tracer.span`."""
+
+    __slots__ = (
+        "_tracer", "sid", "parent_sid", "name", "t0_ns", "t1_ns",
+        "tid", "thread_name", "attrs",
+    )
+
+    def __init__(
+        self,
+        tracer: "Tracer",
+        sid: int,
+        parent_sid: Optional[int],
+        name: str,
+        attrs: Dict[str, Any],
+    ) -> None:
+        self._tracer = tracer
+        self.sid = sid
+        self.parent_sid = parent_sid
+        self.name = name
+        self.attrs = attrs
+        self.t0_ns = time.perf_counter_ns()
+        self.t1_ns: Optional[int] = None
+        t = threading.current_thread()
+        self.tid = t.ident or 0
+        self.thread_name = t.name
+
+    def __enter__(self) -> "Span":
+        self._tracer._push(self)
+        return self
+
+    def __exit__(
+        self,
+        exc_type: Optional[Type[BaseException]],
+        exc: Optional[BaseException],
+        tb: Optional[TracebackType],
+    ) -> None:
+        self.t1_ns = time.perf_counter_ns()
+        self._tracer._pop(self)
+
+
+class _NoopSpan:
+    """The disabled-tracer fast path: one shared do-nothing span."""
+
+    __slots__ = ()
+    sid: Optional[int] = None
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        return None
+
+
+NOOP_SPAN = _NoopSpan()
+
+SpanLike = Union[Span, _NoopSpan]
+
+
+class Tracer:
+    """Process-wide span recorder (module-level instance: ``TRACER``)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._enabled = False
+        self._spans: List[Span] = []
+        self._next_sid = 1
+        self._tls = threading.local()
+        self.base_ns = time.perf_counter_ns()
+        self.epoch = time.time()
+
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def enable(self) -> None:
+        self._enabled = True
+
+    def reset(self, enabled: Optional[bool] = None) -> None:
+        """Start a fresh invocation: drop recorded spans, rebase the
+        clock. Other threads' local stacks may still hold pre-reset
+        spans; ``_pop`` removes by identity, so they cannot corrupt
+        spans recorded after the reset. Sids stay monotone ACROSS
+        resets: a background thread still holding a pre-reset parent
+        handle must register as an orphan (parent sid absent from the
+        new list), never re-parent onto an unrelated post-reset span
+        that happened to be assigned the same sid."""
+        with self._lock:
+            self._spans = []
+            self.base_ns = time.perf_counter_ns()
+            self.epoch = time.time()
+            if enabled is not None:
+                self._enabled = enabled
+
+    def current(self) -> Optional[Span]:
+        """The innermost open span on THIS thread, or None — the handle a
+        spawner passes to a background thread for cross-thread parenting."""
+        stack: Optional[List[Span]] = getattr(self._tls, "stack", None)
+        return stack[-1] if stack else None
+
+    def span(
+        self, name: str, parent: Optional[SpanLike] = None, **attrs: Any
+    ) -> SpanLike:
+        """A new span; parents to ``parent`` when given (cross-thread),
+        else to this thread's innermost open span. Use as a context
+        manager. Returns the shared no-op singleton when disabled."""
+        if not self._enabled:
+            return NOOP_SPAN
+        psid: Optional[int]
+        if parent is not None:
+            psid = parent.sid
+        else:
+            cur = self.current()
+            psid = cur.sid if cur is not None else None
+        with self._lock:
+            sid = self._next_sid
+            self._next_sid += 1
+            # constructed INSIDE the lock: t0 stamps under it, so list
+            # order == start order and exported timestamps are monotone
+            sp = Span(self, sid, psid, name, dict(attrs))
+            self._spans.append(sp)
+        return sp
+
+    def _push(self, sp: Span) -> None:
+        stack: Optional[List[Span]] = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = []
+            self._tls.stack = stack
+        stack.append(sp)
+
+    def _pop(self, sp: Span) -> None:
+        stack: Optional[List[Span]] = getattr(self._tls, "stack", None)
+        if not stack:
+            return
+        if stack[-1] is sp:
+            stack.pop()
+        elif sp in stack:  # unbalanced exit (generator teardown etc.)
+            stack.remove(sp)
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        """Recorded spans as export dicts, start-ordered; spans still in
+        flight report their duration so far with ``done: false``. Start
+        offsets clamp at 0: a pre-reset background span must not export
+        a negative timestamp."""
+        now = time.perf_counter_ns()
+        with self._lock:
+            spans = list(self._spans)
+            base = self.base_ns
+        out: List[Dict[str, Any]] = []
+        for sp in spans:
+            t1 = sp.t1_ns if sp.t1_ns is not None else now
+            row: Dict[str, Any] = {
+                "sid": sp.sid,
+                "parent": sp.parent_sid,
+                "name": sp.name,
+                "tid": sp.tid,
+                "thread": sp.thread_name,
+                "start_us": round(max(0, sp.t0_ns - base) / 1e3, 1),
+                "dur_us": round(max(0, t1 - sp.t0_ns) / 1e3, 1),
+                "done": sp.t1_ns is not None,
+            }
+            if sp.attrs:
+                row["attrs"] = dict(sp.attrs)
+            out.append(row)
+        return out
+
+
+TRACER = Tracer()

@@ -77,6 +77,9 @@ JitWrapped = Any
 
 import numpy as np
 
+from kafkabalancer_tpu import obs
+from kafkabalancer_tpu.obs.metrics import PhasesView
+
 STORE_VERSION = 2
 _MANIFEST = "manifest.json"
 
@@ -95,8 +98,17 @@ _loaded: Dict[str, Any] = {}
 # per-name phase timings of the LAST dispatch (load/exec/jit seconds,
 # blob MB, prefetch/staged markers) — bench.py's cold children read these
 # to attribute the stateless per-invocation cost between transport,
-# store I/O and compute
-stats: Dict[str, Dict[str, float]] = {}
+# store I/O and compute. The storage moved into the unified telemetry
+# registry (kafkabalancer_tpu/obs): the prefetch thread and the main
+# thread both write here, and the old bare module dict was mutated
+# lock-free from both. ``stats`` stays as a READ-ONLY Mapping alias
+# (lookups return copies; ``.clear()`` is the only mutator, a reset);
+# writes go through ``obs.metrics.phase_set``.
+stats: PhasesView = PhasesView(obs.REGISTRY)
+
+# how long a dispatch waits on an in-flight prefetch of its own key
+# before treating it as a miss (matches the warm thread's exit join)
+_PREFETCH_JOIN_S = 30.0
 
 # in-flight background loads (prefetch) and writes (save_async)
 _inflight: Dict[str, threading.Thread] = {}
@@ -436,6 +448,7 @@ def _evict_to_cap(d: str, keep_key: Optional[str] = None) -> None:
             # unreferenced shard/tmp no loader will ever read: reclaim
             try:
                 os.remove(path)
+                obs.metrics.count("aot.orphan_sweeps")
                 _log(f"sweep orphan {fname}")
             except OSError:
                 pass
@@ -447,6 +460,8 @@ def _evict_to_cap(d: str, keep_key: Optional[str] = None) -> None:
         try:
             evict()
             total -= size
+            obs.metrics.count("aot.evictions")
+            obs.metrics.event("aot_evict", bytes=size)
             _log(f"evict {size / 1e6:.1f}MB")
         except Exception:
             pass
@@ -589,11 +604,17 @@ def try_load(
     # snapshot under the lock: prefetch() registers AND starts the
     # thread while holding it, so a thread observed here is guaranteed
     # started — an unlocked read could catch the insert-before-start
-    # window and Thread.join would raise on the unstarted thread
+    # window and Thread.join would raise on the unstarted thread.
+    # BOUNDED join: a loader wedged in a hung store mount (NFS, relay
+    # blackhole) must cost the overlap, not the plan — past the deadline
+    # the dispatch falls through to the jit path like any other miss
     with _inflight_lock:
         th = _inflight.get(key)
     if th is not None and th is not threading.current_thread():
-        th.join()
+        th.join(_PREFETCH_JOIN_S)
+        if th.is_alive():
+            obs.metrics.event("aot_prefetch_join_timeout", name=name)
+            return None
     if key in _loaded:
         return _loaded[key]
     try:
@@ -602,31 +623,37 @@ def try_load(
             deserialize_and_load,
         )
 
-        t0 = time.perf_counter()
-        blob = _read_blob(d, key)
-        if blob is None:
-            return None
-        in_tree = jax.tree_util.tree_flatten((args, {}))[1]
-        skel = 0 if out_leaves == 1 else (0,) * out_leaves
-        out_tree = jax.tree_util.tree_flatten(skel)[1]
-        # the stored executables are single-device programs; restrict
-        # execution to device 0 (the default would hand a multi-device
-        # backend's full device list over and demand N-sharded args).
-        # execution_devices= only exists on newer jax — older versions
-        # replay the devices recorded at serialize time, which is the
-        # same single-device restriction
-        kwargs: Dict[str, Any] = {}
-        if _supports_execution_devices(deserialize_and_load):
-            kwargs["execution_devices"] = jax.devices()[:1]
-        compiled = deserialize_and_load(blob, in_tree, out_tree, **kwargs)
+        with obs.span("aot.load", program=name):
+            t0 = time.perf_counter()
+            blob = _read_blob(d, key)
+            if blob is None:
+                return None
+            in_tree = jax.tree_util.tree_flatten((args, {}))[1]
+            skel = 0 if out_leaves == 1 else (0,) * out_leaves
+            out_tree = jax.tree_util.tree_flatten(skel)[1]
+            # the stored executables are single-device programs; restrict
+            # execution to device 0 (the default would hand a multi-device
+            # backend's full device list over and demand N-sharded args).
+            # execution_devices= only exists on newer jax — older versions
+            # replay the devices recorded at serialize time, which is the
+            # same single-device restriction
+            kwargs: Dict[str, Any] = {}
+            if _supports_execution_devices(deserialize_and_load):
+                kwargs["execution_devices"] = jax.devices()[:1]
+            compiled = deserialize_and_load(blob, in_tree, out_tree, **kwargs)
         _loaded[key] = compiled  # repeat chunks skip re-deserialization
         dt = time.perf_counter() - t0
-        st = stats.setdefault(name, {})
-        st["load_s"] = dt
-        st["blob_mb"] = len(blob) / 1e6
+        obs.metrics.phase_set(name, "load_s", dt)
+        obs.metrics.phase_set(name, "blob_mb", len(blob) / 1e6)
+        obs.metrics.count("aot.loads")
         _log(f"load {name} {len(blob) / 1e6:.1f}MB {dt:.2f}s")
         return compiled
-    except Exception:
+    except Exception as exc:
+        obs.metrics.count("aot.corrupt_drops")
+        obs.metrics.event(
+            "aot_corrupt_drop", name=name, key=key,
+            error=type(exc).__name__,
+        )
         _drop_entry(d, key)
         return None
 
@@ -653,6 +680,9 @@ def prefetch(
     key = aot_key(name, args, statics)
     if key in _loaded:
         return key
+    # captured on the CALLING thread: the loader runs on its own track
+    # but stays parented to the invocation site that asked for it
+    parent = obs.current_span()
     with _inflight_lock:
         if key in _inflight:
             return key
@@ -661,13 +691,16 @@ def prefetch(
 
         def body() -> None:
             try:
-                t0 = time.perf_counter()
-                if try_load(
-                    name, args, statics, out_leaves=out_leaves, key=key
-                ) is not None:
-                    st = stats.setdefault(name, {})
-                    st["prefetch"] = 1.0
-                    st["prefetch_s"] = time.perf_counter() - t0
+                with obs.span("aot.prefetch", parent=parent, program=name):
+                    t0 = time.perf_counter()
+                    if try_load(
+                        name, args, statics, out_leaves=out_leaves, key=key
+                    ) is not None:
+                        obs.metrics.phase_set(name, "prefetch", 1.0)
+                        obs.metrics.phase_set(
+                            name, "prefetch_s", time.perf_counter() - t0
+                        )
+                        obs.metrics.count("aot.prefetch_hits")
             finally:
                 _inflight.pop(key, None)
 
@@ -715,7 +748,11 @@ def _stage_args(args: Tuple) -> Optional[Tuple]:
 
 
 def maybe_save(
-    name: str, fn: JitWrapped, args: Tuple, statics: Dict[str, Any]
+    name: str,
+    fn: JitWrapped,
+    args: Tuple,
+    statics: Dict[str, Any],
+    trace_parent: Optional["obs.SpanLike"] = None,
 ) -> Optional[str]:
     """Compile ``fn`` for ``args`` AOT and store the executable if absent.
 
@@ -735,9 +772,13 @@ def maybe_save(
             return None
         from jax.experimental.serialize_executable import serialize
 
-        compiled = fn.lower(*args, **statics).compile()
-        blob, _in_tree, _out_tree = serialize(compiled)
-        path = _write_blob(d, key, name, _key_parts(name, args, statics), blob)
+        with obs.span("aot.save", parent=trace_parent, program=name):
+            compiled = fn.lower(*args, **statics).compile()
+            blob, _in_tree, _out_tree = serialize(compiled)
+            path = _write_blob(
+                d, key, name, _key_parts(name, args, statics), blob
+            )
+        obs.metrics.count("aot.saves")
         # memoize: the just-compiled executable serves this process's
         # next chunk directly — without this, chunk 2 would re-read and
         # re-ship the multi-MB blob the device already has resident
@@ -760,9 +801,13 @@ def save_async(
     if _sync_saves():
         maybe_save(name, fn, args, statics)
         return
+    # capture the dispatch-site span HERE: the save thread's "aot.save"
+    # renders on its own track but stays linked to the invocation span
+    # that scheduled it (same contract as the prefetch thread)
     t = threading.Thread(
         target=maybe_save,
         args=(name, fn, args, statics),
+        kwargs=dict(trace_parent=obs.current_span()),
         daemon=True,
         name=f"aot-save-{name}",
     )
@@ -813,17 +858,18 @@ def call_or_compile(
         try:
             import jax
 
-            t0 = time.perf_counter()
-            out = compiled(*(staged if staged is not None else args))
-            # materialize INSIDE the fallback scope: a stale/raced entry
-            # can fail asynchronously, surfacing only at transfer time
-            jax.block_until_ready(out)
+            with obs.span("aot.exec", program=name):
+                t0 = time.perf_counter()
+                out = compiled(*(staged if staged is not None else args))
+                # materialize INSIDE the fallback scope: a stale/raced
+                # entry can fail asynchronously, surfacing only at
+                # transfer time
+                jax.block_until_ready(out)
             dt = time.perf_counter() - t0
-            st = stats.setdefault(name, {})
-            st.setdefault("exec1_s", dt)
-            st["exec_s"] = dt
+            obs.metrics.phase_setdefault(name, "exec1_s", dt)
+            obs.metrics.phase_set(name, "exec_s", dt)
             if staged is not None:
-                st["staged"] = 1.0
+                obs.metrics.phase_set(name, "staged", 1.0)
             _log(f"exec {name} {dt:.2f}s")
             return out
         except Exception:
@@ -835,8 +881,11 @@ def call_or_compile(
     # of every input must not sit on the device through a fresh compile
     staged = None
     t0 = time.perf_counter()
-    out = fn(*args, **statics)
-    stats.setdefault(name, {})["jit_s"] = time.perf_counter() - t0
-    _log(f"jit-path {name} {stats[name]['jit_s']:.2f}s")
+    with obs.span("aot.jit", program=name):
+        out = fn(*args, **statics)
+    jit_s = time.perf_counter() - t0
+    obs.metrics.phase_set(name, "jit_s", jit_s)
+    obs.metrics.count("aot.jit_dispatches")
+    _log(f"jit-path {name} {jit_s:.2f}s")
     save_async(name, fn, args, statics)
     return out

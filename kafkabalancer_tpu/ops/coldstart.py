@@ -35,7 +35,9 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional
 
+from kafkabalancer_tpu import obs
 from kafkabalancer_tpu.models import PartitionList
+from kafkabalancer_tpu.obs.trace import SpanLike
 from kafkabalancer_tpu.ops.runtime import next_bucket
 
 
@@ -71,7 +73,7 @@ def prefetch_hints(
     all_allowed = not explicit and (
         not brokers or observed <= set(int(b) for b in brokers)
     )
-    return {
+    hints = {
         "n_parts": n,
         "nb": len(universe),
         "P": next_bucket(n, 8),
@@ -82,6 +84,10 @@ def prefetch_hints(
         "entry_slots": n_entries,
         "all_allowed": all_allowed,
     }
+    # the predicted shape buckets ARE the coldstart attribution an
+    # operator needs when a prefetch misses (predictor-vs-store drift)
+    obs.metrics.gauge("coldstart.hints", dict(hints))
+    return hints
 
 
 def warm_and_prefetch(
@@ -98,46 +104,53 @@ def warm_and_prefetch(
     anti_colocation: float,
     max_reassign: int,
     min_replicas: int,
+    trace_parent: "Optional[SpanLike]" = None,
 ) -> None:
     """Background-thread body: backend warmup, then AOT prefetch of the
     executable the predicted dispatch will ask for. Never raises — a
-    failure here must cost the overlap, not the plan."""
+    failure here must cost the overlap, not the plan. ``trace_parent``
+    links this thread's telemetry spans to the CLI invocation span that
+    launched it (cross-thread parenting, obs/trace.py)."""
     try:
-        import jax
-        import numpy as np
+        obs.metrics.count("coldstart.warm_runs")
+        with obs.span("coldstart.warm", parent=trace_parent):
+            with obs.span("coldstart.backend_warm"):
+                import jax
+                import numpy as np
 
-        # any dtype warms the backend; f32 keeps the dummy transfer off
-        # the x64 path
-        np.asarray(  # jaxlint: disable=R4 — dummy warm-up
-            jax.device_put(np.zeros(1, np.float32))
-        )
-        from kafkabalancer_tpu.ops import aot
-        from kafkabalancer_tpu.ops.runtime import ensure_x64
+                # any dtype warms the backend; f32 keeps the dummy
+                # transfer off the x64 path
+                np.asarray(  # jaxlint: disable=R4 — dummy warm-up
+                    jax.device_put(np.zeros(1, np.float32))
+                )
+            from kafkabalancer_tpu.ops import aot
+            from kafkabalancer_tpu.ops.runtime import ensure_x64
 
-        # ensure_x64 configures the persistent compile cache (and the
-        # x64 mode default_dtype predicts with) — normally a solver
-        # module import does this, but no solver is imported yet on this
-        # thread, and without it aot_dir() reads an unconfigured
-        # jax_compilation_cache_dir and the whole prefetch silently
-        # no-ops in default deployments (only the env-var-configured
-        # bench/test runs would ever overlap)
-        ensure_x64()
-        if aot.aot_dir() is None or max_reassign <= 0:
-            return
-        if fused and not shard:
-            _prefetch_fused(
-                hints,
-                batch=batch,
-                engine=engine,
-                polish=polish,
-                rebalance_leaders=rebalance_leaders,
-                allow_leader=allow_leader,
-                anti_colocation=anti_colocation,
-                max_reassign=max_reassign,
-                min_replicas=min_replicas,
-            )
-        elif not fused and solver == "tpu":
-            _prefetch_window(hints, allow_leader=allow_leader)
+            # ensure_x64 configures the persistent compile cache (and the
+            # x64 mode default_dtype predicts with) — normally a solver
+            # module import does this, but no solver is imported yet on this
+            # thread, and without it aot_dir() reads an unconfigured
+            # jax_compilation_cache_dir and the whole prefetch silently
+            # no-ops in default deployments (only the env-var-configured
+            # bench/test runs would ever overlap)
+            ensure_x64()
+            if aot.aot_dir() is None or max_reassign <= 0:
+                return
+            with obs.span("coldstart.prefetch_predict"):
+                if fused and not shard:
+                    _prefetch_fused(
+                        hints,
+                        batch=batch,
+                        engine=engine,
+                        polish=polish,
+                        rebalance_leaders=rebalance_leaders,
+                        allow_leader=allow_leader,
+                        anti_colocation=anti_colocation,
+                        max_reassign=max_reassign,
+                        min_replicas=min_replicas,
+                    )
+                elif not fused and solver == "tpu":
+                    _prefetch_window(hints, allow_leader=allow_leader)
     except Exception:
         pass  # no backend / no store: solvers surface their own errors
 

@@ -14,6 +14,8 @@ from __future__ import annotations
 import os
 import threading
 
+from kafkabalancer_tpu import obs
+
 _configured = False
 _configure_lock = threading.Lock()
 
@@ -29,16 +31,19 @@ def ensure_x64() -> None:
     with _configure_lock:
         if _configured:
             return
-        ensure_persistent_cache()
-        if os.environ.get("KAFKABALANCER_TPU_NO_X64", "").lower() not in (
-            "1",
-            "true",
-            "yes",
-            "on",
-        ):
-            import jax
+        with obs.span("runtime.configure"):
+            ensure_persistent_cache()
+            if os.environ.get(
+                "KAFKABALANCER_TPU_NO_X64", ""
+            ).lower() not in (
+                "1",
+                "true",
+                "yes",
+                "on",
+            ):
+                import jax
 
-            jax.config.update("jax_enable_x64", True)
+                jax.config.update("jax_enable_x64", True)
         _configured = True
 
 
@@ -104,6 +109,7 @@ def ensure_persistent_cache(path: "str | None" = None) -> "str | None":
         os.makedirs(target, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", target)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        obs.metrics.gauge("runtime.compile_cache_dir", target)
         return None
     except Exception as exc:
         return repr(exc)

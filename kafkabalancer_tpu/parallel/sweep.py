@@ -41,6 +41,7 @@ from dataclasses import dataclass
 from functools import partial
 from typing import List, Optional, Sequence
 
+from kafkabalancer_tpu import obs
 from kafkabalancer_tpu.models import PartitionList, RebalanceConfig
 from kafkabalancer_tpu.models.config import default_dtype, kernel_dtype
 from kafkabalancer_tpu.ops.runtime import ensure_x64, next_bucket
@@ -521,25 +522,31 @@ def sweep(
         )
         ncur_dec = [ncur_np[i] for i in range(S)]
 
-    packed_dev, su_dev = _sweep_exec(
-        jnp.asarray(scenario_mask),
-        reps_arg, member_arg,
-        jnp.asarray(dp.allowed), jnp.asarray(has_explicit),
-        jnp.asarray(dp.weights, dtype), ncur_arg,
-        jnp.asarray(dp.nrep_tgt), jnp.asarray(dp.ncons, dtype),
-        jnp.asarray(dp.pvalid), jnp.asarray(dp.bvalid),
-        jnp.int32(cfg.min_replicas_for_rebalancing),
-        jnp.asarray(cfg.min_unbalance, dtype),
-        budget_arg,
-        mesh=mesh,
-        max_moves=max_moves,
-        max_evac=max_evac,
-        allow_leader=cfg.allow_leader_rebalancing,
-        batch=max(1, batch),
-        engine=engine,
+    obs.metrics.count("sweep.runs")
+    obs.metrics.count("sweep.scenarios", S)
+    with obs.span(
+        "sweep.dispatch", scenarios=S, padded=S_pad, engine=engine,
         per_scenario=scen_pls is not None,
-    )
-    packed = np.asarray(packed_dev)
+    ):
+        packed_dev, su_dev = _sweep_exec(
+            jnp.asarray(scenario_mask),
+            reps_arg, member_arg,
+            jnp.asarray(dp.allowed), jnp.asarray(has_explicit),
+            jnp.asarray(dp.weights, dtype), ncur_arg,
+            jnp.asarray(dp.nrep_tgt), jnp.asarray(dp.ncons, dtype),
+            jnp.asarray(dp.pvalid), jnp.asarray(dp.bvalid),
+            jnp.int32(cfg.min_replicas_for_rebalancing),
+            jnp.asarray(cfg.min_unbalance, dtype),
+            budget_arg,
+            mesh=mesh,
+            max_moves=max_moves,
+            max_evac=max_evac,
+            allow_leader=cfg.allow_leader_rebalancing,
+            batch=max(1, batch),
+            engine=engine,
+            per_scenario=scen_pls is not None,
+        )
+        packed = np.asarray(packed_dev)
     P_pad, R_pad = dp.replicas.shape
     nrep = S_pad * P_pad * R_pad
     replicas_s = packed[:nrep].reshape(S_pad, P_pad, R_pad)
@@ -579,6 +586,9 @@ def sweep(
                 n_repairs=n_repairs,
             )
         )
+    obs.metrics.count(
+        "sweep.infeasible", sum(1 for r in out if not r.feasible)
+    )
     return out
 
 

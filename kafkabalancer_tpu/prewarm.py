@@ -158,7 +158,15 @@ def run(argv: List[str]) -> int:
         "-verify", action="store_true",
         help="reload every written entry from the store afterwards",
     )
+    ap.add_argument(
+        "-stats", action="store_true",
+        help="print a telemetry summary (compile/save/load spans, "
+        "counters) to stderr when done",
+    )
     ns = ap.parse_args(argv)
+    from kafkabalancer_tpu import obs
+
+    obs.begin_invocation(enabled=ns.stats)
     try:
         shapes = _parse_shapes(ns.shapes)
     except ValueError as exc:
@@ -244,6 +252,10 @@ def run(argv: List[str]) -> int:
             }
         )
     )
+    if ns.stats:
+        from kafkabalancer_tpu.obs import export as obs_export
+
+        sys.stderr.write(obs_export.render_stats(obs.REGISTRY, obs.tracer))
     return 0 if failed == 0 else 1
 
 

@@ -70,6 +70,7 @@ from jax import lax  # noqa: E402
 from jax.experimental import pallas as pl  # noqa: E402
 from jax.experimental.pallas import tpu as pltpu  # noqa: E402
 
+from kafkabalancer_tpu import obs  # noqa: E402
 from kafkabalancer_tpu.models.config import kernel_dtype  # noqa: E402
 from kafkabalancer_tpu.ops.cost import overload_penalty as _pen  # noqa: E402
 from kafkabalancer_tpu.solvers.scan import DEFAULT_CHURN_GATE  # noqa: E402
@@ -723,6 +724,17 @@ def pallas_session(
     if max_moves % 128:
         raise ValueError(f"max_moves {max_moves} not a multiple of 128")
     ML = max_moves
+
+    # this body is jit-traced by session_packed / the gate probe, so the
+    # registry write below fires once per TRACE — which is precisely the
+    # host-visible kernel (re)compile event worth counting; per-dispatch
+    # accounting lives at the host call sites (scan._dispatch_chunk)
+    obs.metrics.count("pallas.kernel_traces")
+    # P/R/B/ML are static shape ints, never traced values
+    obs.metrics.gauge(
+        "pallas.last_traced_shape",
+        {"P": P, "R": R, "B": B, "max_moves": ML},
+    )
 
     f32 = kernel_dtype()
     i32 = jnp.int32

@@ -41,6 +41,7 @@ from __future__ import annotations
 from functools import partial
 from typing import List, Tuple
 
+from kafkabalancer_tpu import obs
 from kafkabalancer_tpu.models import Partition, PartitionList, RebalanceConfig
 from kafkabalancer_tpu.models.config import (
     ENGINES,
@@ -258,6 +259,10 @@ def _gate_load() -> dict:
 
 
 def _gate_record(key: str, fits: bool) -> None:
+    # the verdict is observability gold: it decides engine routing for
+    # every future invocation at this shape on this device kind
+    obs.metrics.event("pallas_gate", key=key, fits=bool(fits))
+    obs.metrics.gauge(f"pallas_gate.{key}", bool(fits))
     _gate_load()[key] = bool(fits)
     path = _gate_cache_path()
     if path:
@@ -368,15 +373,17 @@ def pallas_session_fits(
         sds((), f32),                                   # churn_gate
     )
     try:
-        jax.jit(  # jaxlint: disable=R2 — compile probe; statics bound via partial
-            partial(
-                pallas_session,
-                max_moves=max_moves,
-                allow_leader=allow_leader,
-                interpret=False,
-                all_allowed=all_allowed,
-            )
-        ).lower(*args).compile()
+        obs.metrics.count("solver.gate_probes")
+        with obs.span("solver.gate_probe", key=key):
+            jax.jit(  # jaxlint: disable=R2 — compile probe; statics bound via partial
+                partial(
+                    pallas_session,
+                    max_moves=max_moves,
+                    allow_leader=allow_leader,
+                    interpret=False,
+                    all_allowed=all_allowed,
+                )
+            ).lower(*args).compile()
         fits = True
     except Exception as exc:
         if not _is_scoped_vmem_oom(exc):
@@ -906,9 +913,17 @@ def _dispatch_chunk(dp, cfg: RebalanceConfig, chunk: int, *a, **kw) -> "np.ndarr
     from kafkabalancer_tpu.ops import aot
 
     args, statics = packed_call(dp, cfg, chunk, *a, **kw)
-    return np.asarray(
-        aot.call_or_compile("session_packed", session_packed, args, statics)
-    )
+    obs.metrics.count("solver.chunks")
+    with obs.span(
+        "solver.dispatch_chunk",
+        engine=statics["engine"], polish=statics["polish"],
+        leader=statics["leader"], max_moves=statics["max_moves"],
+    ):
+        return np.asarray(
+            aot.call_or_compile(
+                "session_packed", session_packed, args, statics
+            )
+        )
 
 
 # the one shared all-allowed detection (ops/tensorize.py), re-exported
@@ -988,6 +1003,7 @@ def _decode_packed(
     mslot = packed[ml : ml + n]
     mtgt = packed[2 * ml : 2 * ml + n]
     keep = _superseded_mask(mp, mslot) if drop_superseded else None
+    emitted = 0
     for i in range(n):
         part = dp.partitions[int(mp[i])]
         slot = int(mslot[i])
@@ -1006,6 +1022,11 @@ def _decode_packed(
         else:
             part.replicas[slot] = tgt
         opl.append(part)
+        emitted += 1
+    # committed vs emitted is the churn-elision attribution (-stats):
+    # device-side progress against what actually reaches the plan
+    obs.metrics.count("solver.moves_committed", n)
+    obs.metrics.count("solver.moves_emitted", emitted)
     return n
 
 
@@ -1104,7 +1125,8 @@ def _leader_plan(
 
     remaining = budget
     while remaining > 0:
-        dp = tensorize(pl, cfg)
+        with obs.span("tensorize"):
+            dp = tensorize(pl, cfg)
         all_allowed = all_allowed_of(dp)
         chunk = min(remaining, chunk_moves)
         packed = _dispatch_chunk(
@@ -1307,7 +1329,8 @@ def plan(
     remaining = budget
     while remaining > 0:
         # only the partition axis needs TILE_P alignment for the kernel
-        dp = tensorize(pl, cfg, min_bucket=TILE_P if use_pallas else 8)
+        with obs.span("tensorize"):
+            dp = tensorize(pl, cfg, min_bucket=TILE_P if use_pallas else 8)
         # the default FillDefaults outcome allows every broker everywhere
         # (detected by value, before the capacity gate — the all-allowed
         # kernel mode stores no [P, B] matrix and has a far higher ceiling)
@@ -1359,6 +1382,12 @@ def plan(
             raise
         except Exception as exc:
             if engine == "pallas" and _is_vmem_oom(exc):
+                obs.metrics.count("solver.pallas_fallbacks")
+                obs.metrics.event(
+                    "pallas_fallback",
+                    scoped=_is_scoped_vmem_oom(exc),
+                    error=type(exc).__name__,
+                )
                 # fall back to the XLA session for this chunk — same
                 # algorithm, HBM-resident state. A LASTING verdict is
                 # recorded only for the scoped-VMEM/Mosaic signatures

@@ -12,7 +12,11 @@
 #   5. cold-start smoke — fresh single-move CLI subprocess against a
 #                         temp AOT store, cache-cold then cache-warm
 #                         (docs/cold-start.md)
-#   6. tier-1 tests     — the ROADMAP.md verify suite (skip: --no-tests)
+#   6. observability    — run the CLI with -stats -metrics-json - on the
+#      smoke               example input; the metrics line must parse
+#                         and carry the schema version + lifecycle spans
+#                         (docs/observability.md)
+#   7. tier-1 tests     — the ROADMAP.md verify suite (skip: --no-tests)
 #
 # Exit 0 only when every stage that ran passed. Optional tools that are
 # not installed SKIP with a notice instead of failing: the gate must be
@@ -44,12 +48,13 @@ step "jaxlint (R1-R5)"
 step "annotation coverage (mypy --strict floor)"
 "$PYTHON" -m kafkabalancer_tpu.analysis --annotations \
   kafkabalancer_tpu/models kafkabalancer_tpu/ops kafkabalancer_tpu/codecs \
+  kafkabalancer_tpu/obs \
   || fail=1
 
-step "mypy --strict (models/ ops/ codecs/)"
+step "mypy --strict (models/ ops/ codecs/ obs/)"
 if command -v mypy >/dev/null 2>&1; then
   mypy --strict kafkabalancer_tpu/models kafkabalancer_tpu/ops \
-    kafkabalancer_tpu/codecs || fail=1
+    kafkabalancer_tpu/codecs kafkabalancer_tpu/obs || fail=1
 else
   echo "mypy not installed — skipped (annotation-coverage floor ran above)"
 fi
@@ -87,6 +92,27 @@ else
   echo "cache-cold invocation FAILED"; fail=1
 fi
 rm -rf "$smoke_tmp"
+
+step "observability smoke (-stats -metrics-json -)"
+# The flag trio end to end on the example input: the metrics line must
+# be the LAST stdout line (the plan precedes it), parse as JSON, and
+# carry the schema version + lifecycle spans — this is the stage that
+# catches a broken exporter or a schema drift before merge
+# (docs/observability.md).
+obs_out=$(JAX_PLATFORMS=cpu "$PYTHON" -m kafkabalancer_tpu -input-json \
+  -input tests/data/test.json -stats -metrics-json - 2>/dev/null | tail -n 1)
+if printf '%s' "$obs_out" | "$PYTHON" -c '
+import json, sys
+p = json.loads(sys.stdin.read())
+assert p["schema"] == "kafkabalancer-tpu.metrics/1", p.get("schema")
+assert p["rc"] == 0, p.get("rc")
+names = {s["name"] for s in p["spans"]}
+assert {"parse_input", "plan", "emit"} <= names, sorted(names)
+'; then
+  echo "metrics JSON: OK"
+else
+  echo "observability smoke FAILED"; fail=1
+fi
 
 if [ "$run_tests" = 1 ]; then
   step "tier-1 tests"

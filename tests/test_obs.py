@@ -1,0 +1,395 @@
+"""Invocation telemetry (kafkabalancer_tpu/obs): tracer semantics, the
+thread-safe registry, and the CLI's -stats/-metrics-json/-trace trio.
+
+The load-bearing pins:
+
+- cross-thread span parenting (the warmup/prefetch overlap engineered in
+  the cold-path PR must be VISIBLE, attributed to its background thread);
+- the metrics-JSON schema (golden file, versioned — the outer automation
+  loop and bench.py consume this instead of scraping stdout);
+- Perfetto/Chrome trace validity (JSON loads, monotonic ts, pid/tid
+  tracks, thread-name metadata);
+- disabled-path behavior: with the trio off nothing is written, and
+  error exits still never import jax EVEN WITH the trio on (obs/ is
+  jax-free by construction);
+- exporters fire on the exit-3/exit-4 error paths.
+"""
+
+import gzip
+import io
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from kafkabalancer_tpu import obs
+from kafkabalancer_tpu.obs import export as obs_export
+from kafkabalancer_tpu.obs.metrics import SCHEMA, MetricsRegistry
+from kafkabalancer_tpu.obs.trace import NOOP_SPAN, Tracer
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "data", "test.json")
+GOLDEN = os.path.join(
+    os.path.dirname(__file__), "data", "metrics_schema_v1.json"
+)
+
+
+def run_cli(args, stdin=""):
+    from kafkabalancer_tpu.cli import run
+
+    out, err = io.StringIO(), io.StringIO()
+    rv = run(io.StringIO(stdin), out, err, ["kafkabalancer"] + args)
+    return rv, out.getvalue(), err.getvalue()
+
+
+# --- tracer semantics -----------------------------------------------------
+
+
+def test_span_nesting_records_parents():
+    tr = Tracer()
+    tr.reset(enabled=True)
+    with tr.span("a"):
+        with tr.span("b"):
+            with tr.span("c"):
+                pass
+        with tr.span("d"):
+            pass
+    spans = {s["name"]: s for s in tr.snapshot()}
+    assert spans["a"]["parent"] is None
+    assert spans["b"]["parent"] == spans["a"]["sid"]
+    assert spans["c"]["parent"] == spans["b"]["sid"]
+    assert spans["d"]["parent"] == spans["a"]["sid"]
+    assert all(s["done"] for s in spans.values())
+    assert all(s["dur_us"] >= 0 for s in spans.values())
+
+
+def test_cross_thread_parenting():
+    """The CLI pattern: the spawner hands its launch span to the thread
+    body; the child's spans land on the child's tid but parent to it."""
+    tr = Tracer()
+    tr.reset(enabled=True)
+    with tr.span("launch") as parent:
+
+        def body():
+            with tr.span("worker", parent=parent):
+                with tr.span("inner"):
+                    pass
+
+        t = threading.Thread(target=body, name="warm-thread")
+        t.start()
+        t.join()
+    spans = {s["name"]: s for s in tr.snapshot()}
+    assert spans["worker"]["parent"] == spans["launch"]["sid"]
+    # nesting INSIDE the thread needs no explicit parent
+    assert spans["inner"]["parent"] == spans["worker"]["sid"]
+    assert spans["worker"]["tid"] != spans["launch"]["tid"]
+    assert spans["worker"]["thread"] == "warm-thread"
+
+
+def test_in_flight_spans_export_as_unfinished():
+    tr = Tracer()
+    tr.reset(enabled=True)
+    started = threading.Event()
+    release = threading.Event()
+
+    def body():
+        with tr.span("bg"):
+            started.set()
+            release.wait(30.0)
+
+    t = threading.Thread(target=body)
+    t.start()
+    assert started.wait(30.0)
+    snap = {s["name"]: s for s in tr.snapshot()}
+    assert snap["bg"]["done"] is False
+    release.set()
+    t.join(30.0)
+    snap = {s["name"]: s for s in tr.snapshot()}
+    assert snap["bg"]["done"] is True
+
+
+def test_disabled_tracer_is_noop_fast_path():
+    tr = Tracer()  # disabled by default
+    s = tr.span("x")
+    assert s is NOOP_SPAN  # one shared singleton, nothing allocated
+    with s:
+        with tr.span("y"):
+            pass
+    assert tr.snapshot() == []
+    assert tr.current() is None
+
+
+def test_snapshot_timestamps_monotone_in_record_order():
+    tr = Tracer()
+    tr.reset(enabled=True)
+    for i in range(20):
+        with tr.span(f"s{i}"):
+            pass
+    ts = [s["start_us"] for s in tr.snapshot()]
+    assert ts == sorted(ts)
+
+
+# --- registry -------------------------------------------------------------
+
+
+def test_registry_concurrent_mutation():
+    """The satellite pin: the old aot.stats was a bare dict setdefault'd
+    from two threads; the registry must absorb concurrent writers."""
+    reg = MetricsRegistry()
+
+    def body(k):
+        for i in range(1000):
+            reg.count("n")
+            reg.phase_set(f"g{k}", "v", float(i))
+            reg.event("e", k=k) if i % 100 == 0 else None
+
+    threads = [threading.Thread(target=body, args=(k,)) for k in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = reg.snapshot()
+    assert snap["counters"]["n"] == 8000
+    assert len(snap["phases"]) == 8
+
+
+def test_registry_event_cap_counts_drops():
+    from kafkabalancer_tpu.obs.metrics import _MAX_EVENTS
+
+    reg = MetricsRegistry()
+    for _ in range(_MAX_EVENTS + 10):
+        reg.event("x")
+    snap = reg.snapshot()
+    assert len(snap["events"]) == _MAX_EVENTS
+    assert snap["events_dropped"] == 10
+
+
+def test_aot_stats_alias_is_readonly_registry_view():
+    """ops.aot.stats survives as a read-only Mapping over the registry's
+    phase groups: lookups see registry writes, item assignment is gone,
+    clear() is the between-measurements reset the tests/bench idiom
+    needs."""
+    from kafkabalancer_tpu.ops import aot
+
+    obs.metrics.reset()
+    obs.metrics.phase_set("score_window", "prefetch", 1.0)
+    assert aot.stats["score_window"].get("prefetch") == 1.0
+    assert "score_window" in aot.stats
+    assert aot.stats.get("missing", {}) == {}
+    with pytest.raises(TypeError):
+        aot.stats["score_window"] = {}  # read-only: no item assignment
+    # lookups return copies — mutating one never writes through
+    view = aot.stats["score_window"]
+    view["prefetch"] = 99.0
+    assert aot.stats["score_window"]["prefetch"] == 1.0
+    aot.stats.clear()
+    assert "score_window" not in aot.stats
+
+
+# --- CLI flag trio --------------------------------------------------------
+
+
+def test_metrics_json_schema_golden(tmp_path):
+    """Golden-file pin: the payload's top-level keys, span keys, and the
+    schema string are VERSIONED — changing any of them must come with a
+    schema bump and a new golden."""
+    mpath = tmp_path / "m.json"
+    rv, _out, err = run_cli(
+        ["-input-json", "-input", FIXTURE, f"-metrics-json={mpath}"]
+    )
+    assert rv == 0, err
+    raw = mpath.read_text()
+    assert raw.endswith("\n") and "\n" not in raw[:-1]  # single line
+    payload = json.loads(raw)
+    with open(GOLDEN) as f:
+        golden = json.load(f)
+    assert payload["schema"] == golden["schema"] == SCHEMA
+    assert sorted(payload) == sorted(golden["top_level_keys"])
+    for sp in payload["spans"]:
+        base = set(golden["span_keys"])
+        assert base <= set(sp) <= base | {"attrs"}
+    for ev in payload["events"]:
+        assert set(golden["event_base_keys"]) <= set(ev)
+    names = {s["name"] for s in payload["spans"]}
+    assert {"validate_flags", "parse_input", "plan", "emit"} <= names
+    assert payload["rc"] == 0
+    assert payload["counters"]["cli.changes_written"] >= 1
+
+
+def test_metrics_json_dash_is_last_stdout_line():
+    rv, out, _err = run_cli(
+        ["-input-json", "-input", FIXTURE, "-metrics-json=-"]
+    )
+    assert rv == 0
+    lines = out.strip().splitlines()
+    assert lines[0].startswith('{"version"')  # the plan comes first
+    payload = json.loads(lines[-1])
+    assert payload["schema"] == SCHEMA
+
+
+def test_trace_file_is_valid_chrome_trace(tmp_path):
+    tpath = tmp_path / "t.json"
+    rv, _out, err = run_cli(
+        ["-input-json", "-input", FIXTURE, f"-trace={tpath}"]
+    )
+    assert rv == 0, err
+    with open(tpath) as f:
+        doc = json.load(f)
+    evs = doc["traceEvents"]
+    xs = [ev for ev in evs if ev["ph"] == "X"]
+    assert xs
+    for ev in xs:
+        assert {"name", "ph", "ts", "dur", "pid", "tid"} <= set(ev)
+        assert ev["ts"] >= 0 and ev["dur"] >= 0
+        assert ev["pid"] == os.getpid()
+    ts = [ev["ts"] for ev in xs]
+    assert ts == sorted(ts)  # recorded under one lock: start-ordered
+    # every tid carries a thread_name metadata track
+    tids = {ev["tid"] for ev in xs}
+    named = {
+        ev["tid"]
+        for ev in evs
+        if ev["ph"] == "M" and ev["name"] == "thread_name"
+    }
+    assert tids <= named
+
+
+def test_stats_summary_goes_to_stderr():
+    rv, _out, err = run_cli(["-input-json", "-input", FIXTURE, "-stats"])
+    assert rv == 0
+    assert "invocation telemetry" in err
+    assert "parse_input" in err and "emit" in err
+    assert "rc=0" in err
+
+
+def test_disabled_trio_writes_no_files(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    rv, _out, _err = run_cli(["-input-json", "-input", FIXTURE])
+    assert rv == 0
+    assert os.listdir(tmp_path) == []
+
+
+def test_exit3_error_path_still_exports(tmp_path):
+    mpath = tmp_path / "m.json"
+    rv, _out, _err = run_cli(
+        ["-input-json", "-max-reassign=-1", f"-metrics-json={mpath}"]
+    )
+    assert rv == 3
+    payload = json.loads(mpath.read_text())
+    assert payload["rc"] == 3 and payload["schema"] == SCHEMA
+    # the lifecycle got as far as flag validation — and said so
+    assert "validate_flags" in {s["name"] for s in payload["spans"]}
+
+
+def test_exit4_error_path_still_exports(tmp_path):
+    class Boom(io.StringIO):
+        def write(self, s):
+            raise OSError("sink failed")
+
+    from kafkabalancer_tpu.cli import run
+
+    mpath = tmp_path / "m.json"
+    with open(FIXTURE) as f:
+        src = f.read()
+    rv = run(
+        io.StringIO(src), Boom(), io.StringIO(),
+        ["kafkabalancer", "-input-json", f"-metrics-json={mpath}"],
+    )
+    assert rv == 4
+    payload = json.loads(mpath.read_text())
+    assert payload["rc"] == 4
+    assert "emit" in {s["name"] for s in payload["spans"]}
+
+
+def test_flag_error_exit_with_trio_never_imports_jax(tmp_path):
+    """The cold-path guarantee (tests/test_coldstart.py) must survive
+    the full telemetry trio: obs/ is jax-free, so an argument-error exit
+    with -stats -metrics-json -trace all enabled still exits 3 without
+    touching jax — and still exports."""
+    mpath = str(tmp_path / "m.json")
+    tpath = str(tmp_path / "t.json")
+    code = (
+        "import io, sys\n"
+        "from kafkabalancer_tpu.cli import run\n"
+        "rc = run(io.StringIO(''), io.StringIO(), io.StringIO(),\n"
+        "         ['kafkabalancer', '-input-json', '-solver=tpu',\n"
+        f"          '-max-reassign=-1', '-stats', '-metrics-json={mpath}',\n"
+        f"          '-trace={tpath}'])\n"
+        "assert rc == 3, rc\n"
+        "bad = [m for m in sys.modules if m == 'jax' or m.startswith('jax.')]\n"
+        "assert not bad, f'jax imported on an error exit: {bad[:3]}'\n"
+        "assert 'kafkabalancer_tpu.solvers.scan' not in sys.modules\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert json.loads(open(mpath).read())["rc"] == 3
+    assert json.load(open(tpath))["traceEvents"]
+
+
+def test_fused_lifecycle_spans_cover_background_warmup(tmp_path, monkeypatch):
+    """Acceptance pin: a -fused run's metrics JSON carries the lifecycle
+    — parse, the warmup on its own BACKGROUND thread (parented to the
+    launch site), the session dispatch, and emit."""
+    monkeypatch.setenv("KAFKABALANCER_TPU_NO_AOT", "1")
+    mpath = tmp_path / "m.json"
+    rv, _out, err = run_cli(
+        ["-input-json", "-input", FIXTURE, "-fused", "-fused-batch=4",
+         "-max-reassign=4", f"-metrics-json={mpath}"]
+    )
+    assert rv == 0, err
+    payload = json.loads(mpath.read_text())
+    spans = payload["spans"]
+    by_name = {}
+    for s in spans:
+        by_name.setdefault(s["name"], []).append(s)
+    names = set(by_name)
+    assert {
+        "parse_input", "warm_thread_launch", "plan",
+        "solver.dispatch_chunk", "tensorize", "emit",
+    } <= names, sorted(names)
+    launch = by_name["warm_thread_launch"][0]
+    warm = by_name["coldstart.warm"][0]
+    assert warm["thread"] != launch["thread"]  # its own thread track...
+    assert warm["parent"] == launch["sid"]  # ...linked to the launch site
+    # the fused dispatch is nested under the plan span
+    plan_sids = {s["sid"] for s in by_name["plan"]}
+    assert by_name["solver.dispatch_chunk"][0]["parent"] in plan_sids
+    # and the session counters made it into the registry
+    assert payload["counters"]["solver.chunks"] >= 1
+    assert payload["counters"]["solver.moves_committed"] >= 1
+
+
+# --- -pprof-path satellite ------------------------------------------------
+
+
+def test_pprof_path_flag_redirects_profile(tmp_path):
+    p = tmp_path / "prof.pb.gz"
+    rv, _out, _err = run_cli(
+        ["-input-json", "-input", FIXTURE, "-pprof", f"-pprof-path={p}"]
+    )
+    assert rv == 0
+    assert gzip.open(p, "rb").read()  # gzipped profile.proto, non-empty
+
+
+def test_pprof_default_path_unchanged(tmp_path, monkeypatch):
+    monkeypatch.chdir(tmp_path)
+    rv, _out, _err = run_cli(["-input-json", "-input", FIXTURE, "-pprof"])
+    assert rv == 0
+    assert (tmp_path / "cpu.pprof").exists()
+
+
+def test_pprof_write_failure_logged_not_fatal(tmp_path):
+    bad = tmp_path / "no-such-dir" / "cpu.pprof"
+    rv, _out, err = run_cli(
+        ["-input-json", "-input", FIXTURE, "-pprof", f"-pprof-path={bad}"]
+    )
+    assert rv == 0  # the plan must not fail on a profile-write failure
+    assert "failed writing cpu profile" in err
