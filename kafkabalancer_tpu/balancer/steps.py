@@ -16,8 +16,12 @@ Differences from the reference are intentional and documented:
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import List, Optional, Sequence, Tuple
 
+# numpy is imported lazily inside scan_moves: balancer.steps sits on the
+# import path of the daemon's jax-free forwarding client (cli -> balancer
+# -> steps), and a module-level numpy import would put ~0.1 s back into
+# every forwarded invocation's startup — the exact cost serving removes
 from kafkabalancer_tpu.balancer.costmodel import (
     get_bl,
     get_broker_list,
@@ -27,6 +31,7 @@ from kafkabalancer_tpu.balancer.costmodel import (
     get_unbalance_bl,
 )
 from kafkabalancer_tpu.models import Partition, PartitionList, RebalanceConfig
+from kafkabalancer_tpu.models.config import HOST_FLOAT_DTYPE
 from kafkabalancer_tpu.models.partition import single_partition_list
 
 
@@ -264,8 +269,9 @@ def greedy_move(
     su = get_unbalance_bl(bl)
     cu = su
 
-    for p in pl.iter_partitions():
-        cu, best = scan_partition_move(p, bl, cu, best, cfg, leaders)
+    cu, best, _pos = scan_moves(
+        list(pl.iter_partitions()), bl, cu, best, cfg, leaders
+    )
 
     if cu < su - cfg.min_unbalance:
         p, r, b = best
@@ -326,6 +332,121 @@ def scan_partition_move(
         bl[ridx][1] = rload
 
     return cu, best
+
+
+# batched scan: candidates per numpy chunk — bounds the what-if matrix at
+# ~chunk×B doubles while keeping the column accumulation loop long enough
+# to amortize per-op numpy overhead
+_SCAN_CHUNK = 8192
+
+
+def scan_moves(
+    parts: Sequence[Partition],
+    bl,
+    cu: float,
+    best: Optional[tuple],
+    cfg: RebalanceConfig,
+    leaders: bool,
+) -> "Tuple[float, Optional[tuple], int]":
+    """Vectorized replay of :func:`scan_partition_move` over ``parts`` in
+    order — same ``(cu, best)`` to the last bit, plus the index into
+    ``parts`` of the partition contributing ``best`` (``-1`` when ``best``
+    is returned unchanged).
+
+    Bit parity holds by construction, not by tolerance: every candidate's
+    what-if table is the base ``bl`` loads with the source cell decremented
+    and the target cell incremented (the exact two IEEE-754 ops the scalar
+    scan performs), and the objective is accumulated COLUMN BY COLUMN in
+    ``bl`` order — each candidate row sees the identical left-to-right
+    float addition sequence, division-by-zero/NaN semantics included, that
+    :func:`kafkabalancer_tpu.balancer.costmodel.get_unbalance_bl` runs.
+    First-strict-improver selection is then the first candidate, in
+    (partition, replica, bl-rank) enumeration order, attaining the global
+    minimum — which is the first index of that minimum in the scored
+    vector. The scalar scan remains the oracle; the randomized differential
+    pin is tests/test_steps.py.
+    """
+    import numpy as np  # deferred: keep the jax-free client import-light
+
+    nb = len(bl)
+    base = np.array([cell[1] for cell in bl], dtype=HOST_FLOAT_DTYPE)
+    bl_bids = np.array([cell[0] for cell in bl], dtype=np.int64)
+    bid_to_idx = {int(b): i for i, b in enumerate(bl_bids)}
+
+    # -- enumerate candidates (the scalar scan's exact order) -------------
+    src_l: List[np.ndarray] = []
+    tgt_l: List[np.ndarray] = []
+    w_l: List[np.ndarray] = []
+    pos_l: List[np.ndarray] = []
+    r_l: List[np.ndarray] = []
+    allowed_memo: dict = {}  # brokers-list identity -> bl eligibility mask
+    for pos, p in enumerate(parts):
+        if p.num_replicas < cfg.min_replicas_for_rebalancing:
+            continue
+        movable = p.replicas[0:1] if leaders else p.replicas[1:]
+        if not movable:
+            continue
+        am = allowed_memo.get(id(p.brokers))
+        if am is None:
+            am = np.isin(bl_bids, np.asarray(list(p.brokers), dtype=np.int64))
+            allowed_memo[id(p.brokers)] = am
+        elig = np.nonzero(
+            am & ~np.isin(bl_bids, np.asarray(p.replicas, dtype=np.int64))
+        )[0]
+        for r in movable:
+            ridx = bid_to_idx.get(r)
+            if ridx is None:
+                raise BalanceError(
+                    f"assertion failed: replica {r} not in broker loads {bl}"
+                )
+            n = len(elig)
+            if n == 0:
+                continue
+            tgt_l.append(elig.astype(np.int64))
+            src_l.append(np.full(n, ridx, dtype=np.int64))
+            w_l.append(np.full(n, p.weight, dtype=HOST_FLOAT_DTYPE))
+            pos_l.append(np.full(n, pos, dtype=np.int64))
+            r_l.append(np.full(n, r, dtype=np.int64))
+    if not tgt_l:
+        return cu, best, -1
+    src = np.concatenate(src_l)
+    tgt = np.concatenate(tgt_l)
+    w = np.concatenate(w_l)
+    ppos = np.concatenate(pos_l)
+    rids = np.concatenate(r_l)
+
+    # -- score chunks; replay the running strict-< minimum across them ----
+    winner = -1
+    for lo in range(0, len(src), _SCAN_CHUNK):
+        hi = min(lo + _SCAN_CHUNK, len(src))
+        n = hi - lo
+        mat = np.tile(base, (n, 1))
+        rows = np.arange(n)
+        mat[rows, src[lo:hi]] -= w[lo:hi]
+        mat[rows, tgt[lo:hi]] += w[lo:hi]
+        with np.errstate(divide="ignore", invalid="ignore"):
+            s = np.zeros(n, dtype=HOST_FLOAT_DTYPE)
+            for j in range(nb):
+                s = s + mat[:, j]
+            avg = s / float(nb)
+            u = np.zeros(n, dtype=HOST_FLOAT_DTYPE)
+            for j in range(nb):
+                rel = mat[:, j] / avg - 1.0
+                sq = rel * rel
+                u = u + np.where(rel > 0, sq, sq / 2)
+        finite = u[~np.isnan(u)]
+        if finite.size == 0:
+            continue  # all-NaN objectives never beat cu (NaN < cu is False)
+        mn = float(finite.min())
+        if mn < cu:
+            cu = mn
+            k = lo + int(np.flatnonzero(u == mn)[0])
+            winner = k
+    if winner < 0:
+        return cu, best, -1
+    pos = int(ppos[winner])
+    best = (parts[pos], int(rids[winner]), int(bl_bids[tgt[winner]]))
+    return cu, best, pos
 
 
 def distribute_leaders(

@@ -41,6 +41,11 @@ store is evicted LRU (``last_used`` from the manifest) down to the
 Read path: ``try_load`` is corruption-tolerant by contract — a missing
 shard, truncated blob, stale jax, or undecodable manifest entry drops
 the entry and returns None, and the caller recompiles; it never raises.
+Entries record the SAVING backend platform and a blob digest: an entry
+saved by a different platform, or a program this platform has proven it
+cannot deserialize (the ``noload.json`` sidecar — e.g. XLA:CPU's
+"Symbols not found" on the fused session blob), is a clean
+platform-keyed MISS with no blob read, no staging, and no prune.
 ``prefetch`` begins the load on a background thread keyed by *predicted*
 dummy args (same shapes/dtypes — the executable does not depend on
 values), so a CLI process overlaps blob read + deserialize with input
@@ -94,7 +99,42 @@ _SALT_MODULES = (
 )
 
 _source_salt: Optional[str] = None
+# deserialized executables resident in this process, LRU-bounded: the
+# on-disk store has byte-cap eviction but a long-lived serving process
+# (serve/daemon.py) would otherwise accumulate one device-resident
+# executable per (program, shape bucket, flag combo) forever as the
+# outer loop's cluster drifts across bucket boundaries. Insertion order
+# doubles as recency (hits re-insert); the stateless CLI never comes
+# near the cap.
 _loaded: Dict[str, Any] = {}
+_LOADED_CAP_ENV = "KAFKABALANCER_TPU_LOADED_CAP"
+
+
+def _loaded_cap() -> int:
+    try:
+        return int(os.environ.get(_LOADED_CAP_ENV, "64"))
+    except ValueError:
+        return 64
+
+
+def _loaded_get(key: str) -> Any:
+    """Resident executable for ``key`` (refreshing its recency), or
+    None."""
+    compiled = _loaded.pop(key, None)
+    if compiled is not None:
+        _loaded[key] = compiled
+    return compiled
+
+
+def _loaded_put(key: str, compiled: Any) -> None:
+    """Insert at most-recent position, evicting least-recent past the
+    cap (cap <= 0 disables the bound)."""
+    _loaded.pop(key, None)
+    _loaded[key] = compiled
+    cap = _loaded_cap()
+    while cap > 0 and len(_loaded) > cap:
+        _loaded.pop(next(iter(_loaded)), None)
+        obs.metrics.count("aot.resident_evictions")
 # per-name phase timings of the LAST dispatch (load/exec/jit seconds,
 # blob MB, prefetch/staged markers) — bench.py's cold children read these
 # to attribute the stateless per-invocation cost between transport,
@@ -185,6 +225,118 @@ def aot_dir() -> Optional[str]:
     if cache is None:
         return None
     return os.path.join(cache, "aot")
+
+
+def _platform() -> str:
+    """The attached backend's platform string (``cpu``/``tpu``/...)."""
+    import jax
+
+    return str(jax.devices()[0].platform).lower()
+
+
+# --- platform-keyed load gating ------------------------------------------
+#
+# Serialization is not symmetric across backends: XLA:CPU serializes the
+# fused while_loop session executable but CANNOT deserialize it back in a
+# fresh process ("Symbols not found"), so every cold CPU invocation used
+# to pay a doomed blob read + deserialize + entry prune + recompile +
+# re-save cycle. The manifest now records the SAVING platform per entry,
+# and a deserialize failure on an INTACT (md5-verified) entry saved by
+# this very platform is a deterministic (program, platform) property —
+# recorded in a sidecar (``noload.json``) so every later load is a clean
+# platform-keyed MISS: no read, no staging, no prune, and the entry
+# survives for readers that can use it. Verdicts are keyed by
+# ``platform|jax-version`` (a jax upgrade may well fix the deserializer,
+# so a verdict must not outlive the runtime that earned it), and
+# transient-looking failures (resource exhaustion, relay unavailability)
+# record nothing — the pre-existing self-healing prune/recompile
+# contract stays intact for them. Sidecar (not the manifest) so older
+# builds rewriting the manifest cannot drop the verdicts.
+_NOLOAD = "noload.json"
+# per-store memo (keyed by directory: tests and multi-store processes
+# must not leak one store's verdicts into another)
+_noload_mem: Dict[str, Dict[str, List[str]]] = {}
+
+
+def _noload_read(d: str) -> Dict[str, List[str]]:
+    cached = _noload_mem.get(d)
+    if cached is not None:
+        return cached
+    verdicts: Dict[str, List[str]] = {}
+    try:
+        path = os.path.join(d, _NOLOAD)
+        if os.path.exists(path):
+            with open(path) as f:
+                obj = json.load(f)
+            if isinstance(obj, dict):
+                for k, v in obj.items():
+                    if isinstance(v, list):
+                        verdicts[str(k)] = [str(n) for n in v]
+    except Exception:
+        pass  # unreadable sidecar = empty sidecar
+    _noload_mem[d] = verdicts
+    return verdicts
+
+
+def _noload_record(d: str, scope: str, name: str) -> None:
+    """Record that ``name`` cannot be deserialized under ``scope`` (a
+    ``platform|jax-version`` key from :func:`_noload_key`)."""
+    verdicts = _noload_read(d)
+    blocked = verdicts.setdefault(scope, [])
+    if name in blocked:
+        return
+    blocked.append(name)
+    obs.metrics.count("aot.noload_records")
+    obs.metrics.event("aot_noload_record", scope=scope, name=name)
+    _log(f"noload {name} on {scope}: deserialize is a lasting miss")
+    try:
+        # merge-write like the pallas gate: another process's verdicts
+        # must not be clobbered by this one's stale in-memory copy
+        path = os.path.join(d, _NOLOAD)
+        if os.path.exists(path):
+            with open(path) as f:
+                on_disk = json.load(f)
+            if isinstance(on_disk, dict):
+                for k, v in on_disk.items():
+                    if isinstance(v, list):
+                        cur = verdicts.setdefault(str(k), [])
+                        cur.extend(str(n) for n in v if str(n) not in cur)
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+        with os.fdopen(fd, "w") as f:
+            json.dump(verdicts, f, sort_keys=True)
+        os.replace(tmp, path)
+    except Exception:
+        pass
+
+
+def _noload_key() -> str:
+    """Verdict scope: this platform under this jax — an upgrade earns a
+    fresh chance to deserialize."""
+    import jax
+
+    return f"{_platform()}|{jax.__version__}"
+
+
+def _is_deterministic_noload_error(exc: BaseException) -> bool:
+    """Only failure flavors that PROVE a deterministic deserializer gap
+    earn a lasting noload verdict. Everything unrecognized — resource
+    pressure, relay connectivity, a generic RuntimeError — fails open:
+    this load is a plain miss and the next process retries, because a
+    wrong lasting verdict silently disables the whole AOT win for the
+    program until the sidecar is hand-deleted."""
+    msg = f"{type(exc).__name__}: {exc}".lower()
+    return (
+        # XLA:CPU refusing its own fused while_loop session blob
+        "symbols not found" in msg
+        # a deserializer telling us outright it cannot do this
+        or "unimplemented" in msg
+    )
+
+
+def _load_blocked(d: str, name: str) -> bool:
+    """True when this platform+jax is known-unable to deserialize
+    ``name``'s stored executables — the clean platform-keyed miss."""
+    return name in _noload_read(d).get(_noload_key(), ())
 
 
 _exec_devices_kwarg: Optional[bool] = None
@@ -427,6 +579,11 @@ def _evict_to_cap(d: str, keep_key: Optional[str] = None) -> None:
     for fname in listing:
         if fname == _MANIFEST or fname in referenced:
             continue
+        if not (fname.endswith(".bin") or fname.endswith(".tmp")):
+            # sidecars (pallas_gate.json, noload.json) and anything else
+            # that is neither a blob shard nor a write-in-flight are not
+            # this sweep's to reclaim
+            continue
         if keep_key and fname.startswith(keep_key):
             continue
         path = os.path.join(d, fname)
@@ -519,7 +676,8 @@ def _read_blob(d: str, key: str) -> Optional[bytes]:
 
 
 def _write_blob(
-    d: str, key: str, name: str, sig: List[str], blob: bytes
+    d: str, key: str, name: str, sig: List[str], blob: bytes,
+    platform: str = "",
 ) -> str:
     """Shard + compress + atomically write ``blob``; returns the first
     shard's path. The manifest entry lands only after every shard is in
@@ -556,6 +714,13 @@ def _write_blob(
                 "raw_bytes": len(blob),
                 "stored_bytes": stored,
                 "sig": sig,
+                # the SAVING backend platform + blob digest: together
+                # they let the read path tell "this platform cannot
+                # deserialize its own intact blob" (a deterministic
+                # property worth a lasting noload verdict) from plain
+                # corruption (prune + recompile, as ever)
+                "platform": platform,
+                "md5": hashlib.md5(blob).hexdigest(),
                 "created": now,
                 "last_used": now,
             }
@@ -615,14 +780,31 @@ def try_load(
         if th.is_alive():
             obs.metrics.event("aot_prefetch_join_timeout", name=name)
             return None
-    if key in _loaded:
-        return _loaded[key]
+    compiled_hit = _loaded_get(key)
+    if compiled_hit is not None:
+        return compiled_hit
     try:
         import jax
         from jax.experimental.serialize_executable import (
             deserialize_and_load,
         )
 
+        plat = _platform()
+        if _load_blocked(d, name):
+            # this platform is known-unable to deserialize this program:
+            # a clean miss — no blob read, no prune, entry untouched
+            obs.metrics.count("aot.noload_skips")
+            return None
+        entry = _manifest_read(d).get(key)
+        if entry is not None:
+            saved_plat = entry.get("platform")
+            if saved_plat and saved_plat != plat:
+                # saved by a different backend: deserialization is
+                # doomed, and pruning would destroy a blob the saving
+                # platform still serves from — clean platform-keyed miss
+                obs.metrics.count("aot.platform_skips")
+                _log(f"skip {name}: saved by {saved_plat}, running {plat}")
+                return None
         with obs.span("aot.load", program=name):
             t0 = time.perf_counter()
             blob = _read_blob(d, key)
@@ -640,8 +822,36 @@ def try_load(
             kwargs: Dict[str, Any] = {}
             if _supports_execution_devices(deserialize_and_load):
                 kwargs["execution_devices"] = jax.devices()[:1]
-            compiled = deserialize_and_load(blob, in_tree, out_tree, **kwargs)
-        _loaded[key] = compiled  # repeat chunks skip re-deserialization
+            try:
+                compiled = deserialize_and_load(
+                    blob, in_tree, out_tree, **kwargs
+                )
+            except Exception as exc:
+                if (
+                    entry is not None
+                    and entry.get("platform") == plat
+                    and entry.get("md5")
+                    and hashlib.md5(blob).hexdigest() == entry["md5"]
+                ):
+                    # the saving platform cannot read its own INTACT
+                    # blob back (XLA:CPU "Symbols not found" on the
+                    # fused session) — a deterministic (program,
+                    # platform, jax) property: record it so every later
+                    # load is a clean miss, and KEEP the entry (the
+                    # bytes are verifiably the saved ones; pruning
+                    # would just re-trigger the save on the next jit
+                    # dispatch). Anything not on the deterministic
+                    # allowlist (OOM under device pressure, relay
+                    # unavailability, any unrecognized error) records
+                    # NOTHING — this load is simply a miss and the next
+                    # process retries. A digest mismatch means
+                    # corruption instead, and falls through to
+                    # prune-and-recompile.
+                    if _is_deterministic_noload_error(exc):
+                        _noload_record(d, _noload_key(), name)
+                    return None
+                raise  # corruption / pre-v2.1 entry: corrupt-drop path
+        _loaded_put(key, compiled)  # repeat chunks skip re-deserialization
         dt = time.perf_counter() - t0
         obs.metrics.phase_set(name, "load_s", dt)
         obs.metrics.phase_set(name, "blob_mb", len(blob) / 1e6)
@@ -680,6 +890,8 @@ def prefetch(
     key = aot_key(name, args, statics)
     if key in _loaded:
         return key
+    if _load_blocked(d, name):
+        return None  # a known platform-keyed miss: no speculative I/O
     # captured on the CALLING thread: the loader runs on its own track
     # but stays parented to the invocation site that asked for it
     parent = obs.current_span()
@@ -770,19 +982,24 @@ def maybe_save(
         key = aot_key(name, args, statics)
         if _entry_exists(d, key):
             return None
+        if _load_blocked(d, name):
+            # this platform can never read the blob back — serializing
+            # and shipping it would be pure waste on every recompile
+            return None
         from jax.experimental.serialize_executable import serialize
 
         with obs.span("aot.save", parent=trace_parent, program=name):
             compiled = fn.lower(*args, **statics).compile()
             blob, _in_tree, _out_tree = serialize(compiled)
             path = _write_blob(
-                d, key, name, _key_parts(name, args, statics), blob
+                d, key, name, _key_parts(name, args, statics), blob,
+                platform=_platform(),
             )
         obs.metrics.count("aot.saves")
         # memoize: the just-compiled executable serves this process's
         # next chunk directly — without this, chunk 2 would re-read and
         # re-ship the multi-MB blob the device already has resident
-        _loaded[key] = compiled
+        _loaded_put(key, compiled)
         return path
     except Exception:
         return None
@@ -844,7 +1061,11 @@ def call_or_compile(
     d = aot_dir()
     if d is not None:
         key = aot_key(name, args, statics)
-        if (
+        if key not in _loaded and _load_blocked(d, name):
+            # known platform-keyed miss: skip the doomed staging too —
+            # a duplicate of every input on the device buys nothing
+            pass
+        elif (
             key in _loaded
             or key in _inflight
             or _entry_exists(d, key)

@@ -24,7 +24,7 @@ Conventions:
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Iterable, List, Optional, Sequence
+from typing import Any, Iterable, List, Optional, Sequence
 
 import numpy as np
 
@@ -113,6 +113,70 @@ def broker_universe(
     return np.asarray(sorted(seen), dtype=np.int64)
 
 
+def encode_allowed_row(
+    brokers: Optional[Sequence[int]],
+    ids: np.ndarray,
+    nb: int,
+    B: int,
+) -> np.ndarray:
+    """Dense allowed-brokers mask for ONE partition row.
+
+    The single definition of the allowed-row semantics (None ⇒ every
+    real broker; allowed-but-unobserved IDs drop out, see
+    :func:`broker_universe`) — both the full encode below and the
+    incremental patch path (serve/cache.py) call this, so a served
+    cache hit can never diverge from a full re-encode.
+    """
+    row = np.zeros(B, dtype=bool)
+    if brokers is None:
+        row[:nb] = True
+    elif nb:
+        want = np.asarray(list(brokers), dtype=np.int64)
+        pos = np.searchsorted(ids, want)
+        pos = pos[(pos < nb) & (ids[np.minimum(pos, nb - 1)] == want)]
+        row[pos] = True
+    return row
+
+
+def dense_replica_row(
+    replicas: Sequence[int], ids: np.ndarray
+) -> Optional[np.ndarray]:
+    """Broker IDs → dense universe indices for ONE partition's replica
+    list, or None when any ID is outside the universe. The per-row spec
+    of the id→index rule; the full encode's flat vectorized searchsorted
+    pass implements the same mapping (the universe contains every
+    observed replica by construction, so it needs no None case), and
+    the incremental patch path uses the None case to detect vocabulary
+    drift and fall back to the full encode."""
+    nb = len(ids)
+    want = np.asarray(replicas, dtype=np.int64)
+    pos = np.searchsorted(ids, want)
+    if want.size and (
+        np.any(pos >= nb) or np.any(ids[np.minimum(pos, nb - 1)] != want)
+    ):
+        return None
+    return pos.astype(np.int32)
+
+
+# Optional incremental row cache (serve/cache.py TensorizeRowCache or
+# any duck-typed equivalent), installed by the planning daemon so the
+# outer loop's mostly-unchanged input re-encodes only its changed rows.
+# Typed Any to keep the layering: ops/ must not import serve/.
+_row_cache: Optional[Any] = None
+
+
+def set_row_cache(cache: Optional[Any]) -> None:
+    """Install (or, with None, remove) the process-wide incremental
+    tensorize cache. The stateless CLI path never installs one; the
+    daemon does at startup."""
+    global _row_cache
+    _row_cache = cache
+
+
+def row_cache() -> Optional[Any]:
+    return _row_cache
+
+
 def tensorize(
     pl: PartitionList,
     cfg: Optional[RebalanceConfig] = None,
@@ -143,6 +207,27 @@ def tensorize(
     P = next_bucket(np_real, min_bucket)
     R = next_bucket(rmax, max(2, min_replica_bucket))
     B = next_bucket(nb, min_broker_bucket)
+
+    cache = _row_cache
+    if cache is not None:
+        cached = cache.lookup(parts, ids, P, R, B)
+        if cached is not None:
+            a = cached["arrays"]
+            return DensePlan(
+                broker_ids=ids,
+                weights=a["weights"],
+                replicas=a["replicas"],
+                nrep_cur=a["nrep_cur"],
+                nrep_tgt=a["nrep_tgt"],
+                ncons=a["ncons"],
+                allowed=a["allowed"],
+                member=a["member"],
+                pvalid=a["pvalid"],
+                bvalid=a["bvalid"],
+                topic_id=a["topic_id"],
+                topics=cached["topics"],
+                partitions=parts,
+            )
 
     weights = np.zeros(P, dtype=HOST_FLOAT_DTYPE)
     replicas = np.full((P, R), -1, dtype=np.int32)
@@ -197,19 +282,29 @@ def tensorize(
                 None if p.brokers is None else id(p.brokers), (p.brokers, [])
             )[1].append(i)
         for brokers, rows_i in groups.values():
-            row = np.zeros(B, dtype=bool)
-            if brokers is None:
-                row[:nb] = True
-            elif nb:
-                # allowed-but-unobserved IDs drop out: see broker_universe
-                want = np.asarray(list(brokers), dtype=np.int64)
-                pos = np.searchsorted(ids, want)
-                pos = pos[(pos < nb) & (ids[np.minimum(pos, nb - 1)] == want)]
-                row[pos] = True
+            row = encode_allowed_row(brokers, ids, nb, B)
             allowed[np.asarray(rows_i, dtype=np.int64)] = row
 
     rows, cols = np.nonzero(replicas >= 0)
     member[rows, replicas[rows, cols]] = True
+
+    if cache is not None:
+        cache.prime(
+            parts, ids, P, R, B,
+            {
+                "weights": weights,
+                "replicas": replicas,
+                "nrep_cur": nrep_cur,
+                "nrep_tgt": nrep_tgt,
+                "ncons": ncons,
+                "allowed": allowed,
+                "member": member,
+                "pvalid": pvalid,
+                "bvalid": bvalid,
+                "topic_id": topic_id,
+            },
+            topics,
+        )
 
     return DensePlan(
         broker_ids=ids,

@@ -542,6 +542,7 @@ def plan_sharded(
     from kafkabalancer_tpu.solvers.scan import (
         _cfg_broker_mask,
         _decode_packed,
+        _dev_cached_asarray,
         _dispatch_chunk,
         _pack_log,
         _prep_from_dp,
@@ -671,11 +672,16 @@ def plan_sharded(
     # the committed-device fast path
     multiproc = len({d.process_index for d in mesh.devices.flat}) > 1
 
+    # ONE device-upload cache for the whole session: multi-chunk sessions
+    # re-tensorize between chunks, but weights/allowed/validity content
+    # never changes under moves — reuse the device-resident buffers
+    # instead of re-uploading them per chunk (scan._dev_cached_asarray)
+    dev_cache: dict = {}
     remaining = budget
     while remaining > 0:
         dp = tensorize(pl, cfg, min_bucket=min_bucket)
         all_allowed, (loads, w_dev, nc_dev, allowed_dev, _ew) = (
-            _prep_from_dp(dp, dtype)
+            _prep_from_dp(dp, dtype, dev_cache=dev_cache)
         )
         chunk = min(remaining, chunk_moves)
         if anti_colocation:
@@ -712,23 +718,28 @@ def plan_sharded(
                 mesh,
             )
         else:
+            # the session-invariant inputs ride the same device-upload
+            # cache as _prep_from_dp's; replicas/member change per chunk
+            # and miss by digest, which replaces their slot
             args = (
                 loads,
-                jnp.asarray(dp.replicas),
-                jnp.asarray(dp.member),
+                _dev_cached_asarray(dev_cache, "s.replicas", dp.replicas),
+                _dev_cached_asarray(dev_cache, "s.member", dp.member),
                 allowed_dev,
                 w_dev,
-                jnp.asarray(dp.nrep_cur),
-                jnp.asarray(dp.nrep_tgt),
+                _dev_cached_asarray(dev_cache, "s.nrep_cur", dp.nrep_cur),
+                _dev_cached_asarray(dev_cache, "s.nrep_tgt", dp.nrep_tgt),
                 nc_dev,
-                jnp.asarray(dp.pvalid),
-                jnp.asarray(_cfg_broker_mask(dp, cfg)),
-                jnp.asarray(dp.bvalid),
+                _dev_cached_asarray(dev_cache, "s.pvalid", dp.pvalid),
+                _dev_cached_asarray(
+                    dev_cache, "s.cfg_mask", _cfg_broker_mask(dp, cfg)
+                ),
+                _dev_cached_asarray(dev_cache, "s.bvalid", dp.bvalid),
                 jnp.int32(cfg.min_replicas_for_rebalancing),
                 jnp.asarray(cfg.min_unbalance, dtype),
                 jnp.int32(chunk),
                 jnp.asarray(churn_gate, dtype),
-                jnp.asarray(tid_np),
+                _dev_cached_asarray(dev_cache, "s.tid", tid_np),
                 jnp.asarray(lam_np),
             )
         try:

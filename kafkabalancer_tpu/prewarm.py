@@ -119,6 +119,61 @@ def _programs_for_shape(
     return out
 
 
+def warm_store(
+    shapes: str,
+    *,
+    batch: int = 100,
+    polish: bool = False,
+    allow_leader: bool = False,
+    max_reassign: int = 1 << 19,
+    rf: int = 3,
+    single_move: bool = True,
+    fused: bool = True,
+    load: bool = False,
+) -> Dict[str, int]:
+    """Programmatic prewarm of the AOT store for a shape grid — the
+    library seam behind ``-serve-prewarm`` (serve/daemon.py): the daemon
+    calls it at startup so request 1 starts from stored executables.
+
+    ``load=True`` additionally deserializes every entry into the
+    in-process cache (``aot._loaded``) right away, making the
+    executables device/memory-resident before the first request arrives
+    (a stored-but-unloaded entry still costs the blob read + deserialize
+    on first dispatch). Returns ``{"written", "hit", "failed",
+    "loaded"}`` counts; ``{"error": 1}``-style failures never raise past
+    the caller (a warm failure must cost latency, not availability).
+    """
+    from kafkabalancer_tpu.models.config import default_dtype
+    from kafkabalancer_tpu.ops import aot
+    from kafkabalancer_tpu.ops.runtime import ensure_x64
+
+    ensure_x64()
+    d = aot.aot_dir()
+    counts = {"written": 0, "hit": 0, "failed": 0, "loaded": 0}
+    if d is None:
+        return counts
+    ns = argparse.Namespace(
+        rf=rf, max_reassign=max_reassign, batch=batch, polish=polish,
+        allow_leader=allow_leader, single_move=single_move, fused=fused,
+    )
+    dtype = default_dtype()
+    for n_parts, n_brokers in _parse_shapes(shapes):
+        for name, fn, args, statics in _programs_for_shape(
+            n_parts, n_brokers, ns, dtype
+        ):
+            key = aot.aot_key(name, args, statics)
+            if aot._entry_exists(d, key):
+                counts["hit"] += 1
+            elif aot.maybe_save(name, fn, args, statics) is not None:
+                counts["written"] += 1
+            else:
+                counts["failed"] += 1
+                continue
+            if load and aot.try_load(name, args, statics, key=key) is not None:
+                counts["loaded"] += 1
+    return counts
+
+
 def run(argv: List[str]) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m kafkabalancer_tpu.prewarm",

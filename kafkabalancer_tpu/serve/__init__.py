@@ -1,0 +1,36 @@
+"""kafkabalancer_tpu.serve — the persistent planning daemon.
+
+The deployment unit is a stateless planner re-invoked once per move by
+an outer automation loop (the reference's README.md:21-33), so every
+production invocation re-pays process start, the jax import, the
+backend/relay handshake, and the AOT blob load — ~1.8 s of one-time cost
+per ~0.45 s of actual planning at the flagship scale (BENCH_r05). This
+package removes the fresh process from the hot path entirely:
+
+- ``daemon`` — a long-lived planning server on a unix socket that holds
+  the initialized backend, deserialized executables (``ops.aot._loaded``)
+  and the incremental tensorize cache resident across requests, with
+  request coalescing, an idle-timeout shutdown, and a pidfile/socket
+  liveness handshake;
+- ``client`` — the thin, **jax-free** forwarding client embedded in the
+  CLI: every normal invocation transparently forwards its parsed flags +
+  input to a live daemon and falls back to the ordinary in-process path
+  (byte-identical stdout/stderr/exit codes) when none is reachable;
+- ``protocol`` — the versioned length-prefixed JSON frame protocol and
+  the socket-path convention shared by both sides;
+- ``cache`` — the digest-keyed incremental tensorize cache the daemon
+  installs so the outer loop's mostly-unchanged input re-encodes only
+  its changed rows.
+
+HARD CONSTRAINT: ``protocol`` and ``client`` import no jax (directly or
+transitively) — a forwarded invocation must stay as light as an
+error-exit one (pinned by tests/test_serve.py's no-jax subprocess pin).
+
+See docs/serving.md for the architecture and when to use ``-serve``.
+"""
+
+from kafkabalancer_tpu.serve.protocol import (  # noqa: F401
+    PROTO_VERSION,
+    default_socket_path,
+    resolve_socket_path,
+)

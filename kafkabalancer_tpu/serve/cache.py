@@ -1,0 +1,217 @@
+"""Digest-keyed incremental tensorize cache for the planning daemon.
+
+The outer automation loop re-reads cluster state and re-invokes the
+planner once per move, so consecutive requests differ by ONE partition's
+replica list (plus whatever drifted in between). A fresh tensorize pass
+re-encodes every row from Python objects — O(P) list comprehensions and
+per-row dict work that costs a visible slice of the warm-request budget
+at 10k-partition scale. This cache keeps the previous dense encoding and
+its per-row content keys; when the next request matches the same broker
+universe and bucket shapes, only rows whose key changed are re-encoded
+and everything else is a vectorized array copy.
+
+Correctness model: a row's key covers every field the dense encoding
+reads (topic, partition id, replicas, weight, num_replicas,
+num_consumers, the allowed-brokers content), and the reuse precondition
+pins the broker universe and the (P, R, B) buckets byte-for-byte — any
+mismatch, a new topic, an unexpected broker, or too much churn falls
+back to the full encode (which re-primes the cache). The cache returns
+fresh copies and keeps its masters private, so callers may do anything
+with the arrays.
+
+Installed by the daemon via ``ops.tensorize.set_row_cache``; the
+stateless CLI path never constructs one. Thread-safe (the daemon's
+dispatcher serializes plans, but probe threads may race it).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from kafkabalancer_tpu import obs
+from kafkabalancer_tpu.models import Partition
+from kafkabalancer_tpu.ops.tensorize import (
+    dense_replica_row,
+    encode_allowed_row,
+)
+
+RowKey = Tuple[Any, ...]
+
+# past this churn fraction the patch loop stops beating the vectorized
+# full encode; fall back (and re-prime) instead
+_MAX_CHANGED_FRACTION = 0.25
+_MIN_CHANGED_ALLOWANCE = 64
+
+_ARRAY_FIELDS = (
+    "weights",
+    "replicas",
+    "nrep_cur",
+    "nrep_tgt",
+    "ncons",
+    "allowed",
+    "member",
+    "pvalid",
+    "bvalid",
+    "topic_id",
+)
+
+
+def row_keys(parts: List[Partition]) -> List[RowKey]:
+    """Per-partition content keys over every field tensorize encodes.
+
+    The allowed-brokers term memoizes by list identity: after
+    FillDefaults most partitions share ONE brokers-list object, so the
+    tuple-ification cost is paid once per distinct list, not per row.
+    """
+    brokers_fp: Dict[int, Tuple[int, ...]] = {}
+    keys: List[RowKey] = []
+    for p in parts:
+        if p.brokers is None:
+            bfp: Optional[Tuple[int, ...]] = None
+        else:
+            ident = id(p.brokers)
+            bfp = brokers_fp.get(ident)
+            if bfp is None:
+                bfp = brokers_fp[ident] = tuple(p.brokers)
+        keys.append((
+            p.topic,
+            p.partition,
+            tuple(p.replicas),
+            p.weight,
+            p.num_replicas,
+            p.num_consumers,
+            bfp,
+        ))
+    return keys
+
+
+class TensorizeRowCache:
+    """Previous dense encoding + per-row keys; see module docstring."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._meta: Optional[Tuple[bytes, int, int, int]] = None
+        self._ids: Optional[np.ndarray] = None
+        self._keys: List[RowKey] = []
+        self._arrays: Dict[str, np.ndarray] = {}
+        self._topics: List[str] = []
+        self._topic_idx: Dict[str, int] = {}
+        self.hits = 0
+        self.misses = 0
+        self.rows_reused = 0
+
+    def _encode_row(
+        self, p: Partition, ids: np.ndarray, B: int
+    ) -> Optional[Tuple[int, np.ndarray, np.ndarray]]:
+        """(topic_id, dense_replicas, allowed_row) for one changed
+        partition, or None when it cannot be expressed in the cached
+        vocabulary (new topic / out-of-universe broker). Encoding
+        semantics live in ops/tensorize's shared per-row helpers — the
+        patch path cannot drift from the full encode."""
+        tid = self._topic_idx.get(p.topic)
+        if tid is None:
+            return None
+        dense = dense_replica_row(p.replicas, ids)
+        if dense is None:
+            return None
+        allowed_row = encode_allowed_row(p.brokers, ids, len(ids), B)
+        return tid, dense, allowed_row
+
+    def lookup(
+        self,
+        parts: List[Partition],
+        ids: np.ndarray,
+        P: int,
+        R: int,
+        B: int,
+    ) -> Optional[Dict[str, Any]]:
+        """Incrementally re-encode against the cached pass — the entry
+        point ``ops.tensorize`` calls before its full encode.
+
+        Returns ``{"arrays": {...}, "topics": [...]}`` (fresh copies)
+        when the cached encoding covers this input, else None (caller
+        runs the full encode and calls :meth:`prime`).
+        """
+        keys = row_keys(parts)
+        with self._lock:
+            meta = (ids.tobytes(), P, R, B)
+            if (
+                self._meta != meta
+                or len(keys) != len(self._keys)
+                or self._ids is None
+            ):
+                self.misses += 1
+                return None
+            changed = [
+                i for i, k in enumerate(keys) if k != self._keys[i]
+            ]
+            if len(changed) > max(
+                _MIN_CHANGED_ALLOWANCE,
+                int(len(keys) * _MAX_CHANGED_FRACTION),
+            ):
+                self.misses += 1
+                return None
+            # validate EVERY changed row before mutating the masters —
+            # a mid-patch bail would leave the cache half-updated
+            patches = []
+            for i in changed:
+                enc = self._encode_row(parts[i], self._ids, B)
+                if enc is None:
+                    self.misses += 1
+                    return None
+                patches.append((i, parts[i], enc))
+            a = self._arrays
+            for i, p, (tid, dense, allowed_row) in patches:
+                a["weights"][i] = p.weight
+                a["nrep_cur"][i] = len(p.replicas)
+                a["nrep_tgt"][i] = p.num_replicas
+                a["ncons"][i] = p.num_consumers
+                a["replicas"][i, :] = -1
+                a["replicas"][i, : dense.size] = dense
+                a["member"][i, :] = False
+                a["member"][i, dense] = True
+                a["allowed"][i, :] = allowed_row
+                a["topic_id"][i] = tid
+                self._keys[i] = keys[i]
+            self.hits += 1
+            self.rows_reused += len(keys) - len(changed)
+            obs.metrics.count("tensorize.cache_hits")
+            obs.metrics.count(
+                "tensorize.rows_reused", len(keys) - len(changed)
+            )
+            return {
+                "arrays": {f: a[f].copy() for f in _ARRAY_FIELDS},
+                "topics": list(self._topics),
+            }
+
+    def prime(
+        self,
+        parts: List[Partition],
+        ids: np.ndarray,
+        P: int,
+        R: int,
+        B: int,
+        arrays: Dict[str, np.ndarray],
+        topics: List[str],
+    ) -> None:
+        """Prime the cache from a completed full encode (copies taken —
+        the caller keeps exclusive ownership of its arrays)."""
+        keys = row_keys(parts)
+        with self._lock:
+            self._meta = (ids.tobytes(), P, R, B)
+            self._ids = np.array(ids, copy=True)
+            self._keys = list(keys)
+            self._arrays = {f: arrays[f].copy() for f in _ARRAY_FIELDS}
+            self._topics = list(topics)
+            self._topic_idx = {t: i for i, t in enumerate(topics)}
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "rows_reused": self.rows_reused,
+            }

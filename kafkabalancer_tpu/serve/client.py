@@ -1,0 +1,150 @@
+"""The jax-free forwarding client embedded in the CLI.
+
+Every normal CLI invocation asks this module whether a live daemon is
+reachable on the resolved socket; if so, the parsed flags + input text
+are forwarded and the daemon's stdout/stderr/exit code are relayed
+verbatim. EVERY failure mode — no socket, stale socket, version skew,
+truncated response, daemon death mid-plan — returns ``None`` and the CLI
+falls back to the ordinary in-process path, byte-identical to a build
+without a daemon (pinned by tests/test_serve.py).
+
+Nothing here may import jax (directly or transitively): a forwarded
+invocation must stay as light as an error exit — the whole point of the
+daemon is that the client process never pays the jax import.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import Any, Dict, List, NamedTuple, Optional
+
+from kafkabalancer_tpu import __version__
+from kafkabalancer_tpu.serve.protocol import (
+    PROTO_VERSION,
+    read_frame,
+    resolve_socket_path,  # noqa: F401  — re-exported for the CLI
+    write_frame,
+)
+
+# connect + handshake must be near-free when a daemon exists and exactly
+# one failed connect() when it does not; the plan response itself gets a
+# generous ceiling (a convergence-scale session runs minutes)
+CONNECT_TIMEOUT_S = 2.0
+PLAN_TIMEOUT_S = 3600.0
+
+
+class ServedResult(NamedTuple):
+    """One forwarded invocation's outcome, relayed verbatim."""
+
+    rc: int
+    stdout: str
+    stderr: str
+
+
+def socket_exists(path: str) -> bool:
+    """Cheap pre-check (one stat) so invocations on daemon-less hosts
+    pay nothing at all."""
+    try:
+        return os.path.exists(path)
+    except OSError:
+        return False
+
+
+def _connect(path: str, timeout: float) -> Optional[socket.socket]:
+    try:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(timeout)
+        sock.connect(path)
+        return sock
+    except OSError:
+        return None
+
+
+def _hello_ok(resp: Optional[Dict[str, Any]]) -> bool:
+    """A usable daemon: right protocol AND right package version — a
+    daemon left over from an older install must not answer for a newer
+    client (its plans could silently differ from the in-process path)."""
+    return (
+        isinstance(resp, dict)
+        and bool(resp.get("ok"))
+        and resp.get("v") == PROTO_VERSION
+        and resp.get("version") == __version__
+    )
+
+
+def daemon_alive(
+    path: str, timeout: float = CONNECT_TIMEOUT_S
+) -> Optional[Dict[str, Any]]:
+    """Handshake with the daemon at ``path``; its hello response dict
+    when live and compatible, else None (absent, stale, or skewed)."""
+    sock = _connect(path, timeout)
+    if sock is None:
+        return None
+    try:
+        write_frame(sock, {"v": PROTO_VERSION, "op": "hello"})
+        resp = read_frame(sock)
+        return resp if _hello_ok(resp) else None
+    except Exception:
+        return None
+    finally:
+        sock.close()
+
+
+def forward_plan(
+    path: str,
+    argv: List[str],
+    stdin_text: Optional[str],
+    connect_timeout: float = CONNECT_TIMEOUT_S,
+    plan_timeout: float = PLAN_TIMEOUT_S,
+) -> Optional[ServedResult]:
+    """Forward one invocation to the daemon at ``path``.
+
+    ``argv`` is the canonical flag list the CLI built (``-no-daemon``
+    included); ``stdin_text`` is the raw input when no ``-input``/
+    ``-from-zk`` names a source. Returns the daemon's result, or None on
+    ANY failure — the caller falls back in-process.
+    """
+    sock = _connect(path, connect_timeout)
+    if sock is None:
+        return None
+    try:
+        write_frame(sock, {"v": PROTO_VERSION, "op": "hello"})
+        if not _hello_ok(read_frame(sock)):
+            return None
+        req: Dict[str, Any] = {"v": PROTO_VERSION, "op": "plan", "argv": argv}
+        if stdin_text is not None:
+            req["stdin"] = stdin_text
+        sock.settimeout(plan_timeout)
+        write_frame(sock, req)
+        resp = read_frame(sock)
+        if (
+            not isinstance(resp, dict)
+            or not resp.get("ok")
+            or resp.get("v") != PROTO_VERSION
+        ):
+            return None
+        return ServedResult(
+            rc=int(resp["rc"]),
+            stdout=str(resp.get("stdout", "")),
+            stderr=str(resp.get("stderr", "")),
+        )
+    except Exception:
+        return None
+    finally:
+        sock.close()
+
+
+def request_shutdown(path: str, timeout: float = 10.0) -> bool:
+    """Ask the daemon at ``path`` to exit; True when acknowledged."""
+    sock = _connect(path, timeout)
+    if sock is None:
+        return False
+    try:
+        write_frame(sock, {"v": PROTO_VERSION, "op": "shutdown"})
+        resp = read_frame(sock)
+        return isinstance(resp, dict) and bool(resp.get("ok"))
+    except Exception:
+        return False
+    finally:
+        sock.close()

@@ -1,0 +1,542 @@
+"""The persistent planning daemon (``-serve``).
+
+One long-lived process owns everything a stateless invocation re-pays:
+the jax import, the backend/relay attach, the deserialized AOT
+executables (``ops.aot._loaded``), the persistent-cache-configured
+runtime, and the incremental tensorize cache (serve/cache.py). Requests
+arrive as canonical flag lists over a unix socket (serve/protocol.py)
+and run through the very same ``cli.run`` the stateless path uses — the
+response relays its stdout/stderr/exit code verbatim, so the
+``kafka-reassign-partitions.sh`` contract and the outer loop are
+unchanged.
+
+Structure:
+
+- an accept loop (one thread per connection) that answers ``hello``
+  liveness handshakes immediately and enqueues ``plan`` requests;
+- ONE dispatcher (:class:`Coalescer`) that serializes planning — the
+  device is a single resource, and serializing is also what keeps the
+  process-global telemetry registry/tracer coherent per request. Each
+  request runs on its own named thread (``serve-req-N``) so its spans
+  render on their own track;
+- request coalescing: when requests queue up concurrently, the
+  dispatcher probes each waiting request's shape bucket (the same
+  jax-free ``prefetch_hints`` arithmetic the coldstart predictor uses)
+  and drains all same-bucket requests into one dispatch window — they
+  share the one resident executable for that padded bucket, each still
+  producing its own plan. The probe runs only under contention, so the
+  common single-request case pays nothing;
+- an idle-timeout shutdown, a pidfile next to the socket, and stale
+  socket handling (a dead daemon's socket file is unlinked at startup;
+  a live one refuses the second daemon).
+
+Observability: daemon-lifetime counters ride into every request's
+metrics as gauges (``served: true``, ``serve.requests``,
+``serve.coalesced``, ``serve.cache_hits``), so a ``-metrics-json`` line
+from a served invocation is attributable at a glance.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import signal
+import socket
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
+
+from kafkabalancer_tpu import __version__
+from kafkabalancer_tpu.serve.protocol import (
+    PROTO_VERSION,
+    pidfile_path,
+    read_frame,
+    write_frame,
+)
+
+BucketKey = Tuple[int, int, int, bool]
+LogFn = Callable[[str], None]
+
+# a connection sitting in a queued/coalesced plan can legitimately wait
+# minutes for the device; the read timeout only bounds DEAD peers
+PLAN_CONNECTION_TIMEOUT_S = 7200.0
+
+
+def _argv_value(argv: List[str], name: str) -> Optional[str]:
+    """Last value of ``-name=value`` in a canonical argv (the client
+    emits every forwarded flag in exactly that spelling)."""
+    prefix = f"-{name}="
+    val: Optional[str] = None
+    for a in argv:
+        if a.startswith(prefix):
+            val = a[len(prefix):]
+    return val
+
+
+class PlanRequest:
+    """One queued ``plan`` request plus its completion latch."""
+
+    __slots__ = ("argv", "stdin", "done", "response", "bucket", "bucketed")
+
+    def __init__(self, argv: List[str], stdin: Optional[str]) -> None:
+        self.argv = argv
+        self.stdin = stdin
+        self.done = threading.Event()
+        self.response: Optional[Dict[str, Any]] = None
+        self.bucket: Optional[BucketKey] = None
+        self.bucketed = False  # probe memo (None is a valid "no bucket")
+
+
+class Coalescer:
+    """Serialize plan handling, draining same-bucket queue runs together.
+
+    ``handle(req, coalesced)`` runs every request (in arrival order
+    within a group); ``bucket_of(req)`` is the jax-free shape probe,
+    called lazily and only when more than one request is waiting — the
+    uncontended case never pays it.
+    """
+
+    def __init__(
+        self,
+        handle: Callable[[PlanRequest, bool], None],
+        bucket_of: Callable[[PlanRequest], Optional[BucketKey]],
+    ) -> None:
+        self._handle = handle
+        self._bucket_of = bucket_of
+        self._dq: Deque[PlanRequest] = deque()
+        self._cv = threading.Condition()
+        self._stop = False
+        self._active = 0  # requests popped but not yet completed
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-dispatch", daemon=True
+        )
+        self._thread.start()
+
+    def busy(self) -> bool:
+        """Queued or in-flight work — the daemon's idle-timeout check
+        must not count a long-running plan as idleness."""
+        with self._cv:
+            return bool(self._dq) or self._active > 0
+
+    def _bucket(self, req: PlanRequest) -> Optional[BucketKey]:
+        if not req.bucketed:
+            req.bucketed = True
+            try:
+                req.bucket = self._bucket_of(req)
+            except Exception:
+                req.bucket = None
+        return req.bucket
+
+    def submit(self, req: PlanRequest) -> Dict[str, Any]:
+        with self._cv:
+            if self._stop:
+                return {
+                    "v": PROTO_VERSION, "ok": False,
+                    "error": "daemon shutting down",
+                }
+            self._dq.append(req)
+            self._cv.notify_all()
+        req.done.wait()
+        return req.response or {
+            "v": PROTO_VERSION, "ok": False, "error": "request dropped",
+        }
+
+    def stop(self, timeout: float = 30.0) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        self._thread.join(timeout)
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._dq and not self._stop:
+                    self._cv.wait()
+                if not self._dq:
+                    return  # stopping, queue drained
+                first = self._dq.popleft()
+                self._active += 1
+                contended = bool(self._dq)
+            try:
+                group = [first]
+                if contended:
+                    # the bucket probes (input read + parse) run OUTSIDE
+                    # the lock: submitters must stay able to enqueue
+                    # while the window is being assembled. Safe because
+                    # this loop is the only consumer — a snapshotted
+                    # request cannot be removed by anyone else.
+                    b0 = self._bucket(first)
+                    if b0 is not None:
+                        with self._cv:
+                            pending = list(self._dq)
+                        same = [r for r in pending if self._bucket(r) == b0]
+                        if same:
+                            with self._cv:
+                                for r in same:
+                                    self._dq.remove(r)
+                                self._active += len(same)
+                            group.extend(same)
+                for idx, req in enumerate(group):
+                    try:
+                        self._handle(req, idx > 0)
+                    except Exception as exc:  # never wedge a waiter
+                        req.response = {
+                            "v": PROTO_VERSION, "ok": False,
+                            "error": f"{type(exc).__name__}: {exc}",
+                        }
+                    finally:
+                        with self._cv:
+                            self._active -= 1
+                        req.done.set()
+            except Exception:
+                # group-assembly failure: the popped requests must not
+                # wedge their waiters nor leak the active count
+                with self._cv:
+                    self._active -= sum(
+                        1 for r in group if not r.done.is_set()
+                    )
+                for r in group:
+                    if not r.done.is_set():
+                        r.response = {
+                            "v": PROTO_VERSION, "ok": False,
+                            "error": "dispatch failed",
+                        }
+                        r.done.set()
+
+
+class Daemon:
+    """The ``-serve`` daemon; see the module docstring."""
+
+    def __init__(
+        self,
+        socket_path: str,
+        idle_timeout: float = 900.0,
+        prewarm_shapes: str = "",
+        log: Optional[LogFn] = None,
+        warm: bool = True,
+    ) -> None:
+        self.socket_path = socket_path
+        self.idle_timeout = idle_timeout
+        self.prewarm_shapes = prewarm_shapes
+        self.warm = warm
+        self._log: LogFn = log or (
+            lambda msg: print(msg, file=sys.stderr, flush=True)
+        )
+        self._stop = threading.Event()
+        self._warm_done = threading.Event()
+        self._lock = threading.Lock()
+        self._requests = 0
+        self._coalesced = 0
+        self._started = time.monotonic()
+        self._last_activity = time.monotonic()
+        self._seq = 0
+        from kafkabalancer_tpu.serve.cache import TensorizeRowCache
+
+        self.tensorize_cache = TensorizeRowCache()
+        self._coalescer: Optional[Coalescer] = None
+
+    # -- warmup ----------------------------------------------------------
+    def _warm_body(self) -> None:
+        """Background startup warm: backend attach, then (optionally)
+        AOT-prewarm a shape grid and pull its executables resident so
+        request 1 skips even the blob load. Never raises — a warm
+        failure costs latency on request 1, not availability."""
+        try:
+            from kafkabalancer_tpu.ops.coldstart import (
+                mark_process_warm,
+                warm_backend,
+            )
+
+            warm_backend()
+            self._log("serve: backend warm")
+            # requests in this process now skip their per-request warm
+            # thread: the one-time costs it overlaps are already paid
+            mark_process_warm()
+            if self.prewarm_shapes:
+                from kafkabalancer_tpu import prewarm
+
+                summary = prewarm.warm_store(self.prewarm_shapes, load=True)
+                self._log(f"serve: prewarm {summary}")
+        except Exception as exc:
+            self._log(f"serve: warmup failed: {exc!r}")
+        finally:
+            # the idle clock starts HERE: a long -serve-prewarm compile
+            # must not count as idleness (the daemon would shut itself
+            # down mid-warm before serving a single request)
+            self._touch()
+            self._warm_done.set()
+
+    # -- request handling ------------------------------------------------
+    def _bucket_of(self, req: PlanRequest) -> Optional[BucketKey]:
+        """Jax-free shape-bucket probe of one queued request — the same
+        ``prefetch_hints`` arithmetic the coldstart predictor uses, so
+        two requests coalesce exactly when they would reuse one padded
+        executable. None (= never coalesced) for zookeeper inputs and
+        anything that fails to parse (the real run surfaces the error)."""
+        if _argv_value(req.argv, "from-zk"):
+            return None
+        input_path = _argv_value(req.argv, "input")
+        if input_path:
+            with open(input_path, "r") as fh:
+                text = fh.read()
+        elif req.stdin is not None:
+            text = req.stdin
+        else:
+            return None
+        from kafkabalancer_tpu.codecs import get_partition_list_from_reader
+        from kafkabalancer_tpu.ops.coldstart import prefetch_hints
+        from kafkabalancer_tpu.utils.flags import go_atoi
+
+        as_json = _argv_value(req.argv, "input-json") == "true"
+        topics_raw = _argv_value(req.argv, "topics") or ""
+        topics = [t for t in topics_raw.split(",") if len(t) >= 1]
+        pl = get_partition_list_from_reader(io.StringIO(text), as_json, topics)
+        brokers: Optional[List[int]] = None
+        brokers_raw = _argv_value(req.argv, "broker-ids")
+        if brokers_raw and brokers_raw != "auto":
+            brokers = [go_atoi(b) for b in brokers_raw.split(",")]
+        hints = prefetch_hints(pl, brokers)
+        return (
+            int(hints["P"]), int(hints["R"]), int(hints["B"]),
+            bool(hints["all_allowed"]),
+        )
+
+    def _handle_plan(self, req: PlanRequest, coalesced: bool) -> None:
+        from kafkabalancer_tpu import cli
+
+        with self._lock:
+            self._requests += 1
+            if coalesced:
+                self._coalesced += 1
+            n = self._requests
+            n_coal = self._coalesced
+            self._seq += 1
+            seq = self._seq
+        cache_stats = self.tensorize_cache.stats()
+        attrs: Dict[str, Any] = {
+            "served": True,
+            "serve.requests": float(n),
+            "serve.coalesced": float(n_coal),
+            "serve.cache_hits": float(cache_stats["hits"]),
+        }
+        i = io.StringIO(req.stdin or "")
+        out, err = io.StringIO(), io.StringIO()
+        rc_box: List[int] = []
+
+        def body() -> None:
+            rc_box.append(
+                cli.run(
+                    i, out, err, ["kafkabalancer"] + req.argv, attrs=attrs
+                )
+            )
+
+        # a named thread per request: the request's telemetry spans get
+        # their own track ("serve-req-N") in -stats / -trace output
+        t = threading.Thread(target=body, name=f"serve-req-{seq}")
+        t.start()
+        t.join()
+        if not rc_box:
+            # cli.run raised: a daemon-side crash must NOT masquerade as
+            # one of the CLI's documented exit codes — an ok:false
+            # response makes the client fall back and plan in-process
+            self._log(f"serve: request {seq} crashed (see traceback above)")
+            req.response = {
+                "v": PROTO_VERSION,
+                "ok": False,
+                "error": "internal error: planner thread died",
+            }
+            self._touch()
+            return
+        req.response = {
+            "v": PROTO_VERSION,
+            "ok": True,
+            "rc": rc_box[0],
+            "stdout": out.getvalue(),
+            "stderr": err.getvalue(),
+        }
+        self._touch()
+
+    def _hello(self) -> Dict[str, Any]:
+        with self._lock:
+            n, n_coal = self._requests, self._coalesced
+        return {
+            "v": PROTO_VERSION,
+            "ok": True,
+            "op": "hello",
+            "pid": os.getpid(),
+            "version": __version__,
+            "uptime_s": round(time.monotonic() - self._started, 3),
+            "requests": n,
+            "coalesced": n_coal,
+            "cache": self.tensorize_cache.stats(),
+        }
+
+    def _touch(self) -> None:
+        self._last_activity = time.monotonic()
+
+    def _serve_connection(self, conn: socket.socket) -> None:
+        try:
+            conn.settimeout(PLAN_CONNECTION_TIMEOUT_S)
+            while True:
+                try:
+                    msg = read_frame(conn)
+                except Exception:
+                    return
+                if msg is None:
+                    return
+                if msg.get("v") != PROTO_VERSION:
+                    write_frame(conn, {
+                        "v": PROTO_VERSION, "ok": False,
+                        "error": f"protocol version {msg.get('v')!r}",
+                    })
+                    return
+                op = msg.get("op")
+                self._touch()
+                if op == "hello":
+                    write_frame(conn, self._hello())
+                elif op == "plan":
+                    argv = [str(a) for a in msg.get("argv", [])]
+                    stdin = msg.get("stdin")
+                    req = PlanRequest(
+                        argv, str(stdin) if stdin is not None else None
+                    )
+                    assert self._coalescer is not None
+                    write_frame(conn, self._coalescer.submit(req))
+                elif op == "shutdown":
+                    write_frame(conn, {"v": PROTO_VERSION, "ok": True})
+                    self._stop.set()
+                    return
+                else:
+                    write_frame(conn, {
+                        "v": PROTO_VERSION, "ok": False,
+                        "error": f"unknown op {op!r}",
+                    })
+        except Exception:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- lifecycle -------------------------------------------------------
+    def _preflight_socket(self) -> Optional[str]:
+        """None when the socket path is free (stale files unlinked), an
+        error string when a live daemon already owns it."""
+        if not os.path.exists(self.socket_path):
+            return None
+        from kafkabalancer_tpu.serve import client
+
+        hello = client.daemon_alive(self.socket_path, timeout=1.0)
+        if hello is not None:
+            return (
+                f"daemon already running on {self.socket_path} "
+                f"(pid {hello.get('pid')})"
+            )
+        try:
+            os.unlink(self.socket_path)
+            self._log(f"serve: removed stale socket {self.socket_path}")
+        except OSError as exc:
+            return f"cannot remove stale socket {self.socket_path}: {exc}"
+        return None
+
+    def serve_forever(self) -> int:
+        """Run until shutdown/idle-timeout/signal; 0 on a clean exit,
+        3 when the socket is unusable (live daemon, bind failure)."""
+        err = self._preflight_socket()
+        if err is not None:
+            self._log(f"serve: {err}")
+            return 3
+        listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        try:
+            listener.bind(self.socket_path)
+        except OSError as exc:
+            self._log(f"serve: cannot bind {self.socket_path}: {exc}")
+            listener.close()
+            return 3
+        listener.listen(16)
+        listener.settimeout(0.5)
+        pid_path = pidfile_path(self.socket_path)
+        try:
+            with open(pid_path, "w") as f:
+                f.write(f"{os.getpid()}\n")
+        except OSError:
+            pid_path = ""
+
+        from kafkabalancer_tpu.ops.tensorize import set_row_cache
+
+        set_row_cache(self.tensorize_cache)
+        self._coalescer = Coalescer(self._handle_plan, self._bucket_of)
+        if self.warm:
+            threading.Thread(
+                target=self._warm_body, name="serve-warm", daemon=True
+            ).start()
+        else:
+            self._warm_done.set()
+
+        old_handlers: List[Tuple[int, Any]] = []
+        if threading.current_thread() is threading.main_thread():
+            for sig in (signal.SIGTERM, signal.SIGINT):
+                old_handlers.append((sig, signal.getsignal(sig)))
+                signal.signal(sig, lambda *_a: self._stop.set())
+
+        self._log(
+            f"serve: listening on {self.socket_path} "
+            f"(pid {os.getpid()}, idle timeout "
+            f"{self.idle_timeout:g}s)" if self.idle_timeout > 0 else
+            f"serve: listening on {self.socket_path} (pid {os.getpid()})"
+        )
+        self._touch()
+        try:
+            while not self._stop.is_set():
+                if (
+                    self.idle_timeout > 0
+                    and self._warm_done.is_set()
+                    and not self._coalescer.busy()
+                    and time.monotonic() - self._last_activity
+                    > self.idle_timeout
+                ):
+                    self._log(
+                        f"serve: idle for {self.idle_timeout:g}s, "
+                        "shutting down"
+                    )
+                    break
+                try:
+                    conn, _addr = listener.accept()
+                except socket.timeout:
+                    continue
+                except OSError:
+                    break
+                threading.Thread(
+                    target=self._serve_connection,
+                    args=(conn,),
+                    name="serve-conn",
+                    daemon=True,
+                ).start()
+        finally:
+            listener.close()
+            if self._coalescer is not None:
+                self._coalescer.stop()
+            set_row_cache(None)
+            for sig, handler in old_handlers:
+                try:
+                    signal.signal(sig, handler)
+                except (ValueError, OSError):
+                    pass
+            for path in (self.socket_path, pid_path):
+                if path:
+                    try:
+                        os.unlink(path)
+                    except OSError:
+                        pass
+        with self._lock:
+            n, n_coal = self._requests, self._coalesced
+        cache_stats = self.tensorize_cache.stats()
+        self._log(
+            f"serve: exiting after {n} request"
+            f"{'s' if n != 1 else ''} ({n_coal} coalesced, "
+            f"{cache_stats['hits']} tensorize cache hits)"
+        )
+        return 0

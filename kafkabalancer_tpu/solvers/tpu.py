@@ -292,7 +292,7 @@ def find_best_move(
     ``None`` also signals the caller must fall back to the greedy scan
     (tie-window overflow) via the :class:`TieOverflow` exception instead.
     """
-    from kafkabalancer_tpu.balancer.steps import scan_partition_move
+    from kafkabalancer_tpu.balancer.steps import scan_moves
 
     nb = dp.nb
     B = dp.bvalid.shape[0]
@@ -382,17 +382,16 @@ def find_best_move(
         raise TieOverflow
 
     # replay the ORACLE's own per-partition scan over just the flagged
-    # rows — same bl table, same mutation/restore dance, same candidate
-    # order, same first-strict-improver rule — byte parity by construction
+    # rows — same bl table, same candidate order, same
+    # first-strict-improver rule — byte parity by construction
+    # (steps.scan_moves is the vectorized replay of scan_partition_move,
+    # bit-identical by the column-order argument documented there)
     bl = costmodel.get_bl(loads_map)  # oracle bl, (load, ID) ascending
     su = costmodel.get_unbalance_bl(bl)
-    cu, best, best_row = su, None, -1
-    for row in rows:
-        cu, nbest = scan_partition_move(
-            dp.partitions[int(row)], bl, cu, best, cfg, leaders
-        )
-        if nbest is not best:
-            best, best_row = nbest, int(row)
+    cu, best, wpos = scan_moves(
+        [dp.partitions[int(row)] for row in rows], bl, su, None, cfg, leaders
+    )
+    best_row = int(rows[wpos]) if wpos >= 0 else -1
 
     if best is None or not (cu < su - cfg.min_unbalance):
         return None

@@ -16,7 +16,10 @@
 #      smoke               example input; the metrics line must parse
 #                         and carry the schema version + lifecycle spans
 #                         (docs/observability.md)
-#   7. tier-1 tests     — the ROADMAP.md verify suite (skip: --no-tests)
+#   7. serve smoke      — start the planning daemon, plan through it,
+#                         assert byte parity with the in-process path,
+#                         clean shutdown (docs/serving.md)
+#   8. tier-1 tests     — the ROADMAP.md verify suite (skip: --no-tests)
 #
 # Exit 0 only when every stage that ran passed. Optional tools that are
 # not installed SKIP with a notice instead of failing: the gate must be
@@ -48,13 +51,14 @@ step "jaxlint (R1-R5)"
 step "annotation coverage (mypy --strict floor)"
 "$PYTHON" -m kafkabalancer_tpu.analysis --annotations \
   kafkabalancer_tpu/models kafkabalancer_tpu/ops kafkabalancer_tpu/codecs \
-  kafkabalancer_tpu/obs \
+  kafkabalancer_tpu/obs kafkabalancer_tpu/serve \
   || fail=1
 
-step "mypy --strict (models/ ops/ codecs/ obs/)"
+step "mypy --strict (models/ ops/ codecs/ obs/ serve/)"
 if command -v mypy >/dev/null 2>&1; then
   mypy --strict kafkabalancer_tpu/models kafkabalancer_tpu/ops \
-    kafkabalancer_tpu/codecs kafkabalancer_tpu/obs || fail=1
+    kafkabalancer_tpu/codecs kafkabalancer_tpu/obs kafkabalancer_tpu/serve \
+    || fail=1
 else
   echo "mypy not installed — skipped (annotation-coverage floor ran above)"
 fi
@@ -79,7 +83,7 @@ cold_smoke() {
   JAX_PLATFORMS=cpu JAX_COMPILATION_CACHE_DIR="$smoke_tmp" \
   KAFKABALANCER_TPU_AOT_SYNC_SAVE=1 \
   "$PYTHON" -m kafkabalancer_tpu -input-json -input tests/data/test.json \
-    -fused -fused-batch=4 -max-reassign=4 >/dev/null
+    -fused -fused-batch=4 -max-reassign=4 -no-daemon >/dev/null
 }
 if cold_smoke; then
   echo "cache-cold invocation: OK"
@@ -100,7 +104,8 @@ step "observability smoke (-stats -metrics-json -)"
 # catches a broken exporter or a schema drift before merge
 # (docs/observability.md).
 obs_out=$(JAX_PLATFORMS=cpu "$PYTHON" -m kafkabalancer_tpu -input-json \
-  -input tests/data/test.json -stats -metrics-json - 2>/dev/null | tail -n 1)
+  -input tests/data/test.json -stats -metrics-json - -no-daemon \
+  2>/dev/null | tail -n 1)
 if printf '%s' "$obs_out" | "$PYTHON" -c '
 import json, sys
 p = json.loads(sys.stdin.read())
@@ -113,6 +118,64 @@ assert {"parse_input", "plan", "emit"} <= names, sorted(names)
 else
   echo "observability smoke FAILED"; fail=1
 fi
+
+step "serve smoke (daemon parity + clean shutdown)"
+# The persistent planning daemon end to end: start it on a private
+# socket, plan the example input THROUGH it, assert byte parity with
+# the in-process path (-no-daemon), then shut it down cleanly. This is
+# the stage that catches a forwarding/parity regression — the outer
+# loop's contract is that a served plan is indistinguishable from a
+# stateless one (docs/serving.md).
+serve_tmp=$(mktemp -d)
+serve_sock="$serve_tmp/kb.sock"
+JAX_PLATFORMS=cpu JAX_COMPILATION_CACHE_DIR="$serve_tmp" \
+  "$PYTHON" -m kafkabalancer_tpu -serve "-serve-socket=$serve_sock" \
+  -serve-idle-timeout=120 >"$serve_tmp/daemon.log" 2>&1 &
+serve_pid=$!
+serve_ready=0
+for _ in $(seq 1 60); do
+  if "$PYTHON" -c "import sys
+from kafkabalancer_tpu.serve.client import daemon_alive
+sys.exit(0 if daemon_alive('$serve_sock') else 1)" 2>/dev/null; then
+    serve_ready=1; break
+  fi
+  sleep 0.25
+done
+if [ "$serve_ready" = 1 ]; then
+  served_out=$(JAX_PLATFORMS=cpu "$PYTHON" -m kafkabalancer_tpu \
+    -input-json -input tests/data/test.json "-serve-socket=$serve_sock" \
+    "-metrics-json=$serve_tmp/served.metrics.json" 2>/dev/null)
+  local_out=$(JAX_PLATFORMS=cpu "$PYTHON" -m kafkabalancer_tpu \
+    -input-json -input tests/data/test.json -no-daemon 2>/dev/null)
+  if [ -n "$served_out" ] && [ "$served_out" = "$local_out" ]; then
+    echo "served plan parity: OK"
+  else
+    echo "served plan parity FAILED"; fail=1
+  fi
+  # byte parity alone is satisfied by the in-process FALLBACK — assert
+  # the plan actually went through the daemon (served: true gauge),
+  # otherwise a broken forwarding path sails through this stage
+  if "$PYTHON" -c "import json, sys
+m = json.load(open('$serve_tmp/served.metrics.json'))
+sys.exit(0 if m.get('gauges', {}).get('served') else 1)" 2>/dev/null; then
+    echo "served attribution: OK"
+  else
+    echo "served attribution MISSING — plan fell back in-process"; fail=1
+  fi
+  "$PYTHON" -c "from kafkabalancer_tpu.serve.client import request_shutdown
+request_shutdown('$serve_sock')" || true
+  if wait "$serve_pid"; then
+    echo "daemon clean shutdown: OK"
+  else
+    echo "daemon exited nonzero"; fail=1
+  fi
+else
+  echo "daemon never became ready (see $serve_tmp/daemon.log)"
+  cat "$serve_tmp/daemon.log" 2>/dev/null | tail -20
+  kill "$serve_pid" 2>/dev/null
+  fail=1
+fi
+rm -rf "$serve_tmp"
 
 if [ "$run_tests" = 1 ]; then
   step "tier-1 tests"

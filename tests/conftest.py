@@ -21,6 +21,16 @@ if "xla_force_host_platform_device_count" not in _flags:
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "1")
 
+# daemon isolation: a developer's live planning daemon on the default
+# per-uid socket must never serve the suite's cli.run() invocations (the
+# tests must exercise THIS working tree, not whatever code the daemon
+# loaded). Point the default socket at a path that cannot exist; tests
+# that want a daemon pass -serve-socket explicitly, which overrides this.
+os.environ["KAFKABALANCER_TPU_SOCKET"] = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "no-daemon-here.sock",
+)
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")

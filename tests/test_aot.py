@@ -334,3 +334,194 @@ def test_manifest_cache_tracks_rapid_writes(cache_dir):
     assert set(aot._manifest_read(d)) == set(on_disk)
     cached = aot._manifest_cache
     assert cached is not None and set(cached[2]) == set(on_disk)
+
+
+# --- platform-keyed load gating (noload.json sidecar) --------------------
+
+
+def test_save_records_platform_and_digest(cache_dir):
+    """Every v2.1 entry carries the saving backend platform and a blob
+    md5 — the two facts the read path classifies deserialize failures
+    with."""
+    _fn, _args, key = _store_one(cache_dir, name="plat")
+    entry = aot._manifest_read(aot.aot_dir())[key]
+    assert entry["platform"] == aot._platform()
+    assert len(entry["md5"]) == 32
+
+
+def test_other_platform_entry_is_clean_miss(cache_dir):
+    """An entry saved by a DIFFERENT platform is skipped without a blob
+    read or a prune — the saving platform still serves from it."""
+    fn, args, key = _store_one(cache_dir, name="xplat")
+    d = aot.aot_dir()
+
+    def fake_platform(e):
+        e[key]["platform"] = "definitely-not-this-one"
+
+    aot._manifest_update(d, fake_platform)
+    aot._loaded.clear()
+    from kafkabalancer_tpu import obs
+
+    before = obs.metrics.counter_get("aot.platform_skips")
+    assert aot.try_load("xplat", args, {}) is None
+    assert obs.metrics.counter_get("aot.platform_skips") == before + 1
+    assert key in aot._manifest_read(d)  # entry preserved
+
+
+def test_own_platform_deserialize_failure_records_noload(
+    cache_dir, monkeypatch
+):
+    """The satellite's core pin: a deserialize failure on an INTACT blob
+    this very platform saved becomes a lasting noload verdict — the
+    entry survives, later loads are clean misses (no deserialize
+    attempt), prefetch declines, and maybe_save stops re-serializing."""
+    import jax.experimental.serialize_executable as se
+
+    fn, args, key = _store_one(cache_dir, name="doomed")
+    d = aot.aot_dir()
+    aot._loaded.clear()
+
+    calls = []
+
+    def boom(*a, **kw):
+        calls.append(1)
+        raise RuntimeError("Symbols not found simulated")
+
+    monkeypatch.setattr(se, "deserialize_and_load", boom)
+    # failure 1: records the verdict, KEEPS the entry
+    assert aot.try_load("doomed", args, {}) is None
+    assert len(calls) == 1
+    assert key in aot._manifest_read(d)
+    assert os.path.exists(os.path.join(d, "noload.json"))
+    with open(os.path.join(d, "noload.json")) as f:
+        verdicts = json.load(f)
+    # scoped to platform AND jax version: an upgrade re-earns the load
+    assert "doomed" in verdicts[aot._noload_key()]
+    assert aot._noload_key().startswith(aot._platform() + "|")
+    # later loads: clean miss, deserialize never called again
+    from kafkabalancer_tpu import obs
+
+    before = obs.metrics.counter_get("aot.noload_skips")
+    assert aot.try_load("doomed", args, {}) is None
+    assert len(calls) == 1
+    assert obs.metrics.counter_get("aot.noload_skips") == before + 1
+    # prefetch declines instead of spawning a doomed loader
+    assert aot.prefetch("doomed", args, {}) is None
+    # a save this platform can never read back is skipped
+    aot._manifest_update(d, lambda e: e.pop(key, None))
+    assert aot.maybe_save("doomed", fn, args, {}) is None
+
+
+def test_resident_executables_lru_bounded(monkeypatch):
+    """aot._loaded is LRU-bounded: a long-lived daemon drifting across
+    shape buckets must not accumulate device-resident executables
+    forever. Hits refresh recency; inserts past the cap evict the
+    least-recently-used entry."""
+    monkeypatch.setenv("KAFKABALANCER_TPU_LOADED_CAP", "2")
+    monkeypatch.setattr(aot, "_loaded", {})
+    aot._loaded_put("a", "exe-a")
+    aot._loaded_put("b", "exe-b")
+    assert aot._loaded_get("a") == "exe-a"  # refreshes a's recency
+    aot._loaded_put("c", "exe-c")  # evicts b (now least recent)
+    assert set(aot._loaded) == {"a", "c"}
+    assert aot._loaded_get("b") is None
+    # cap <= 0 disables the bound
+    monkeypatch.setenv("KAFKABALANCER_TPU_LOADED_CAP", "0")
+    for i in range(8):
+        aot._loaded_put(f"k{i}", i)
+    assert len(aot._loaded) == 10
+
+
+def test_transient_deserialize_failure_records_no_verdict(
+    cache_dir, monkeypatch
+):
+    """A transient-looking failure (resource pressure, relay
+    connectivity) proves nothing about the deserializer — no lasting
+    verdict, the intact entry survives, and the next process simply
+    retries the load."""
+    import jax.experimental.serialize_executable as se
+
+    _fn, args, key = _store_one(cache_dir, name="flaky")
+    d = aot.aot_dir()
+    aot._loaded.clear()
+
+    monkeypatch.setattr(
+        se, "deserialize_and_load",
+        lambda *a, **kw: (_ for _ in ()).throw(
+            RuntimeError("RESOURCE_EXHAUSTED: backend unavailable")
+        ),
+    )
+    assert aot.try_load("flaky", args, {}) is None
+    assert not os.path.exists(os.path.join(d, "noload.json"))
+    assert not aot._load_blocked(d, "flaky")
+    assert key in aot._manifest_read(d)  # intact entry survives for retry
+
+
+def test_unrecognized_deserialize_failure_records_no_verdict(
+    cache_dir, monkeypatch
+):
+    """Verdicts come from an ALLOWLIST of known-deterministic
+    signatures: an unrecognized failure (a relay hiccup surfacing as a
+    generic error) fails open — no lasting verdict, entry kept, next
+    process retries."""
+    import jax.experimental.serialize_executable as se
+
+    _fn, args, key = _store_one(cache_dir, name="oddball")
+    d = aot.aot_dir()
+    aot._loaded.clear()
+
+    monkeypatch.setattr(
+        se, "deserialize_and_load",
+        lambda *a, **kw: (_ for _ in ()).throw(
+            RuntimeError("Connection reset by peer")
+        ),
+    )
+    assert aot.try_load("oddball", args, {}) is None
+    assert not os.path.exists(os.path.join(d, "noload.json"))
+    assert not aot._load_blocked(d, "oddball")
+    assert key in aot._manifest_read(d)
+
+
+def test_corrupted_blob_still_prunes_not_noload(cache_dir, monkeypatch):
+    """A deserialize failure whose blob digest does NOT match the saved
+    md5 is corruption: pruned and recompiled as ever — no lasting
+    platform verdict from damaged bytes."""
+    import jax.experimental.serialize_executable as se
+
+    _fn, args, key = _store_one(cache_dir, name="damaged")
+    d = aot.aot_dir()
+    aot._loaded.clear()
+
+    def lie(e):
+        e[key]["md5"] = "0" * 32
+
+    aot._manifest_update(d, lie)
+    monkeypatch.setattr(
+        se, "deserialize_and_load",
+        lambda *a, **kw: (_ for _ in ()).throw(RuntimeError("boom")),
+    )
+    assert aot.try_load("damaged", args, {}) is None
+    assert key not in aot._manifest_read(d)  # pruned
+    assert not os.path.exists(os.path.join(d, "noload.json"))
+
+
+def test_sidecars_survive_orphan_sweep(cache_dir, monkeypatch):
+    """The eviction sweep reclaims blob shards and .tmp orphans only —
+    aged sidecar files (noload.json, pallas_gate.json) are not its to
+    delete."""
+    d = os.path.join(cache_dir, "aot")
+    os.makedirs(d, exist_ok=True)
+    for fname in ("noload.json", "pallas_gate.json"):
+        with open(os.path.join(d, fname), "w") as f:
+            f.write("{}")
+    orphan = os.path.join(d, "deadbeef.s00.bin")
+    with open(orphan, "wb") as f:
+        f.write(b"x" * 16)
+    old = 1.0  # epoch 1970: well past the orphan age
+    for fname in ("noload.json", "pallas_gate.json", "deadbeef.s00.bin"):
+        os.utime(os.path.join(d, fname), (old, old))
+    monkeypatch.setenv("KAFKABALANCER_TPU_AOT_CAP_MB", "0.00001")
+    aot._evict_to_cap(d)
+    assert not os.path.exists(orphan)
+    assert os.path.exists(os.path.join(d, "noload.json"))
+    assert os.path.exists(os.path.join(d, "pallas_gate.json"))

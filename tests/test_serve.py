@@ -1,0 +1,634 @@
+"""Persistent planning daemon (kafkabalancer_tpu/serve/): lifecycle,
+fallback parity, coalescing, the incremental tensorize cache, and the
+no-jax client pin.
+
+The load-bearing pins:
+
+- with the daemon STOPPED, a forwarding-enabled invocation is
+  byte-identical (stdout + exit code, stderr modulo timestamps) to
+  ``-no-daemon`` — the outer automation loop must not be able to tell
+  the feature exists until a daemon is started;
+- a SERVED plan is byte-identical to the in-process plan;
+- the client path of a served invocation never imports jax (that is the
+  entire point of the daemon);
+- two concurrent same-bucket requests coalesce into one dispatch window
+  and still each get their own correct plan.
+"""
+
+import io
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import pytest
+
+from kafkabalancer_tpu import cli
+from kafkabalancer_tpu.serve import client as sclient
+from kafkabalancer_tpu.serve import protocol
+from kafkabalancer_tpu.serve.daemon import Coalescer, Daemon, PlanRequest
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "data", "test.json")
+
+# Go-log timestamp prefix on stderr lines ("2025/01/01 00:00:00 ")
+_TS = re.compile(r"^\d{4}/\d{2}/\d{2} \d{2}:\d{2}:\d{2} ", re.M)
+
+
+def run_cli(args, stdin=""):
+    out, err = io.StringIO(), io.StringIO()
+    rv = cli.run(io.StringIO(stdin), out, err, ["kafkabalancer"] + args)
+    return rv, out.getvalue(), err.getvalue()
+
+
+@pytest.fixture
+def sock_dir():
+    # NOT tmp_path: unix socket paths are limited to ~104 bytes and
+    # pytest's tmp_path nests deep enough to cross it
+    d = tempfile.mkdtemp(prefix="kbs-")
+    yield d
+    shutil.rmtree(d, ignore_errors=True)
+
+
+@pytest.fixture
+def daemon(sock_dir):
+    """A live daemon on a private socket, serving from a background
+    thread in THIS process (warm=False: lifecycle tests need no
+    backend). Always shut down, even on test failure."""
+    sock = os.path.join(sock_dir, "kb.sock")
+    d = Daemon(sock, idle_timeout=60.0, warm=False, log=lambda _m: None)
+    rc_box = []
+    t = threading.Thread(
+        target=lambda: rc_box.append(d.serve_forever()), daemon=True
+    )
+    t.start()
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if sclient.daemon_alive(sock) is not None:
+            break
+        time.sleep(0.02)
+    else:
+        pytest.fail("daemon never became ready")
+    yield sock, d
+    sclient.request_shutdown(sock)
+    t.join(15)
+    assert rc_box == [0], rc_box
+
+
+# --- protocol -------------------------------------------------------------
+
+
+def test_frame_roundtrip_and_limits():
+    import socket as socket_mod
+
+    a, b = socket_mod.socketpair()
+    try:
+        msg = {"v": 1, "op": "hello", "blob": "x" * 10000}
+        protocol.write_frame(a, msg)
+        assert protocol.read_frame(b) == msg
+        # clean EOF at a frame boundary reads as None
+        a.close()
+        assert protocol.read_frame(b) is None
+    finally:
+        b.close()
+    with pytest.raises(ValueError):
+        protocol.write_frame(None, {"x": "y" * (protocol.MAX_FRAME_BYTES + 1)})
+
+
+def test_resolve_socket_path_precedence(monkeypatch):
+    monkeypatch.setenv("KAFKABALANCER_TPU_SOCKET", "/env/path.sock")
+    assert protocol.resolve_socket_path("") == "/env/path.sock"
+    assert protocol.resolve_socket_path("/flag.sock") == "/flag.sock"
+    monkeypatch.delenv("KAFKABALANCER_TPU_SOCKET")
+    assert protocol.resolve_socket_path("").endswith(".sock")
+
+
+# --- lifecycle ------------------------------------------------------------
+
+
+def test_handshake_pidfile_and_clean_shutdown(sock_dir):
+    sock = os.path.join(sock_dir, "kb.sock")
+    d = Daemon(sock, idle_timeout=60.0, warm=False, log=lambda _m: None)
+    rc_box = []
+    t = threading.Thread(
+        target=lambda: rc_box.append(d.serve_forever()), daemon=True
+    )
+    t.start()
+    deadline = time.monotonic() + 15
+    hello = None
+    while time.monotonic() < deadline and hello is None:
+        hello = sclient.daemon_alive(sock)
+        time.sleep(0.02)
+    assert hello is not None
+    assert hello["pid"] == os.getpid()
+    assert hello["requests"] == 0
+    with open(protocol.pidfile_path(sock)) as f:
+        assert int(f.read().strip()) == os.getpid()
+    assert sclient.request_shutdown(sock)
+    t.join(15)
+    assert rc_box == [0]
+    assert not os.path.exists(sock)
+    assert not os.path.exists(protocol.pidfile_path(sock))
+
+
+def test_idle_timeout_shuts_down(sock_dir):
+    sock = os.path.join(sock_dir, "kb.sock")
+    d = Daemon(sock, idle_timeout=0.6, warm=False, log=lambda _m: None)
+    rc_box = []
+    t = threading.Thread(
+        target=lambda: rc_box.append(d.serve_forever()), daemon=True
+    )
+    t.start()
+    t.join(20)
+    assert not t.is_alive(), "idle timeout never fired"
+    assert rc_box == [0]
+    assert not os.path.exists(sock)
+
+
+def test_stale_socket_is_not_alive_and_gets_replaced(sock_dir):
+    """A socket file with no listener behind it: the client treats it as
+    daemon-down (fallback), and a starting daemon unlinks it."""
+    import socket as socket_mod
+
+    sock = os.path.join(sock_dir, "kb.sock")
+    s = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+    s.bind(sock)
+    s.close()  # leaves the file behind, nobody listening
+    assert os.path.exists(sock)
+    assert sclient.daemon_alive(sock) is None
+    assert sclient.forward_plan(sock, ["-no-daemon=true"], "") is None
+    d = Daemon(sock, idle_timeout=60.0, warm=False, log=lambda _m: None)
+    rc_box = []
+    t = threading.Thread(
+        target=lambda: rc_box.append(d.serve_forever()), daemon=True
+    )
+    t.start()
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if sclient.daemon_alive(sock) is not None:
+            break
+        time.sleep(0.02)
+    else:
+        pytest.fail("daemon did not replace the stale socket")
+    sclient.request_shutdown(sock)
+    t.join(15)
+    assert rc_box == [0]
+
+
+def test_second_daemon_refuses_live_socket(daemon):
+    sock, _d = daemon
+    d2 = Daemon(sock, idle_timeout=60.0, warm=False, log=lambda _m: None)
+    assert d2.serve_forever() == 3
+    # the loser must not have torn down the winner's socket
+    assert sclient.daemon_alive(sock) is not None
+
+
+def test_serve_flag_rejects_input_flags():
+    rv, _out, err = run_cli(["-serve", f"-input={FIXTURE}"])
+    assert rv == 3
+    assert "-serve takes no input" in err
+
+
+# --- served-vs-inprocess parity ------------------------------------------
+
+
+def test_served_plan_byte_identical_to_inprocess(daemon):
+    sock, d = daemon
+    rv_s, out_s, _err_s = run_cli(
+        ["-input-json", f"-input={FIXTURE}", f"-serve-socket={sock}"]
+    )
+    rv_l, out_l, _err_l = run_cli(
+        ["-input-json", f"-input={FIXTURE}", "-no-daemon"]
+    )
+    assert rv_s == rv_l == 0
+    assert out_s == out_l
+    assert d._requests == 1  # it really went through the daemon
+
+
+def test_served_stdin_plan_byte_identical(daemon):
+    sock, _d = daemon
+    with open(FIXTURE) as fh:
+        src = fh.read()
+    rv_s, out_s, _ = run_cli(
+        ["-input-json", f"-serve-socket={sock}"], stdin=src
+    )
+    rv_l, out_l, _ = run_cli(["-input-json", "-no-daemon"], stdin=src)
+    assert rv_s == rv_l == 0
+    assert out_s == out_l
+
+
+def test_served_error_exit_codes_match(daemon):
+    """Exit codes 1/2/3 round-trip the daemon unchanged."""
+    sock, _d = daemon
+    cases = [
+        (["-input-json"], "::malformed::", 2),
+        (["-input-json", f"-input={FIXTURE}", "-broker-ids=bogus"], "", 3),
+        (["-input-json", "-input=/nonexistent/x.json"], "", 1),
+    ]
+    for args, stdin, want in cases:
+        rv_s, out_s, _ = run_cli(args + [f"-serve-socket={sock}"], stdin)
+        rv_l, out_l, _ = run_cli(args + ["-no-daemon"], stdin)
+        assert rv_s == rv_l == want, (args, rv_s, rv_l)
+        assert out_s == out_l
+
+
+def test_served_metrics_carry_attribution(daemon, sock_dir):
+    sock, _d = daemon
+    mpath = os.path.join(sock_dir, "m.json")
+    rv, _out, _err = run_cli(
+        ["-input-json", f"-input={FIXTURE}", f"-serve-socket={sock}",
+         f"-metrics-json={mpath}"]
+    )
+    assert rv == 0
+    with open(mpath) as f:
+        payload = json.load(f)
+    g = payload["gauges"]
+    assert g["served"] is True
+    assert g["serve.requests"] >= 1.0
+    assert "serve.coalesced" in g and "serve.cache_hits" in g
+    # exactly ONE metrics line and it came from the daemon side: the
+    # client's own exporter must not double-write
+    with open(mpath) as f:
+        assert len(f.read().strip().splitlines()) == 1
+
+
+def test_served_relative_input_error_stderr_parity(daemon, monkeypatch):
+    """Exit-1 on a RELATIVE -input path that does not exist: with a live
+    daemon the stderr must still name the path exactly as the user
+    spelled it (review r4: forwarding the flag absolutized it, so the
+    served error named /abs/missing.json while the stateless one named
+    missing.json)."""
+    sock, _d = daemon
+    monkeypatch.chdir(tempfile.mkdtemp(prefix="kbs-rel-"))
+    args = ["-input-json", "-input=does-not-exist.json"]
+    rv_s, out_s, err_s = run_cli(args + [f"-serve-socket={sock}"])
+    rv_n, out_n, err_n = run_cli(args + ["-no-daemon"])
+    assert rv_s == rv_n == 1
+    assert out_s == out_n
+    assert _TS.sub("", err_s) == _TS.sub("", err_n)
+    assert "does-not-exist.json" in err_s
+
+
+def test_served_relative_input_file_plans_through_daemon(daemon):
+    """A READABLE relative -input forwards (inlined as request stdin)
+    and plans byte-identically to the stateless path."""
+    sock, d = daemon
+    rel = os.path.relpath(FIXTURE)
+    rv_s, out_s, _ = run_cli(
+        ["-input-json", f"-input={rel}", f"-serve-socket={sock}"]
+    )
+    rv_n, out_n, _ = run_cli(["-input-json", f"-input={rel}", "-no-daemon"])
+    assert rv_s == rv_n == 0
+    assert out_s == out_n
+    assert d._requests >= 1  # genuinely served, not a silent fallback
+
+
+def test_process_warm_latch_suppresses_per_request_warm_thread(
+    sock_dir, monkeypatch
+):
+    """Once a serving process is marked durably warm (daemon startup-warm
+    hook), planning invocations in it skip the per-request warm-thread
+    launch — the one-time costs it overlaps are already paid. A process
+    that never marked itself warm still launches it."""
+    from kafkabalancer_tpu.ops import coldstart
+
+    monkeypatch.setattr(coldstart, "_process_warm", threading.Event())
+
+    def spans_of(tag):
+        mpath = os.path.join(sock_dir, f"warmlatch-{tag}.json")
+        rv, _out, err = run_cli(
+            ["-input-json", f"-input={FIXTURE}", "-solver=tpu",
+             "-no-daemon", f"-metrics-json={mpath}"]
+        )
+        assert rv == 0, err
+        with open(mpath) as f:
+            return {s["name"] for s in json.load(f)["spans"]}
+
+    assert "warm_thread_launch" in spans_of("cold")
+    coldstart.mark_process_warm()
+    assert "warm_thread_launch" not in spans_of("warm")
+
+
+# --- daemon-down fallback parity -----------------------------------------
+
+
+def test_daemon_down_fallback_byte_identical(sock_dir):
+    """The tentpole's contract pin: with no daemon reachable, the
+    forwarding-enabled invocation is byte-identical (stdout + rc,
+    stderr modulo log timestamps) to an explicit -no-daemon one, for
+    exit codes 0 through 3."""
+    sock = os.path.join(sock_dir, "absent.sock")
+    assert not os.path.exists(sock)
+    with open(FIXTURE) as fh:
+        src = fh.read()
+    cases = [
+        (["-input-json", f"-input={FIXTURE}"], "", 0),
+        (["-input-json"], src, 0),  # stdin read + replay path
+        (["-input-json"], "::malformed::", 2),
+        (["-input-json", f"-input={FIXTURE}", "-broker-ids=x"], "", 3),
+        (["-input-json", "-input=/nonexistent/x.json"], "", 1),
+    ]
+    for args, stdin, want in cases:
+        rv_f, out_f, err_f = run_cli(
+            args + [f"-serve-socket={sock}"], stdin
+        )
+        rv_n, out_n, err_n = run_cli(args + ["-no-daemon"], stdin)
+        assert rv_f == rv_n == want, (args, rv_f, rv_n)
+        assert out_f == out_n
+        assert _TS.sub("", err_f) == _TS.sub("", err_n)
+
+
+def test_profiling_flags_never_forward(daemon, sock_dir, monkeypatch):
+    """-pprof / -jax-profile pin the work to THIS process by intent."""
+    sock, d = daemon
+    pprof_path = os.path.join(sock_dir, "cpu.pprof")
+    rv, _out, _err = run_cli(
+        ["-input-json", f"-input={FIXTURE}", f"-serve-socket={sock}",
+         "-pprof", f"-pprof-path={pprof_path}"]
+    )
+    assert rv == 0
+    assert d._requests == 0  # never reached the daemon
+    assert os.path.exists(pprof_path)
+
+
+# --- canonical forwarded argv --------------------------------------------
+
+
+def test_forward_argv_canonicalization(monkeypatch, sock_dir):
+    """The forwarded argv: -no-daemon pinned, serve/profiling flags
+    stripped, non-default flags as -name=value, paths absolutized."""
+    captured = {}
+
+    def fake_forward(sock, argv, stdin_text, **kw):
+        captured["argv"] = argv
+        captured["stdin"] = stdin_text
+        return sclient.ServedResult(rc=0, stdout="", stderr="")
+
+    monkeypatch.setattr(sclient, "forward_plan", fake_forward)
+    monkeypatch.setattr(sclient, "socket_exists", lambda _p: True)
+    sock = os.path.join(sock_dir, "any.sock")
+    rel_metrics = "rel/metrics.json"
+    rv, _out, _err = run_cli(
+        ["-input-json", "-input", FIXTURE, "-max-reassign=3",
+         "-fused", "-fused-batch=4", f"-serve-socket={sock}",
+         f"-metrics-json={rel_metrics}"]
+    )
+    assert rv == 0
+    argv = captured["argv"]
+    assert argv[0] == "-no-daemon=true"
+    # -input is inlined as request stdin, never forwarded as a flag:
+    # the daemon needs no filesystem access and open-failure stderr
+    # keeps naming the path as the user spelled it
+    assert not any(a.startswith("-input=") for a in argv)
+    assert captured["stdin"] == open(FIXTURE).read()
+    assert "-max-reassign=3" in argv
+    assert "-fused=true" in argv
+    assert "-fused-batch=4" in argv
+    assert f"-metrics-json={os.path.abspath(rel_metrics)}" in argv
+    assert not any(a.startswith("-serve") for a in argv)
+    # defaults are omitted: the daemon's own defaults are identical
+    assert not any(a.startswith("-beam-width") for a in argv)
+
+
+# --- coalescing -----------------------------------------------------------
+
+
+def test_coalescer_groups_same_bucket():
+    """Two same-bucket requests queued behind a blocker drain as ONE
+    dispatch window (second flagged coalesced); a different-bucket
+    request does not ride along."""
+    release = threading.Event()
+    entered = threading.Event()
+    handled = []
+
+    def handle(req, coalesced):
+        if req.argv == ["block"]:
+            entered.set()
+            release.wait(10)
+        handled.append((req.argv[0], coalesced))
+        req.response = {"ok": True, "id": req.argv[0]}
+
+    buckets = {"block": (1, 1, 1, True), "a1": (8, 2, 4, True),
+               "a2": (8, 2, 4, True), "b": (16, 2, 4, False)}
+    co = Coalescer(handle, lambda r: buckets[r.argv[0]])
+    results = {}
+
+    def submit(name):
+        results[name] = co.submit(PlanRequest([name], None))
+
+    threads = [threading.Thread(target=submit, args=("block",))]
+    threads[0].start()
+    assert entered.wait(10), "worker never picked up the blocker"
+    for name in ("a1", "a2", "b"):
+        threads.append(threading.Thread(target=submit, args=(name,)))
+        threads[-1].start()
+    # wait until all three are queued behind the blocker
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and len(co._dq) < 3:
+        time.sleep(0.01)
+    assert len(co._dq) == 3, "followers never queued"
+    release.set()
+    for t in threads:
+        t.join(10)
+    co.stop()
+    assert {r["id"] for r in results.values()} == {"block", "a1", "a2", "b"}
+    flags = dict(handled)
+    assert flags["block"] is False
+    # exactly one of the same-bucket pair rode the other's window
+    assert [flags["a1"], flags["a2"]].count(True) == 1
+    assert flags["b"] is False
+
+
+def test_concurrent_served_requests_each_get_correct_plan(daemon):
+    sock, d = daemon
+    want_rv, want_out, _ = run_cli(
+        ["-input-json", f"-input={FIXTURE}", "-no-daemon"]
+    )
+    results = []
+
+    def one():
+        results.append(
+            run_cli(["-input-json", f"-input={FIXTURE}",
+                     f"-serve-socket={sock}"])
+        )
+
+    threads = [threading.Thread(target=one) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert len(results) == 3
+    for rv, out, _err in results:
+        assert rv == want_rv == 0
+        assert out == want_out
+    assert d._requests == 3
+
+
+# --- the incremental tensorize cache -------------------------------------
+
+
+def _parse_fixture():
+    from kafkabalancer_tpu.codecs import get_partition_list_from_reader
+    from kafkabalancer_tpu.models import default_rebalance_config
+    from kafkabalancer_tpu.solvers.scan import _settle_head
+
+    with open(FIXTURE) as fh:
+        pl = get_partition_list_from_reader(fh, True, [])
+    cfg = default_rebalance_config()
+    _settle_head(pl, cfg, 0)
+    return pl, cfg
+
+
+def test_tensorize_cache_incremental_hit_matches_full_encode():
+    import numpy as np
+
+    from kafkabalancer_tpu.ops.tensorize import set_row_cache, tensorize
+    from kafkabalancer_tpu.serve.cache import TensorizeRowCache
+
+    pl, cfg = _parse_fixture()
+    want_cold = tensorize(pl, cfg)  # uncached reference encode
+    cache = TensorizeRowCache()
+    set_row_cache(cache)
+    try:
+        dp1 = tensorize(pl, cfg)  # primes
+        assert cache.stats()["hits"] == 0
+        # one changed partition — the outer loop's steady state
+        p0 = pl.partitions[0]
+        p0.replicas[0], p0.replicas[1] = p0.replicas[1], p0.replicas[0]
+        dp2 = tensorize(pl, cfg)  # incremental
+        assert cache.stats()["hits"] == 1
+        assert cache.stats()["rows_reused"] == len(pl.partitions) - 1
+        set_row_cache(None)
+        want_warm = tensorize(pl, cfg)  # uncached encode of mutated pl
+        for f in ("weights", "replicas", "nrep_cur", "nrep_tgt", "ncons",
+                  "allowed", "member", "pvalid", "bvalid", "topic_id"):
+            np.testing.assert_array_equal(
+                getattr(dp2, f), getattr(want_warm, f), err_msg=f
+            )
+        assert dp2.topics == want_warm.topics
+        np.testing.assert_array_equal(dp2.broker_ids, want_warm.broker_ids)
+        # and the primed pass matched the cold encode
+        np.testing.assert_array_equal(dp1.replicas, want_cold.replicas)
+    finally:
+        set_row_cache(None)
+
+
+def test_tensorize_cache_returns_independent_copies():
+    import numpy as np
+
+    from kafkabalancer_tpu.ops.tensorize import set_row_cache, tensorize
+    from kafkabalancer_tpu.serve.cache import TensorizeRowCache
+
+    pl, cfg = _parse_fixture()
+    cache = TensorizeRowCache()
+    set_row_cache(cache)
+    try:
+        tensorize(pl, cfg)
+        dp_a = tensorize(pl, cfg)
+        assert cache.stats()["hits"] == 1
+        dp_a.replicas[:] = -7  # caller vandalism must not reach the cache
+        dp_b = tensorize(pl, cfg)
+        assert not np.any(dp_b.replicas == -7)
+    finally:
+        set_row_cache(None)
+
+
+def test_tensorize_cache_misses_on_new_topic_and_universe_change():
+    from kafkabalancer_tpu.ops.tensorize import set_row_cache, tensorize
+    from kafkabalancer_tpu.serve.cache import TensorizeRowCache
+
+    pl, cfg = _parse_fixture()
+    cache = TensorizeRowCache()
+    set_row_cache(cache)
+    try:
+        tensorize(pl, cfg)
+        # a brand-new topic cannot be expressed in the cached vocabulary
+        pl.partitions[0].topic = "freshly-minted-topic"
+        dp = tensorize(pl, cfg)
+        assert cache.stats()["hits"] == 0
+        assert "freshly-minted-topic" in dp.topics
+        # a universe change (extra broker) misses on the meta check
+        dp2 = tensorize(pl, cfg, extra_brokers=(999,))
+        assert cache.stats()["hits"] == 0
+        assert 999 in list(dp2.broker_ids)
+    finally:
+        set_row_cache(None)
+
+
+def test_served_fused_plan_uses_tensorize_cache(daemon):
+    """End to end through the daemon: two identical -fused requests; the
+    second re-tensorizes incrementally (serve.cache_hits visible in the
+    hello counters) and both plans are byte-identical to in-process."""
+    sock, d = daemon
+    args = ["-input-json", f"-input={FIXTURE}", "-fused",
+            "-fused-batch=4", "-max-reassign=4"]
+    want_rv, want_out, _ = run_cli(args + ["-no-daemon"])
+    rv1, out1, _ = run_cli(args + [f"-serve-socket={sock}"])
+    rv2, out2, _ = run_cli(args + [f"-serve-socket={sock}"])
+    assert rv1 == rv2 == want_rv == 0
+    assert out1 == want_out and out2 == want_out
+    assert d.tensorize_cache.stats()["hits"] >= 1
+
+
+# --- the no-jax client pin ------------------------------------------------
+
+
+def test_served_client_path_never_imports_jax(daemon):
+    """The tentpole's raison d'être, pinned: a CLIENT process whose
+    request is served by a daemon exits without importing jax or the
+    solver stack — even for a -solver=tpu request (the daemon pays the
+    device work)."""
+    sock, _d = daemon
+    code = (
+        "import io, sys\n"
+        "from kafkabalancer_tpu.cli import run\n"
+        "rc = run(io.StringIO(), io.StringIO(), io.StringIO(),\n"
+        "         ['kafkabalancer', '-input-json', '-input', "
+        f"{FIXTURE!r}, '-solver=greedy', '-serve-socket={sock}'])\n"
+        "assert rc == 0, f'exit {rc}'\n"
+        "bad = [m for m in sys.modules if m == 'jax' "
+        "or m.startswith('jax.')]\n"
+        "assert not bad, f'jax imported on the client path: {bad[:3]}'\n"
+        "assert 'kafkabalancer_tpu.solvers.scan' not in sys.modules\n"
+        "assert 'kafkabalancer_tpu.solvers.tpu' not in sys.modules\n"
+        # numpy rides the same pin: balancer.steps/costmodel defer it, and
+        # a module-level regression puts ~0.1 s back into EVERY forwarded
+        # invocation's startup
+        "assert 'numpy' not in sys.modules, 'numpy on the client path'\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+
+# --- the device-upload cache (scan._dev_cached_asarray) -------------------
+
+
+def test_dev_cached_asarray_reuses_equal_content():
+    import numpy as np
+
+    from kafkabalancer_tpu.solvers.scan import _dev_cached_asarray
+
+    cache = {}
+    a1 = np.arange(16.0)
+    dev1 = _dev_cached_asarray(cache, "w", a1)
+    # a FRESH array with identical content (what re-tensorize produces)
+    dev2 = _dev_cached_asarray(cache, "w", np.arange(16.0))
+    assert dev2 is dev1  # no re-upload
+    # changed content misses and replaces the slot
+    a3 = np.arange(16.0) * 2
+    dev3 = _dev_cached_asarray(cache, "w", a3)
+    assert dev3 is not dev1
+    np.testing.assert_array_equal(np.asarray(dev3), a3)
+    # None passes through; no cache is a plain asarray
+    assert _dev_cached_asarray(cache, "x", None) is None
+    assert _dev_cached_asarray(None, "w", a1) is not None

@@ -1,0 +1,184 @@
+"""Differential pins for the vectorized greedy scan (steps.scan_moves).
+
+scan_partition_move is the parity oracle (a faithful transcription of the
+reference move() loop body); scan_moves is its batched numpy replay. The
+contract is BIT equality — same cu double, same (partition, replica,
+target) winner — because the greedy scan is itself the byte-parity oracle
+for the device solvers, and any float drift here would cascade into plan
+differences downstream.
+"""
+
+import copy
+import random
+
+import pytest
+
+from kafkabalancer_tpu.balancer import costmodel
+from kafkabalancer_tpu.balancer.steps import (
+    BalanceError,
+    fill_defaults,
+    greedy_move,
+    scan_moves,
+    scan_partition_move,
+)
+from kafkabalancer_tpu.models import Partition, PartitionList
+from kafkabalancer_tpu.models.config import default_rebalance_config
+from tests.helpers import random_partition_list
+
+
+def _bl_of(pl, cfg):
+    loads = costmodel.get_broker_load(pl)
+    for bid in cfg.brokers or []:
+        if bid not in loads:
+            loads[bid] = 0.0
+    return costmodel.get_bl(loads)
+
+
+def _sequential(parts, bl, cu, best, cfg, leaders):
+    """The scalar oracle, threaded exactly like greedy_move does."""
+    winner = -1
+    for pos, p in enumerate(parts):
+        cu, nbest = scan_partition_move(p, bl, cu, best, cfg, leaders)
+        if nbest is not best:
+            best, winner = nbest, pos
+    return cu, best, winner
+
+
+def _assert_scan_parity(pl, cfg, leaders=False):
+    parts = list(pl.iter_partitions())
+    bl_a = _bl_of(pl, cfg)
+    bl_b = copy.deepcopy(bl_a)
+    su = costmodel.get_unbalance_bl(bl_a)
+    cu_s, best_s, pos_s = _sequential(parts, bl_a, su, None, cfg, leaders)
+    cu_v, best_v, pos_v = scan_moves(parts, bl_b, su, None, cfg, leaders)
+    # bit equality, NaN-aware (an all-zero-loads cluster keeps cu = NaN)
+    assert repr(cu_s) == repr(cu_v), (cu_s, cu_v)
+    assert pos_s == pos_v
+    if best_s is None:
+        assert best_v is None
+    else:
+        ps, rs, bs = best_s
+        pv, rv, bv = best_v
+        assert ps is pv  # same partition OBJECT: replace_replica needs it
+        assert (rs, bs) == (rv, bv)
+    # the batch path must leave bl untouched (the scalar restores it)
+    assert bl_a == bl_b
+
+
+@pytest.mark.parametrize("seed", range(25))
+def test_scan_moves_randomized_bit_parity(seed):
+    rng = random.Random(seed)
+    pl = random_partition_list(
+        rng,
+        n_partitions=rng.randint(1, 60),
+        n_brokers=rng.randint(2, 12),
+        max_rf=4,
+        with_consumers=True,
+        restrict_brokers=True,
+        filled=True,
+    )
+    cfg = default_rebalance_config()
+    cfg.min_unbalance = 0.0
+    _assert_scan_parity(pl, cfg, leaders=False)
+    _assert_scan_parity(pl, cfg, leaders=True)
+
+
+@pytest.mark.parametrize("seed", range(10))
+def test_get_broker_load_bit_matches_reference(seed):
+    """The np.add.at accumulation must reproduce the reference dict
+    loop's per-broker float sums to the last bit (same accrual order per
+    broker cell), including the leader premium and consumer terms."""
+    rng = random.Random(1000 + seed)
+    pl = random_partition_list(
+        rng,
+        n_partitions=rng.randint(0, 80),
+        n_brokers=rng.randint(2, 10),
+        max_rf=4,
+        with_consumers=True,
+        filled=True,
+    )
+    fast = costmodel.get_broker_load(pl)
+    ref = costmodel._get_broker_load_ref(pl)
+    assert set(fast) == set(ref)
+    for bid in ref:
+        assert repr(fast[bid]) == repr(ref[bid]), bid
+
+
+def test_scan_moves_zero_loads_nan_objective():
+    """All-zero loads: the objective is NaN end to end and no candidate
+    may ever win (NaN < NaN is False) — the reference's no-candidate
+    exit-0 contract."""
+    parts = [
+        Partition(
+            topic="t", partition=i, replicas=[1, 2], weight=0.0,
+            num_replicas=2, brokers=[1, 2, 3], num_consumers=0,
+        )
+        for i in range(4)
+    ]
+    pl = PartitionList(version=1, partitions=parts)
+    cfg = default_rebalance_config()
+    _assert_scan_parity(pl, cfg)
+
+
+def test_scan_moves_min_replicas_filter_and_empty_movable():
+    """Partitions under min_replicas_for_rebalancing and RF-1 partitions
+    (no movable follower) are skipped identically."""
+    parts = [
+        Partition(
+            topic="t", partition=0, replicas=[1], weight=1.0,
+            num_replicas=1, brokers=[1, 2, 3], num_consumers=0,
+        ),
+        Partition(
+            topic="t", partition=1, replicas=[1, 2], weight=5.0,
+            num_replicas=2, brokers=[1, 2, 3], num_consumers=0,
+        ),
+    ]
+    pl = PartitionList(version=1, partitions=parts)
+    cfg = default_rebalance_config()
+    cfg.min_unbalance = 0.0
+    _assert_scan_parity(pl, cfg)
+
+
+def test_scan_moves_missing_replica_raises_like_oracle():
+    """A replica absent from the broker-load table raises the same
+    BalanceError (message included) as the scalar scan."""
+    good = Partition(
+        topic="t", partition=0, replicas=[1, 2], weight=1.0,
+        num_replicas=2, brokers=[1, 2], num_consumers=0,
+    )
+    pl = PartitionList(version=1, partitions=[good])
+    cfg = default_rebalance_config()
+    bl = _bl_of(pl, cfg)
+    bad = Partition(
+        topic="t", partition=1, replicas=[1, 99], weight=1.0,
+        num_replicas=2, brokers=[1, 2], num_consumers=0,
+    )
+    with pytest.raises(BalanceError) as e_seq:
+        _sequential([good, bad], copy.deepcopy(bl), 0.0, None, cfg, False)
+    with pytest.raises(BalanceError) as e_vec:
+        scan_moves([good, bad], copy.deepcopy(bl), 0.0, None, cfg, False)
+    assert str(e_seq.value) == str(e_vec.value)
+
+
+def test_greedy_move_still_byte_stable():
+    """End-to-end: greedy_move (now on the batched scan) still produces
+    the documented winner on a hand-built unbalanced cluster."""
+    parts = [
+        Partition(
+            topic="t", partition=i, replicas=[1, 2], weight=1.0,
+            num_replicas=2, brokers=[1, 2, 3], num_consumers=0,
+        )
+        for i in range(6)
+    ]
+    pl = PartitionList(version=1, partitions=parts)
+    cfg = default_rebalance_config()
+    cfg.min_unbalance = 0.0
+    cfg.brokers = [1, 2, 3]  # zero-fills idle broker 3 into the table
+    fill_defaults(pl, cfg)
+    out = greedy_move(pl, cfg, False)
+    assert out is not None
+    moved = out.partitions[0]
+    # first-strict-improver: partition 0's follower moves to the idle
+    # broker 3
+    assert (moved.topic, moved.partition) == ("t", 0)
+    assert moved.replicas == [1, 3]
