@@ -1,0 +1,105 @@
+"""The daemon wire protocol: versioned length-prefixed JSON frames.
+
+One frame = a 4-byte big-endian payload length followed by that many
+bytes of UTF-8 JSON. Every message carries ``{"v": PROTO_VERSION}``; a
+peer speaking a different version is treated as unreachable (the client
+falls back to the in-process path rather than risk a half-understood
+plan). Requests carry ``"op"``:
+
+- ``hello``    — liveness/identity handshake; the response carries the
+  daemon pid, package version, uptime and request counters, and is what
+  distinguishes a live daemon from a stale socket file;
+- ``plan``     — one CLI invocation: ``argv`` (the canonical flag list
+  the client built, ``-no-daemon`` included so the daemon never
+  re-forwards) plus ``stdin`` (the input text when no ``-input``/
+  ``-from-zk`` names a source). The response carries ``rc``/``stdout``/
+  ``stderr`` verbatim;
+- ``shutdown`` — orderly daemon exit (acknowledged before the listener
+  closes).
+
+Nothing in this module (or ``serve.client``) imports jax: the client
+side of a forwarded invocation must stay as light as an error exit.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import tempfile
+from typing import Any, Dict, Optional
+
+PROTO_VERSION = 1
+
+# a frame larger than this is a protocol error, not a payload: the
+# biggest legitimate frame is a -full-output plan for a very large
+# cluster (tens of MB), and an unframed/garbage peer must not make the
+# reader allocate gigabytes from four random length bytes
+MAX_FRAME_BYTES = 1 << 28
+
+_LEN = struct.Struct(">I")
+
+
+def default_socket_path() -> str:
+    """The per-user default socket: ``$KAFKABALANCER_TPU_SOCKET`` when
+    set, else ``<tmpdir>/kafkabalancer-tpu-<uid>.sock`` (per-uid so two
+    operators on one host get independent daemons)."""
+    env = os.environ.get("KAFKABALANCER_TPU_SOCKET", "")
+    if env:
+        return env
+    uid = os.getuid() if hasattr(os, "getuid") else 0
+    return os.path.join(tempfile.gettempdir(), f"kafkabalancer-tpu-{uid}.sock")
+
+
+def resolve_socket_path(flag_value: str = "") -> str:
+    """The one precedence rule shared by daemon and client:
+    ``-serve-socket`` flag > ``$KAFKABALANCER_TPU_SOCKET`` > default."""
+    return flag_value or default_socket_path()
+
+
+def pidfile_path(socket_path: str) -> str:
+    """The liveness pidfile rides next to the socket."""
+    return socket_path + ".pid"
+
+
+def write_frame(sock: socket.socket, obj: Dict[str, Any]) -> None:
+    payload = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    if len(payload) > MAX_FRAME_BYTES:
+        raise ValueError(f"frame too large: {len(payload)} bytes")
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
+    """Read exactly ``n`` bytes, or None on a clean EOF at a frame
+    boundary (mid-frame EOF raises — that is a truncation, not a
+    close)."""
+    chunks = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(min(n - got, 1 << 20))
+        if not chunk:
+            if got == 0:
+                return None
+            raise ConnectionError(f"EOF mid-frame ({got}/{n} bytes)")
+        chunks.append(chunk)
+        got += len(chunk)
+    return b"".join(chunks)
+
+
+def read_frame(sock: socket.socket) -> Optional[Dict[str, Any]]:
+    """One frame as a dict, or None on clean EOF. Raises on truncation,
+    an oversized length prefix, or non-JSON payload."""
+    head = _recv_exact(sock, _LEN.size)
+    if head is None:
+        return None
+    (n,) = _LEN.unpack(head)
+    if n > MAX_FRAME_BYTES:
+        raise ValueError(f"frame length {n} exceeds {MAX_FRAME_BYTES}")
+    body = _recv_exact(sock, n) if n else b""
+    if body is None:
+        raise ConnectionError("EOF after frame header")
+    obj = json.loads(body.decode("utf-8"))
+    if not isinstance(obj, dict):
+        raise ValueError("frame payload is not a JSON object")
+    return obj
