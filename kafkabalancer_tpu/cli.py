@@ -99,14 +99,21 @@ class _TelemetryFlags:
     """Export targets from the ``-stats``/``-metrics-json``/``-trace``
     flag trio, filled by ``_run_impl`` once flags parse so the exporter
     tail in :func:`run` can fire on EVERY exit path (error exits
-    included — those are the invocations an operator debugs)."""
+    included — those are the invocations an operator debugs).
 
-    __slots__ = ("stats", "metrics_path", "trace_path")
+    ``attrs`` carries this invocation's attribution gauges so the
+    exporter can overlay them at export time: in the multi-lane daemon's
+    shared-registry mode a CONCURRENT request's gauge writes would
+    otherwise clobber this request's (e.g. its ``serve.lane``) between
+    stamping and export."""
+
+    __slots__ = ("stats", "metrics_path", "trace_path", "attrs")
 
     def __init__(self) -> None:
         self.stats = False
         self.metrics_path = ""
         self.trace_path = ""
+        self.attrs: Dict[str, Any] = {}
 
     def any(self) -> bool:
         return bool(self.stats or self.metrics_path or self.trace_path)
@@ -130,11 +137,15 @@ def _export_telemetry(
             logger.printf(f"failed rendering -stats summary: {exc}")
     if tel.metrics_path:
         try:
-            obs_export.write_metrics_json(
-                tel.metrics_path,
-                obs_export.metrics_payload(obs.REGISTRY, obs.tracer, rc=rc),
-                o,
+            payload = obs_export.metrics_payload(
+                obs.REGISTRY, obs.tracer, rc=rc
             )
+            if tel.attrs:
+                # this request's attribution wins over any concurrent
+                # request's writes to the shared registry (see
+                # _TelemetryFlags.attrs)
+                payload["gauges"] = {**payload.get("gauges", {}), **tel.attrs}
+            obs_export.write_metrics_json(tel.metrics_path, payload, o)
         except Exception as exc:
             logger.printf(
                 f"failed writing metrics JSON to {tel.metrics_path}: {exc}"
@@ -176,6 +187,7 @@ def _track_warm_thread(t: Any) -> None:
 # naming the path exactly as the user spelled it (stderr parity)
 _NO_FORWARD_FLAGS = frozenset((
     "serve", "serve-socket", "serve-idle-timeout", "serve-prewarm",
+    "serve-lanes", "serve-microbatch",
     "no-daemon", "help", "pprof", "pprof-path", "jax-profile", "input",
 ))
 # flags whose value names a filesystem path the DAEMON will write — made
@@ -225,6 +237,7 @@ def run(
     tel = _TelemetryFlags()
     obs.begin_invocation()
     if attrs:
+        tel.attrs = dict(attrs)
         for k, v in attrs.items():
             obs.metrics.gauge(k, v)
     rc = -1  # sentinel: an uncaught exception exports rc=-1
@@ -239,7 +252,13 @@ def run(
             # the shared head (the obs.export import) — a telemetry
             # failure must neither mask rc nor skip the stderr flush
             logger.printf(f"telemetry export failed: {exc}")
-        be.close()
+        finally:
+            if tel.any():
+                # shared-registry bookkeeping: when the last tracing
+                # request finishes, the tracer returns to its no-op
+                # fast path (no-op outside shared mode)
+                obs.end_invocation()
+            be.close()
 
 
 def _run_impl(
@@ -423,6 +442,21 @@ def _run_impl(
             "Daemon: AOT-prewarm this PARTITIONSxBROKERS[,...] shape "
             "grid at startup and hold the executables device-resident",
         )
+        f_serve_lanes = f.int(
+            "serve-lanes",
+            0,
+            "Daemon: worker lanes, one per device (0 = one lane per "
+            "visible device; 1 = the single-lane dispatcher; N caps at "
+            "the device count). Lanes get bucket-affinity routing and "
+            "work stealing (docs/serving.md)",
+        )
+        f_serve_microbatch = f.int(
+            "serve-microbatch",
+            4,
+            "Daemon: fuse up to this many queued same-bucket requests "
+            "into one batched device dispatch (1 disables; results stay "
+            "byte-identical to solo dispatches)",
+        )
         f_no_daemon = f.bool(
             "no-daemon",
             False,
@@ -549,6 +583,8 @@ def _run_impl(
                 idle_timeout=f_serve_idle.value,
                 prewarm_shapes=f_serve_prewarm.value,
                 log=log,
+                lanes=f_serve_lanes.value,
+                microbatch=f_serve_microbatch.value,
             ).serve_forever()
 
         if not f_no_daemon.value and not (f_pprof.value or f_jaxprof.value):
@@ -586,9 +622,21 @@ def _run_impl(
                     # below when the daemon turns out unreachable
                     stdin_text = i.read()
             if forwardable:
+                declined: List[str] = []
                 with obs.span("serve.forward", socket=sock):
                     served = serve_client.forward_plan(
-                        sock, _forward_argv(f), stdin_text
+                        sock, _forward_argv(f), stdin_text,
+                        on_fallback=declined.append,
+                    )
+                if served is None and declined:
+                    # the daemon POSITIVELY declined (structured error
+                    # frame / frame-cap overflow) — name the reason
+                    # instead of a generic silent fallback. Silent
+                    # failure modes (daemon down, stale socket) log
+                    # nothing, preserving daemon-down stderr parity.
+                    log(
+                        f"daemon declined request ({declined[0]}); "
+                        "planning in-process"
                     )
                 if served is not None:
                     obs.metrics.count("cli.served")

@@ -22,6 +22,7 @@ tests/test_coldstart.py must survive every telemetry flag.
 
 from __future__ import annotations
 
+import threading
 from typing import Any, Optional
 
 from kafkabalancer_tpu.obs.metrics import (  # noqa: F401
@@ -47,15 +48,72 @@ from kafkabalancer_tpu.obs.trace import (  # noqa: F401
 tracer = TRACER
 
 
+# Concurrent-serving mode (the multi-lane daemon, serve/lanes.py): with
+# several requests in flight at once, a per-request registry/tracer
+# reset would wipe another request's attribution mid-export, so
+# begin_invocation keeps the daemon-lifetime stores instead. Counters
+# then read as daemon-lifetime totals — which is exactly the right
+# denominator for throughput attribution (serve.lane_busy_s,
+# serve.microbatched). The stateless CLI and the single-lane daemon
+# never set this.
+_shared_registry = False
+# tracing requests in flight (shared mode only): the tracer stays
+# enabled while ANY -stats/-metrics-json/-trace request runs and drops
+# back to the no-op fast path when the last one finishes — one traced
+# request must not leave span recording on for the daemon's lifetime
+_shared_tracing = 0
+_shared_lock = threading.Lock()
+
+
+def set_shared_registry(on: bool) -> None:
+    """Enter/leave concurrent-serving mode; see the comment above."""
+    global _shared_registry, _shared_tracing
+    _shared_registry = on
+    if not on:
+        with _shared_lock:
+            _shared_tracing = 0
+
+
+def shared_registry() -> bool:
+    return _shared_registry
+
+
 def begin_invocation(enabled: bool = False) -> None:
     """Reset the process-global registry + tracer for a fresh invocation
-    (the CLI calls this at the top of every ``run``)."""
+    (the CLI calls this at the top of every ``run``). In shared-registry
+    mode (multi-lane serving) the stores are daemon-lifetime: nothing
+    resets, and the tracer only trims completed spans past its cap so a
+    long-lived tracing daemon stays bounded."""
+    if _shared_registry:
+        if enabled:
+            enable_tracing()
+        TRACER.trim()
+        return
     REGISTRY.reset()
     TRACER.reset(enabled=enabled)
 
 
 def enable_tracing() -> None:
+    if _shared_registry:
+        global _shared_tracing
+        with _shared_lock:
+            _shared_tracing += 1
     TRACER.enable()
+
+
+def end_invocation() -> None:
+    """Shared-mode bookkeeping, called from ``cli.run``'s finally for
+    invocations that enabled tracing: when the LAST tracing request
+    finishes, the tracer returns to the no-op fast path (spans already
+    recorded stay until trim). A no-op outside shared mode."""
+    if not _shared_registry:
+        return
+    global _shared_tracing
+    with _shared_lock:
+        if _shared_tracing > 0:
+            _shared_tracing -= 1
+        if _shared_tracing == 0:
+            TRACER.disable()
 
 
 def span(

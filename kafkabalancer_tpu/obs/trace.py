@@ -113,6 +113,11 @@ class Tracer:
     def enable(self) -> None:
         self._enabled = True
 
+    def disable(self) -> None:
+        """Back to the no-op fast path without dropping recorded spans
+        (shared-registry mode's last-tracing-request-out hook)."""
+        self._enabled = False
+
     def reset(self, enabled: Optional[bool] = None) -> None:
         """Start a fresh invocation: drop recorded spans, rebase the
         clock. Other threads' local stacks may still hold pre-reset
@@ -128,6 +133,28 @@ class Tracer:
             self.epoch = time.time()
             if enabled is not None:
                 self._enabled = enabled
+
+    # shared-registry (multi-lane daemon) bound: a tracing daemon never
+    # resets, so completed spans past this cap are dropped oldest-first
+    # on each begin_invocation to keep the process bounded
+    TRIM_CAP = 4096
+
+    def trim(self, cap: Optional[int] = None) -> None:
+        """Drop the oldest COMPLETED spans past ``cap`` (in-flight spans
+        are kept — another thread still owns them). The shared-registry
+        mode's bound; a no-op while under the cap."""
+        cap = self.TRIM_CAP if cap is None else cap
+        with self._lock:
+            excess = len(self._spans) - cap
+            if excess <= 0:
+                return
+            kept: List[Span] = []
+            for sp in self._spans:
+                if excess > 0 and sp.t1_ns is not None:
+                    excess -= 1
+                else:
+                    kept.append(sp)
+            self._spans = kept
 
     def current(self) -> Optional[Span]:
         """The innermost open span on THIS thread, or None — the handle a

@@ -105,8 +105,94 @@ _source_salt: Optional[str] = None
 # executable per (program, shape bucket, flag combo) forever as the
 # outer loop's cluster drifts across bucket boundaries. Insertion order
 # doubles as recency (hits re-insert); the stateless CLI never comes
-# near the cap.
+# near the cap. Keys carry the pinned execution device when one is set
+# (see :func:`set_execution_device`): a deserialized executable is bound
+# to its execution device, so a multi-lane daemon holds one resident
+# copy per (program, shapes, device) while the on-disk blob — device
+# independent — is shared by every lane.
 _loaded: Dict[str, Any] = {}
+
+# per-thread execution pinning for a multi-lane serving process: the
+# lane's worker/request threads pin loads, staging and execution to the
+# lane's device; everything else (the stateless CLI, the single-lane
+# daemon) leaves it unset and keeps the device-0 default.
+_tls = threading.local()
+
+
+def set_execution_device(dev: Any) -> None:
+    """Pin THIS thread's AOT loads/staging to ``dev`` (a jax Device), or
+    clear the pin with None. Installed by a serve lane's device context
+    (serve/lanes.py) so each lane deserializes and executes against its
+    own device."""
+    _tls.exec_device = dev
+
+
+def execution_device() -> Any:
+    """This thread's pinned execution device, or None (device 0)."""
+    return getattr(_tls, "exec_device", None)
+
+
+def _resident_key(key: str) -> str:
+    """The in-process resident-executable key: the content key plus the
+    pinned device (the DISK key stays device-free — one blob serves
+    every lane; only the deserialized copy is device-bound)."""
+    dev = execution_device()
+    return key if dev is None else f"{key}@dev{getattr(dev, 'id', dev)}"
+
+
+def set_staging_cache(cache: Optional[Dict[Any, Any]]) -> None:
+    """Install a per-thread digest-keyed staging cache (serve lane
+    pipelining): arrays a stage thread already ``device_put`` for the
+    NEXT request are reused by :func:`_stage_args` instead of paying the
+    transfer again inside the dispatch. None clears it."""
+    _tls.stage_cache = cache
+
+
+def staging_cache() -> Optional[Dict[Any, Any]]:
+    return getattr(_tls, "stage_cache", None)
+
+
+def _stage_key(a: "np.ndarray") -> Tuple[Any, ...]:
+    arr = np.ascontiguousarray(a)
+    return (arr.shape, arr.dtype.str, hashlib.md5(arr.tobytes()).digest())
+
+
+# mispredicted stage entries are never consumed; past this many the
+# stage thread drops the stale set before staging fresh ones (consumed
+# entries are popped by _stage_args, so a healthy pipeline stays small)
+_STAGE_CACHE_CAP = 64
+
+
+def stage_host_arrays(cache: Dict[Any, Any], arrays: Any) -> int:
+    """Stage-thread half of the double buffer: ``device_put`` each array
+    onto this thread's pinned device (see :func:`set_execution_device`),
+    digest-keyed into ``cache`` so the dispatch-side :func:`_stage_args`
+    CONSUMES the already-resident buffer (pop — staged buffers are
+    single-use). Content-addressed, so a misprediction is a harmless
+    miss; accumulated mispredictions are dropped past the cap. Returns
+    the number staged."""
+    try:
+        import jax
+
+        dev = execution_device()
+        if dev is None:
+            dev = jax.devices()[0]
+        if len(cache) > _STAGE_CACHE_CAP:
+            cache.clear()
+        n = 0
+        for a in arrays:
+            if a is None:
+                continue
+            arr = np.asarray(a)
+            key = _stage_key(arr)
+            if key not in cache:
+                cache[key] = jax.device_put(arr, dev)
+                n += 1
+        if n:
+            obs.metrics.count("aot.staged_ahead", n)
+        return n
+    except Exception:
+        return 0
 _LOADED_CAP_ENV = "KAFKABALANCER_TPU_LOADED_CAP"
 
 
@@ -774,13 +860,13 @@ def try_load(
     # blackhole) must cost the overlap, not the plan — past the deadline
     # the dispatch falls through to the jit path like any other miss
     with _inflight_lock:
-        th = _inflight.get(key)
+        th = _inflight.get(_resident_key(key))
     if th is not None and th is not threading.current_thread():
         th.join(_PREFETCH_JOIN_S)
         if th.is_alive():
             obs.metrics.event("aot_prefetch_join_timeout", name=name)
             return None
-    compiled_hit = _loaded_get(key)
+    compiled_hit = _loaded_get(_resident_key(key))
     if compiled_hit is not None:
         return compiled_hit
     try:
@@ -814,14 +900,19 @@ def try_load(
             skel = 0 if out_leaves == 1 else (0,) * out_leaves
             out_tree = jax.tree_util.tree_flatten(skel)[1]
             # the stored executables are single-device programs; restrict
-            # execution to device 0 (the default would hand a multi-device
-            # backend's full device list over and demand N-sharded args).
+            # execution to the pinned lane device when one is set, else
+            # device 0 (the default would hand a multi-device backend's
+            # full device list over and demand N-sharded args).
             # execution_devices= only exists on newer jax — older versions
             # replay the devices recorded at serialize time, which is the
-            # same single-device restriction
+            # same single-device restriction (a lane pin then degrades to
+            # device 0 for AOT hits; the jit path still honors the lane)
             kwargs: Dict[str, Any] = {}
             if _supports_execution_devices(deserialize_and_load):
-                kwargs["execution_devices"] = jax.devices()[:1]
+                pin = execution_device()
+                kwargs["execution_devices"] = (
+                    [pin] if pin is not None else jax.devices()[:1]
+                )
             try:
                 compiled = deserialize_and_load(
                     blob, in_tree, out_tree, **kwargs
@@ -851,7 +942,9 @@ def try_load(
                         _noload_record(d, _noload_key(), name)
                     return None
                 raise  # corruption / pre-v2.1 entry: corrupt-drop path
-        _loaded_put(key, compiled)  # repeat chunks skip re-deserialization
+        # repeat chunks skip re-deserialization (device-suffixed key: a
+        # lane's resident copy never answers for another device's)
+        _loaded_put(_resident_key(key), compiled)
         dt = time.perf_counter() - t0
         obs.metrics.phase_set(name, "load_s", dt)
         obs.metrics.phase_set(name, "blob_mb", len(blob) / 1e6)
@@ -888,21 +981,25 @@ def prefetch(
     if d is None:
         return None
     key = aot_key(name, args, statics)
-    if key in _loaded:
+    res_key = _resident_key(key)
+    if res_key in _loaded:
         return key
     if _load_blocked(d, name):
         return None  # a known platform-keyed miss: no speculative I/O
     # captured on the CALLING thread: the loader runs on its own track
-    # but stays parented to the invocation site that asked for it
+    # but stays parented to the invocation site that asked for it —
+    # likewise the execution-device pin, which thread-locals would lose
     parent = obs.current_span()
+    pin = execution_device()
     with _inflight_lock:
-        if key in _inflight:
+        if res_key in _inflight:
             return key
         if not _entry_exists(d, key):
             return None
 
         def body() -> None:
             try:
+                set_execution_device(pin)
                 with obs.span("aot.prefetch", parent=parent, program=name):
                     t0 = time.perf_counter()
                     if try_load(
@@ -914,12 +1011,12 @@ def prefetch(
                         )
                         obs.metrics.count("aot.prefetch_hits")
             finally:
-                _inflight.pop(key, None)
+                _inflight.pop(res_key, None)
 
         t = threading.Thread(
             target=body, daemon=True, name=f"aot-prefetch-{name}"
         )
-        _inflight[key] = t
+        _inflight[res_key] = t
         # started INSIDE the lock: a dispatch thread that reads
         # _inflight must never observe (and try to join) an unstarted
         # thread — Thread.join raises on those. Like save_async, the
@@ -940,21 +1037,49 @@ def flush_prefetches(timeout: Optional[float] = None) -> None:
 
 
 def _stage_args(args: Tuple) -> Optional[Tuple]:
-    """Asynchronously ship the real input arrays to device 0 — called
-    BEFORE the blob read/deserialize so the transfer overlaps store I/O
-    and the first execution stops paying a second transfer/layout pass.
-    The caller drops the staged tuple right after the first call, which
-    is the donation this path can honor post-compile (donation proper is
-    baked at serialize time; these executables are serialized without it
-    because the tiered window scorer re-uses its host args across
-    precision tiers)."""
+    """Asynchronously ship the real input arrays to the execution device
+    (the pinned lane device when set, else device 0) — called BEFORE the
+    blob read/deserialize so the transfer overlaps store I/O and the
+    first execution stops paying a second transfer/layout pass. When a
+    per-thread staging cache is installed (serve lane pipelining), an
+    array the stage thread already shipped is reused by content digest
+    instead of transferring again. The caller drops the staged tuple
+    right after the first call, which is the donation this path can
+    honor post-compile (donation proper is baked at serialize time;
+    these executables are serialized without it because the tiered
+    window scorer re-uses its host args across precision tiers)."""
     try:
         import jax
 
-        dev = jax.devices()[0]
-        return tuple(
-            None if a is None else jax.device_put(a, dev) for a in args
-        )
+        dev = execution_device()
+        if dev is None:
+            dev = jax.devices()[0]
+        cache = staging_cache()
+        if not cache:
+            # no staging cache, or nothing staged ahead (the uncontended
+            # steady state): the plain transfer — computing content
+            # digests against an empty cache would tax every dispatch
+            # for a lookup that cannot hit
+            return tuple(
+                None if a is None else jax.device_put(a, dev) for a in args
+            )
+        out = []
+        for a in args:
+            if a is None:
+                out.append(None)
+                continue
+            # CONSUME (pop, don't get): staged buffers are single-use —
+            # the dispatch drops them after the first call, and leaving
+            # consumed entries behind would keep their device memory
+            # alive through the cache reference. Mispredicted leftovers
+            # are bounded by the stage thread (stage_host_arrays).
+            hit = cache.pop(_stage_key(np.asarray(a)), None)
+            if hit is not None:
+                obs.metrics.count("aot.stage_cache_hits")
+                out.append(hit)
+            else:
+                out.append(jax.device_put(np.asarray(a), dev))
+        return tuple(out)
     except Exception:
         return None
 
@@ -999,7 +1124,7 @@ def maybe_save(
         # memoize: the just-compiled executable serves this process's
         # next chunk directly — without this, chunk 2 would re-read and
         # re-ship the multi-MB blob the device already has resident
-        _loaded_put(key, compiled)
+        _loaded_put(_resident_key(key), compiled)
         return path
     except Exception:
         return None
@@ -1020,11 +1145,30 @@ def save_async(
         return
     # capture the dispatch-site span HERE: the save thread's "aot.save"
     # renders on its own track but stays linked to the invocation span
-    # that scheduled it (same contract as the prefetch thread)
+    # that scheduled it (same contract as the prefetch thread). The
+    # execution-device pin is captured the same way: without it the
+    # save thread would compile AND memoize under the unpinned key — a
+    # lane's next chunk would miss its own just-compiled executable (and
+    # a pin-keyed memo of an unpinned compile would bind the wrong
+    # device).
+    parent = obs.current_span()
+    pin = execution_device()
+
+    def body() -> None:
+        set_execution_device(pin)
+        if pin is not None:
+            try:
+                import jax
+
+                with jax.default_device(pin):
+                    maybe_save(name, fn, args, statics, trace_parent=parent)
+                return
+            except Exception:
+                return
+        maybe_save(name, fn, args, statics, trace_parent=parent)
+
     t = threading.Thread(
-        target=maybe_save,
-        args=(name, fn, args, statics),
-        kwargs=dict(trace_parent=obs.current_span()),
+        target=body,
         daemon=True,
         name=f"aot-save-{name}",
     )
@@ -1061,13 +1205,14 @@ def call_or_compile(
     d = aot_dir()
     if d is not None:
         key = aot_key(name, args, statics)
-        if key not in _loaded and _load_blocked(d, name):
+        res_key = _resident_key(key)
+        if res_key not in _loaded and _load_blocked(d, name):
             # known platform-keyed miss: skip the doomed staging too —
             # a duplicate of every input on the device buys nothing
             pass
         elif (
-            key in _loaded
-            or key in _inflight
+            res_key in _loaded
+            or res_key in _inflight
             or _entry_exists(d, key)
         ):
             # a load is resident, in flight, or about to happen: start

@@ -23,6 +23,7 @@ Conventions:
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 from typing import Any, Iterable, List, Optional, Sequence
 
@@ -163,6 +164,12 @@ def dense_replica_row(
 # outer loop's mostly-unchanged input re-encodes only its changed rows.
 # Typed Any to keep the layering: ops/ must not import serve/.
 _row_cache: Optional[Any] = None
+# per-thread override: a multi-lane daemon gives each device lane its
+# own row cache (the lanes serve different shape buckets, and one shared
+# cache would thrash its single-entry meta across lanes) — the lane's
+# request threads install theirs here, everything else falls through to
+# the process-wide cache.
+_tls_row_cache = threading.local()
 
 
 def set_row_cache(cache: Optional[Any]) -> None:
@@ -173,8 +180,15 @@ def set_row_cache(cache: Optional[Any]) -> None:
     _row_cache = cache
 
 
+def set_thread_row_cache(cache: Optional[Any]) -> None:
+    """Install (or clear) THIS thread's row cache, overriding the
+    process-wide one — the per-lane seam (serve/lanes.py)."""
+    _tls_row_cache.cache = cache
+
+
 def row_cache() -> Optional[Any]:
-    return _row_cache
+    cache = getattr(_tls_row_cache, "cache", None)
+    return cache if cache is not None else _row_cache
 
 
 def tensorize(
@@ -208,7 +222,7 @@ def tensorize(
     R = next_bucket(rmax, max(2, min_replica_bucket))
     B = next_bucket(nb, min_broker_bucket)
 
-    cache = _row_cache
+    cache = row_cache()
     if cache is not None:
         cached = cache.lookup(parts, ids, P, R, B)
         if cached is not None:

@@ -65,6 +65,16 @@ from kafkabalancer_tpu.parallel.mesh import (  # noqa: E402
 from kafkabalancer_tpu.solvers.scan import session  # noqa: E402
 
 
+def stack_instances(rows: "Sequence[np.ndarray]") -> "np.ndarray":
+    """Stack per-instance host arrays along a new leading axis — the
+    sweep's per-scenario stacking layout. ONE definition shared by the
+    per-scenario sweep path below and the serve microbatcher
+    (serve/lanes.py), which fuses K independent same-bucket requests
+    into one padded batched dispatch exactly the way the sweep stacks
+    scenarios."""
+    return np.stack([np.asarray(r) for r in rows])
+
+
 @dataclass
 class SweepResult:
     """Outcome of one what-if scenario."""
@@ -507,7 +517,7 @@ def sweep(
         def stack(get):
             rows = [get(sdp) for sdp in scen_dps]
             rows += [rows[0]] * (S_pad - len(rows))  # pad rows: scenario 0
-            return np.stack(rows)
+            return stack_instances(rows)
 
         reps_arg = jnp.asarray(stack(lambda d: d.replicas))
         member_arg = jnp.asarray(stack(lambda d: d.member))

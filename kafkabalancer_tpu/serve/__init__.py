@@ -12,6 +12,14 @@ package removes the fresh process from the hot path entirely:
   and the incremental tensorize cache resident across requests, with
   request coalescing, an idle-timeout shutdown, and a pidfile/socket
   liveness handshake;
+- ``lanes`` — the multi-device executor: one pipelined worker lane per
+  visible device (bucket-affinity routing, work stealing, per-lane
+  caches and staging) and cross-request microbatching (K same-bucket
+  requests fused into one batched device dispatch with bit-identical
+  per-request move logs); one visible device degrades to one lane, and
+  with microbatching also disabled (``-serve-lanes=1`` or
+  ``-serve-microbatch=1``) to the PR-4 single-lane dispatcher byte for
+  byte;
 - ``client`` — the thin, **jax-free** forwarding client embedded in the
   CLI: every normal invocation transparently forwards its parsed flags +
   input to a live daemon and falls back to the ordinary in-process path

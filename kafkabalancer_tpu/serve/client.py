@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import os
 import socket
-from typing import Any, Dict, List, NamedTuple, Optional
+from typing import Any, Callable, Dict, List, NamedTuple, Optional
 
 from kafkabalancer_tpu import __version__
 from kafkabalancer_tpu.serve.protocol import (
@@ -97,6 +97,7 @@ def forward_plan(
     stdin_text: Optional[str],
     connect_timeout: float = CONNECT_TIMEOUT_S,
     plan_timeout: float = PLAN_TIMEOUT_S,
+    on_fallback: Optional[Callable[[str], None]] = None,
 ) -> Optional[ServedResult]:
     """Forward one invocation to the daemon at ``path``.
 
@@ -104,7 +105,24 @@ def forward_plan(
     included); ``stdin_text`` is the raw input when no ``-input``/
     ``-from-zk`` names a source. Returns the daemon's result, or None on
     ANY failure — the caller falls back in-process.
+
+    ``on_fallback`` receives the REASON when the daemon positively
+    declined the request (a structured ``op: "error"`` frame — oversized
+    payload, unparseable frame) or the payload exceeds the protocol's
+    frame cap client-side, so the CLI can log why it planned in-process
+    instead of a generic silent fallback. Silent failure modes (no
+    daemon, dead socket, version skew) deliberately stay silent — the
+    daemon-down path must remain byte-identical to a build without a
+    daemon.
     """
+
+    def _declined(reason: str) -> None:
+        if on_fallback is not None:
+            try:
+                on_fallback(reason)
+            except Exception:
+                pass
+
     sock = _connect(path, connect_timeout)
     if sock is None:
         return None
@@ -116,13 +134,21 @@ def forward_plan(
         if stdin_text is not None:
             req["stdin"] = stdin_text
         sock.settimeout(plan_timeout)
-        write_frame(sock, req)
+        try:
+            write_frame(sock, req)
+        except ValueError as exc:
+            # the input is too large for one protocol frame — a positive
+            # local refusal, not a daemon failure
+            _declined(f"request exceeds the protocol frame cap: {exc}")
+            return None
         resp = read_frame(sock)
         if (
             not isinstance(resp, dict)
             or not resp.get("ok")
             or resp.get("v") != PROTO_VERSION
         ):
+            if isinstance(resp, dict) and resp.get("error"):
+                _declined(str(resp["error"]))
             return None
         return ServedResult(
             rc=int(resp["rc"]),

@@ -48,7 +48,7 @@ import time
 from collections import deque
 from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
-from kafkabalancer_tpu import __version__
+from kafkabalancer_tpu import __version__, obs
 from kafkabalancer_tpu.serve.protocol import (
     PROTO_VERSION,
     pidfile_path,
@@ -62,6 +62,11 @@ LogFn = Callable[[str], None]
 # a connection sitting in a queued/coalesced plan can legitimately wait
 # minutes for the device; the read timeout only bounds DEAD peers
 PLAN_CONNECTION_TIMEOUT_S = 7200.0
+
+# a plan arriving during startup waits for the dispatcher (built on the
+# warm thread — lane resolution performs the backend attach); far past
+# this the warm thread is presumed wedged and the request is refused
+DISPATCHER_WAIT_S = 600.0
 
 
 def _argv_value(argv: List[str], name: str) -> Optional[str]:
@@ -78,7 +83,10 @@ def _argv_value(argv: List[str], name: str) -> Optional[str]:
 class PlanRequest:
     """One queued ``plan`` request plus its completion latch."""
 
-    __slots__ = ("argv", "stdin", "done", "response", "bucket", "bucketed")
+    __slots__ = (
+        "argv", "stdin", "done", "response", "bucket", "bucketed", "staged",
+        "mb_entered",
+    )
 
     def __init__(self, argv: List[str], stdin: Optional[str]) -> None:
         self.argv = argv
@@ -87,6 +95,8 @@ class PlanRequest:
         self.response: Optional[Dict[str, Any]] = None
         self.bucket: Optional[BucketKey] = None
         self.bucketed = False  # probe memo (None is a valid "no bucket")
+        self.staged = False  # lane pipelining: host-encode stage fired
+        self.mb_entered = False  # joined its microbatch barrier
 
 
 class Coalescer:
@@ -121,13 +131,9 @@ class Coalescer:
             return bool(self._dq) or self._active > 0
 
     def _bucket(self, req: PlanRequest) -> Optional[BucketKey]:
-        if not req.bucketed:
-            req.bucketed = True
-            try:
-                req.bucket = self._bucket_of(req)
-            except Exception:
-                req.bucket = None
-        return req.bucket
+        from kafkabalancer_tpu.serve.lanes import probe_bucket
+
+        return probe_bucket(req, self._bucket_of)
 
     def submit(self, req: PlanRequest) -> Dict[str, Any]:
         with self._cv:
@@ -216,11 +222,19 @@ class Daemon:
         prewarm_shapes: str = "",
         log: Optional[LogFn] = None,
         warm: bool = True,
+        lanes: int = 1,
+        microbatch: int = 1,
     ) -> None:
         self.socket_path = socket_path
         self.idle_timeout = idle_timeout
         self.prewarm_shapes = prewarm_shapes
         self.warm = warm
+        # lanes: 1 = today's single-lane Coalescer, byte for byte (and no
+        # jax import before the warm thread); 0/negative = one lane per
+        # visible device; N>1 = min(N, devices). microbatch: max fused
+        # requests per device dispatch (1 disables fusion).
+        self.lanes = lanes
+        self.microbatch = max(1, microbatch)
         self._log: LogFn = log or (
             lambda msg: print(msg, file=sys.stderr, flush=True)
         )
@@ -235,14 +249,35 @@ class Daemon:
         from kafkabalancer_tpu.serve.cache import TensorizeRowCache
 
         self.tensorize_cache = TensorizeRowCache()
-        self._coalescer: Optional[Coalescer] = None
+        self._coalescer: Optional[Any] = None
+        self._dispatcher_ready = threading.Event()
+        self._lanes: "List[Any]" = []
 
     # -- warmup ----------------------------------------------------------
     def _warm_body(self) -> None:
-        """Background startup warm: backend attach, then (optionally)
-        AOT-prewarm a shape grid and pull its executables resident so
-        request 1 skips even the blob load. Never raises — a warm
-        failure costs latency on request 1, not availability."""
+        """Background startup warm: dispatcher construction FIRST (lane
+        resolution performs the jax import + device query — the backend
+        attach this thread exists to overlap; the accept loop answers
+        hello immediately while it runs), then the backend warm and
+        (optionally) an AOT-prewarm of a shape grid whose executables
+        are pulled resident so request 1 skips even the blob load.
+        Never raises — a warm failure costs latency on request 1, not
+        availability."""
+        try:
+            self._coalescer = self._make_dispatcher()
+        except Exception as exc:
+            # a broken backend must not cost availability: fall back to
+            # the single-lane dispatcher (no jax needed), with every
+            # lane-mode side effect undone
+            self._log(f"serve: dispatcher init failed ({exc!r}); 1 lane")
+            from kafkabalancer_tpu.ops.tensorize import set_row_cache
+
+            obs.set_shared_registry(False)
+            self._lanes = []
+            set_row_cache(self.tensorize_cache)
+            self._coalescer = Coalescer(self._handle_plan, self._bucket_of)
+        finally:
+            self._dispatcher_ready.set()
         try:
             from kafkabalancer_tpu.ops.coldstart import (
                 mark_process_warm,
@@ -259,6 +294,29 @@ class Daemon:
 
                 summary = prewarm.warm_store(self.prewarm_shapes, load=True)
                 self._log(f"serve: prewarm {summary}")
+                if self._lanes:
+                    # lane-pinned residency: resident keys carry the
+                    # execution device, so the unpinned load above is
+                    # invisible to the lanes — re-load each grid entry
+                    # under every lane's pin (store hits: deserialize
+                    # only, off the request path) so request 1 PER LANE
+                    # skips the blob load too
+                    from kafkabalancer_tpu.ops import aot
+
+                    for lane in self._lanes:
+                        if lane.device is None:
+                            continue
+                        try:
+                            aot.set_execution_device(lane.device)
+                            prewarm.warm_store(
+                                self.prewarm_shapes, load=True
+                            )
+                        finally:
+                            aot.set_execution_device(None)
+                    self._log(
+                        "serve: prewarm resident on "
+                        f"{len(self._lanes)} lanes"
+                    )
         except Exception as exc:
             self._log(f"serve: warmup failed: {exc!r}")
         finally:
@@ -269,12 +327,15 @@ class Daemon:
             self._warm_done.set()
 
     # -- request handling ------------------------------------------------
-    def _bucket_of(self, req: PlanRequest) -> Optional[BucketKey]:
-        """Jax-free shape-bucket probe of one queued request — the same
-        ``prefetch_hints`` arithmetic the coldstart predictor uses, so
-        two requests coalesce exactly when they would reuse one padded
-        executable. None (= never coalesced) for zookeeper inputs and
-        anything that fails to parse (the real run surfaces the error)."""
+    def _parse_request(
+        self, req: PlanRequest
+    ) -> "Optional[Tuple[Any, Optional[List[int]]]]":
+        """Parse one queued request's input the way the real run will
+        (reader + -input-json + -topics + -broker-ids semantics) — the
+        ONE request-argv parse shared by the bucket probe and the lane
+        stage hook, so the two cannot drift. Returns ``(partition_list,
+        brokers)`` or None (zookeeper input / nothing to read — the
+        real run surfaces any error)."""
         if _argv_value(req.argv, "from-zk"):
             return None
         input_path = _argv_value(req.argv, "input")
@@ -286,7 +347,6 @@ class Daemon:
         else:
             return None
         from kafkabalancer_tpu.codecs import get_partition_list_from_reader
-        from kafkabalancer_tpu.ops.coldstart import prefetch_hints
         from kafkabalancer_tpu.utils.flags import go_atoi
 
         as_json = _argv_value(req.argv, "input-json") == "true"
@@ -297,13 +357,33 @@ class Daemon:
         brokers_raw = _argv_value(req.argv, "broker-ids")
         if brokers_raw and brokers_raw != "auto":
             brokers = [go_atoi(b) for b in brokers_raw.split(",")]
+        return pl, brokers
+
+    def _bucket_of(self, req: PlanRequest) -> Optional[BucketKey]:
+        """Jax-free shape-bucket probe of one queued request — the same
+        ``prefetch_hints`` arithmetic the coldstart predictor uses, so
+        two requests coalesce exactly when they would reuse one padded
+        executable. None (= never coalesced) for zookeeper inputs and
+        anything that fails to parse (the real run surfaces the error)."""
+        parsed = self._parse_request(req)
+        if parsed is None:
+            return None
+        pl, brokers = parsed
+        from kafkabalancer_tpu.ops.coldstart import prefetch_hints
+
         hints = prefetch_hints(pl, brokers)
         return (
             int(hints["P"]), int(hints["R"]), int(hints["B"]),
             bool(hints["all_allowed"]),
         )
 
-    def _handle_plan(self, req: PlanRequest, coalesced: bool) -> None:
+    def _handle_plan(
+        self,
+        req: PlanRequest,
+        coalesced: bool,
+        lane: Optional[Any] = None,
+        mb: Optional[Any] = None,
+    ) -> None:
         from kafkabalancer_tpu import cli
 
         with self._lock:
@@ -314,23 +394,45 @@ class Daemon:
             n_coal = self._coalesced
             self._seq += 1
             seq = self._seq
-        cache_stats = self.tensorize_cache.stats()
         attrs: Dict[str, Any] = {
             "served": True,
             "serve.requests": float(n),
             "serve.coalesced": float(n_coal),
-            "serve.cache_hits": float(cache_stats["hits"]),
         }
+        sched = self._coalescer
+        if lane is not None and hasattr(sched, "stats"):
+            s = sched.stats()
+            attrs.update({
+                "serve.lanes": s["lanes"],
+                "serve.lane": float(lane.index),
+                "serve.lane_busy_s": s["lane_busy_s"],
+                "serve.steals": s["steals"],
+                "serve.microbatched": s["microbatched"],
+                "serve.cache_hits": s["cache_hits"],
+            })
+        else:
+            attrs["serve.lanes"] = 1.0
+            attrs["serve.cache_hits"] = float(
+                self.tensorize_cache.stats()["hits"]
+            )
         i = io.StringIO(req.stdin or "")
         out, err = io.StringIO(), io.StringIO()
         rc_box: List[int] = []
 
         def body() -> None:
-            rc_box.append(
-                cli.run(
-                    i, out, err, ["kafkabalancer"] + req.argv, attrs=attrs
+            import contextlib
+
+            with contextlib.ExitStack() as st:
+                if lane is not None:
+                    st.enter_context(lane.context())
+                if mb is not None:
+                    st.enter_context(mb.member(req))
+                rc_box.append(
+                    cli.run(
+                        i, out, err, ["kafkabalancer"] + req.argv,
+                        attrs=attrs,
+                    )
                 )
-            )
 
         # a named thread per request: the request's telemetry spans get
         # their own track ("serve-req-N") in -stats / -trace output
@@ -342,6 +444,11 @@ class Daemon:
             # one of the CLI's documented exit codes — an ok:false
             # response makes the client fall back and plan in-process
             self._log(f"serve: request {seq} crashed (see traceback above)")
+            if mb is not None and not req.mb_entered:
+                # the body died BEFORE joining its microbatch barrier
+                # (lane-context entry failure): release the slot, or the
+                # healthy peers stall at the barrier until its timeout
+                mb.abandon()
             req.response = {
                 "v": PROTO_VERSION,
                 "ok": False,
@@ -358,10 +465,152 @@ class Daemon:
         }
         self._touch()
 
+    # -- lanes -----------------------------------------------------------
+    def _resolve_lanes(self) -> int:
+        """How many lanes to run: 1 stays the Coalescer (and never
+        imports jax here); auto (<=0) and N>1 resolve against the
+        visible device count. One visible device always degrades to 1."""
+        if self.lanes == 1:
+            return 1
+        try:
+            import jax
+
+            ndev = len(jax.devices())
+        except Exception as exc:
+            self._log(f"serve: lane resolution failed ({exc!r}); 1 lane")
+            return 1
+        n = ndev if self.lanes <= 0 else min(self.lanes, ndev)
+        return max(1, n)
+
+    def _make_dispatcher(self) -> Any:
+        """The request dispatcher: today's single-lane Coalescer when one
+        lane suffices (byte-for-byte PR-4 behavior), else the multi-lane
+        scheduler with per-device lanes, affinity routing, stealing and
+        (with ``microbatch > 1``) cross-request fusion."""
+        n_lanes = self._resolve_lanes()
+        # explicit -serve-lanes=1 is the PR-4 contract pin: the plain
+        # Coalescer regardless of microbatch. Auto/multi keep the lane
+        # scheduler whenever it buys something (several lanes, or
+        # single-lane fusion with microbatch > 1).
+        if self.lanes == 1 or (n_lanes <= 1 and self.microbatch <= 1):
+            from kafkabalancer_tpu.ops.tensorize import set_row_cache
+
+            set_row_cache(self.tensorize_cache)
+            return Coalescer(self._handle_plan, self._bucket_of)
+        from kafkabalancer_tpu import obs
+        from kafkabalancer_tpu.serve.cache import TensorizeRowCache
+        from kafkabalancer_tpu.serve.lanes import Lane, LaneScheduler
+
+        try:
+            import jax
+
+            devices = list(jax.devices())[:n_lanes]
+        except Exception:
+            devices = []
+        self._lanes = []
+        for i in range(n_lanes):
+            lane = Lane(i, devices[i] if i < len(devices) else None)
+            lane.row_cache = TensorizeRowCache()
+            self._lanes.append(lane)
+        scheduler = LaneScheduler(
+            self._handle_plan,
+            self._bucket_of,
+            self._lanes,
+            microbatch=self.microbatch,
+            stage=self._stage_request,
+            fusible=self._fusible_request,
+        )
+        # concurrent request bodies share the daemon-lifetime registry:
+        # a per-request reset would wipe an in-flight peer's attribution.
+        # Set only AFTER the scheduler constructed — a construction
+        # failure falls back to the Coalescer, which must keep the
+        # per-invocation metrics epochs.
+        obs.set_shared_registry(True)
+        self._log(
+            f"serve: {n_lanes} device lane{'s' if n_lanes != 1 else ''}"
+            + (
+                f", microbatch up to {self.microbatch}"
+                if self.microbatch > 1
+                else ""
+            )
+        )
+        return scheduler
+
+    @staticmethod
+    def _fusible_request(req: PlanRequest) -> bool:
+        """Will this request's planning reach the fusible dispatch (the
+        XLA fused session)? Only such requests join a fusion barrier —
+        see LaneScheduler._run_group. Conservative on purpose: a false
+        negative costs a missed fusion, a false positive stalls peers."""
+        if _argv_value(req.argv, "fused") != "true":
+            return False
+        if _argv_value(req.argv, "rebalance-leader") == "true":
+            return False
+        engine = _argv_value(req.argv, "fused-engine") or "auto"
+        return (
+            engine in ("auto", "xla")
+            and _argv_value(req.argv, "fused-shard") != "true"
+        )
+
+    def _stage_request(self, req: PlanRequest, lane: Any) -> None:
+        """Host-encode stage of the lane pipeline (runs on the lane's
+        stage thread while the device executes the request ahead): parse
+        + settle + tensorize the NEXT request — priming the lane's row
+        cache — and ``device_put`` its dense tensors onto the lane's
+        device, digest-keyed so the dispatch reuses the transfer. Pure
+        overlap: any failure or misprediction costs nothing."""
+        fused = _argv_value(req.argv, "fused") == "true"
+        solver = _argv_value(req.argv, "solver") or "greedy"
+        if not fused and solver != "tpu":
+            return  # host-only planning: nothing to stage
+        parsed = self._parse_request(req)
+        if parsed is None:
+            return
+        pl, brokers = parsed
+        from kafkabalancer_tpu.models import default_rebalance_config
+        from kafkabalancer_tpu.utils.flags import go_atoi
+
+        # the config subset that shapes settle/tensorize; staging is
+        # fail-open, so a flag this prediction misses costs only the
+        # overlap (digest misses), never correctness
+        cfg = default_rebalance_config()
+        cfg.brokers = brokers
+        if _argv_value(req.argv, "allow-leader") == "true":
+            cfg.allow_leader_rebalancing = True
+        mr = _argv_value(req.argv, "min-replicas")
+        if mr is not None:
+            cfg.min_replicas_for_rebalancing = go_atoi(mr)
+        budget_raw = _argv_value(req.argv, "max-reassign")
+        budget = go_atoi(budget_raw) if budget_raw is not None else 1
+        if budget <= 0:
+            return
+        with lane.context():
+            from kafkabalancer_tpu.ops import aot
+            from kafkabalancer_tpu.ops.tensorize import tensorize
+            from kafkabalancer_tpu.solvers.scan import _settle_head
+
+            # no clear here: the request AHEAD of this one may not have
+            # consumed its staged buffers yet (that is the overlap this
+            # stage exists for). Consumed entries are popped at dispatch
+            # (_stage_args); mispredictions are bounded by the stage
+            # cap in stage_host_arrays.
+            _settle_head(pl, cfg, budget)
+            with obs.span("serve.stage_encode", lane=lane.index):
+                dp = tensorize(pl, cfg)
+            staged = aot.stage_host_arrays(
+                lane.stage_cache,
+                (
+                    dp.replicas, dp.weights, dp.nrep_cur, dp.nrep_tgt,
+                    dp.ncons, dp.allowed, dp.pvalid, dp.bvalid,
+                ),
+            )
+        obs.metrics.count("serve.staged_requests")
+        obs.metrics.gauge("serve.last_staged_arrays", float(staged))
+
     def _hello(self) -> Dict[str, Any]:
         with self._lock:
             n, n_coal = self._requests, self._coalesced
-        return {
+        out: Dict[str, Any] = {
             "v": PROTO_VERSION,
             "ok": True,
             "op": "hello",
@@ -372,6 +621,26 @@ class Daemon:
             "coalesced": n_coal,
             "cache": self.tensorize_cache.stats(),
         }
+        sched = self._coalescer
+        if self._lanes and hasattr(sched, "stats"):
+            s = sched.stats()
+            out["lanes"] = int(s["lanes"])
+            out["steals"] = int(s["steals"])
+            out["microbatched"] = int(s["microbatched"])
+            out["lane_busy_s"] = [
+                round(ln.busy_s, 3) for ln in self._lanes
+            ]
+            out["lane_requests"] = [ln.requests for ln in self._lanes]
+            out["cache"] = {
+                "hits": sum(ln.cache_stats()["hits"] for ln in self._lanes),
+                "misses": sum(
+                    ln.cache_stats()["misses"] for ln in self._lanes
+                ),
+                "rows_reused": sum(
+                    ln.cache_stats()["rows_reused"] for ln in self._lanes
+                ),
+            }
+        return out
 
     def _touch(self) -> None:
         self._last_activity = time.monotonic()
@@ -382,13 +651,28 @@ class Daemon:
             while True:
                 try:
                     msg = read_frame(conn)
-                except Exception:
+                except ValueError as exc:
+                    # a structured refusal instead of a dropped
+                    # connection: an oversized length prefix or an
+                    # unparseable payload gets an op-"error" frame with
+                    # the reason, so the client can log WHY it fell back
+                    # in-process instead of a generic fallback
+                    self._log(f"serve: refused frame: {exc}")
+                    try:
+                        write_frame(conn, {
+                            "v": PROTO_VERSION, "ok": False, "op": "error",
+                            "error": f"bad frame: {exc}",
+                        })
+                    except Exception:
+                        pass
                     return
+                except Exception:
+                    return  # dead peer / mid-frame EOF: nothing to tell
                 if msg is None:
                     return
                 if msg.get("v") != PROTO_VERSION:
                     write_frame(conn, {
-                        "v": PROTO_VERSION, "ok": False,
+                        "v": PROTO_VERSION, "ok": False, "op": "error",
                         "error": f"protocol version {msg.get('v')!r}",
                     })
                     return
@@ -397,13 +681,29 @@ class Daemon:
                 if op == "hello":
                     write_frame(conn, self._hello())
                 elif op == "plan":
-                    argv = [str(a) for a in msg.get("argv", [])]
+                    raw_argv = msg.get("argv", [])
+                    if not isinstance(raw_argv, list):
+                        write_frame(conn, {
+                            "v": PROTO_VERSION, "ok": False, "op": "error",
+                            "error": "plan payload: argv is not a list",
+                        })
+                        return
+                    argv = [str(a) for a in raw_argv]
                     stdin = msg.get("stdin")
                     req = PlanRequest(
                         argv, str(stdin) if stdin is not None else None
                     )
-                    assert self._coalescer is not None
-                    write_frame(conn, self._coalescer.submit(req))
+                    # startup race: the dispatcher is built on the warm
+                    # thread; a plan arriving first waits for it
+                    self._dispatcher_ready.wait(DISPATCHER_WAIT_S)
+                    dispatcher = self._coalescer
+                    if dispatcher is None:
+                        write_frame(conn, {
+                            "v": PROTO_VERSION, "ok": False, "op": "error",
+                            "error": "daemon dispatcher not ready",
+                        })
+                        return
+                    write_frame(conn, dispatcher.submit(req))
                 elif op == "shutdown":
                     write_frame(conn, {"v": PROTO_VERSION, "ok": True})
                     self._stop.set()
@@ -467,13 +767,16 @@ class Daemon:
 
         from kafkabalancer_tpu.ops.tensorize import set_row_cache
 
-        set_row_cache(self.tensorize_cache)
-        self._coalescer = Coalescer(self._handle_plan, self._bucket_of)
         if self.warm:
+            # the dispatcher is built on the warm thread (its lane
+            # resolution pays the backend attach) so the accept loop
+            # answers hello immediately; plans wait on _dispatcher_ready
             threading.Thread(
                 target=self._warm_body, name="serve-warm", daemon=True
             ).start()
         else:
+            self._coalescer = self._make_dispatcher()
+            self._dispatcher_ready.set()
             self._warm_done.set()
 
         old_handlers: List[Tuple[int, Any]] = []
@@ -494,6 +797,7 @@ class Daemon:
                 if (
                     self.idle_timeout > 0
                     and self._warm_done.is_set()
+                    and self._coalescer is not None
                     and not self._coalescer.busy()
                     and time.monotonic() - self._last_activity
                     > self.idle_timeout
@@ -519,6 +823,7 @@ class Daemon:
             listener.close()
             if self._coalescer is not None:
                 self._coalescer.stop()
+            obs.set_shared_registry(False)
             set_row_cache(None)
             for sig, handler in old_handlers:
                 try:
@@ -533,6 +838,22 @@ class Daemon:
                         pass
         with self._lock:
             n, n_coal = self._requests, self._coalesced
+        if self._lanes:
+            sched = self._coalescer
+            s = sched.stats() if hasattr(sched, "stats") else {}
+            per_lane = ", ".join(
+                f"lane{ln.index}: {ln.requests} req / {ln.busy_s:.1f}s busy"
+                for ln in self._lanes
+            )
+            self._log(
+                f"serve: exiting after {n} request"
+                f"{'s' if n != 1 else ''} ({n_coal} coalesced, "
+                f"{int(s.get('microbatched', 0))} microbatched, "
+                f"{int(s.get('steals', 0))} steals, "
+                f"{int(s.get('cache_hits', 0))} tensorize cache hits; "
+                f"{per_lane})"
+            )
+            return 0
         cache_stats = self.tensorize_cache.stats()
         self._log(
             f"serve: exiting after {n} request"

@@ -1,0 +1,622 @@
+"""Device lanes: the multi-device scheduler and cross-request microbatching.
+
+The PR-4 daemon funnels every request through ONE dispatcher onto one
+device — correct, but it leaves every other attached device idle while
+the outer automation loop queues up. This module turns the daemon into a
+multi-device pipelined executor:
+
+- :class:`Lane` — one worker lane per visible device, pinned to it: the
+  lane's request threads deserialize AOT executables against the lane's
+  device (``ops.aot.set_execution_device``), place jit dispatches on it
+  (``jax.default_device``), keep a private digest-keyed tensorize row
+  cache (``ops.tensorize.set_thread_row_cache``) and a private staging
+  cache of pre-shipped device buffers;
+- :class:`LaneScheduler` — routes queued requests across lanes with
+  shape-bucket AFFINITY (a bucket sticks to the lane that already holds
+  its compiled executable and primed row cache) plus WORK STEALING when
+  a lane's queue is empty. Same ``submit``/``busy``/``stop`` interface
+  as the single-lane ``Coalescer`` (serve/daemon.py). One visible
+  device degrades to ONE lane; with microbatching also disabled
+  (``-serve-microbatch=1``, or explicit ``-serve-lanes=1``) the daemon
+  keeps the plain Coalescer — byte-for-byte the PR-4 dispatcher;
+- per-lane 3-stage pipelining: while a lane executes request N on
+  device, a stage thread host-encodes request N+1 (parse → settle →
+  tensorize, priming the lane's row cache) and ``device_put``s its dense
+  tensors into the lane's staging cache (``ops.aot.stage_host_arrays``),
+  so N+1's dispatch finds its inputs already resident — double-buffered:
+  at most one request staged ahead per lane;
+- :class:`MicrobatchGroup` — cross-request microbatching: when a lane
+  pops a same-bucket run deeper than one request, up to K requests run
+  concurrently and their fused-session device dispatches are fused into
+  ONE padded batched dispatch (``solvers.scan.session_packed_batched``
+  over the sweep's per-scenario stacking layout). Today's coalescing
+  dedupes the *window*; this fuses *distinct* requests into one device
+  call, each still receiving its own bit-identical packed move log
+  (pinned by the differential tests in tests/test_serve.py).
+
+Layering: this module imports jax/numpy/solvers only lazily inside
+methods — constructing a scheduler with ``device=None`` lanes (tests)
+touches neither.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import deque
+from typing import (
+    Any,
+    Callable,
+    Deque,
+    Dict,
+    Iterator,
+    List,
+    Optional,
+    Sequence,
+    Tuple,
+)
+
+from kafkabalancer_tpu import obs
+from kafkabalancer_tpu.serve.protocol import PROTO_VERSION
+
+BucketKey = Tuple[int, int, int, bool]
+# handler contract (daemon._handle_plan): sets req.response, never sets
+# req.done (the scheduler owns the completion latch)
+LaneHandler = Callable[[Any, bool, "Lane", Optional["MicrobatchGroup"]], None]
+BucketFn = Callable[[Any], Optional[BucketKey]]
+StageFn = Callable[[Any, "Lane"], None]
+# predicate: will this request's planning reach the fusible dispatch
+# (the XLA fused session)? Only such requests join a fusion barrier — a
+# member that never dispatches would stall its peers until its whole
+# request completes
+FusibleFn = Callable[[Any], bool]
+
+
+def probe_bucket(req: Any, bucket_of: BucketFn) -> Optional[BucketKey]:
+    """The memoized shape-bucket probe shared by the Coalescer and the
+    LaneScheduler (one definition: the memo-ordering subtleties must not
+    drift between the two dispatchers). None is a valid 'no bucket'."""
+    if not req.bucketed:
+        req.bucketed = True
+        try:
+            req.bucket = bucket_of(req)
+        except Exception:
+            req.bucket = None
+    bucket: Optional[BucketKey] = req.bucket
+    return bucket
+
+# a microbatch member waiting on the fusion barrier gives up and runs
+# solo past this — the barrier fills as fast as the slowest member's
+# host-side head (parse + settle + tensorize), seconds at flagship scale
+MICROBATCH_WAIT_S = 120.0
+
+
+class Lane:
+    """One device lane: identity, pinned device, per-lane caches and
+    counters. The worker thread lives in :class:`LaneScheduler`."""
+
+    __slots__ = (
+        "index", "device", "row_cache", "stage_cache", "busy_s", "requests",
+    )
+
+    def __init__(self, index: int, device: Any = None) -> None:
+        self.index = index
+        self.device = device
+        self.row_cache: Any = None  # TensorizeRowCache, daemon-installed
+        self.stage_cache: Dict[Any, Any] = {}
+        self.busy_s = 0.0
+        self.requests = 0
+
+    @contextlib.contextmanager
+    def context(self) -> Iterator[None]:
+        """Pin the calling thread to this lane: AOT loads/staging and
+        jit placement go to the lane's device, tensorize uses the lane's
+        row cache, and the staging cache the stage thread fills is the
+        one the dispatch consults."""
+        from kafkabalancer_tpu.ops import aot
+        # NOTE: ops/__init__ shadows the tensorize SUBMODULE with the
+        # tensorize function; import the seam directly from the module
+        from kafkabalancer_tpu.ops.tensorize import set_thread_row_cache
+
+        aot.set_execution_device(self.device)
+        aot.set_staging_cache(self.stage_cache)
+        set_thread_row_cache(self.row_cache)
+        try:
+            if self.device is not None:
+                import jax
+
+                with jax.default_device(self.device):
+                    yield
+            else:
+                yield
+        finally:
+            set_thread_row_cache(None)
+            aot.set_staging_cache(None)
+            aot.set_execution_device(None)
+
+    def cache_stats(self) -> Dict[str, int]:
+        if self.row_cache is None:
+            return {"hits": 0, "misses": 0, "rows_reused": 0}
+        stats: Dict[str, int] = self.row_cache.stats()
+        return stats
+
+
+class _MbEntry:
+    """One member's pending submission at the microbatch barrier."""
+
+    __slots__ = ("args", "statics", "result", "done", "solo")
+
+    def __init__(self, args: Tuple, statics: Dict[str, Any]) -> None:
+        self.args = args
+        self.statics = statics
+        self.result: Any = None
+        self.done = False
+        self.solo = False
+
+
+def _mb_sig(args: Tuple, statics: Dict[str, Any]) -> Tuple[Any, ...]:
+    """Fusion signature: leaf shapes/dtypes (None-ness included) plus the
+    statics — two dispatches fuse only when they would compile the same
+    program."""
+    import numpy as np
+
+    leaves = tuple(
+        None if a is None else (np.asarray(a).shape, np.asarray(a).dtype.str)
+        for a in args
+    )
+    return (leaves, tuple(sorted((k, repr(v)) for k, v in statics.items())))
+
+
+class MicrobatchGroup:
+    """Fusion barrier for K concurrently-running same-bucket requests.
+
+    Each member's request thread installs the group via :meth:`member`;
+    ``solvers.scan._dispatch_chunk`` then offers every fused-session
+    dispatch here. A round completes when every LIVE member has either
+    submitted a dispatch or finished its request entirely; submissions
+    sharing a program signature are stacked (sweep scenario layout) and
+    run as ONE batched device dispatch, each member receiving its own
+    packed move log slice — bit-identical to a solo dispatch. Everything
+    else (singleton signatures, non-XLA engines, any batched failure)
+    FAILS OPEN: ``dispatch`` returns None and the caller runs the
+    ordinary solo path, so fusion can cost correctness nothing.
+    """
+
+    def __init__(self, size: int, wait_s: float = MICROBATCH_WAIT_S) -> None:
+        self._cv = threading.Condition()
+        self._live = size
+        self._pending: List[_MbEntry] = []
+        self._wait_s = wait_s
+        self.fused_requests = 0
+        self.fused_dispatches = 0
+
+    @contextlib.contextmanager
+    def member(self, req: Any = None) -> Iterator[None]:
+        """Install this group on the calling request thread; on exit the
+        member leaves the barrier (so stragglers stop waiting for it).
+        ``req`` (when given) is marked entered, so the scheduler can
+        tell a member that died BEFORE joining from one that joined and
+        left — see :meth:`abandon`."""
+        from kafkabalancer_tpu.solvers import scan
+
+        if req is not None:
+            req.mb_entered = True
+        scan.set_microbatcher(self)
+        try:
+            yield
+        finally:
+            scan.set_microbatcher(None)
+            self._leave()
+
+    def abandon(self) -> None:
+        """A member failed before ever entering :meth:`member` (thread
+        start failure, context-entry crash): release its barrier slot so
+        the live peers' round can still complete instead of stalling to
+        the timeout."""
+        self._leave()
+
+    def _leave(self) -> None:
+        with self._cv:
+            self._live -= 1
+            batch = self._take_round_locked()
+        if batch:
+            self._execute(batch)
+
+    def _take_round_locked(self) -> Optional[List[_MbEntry]]:
+        if self._pending and len(self._pending) >= self._live:
+            batch = self._pending
+            self._pending = []
+            return batch
+        return None
+
+    def dispatch(self, args: Tuple, statics: Dict[str, Any]) -> Optional[Any]:
+        """Offer one dispatch for fusion; this member's packed move log,
+        or None to run solo (declined / timed out / batch failed)."""
+        if statics.get("engine") != "xla" or statics.get("leader"):
+            return None  # kernel engines and the leader session run solo
+        e = _MbEntry(args, statics)
+        with self._cv:
+            self._pending.append(e)
+            batch = self._take_round_locked()
+        if batch:
+            self._execute(batch)
+        deadline = time.monotonic() + self._wait_s
+        with self._cv:
+            while not e.done and not e.solo:
+                left = deadline - time.monotonic()
+                if left <= 0 or not self._cv.wait(left):
+                    if e in self._pending:
+                        self._pending.remove(e)
+                    e.solo = True
+        return None if e.solo else e.result
+
+    def _execute(self, batch: List[_MbEntry]) -> None:
+        by_sig: Dict[Tuple[Any, ...], List[_MbEntry]] = {}
+        for e in batch:
+            try:
+                by_sig.setdefault(_mb_sig(e.args, e.statics), []).append(e)
+            except Exception:
+                with self._cv:
+                    e.solo = True
+        for entries in by_sig.values():
+            if len(entries) == 1:
+                with self._cv:
+                    entries[0].solo = True
+            else:
+                self._run_fused(entries)
+        with self._cv:
+            self._cv.notify_all()
+
+    def _run_fused(self, entries: List[_MbEntry]) -> None:
+        try:
+            import numpy as np
+
+            from kafkabalancer_tpu.ops import aot
+            from kafkabalancer_tpu.parallel.sweep import stack_instances
+            from kafkabalancer_tpu.solvers import scan
+
+            stacked: List[Any] = []
+            for pos in range(len(entries[0].args)):
+                vals = [e.args[pos] for e in entries]
+                stacked.append(
+                    None if vals[0] is None else stack_instances(vals)
+                )
+            with obs.span("serve.microbatch_dispatch", k=len(entries)):
+                out = np.asarray(
+                    aot.call_or_compile(
+                        "session_packed_batched",
+                        scan.session_packed_batched,
+                        tuple(stacked),
+                        dict(entries[0].statics),
+                    )
+                )
+            with self._cv:
+                for k, e in enumerate(entries):
+                    if not e.solo:  # a timed-out member already went solo
+                        e.result = out[k]
+                        e.done = True
+                self.fused_requests += len(entries)
+                self.fused_dispatches += 1
+            obs.metrics.count("serve.microbatched", len(entries))
+        except Exception:
+            # fail open: every waiter runs its own solo dispatch
+            with self._cv:
+                for e in entries:
+                    if not e.done:
+                        e.solo = True
+
+
+class LaneScheduler:
+    """Multi-lane dispatcher with bucket affinity, work stealing and
+    optional microbatching; Coalescer-compatible interface."""
+
+    def __init__(
+        self,
+        handle: LaneHandler,
+        bucket_of: BucketFn,
+        lanes: Sequence[Lane],
+        microbatch: int = 1,
+        stage: Optional[StageFn] = None,
+        fusible: Optional[FusibleFn] = None,
+    ) -> None:
+        self._handle = handle
+        self._bucket_of = bucket_of
+        self.lanes = list(lanes)
+        self._microbatch = max(1, microbatch)
+        self._stage = stage
+        self._fusible = fusible
+        self._cv = threading.Condition()
+        self._queues: List[Deque[Any]] = [deque() for _ in self.lanes]
+        self._active = [0] * len(self.lanes)
+        self._affinity: Dict[BucketKey, int] = {}
+        self._stop = False
+        self.steals = 0
+        self.microbatched = 0
+        self._workers = [
+            threading.Thread(
+                target=self._worker, args=(i,), name=f"serve-lane-{i}",
+                daemon=True,
+            )
+            for i in range(len(self.lanes))
+        ]
+        for t in self._workers:
+            t.start()
+
+    # -- Coalescer-compatible surface ------------------------------------
+    def busy(self) -> bool:
+        """Queued or in-flight work on ANY lane — the daemon's
+        idle-timeout check must not shut down under a long-running plan
+        on one lane while the others sit empty."""
+        with self._cv:
+            return any(self._queues) or any(self._active)
+
+    def submit(self, req: Any) -> Dict[str, Any]:
+        # the routing probe runs OUTSIDE the lock (it parses the input)
+        # and only when there is more than one lane to route between —
+        # the single-lane scheduler keeps the Coalescer's probe-only-
+        # under-contention economy (group assembly probes on demand).
+        # Memoized on the request so group assembly never re-pays it.
+        b = self._bucket(req) if len(self.lanes) > 1 else None
+        with self._cv:
+            if self._stop:
+                return {
+                    "v": PROTO_VERSION, "ok": False,
+                    "error": "daemon shutting down",
+                }
+            i = self._route_locked(b)
+            self._queues[i].append(req)
+            self._cv.notify_all()
+        req.done.wait()
+        return req.response or {
+            "v": PROTO_VERSION, "ok": False, "error": "request dropped",
+        }
+
+    def stop(self, timeout: float = 30.0) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        for t in self._workers:
+            t.join(timeout)
+
+    def stats(self) -> Dict[str, float]:
+        with self._cv:
+            return {
+                "lanes": float(len(self.lanes)),
+                "steals": float(self.steals),
+                "microbatched": float(self.microbatched),
+                "lane_busy_s": float(sum(ln.busy_s for ln in self.lanes)),
+                "cache_hits": float(
+                    sum(ln.cache_stats()["hits"] for ln in self.lanes)
+                ),
+            }
+
+    # -- routing ----------------------------------------------------------
+    def _bucket(self, req: Any) -> Optional[BucketKey]:
+        return probe_bucket(req, self._bucket_of)
+
+    def _route_locked(self, b: Optional[BucketKey]) -> int:
+        if b is not None:
+            owner = self._affinity.get(b)
+            if owner is not None:
+                return owner
+        load = [len(q) + a for q, a in zip(self._queues, self._active)]
+        i = load.index(min(load))
+        if b is not None:
+            self._affinity[b] = i
+        return i
+
+    def _steal_locked(self, i: int) -> Optional[Any]:
+        """One request from the tail of the longest other queue (the
+        victim's FIFO head keeps its lane + staged state).
+
+        A run of requests sharing the victim's head bucket is left in
+        place — the victim will drain it as one coalesced/fused group,
+        and stealing out of it would trade a free ride on the resident
+        executable for a cold load elsewhere — UNLESS the run is deeper
+        than one fused dispatch can absorb (past the microbatch width
+        the surplus gains nothing by waiting)."""
+        best, best_len = -1, 0
+        for j, q in enumerate(self._queues):
+            if j != i and len(q) > best_len:
+                best, best_len = j, len(q)
+        if best < 0:
+            return None
+        q = self._queues[best]
+        head = q[0]
+        head_b = head.bucket if head.bucketed else None
+        for idx in range(len(q) - 1, -1, -1):
+            r = q[idx]
+            rb = r.bucket if r.bucketed else None
+            if (
+                head_b is None
+                or rb != head_b
+                or len(q) > self._microbatch
+            ):
+                del q[idx]
+                self.steals += 1
+                obs.metrics.count("serve.steals")
+                return r
+        return None
+
+    # -- the lane worker ---------------------------------------------------
+    def _worker(self, i: int) -> None:
+        lane = self.lanes[i]
+        while True:
+            first: Any = None
+            contended = False
+            with self._cv:
+                while True:
+                    if self._queues[i]:
+                        first = self._queues[i].popleft()
+                        contended = bool(self._queues[i])
+                        break
+                    stolen = self._steal_locked(i)
+                    if stolen is not None:
+                        first = stolen
+                        break
+                    if self._stop:
+                        return
+                    self._cv.wait()
+                self._active[i] += 1
+            group = [first]
+            if contended:
+                # same-bucket group assembly, probes OUTSIDE the lock
+                # (the probe parses the request's input) — exactly the
+                # Coalescer's contention-only economy. Snapshot, probe,
+                # then re-check membership under the lock: a stealer may
+                # have taken a snapshotted request in between.
+                b0 = self._bucket(first)
+                if b0 is not None:
+                    with self._cv:
+                        pending = list(self._queues[i])
+                    same = [r for r in pending if self._bucket(r) == b0]
+                    if same:
+                        with self._cv:
+                            taken = [
+                                r for r in same if r in self._queues[i]
+                            ]
+                            for r in taken:
+                                self._queues[i].remove(r)
+                            self._active[i] += len(taken)
+                        group.extend(taken)
+            t0 = time.monotonic()
+            try:
+                self._run_group(lane, group)
+            except Exception as exc:
+                # the worker must SURVIVE anything a group throws
+                # (thread exhaustion in a fused run, a stage-thread
+                # start failure): answer every unanswered member and
+                # keep serving — a dead worker would wedge its queue's
+                # clients forever (submit blocks on req.done with no
+                # timeout, and affinity keeps routing here)
+                obs.metrics.event(
+                    "serve_lane_group_failed",
+                    lane=lane.index,
+                    error=type(exc).__name__,
+                )
+                for req in group:
+                    if not req.done.is_set():
+                        req.response = {
+                            "v": PROTO_VERSION, "ok": False,
+                            "error": (
+                                f"lane dispatch failed: "
+                                f"{type(exc).__name__}: {exc}"
+                            ),
+                        }
+                        req.done.set()
+            finally:
+                with self._cv:
+                    self._active[i] -= len(group)
+                    lane.busy_s += time.monotonic() - t0
+                    lane.requests += len(group)
+                    self._cv.notify_all()
+
+    def _stage_ahead(self, lane: Lane) -> None:
+        """Kick the host-encode stage for this lane's NEXT queued request
+        on a stage thread — double-buffered (the `staged` memo on the
+        request bounds it to one stage per request)."""
+        if self._stage is None:
+            return
+        with self._cv:
+            q = self._queues[lane.index]
+            nxt = q[0] if q else None
+            if nxt is None or getattr(nxt, "staged", False):
+                return
+            nxt.staged = True
+        stage = self._stage
+
+        def body() -> None:
+            try:
+                stage(nxt, lane)
+            except Exception:
+                pass  # staging is an overlap, never a correctness step
+
+        try:
+            threading.Thread(
+                target=body, name=f"serve-lane-{lane.index}-stage",
+                daemon=True,
+            ).start()
+        except Exception:
+            pass  # no thread to spare: the overlap is skipped, that's all
+
+    def _run_group(self, lane: Lane, group: List[Any]) -> None:
+        self._stage_ahead(lane)
+        k = self._microbatch
+        if k > 1 and len(group) > 1 and self._fusible is not None:
+            # only PREDICTED-fusible requests join a fusion barrier: a
+            # member that never reaches the fusible dispatch (greedy
+            # solver, kernel engine, leader session) would stall its
+            # peers until its entire request completed. Non-fusible
+            # riders run serially after, still coalesced in the window.
+            fusible: List[Any] = []
+            rest: List[Any] = []
+            for req in group:
+                try:
+                    (fusible if self._fusible(req) else rest).append(req)
+                except Exception:
+                    rest.append(req)
+            first = True
+            for j in range(0, len(fusible), k):
+                run = fusible[j : j + k]
+                if len(run) == 1:
+                    self._run_one(lane, run[0], coalesced=not first)
+                else:
+                    self._run_fused(lane, run, first=first)
+                first = False
+            for req in rest:
+                self._run_one(lane, req, coalesced=not first)
+                first = False
+        else:
+            for idx, req in enumerate(group):
+                self._run_one(lane, req, coalesced=idx > 0)
+
+    def _run_one(
+        self,
+        lane: Lane,
+        req: Any,
+        coalesced: bool,
+        mb: Optional[MicrobatchGroup] = None,
+    ) -> None:
+        try:
+            self._handle(req, coalesced, lane, mb)
+        except Exception as exc:  # never wedge a waiter
+            req.response = {
+                "v": PROTO_VERSION, "ok": False,
+                "error": f"{type(exc).__name__}: {exc}",
+            }
+            if mb is not None and not getattr(req, "mb_entered", False):
+                # the member died before joining the barrier: its slot
+                # must not leave the live peers waiting for a join that
+                # will never come
+                mb.abandon()
+        finally:
+            req.done.set()
+
+    def _run_fused(self, lane: Lane, run: List[Any], first: bool) -> None:
+        mb = MicrobatchGroup(len(run))
+        started: List[threading.Thread] = []
+        inline: List[Tuple[Any, bool]] = []
+        for idx, req in enumerate(run):
+            coalesced = idx > 0 or not first
+            t = threading.Thread(
+                target=self._run_one,
+                args=(lane, req, coalesced, mb),
+                name=f"serve-lane-{lane.index}-mb{idx}",
+            )
+            try:
+                t.start()
+            except Exception:
+                # can't start the member thread (thread exhaustion):
+                # release its barrier slot so the started peers' rounds
+                # still complete, and run it inline after them, solo
+                mb.abandon()
+                inline.append((req, coalesced))
+                continue
+            started.append(t)
+        for t in started:
+            t.join()
+        for req, coalesced in inline:
+            self._run_one(lane, req, coalesced, None)
+        with self._cv:
+            self.microbatched += mb.fused_requests

@@ -39,8 +39,9 @@ any real decision margin.
 from __future__ import annotations
 
 import hashlib
+import threading
 from functools import partial
-from typing import List, Tuple
+from typing import Any, List, Optional, Tuple
 
 from kafkabalancer_tpu import obs
 from kafkabalancer_tpu.models import Partition, PartitionList, RebalanceConfig
@@ -908,13 +909,82 @@ def packed_call(
     return args, statics
 
 
+# --- serve microbatching seam --------------------------------------------
+# A multi-lane daemon (serve/lanes.py) fuses K independent same-bucket
+# requests into ONE padded batched device dispatch. The fusion point is
+# here: each request's thread installs its MicrobatchGroup, and
+# _dispatch_chunk offers the group its (args, statics) before falling
+# through to the ordinary solo dispatch. Thread-local so the stateless
+# CLI and single-lane daemon never see it.
+_mb_tls = threading.local()
+
+
+def set_microbatcher(mb: "Optional[Any]") -> None:
+    """Install (or, with None, clear) THIS thread's microbatch group —
+    an object with ``dispatch(args, statics) -> Optional[np.ndarray]``
+    returning this caller's packed move log, or None to run solo."""
+    _mb_tls.mb = mb
+
+
+def microbatcher() -> "Optional[Any]":
+    return getattr(_mb_tls, "mb", None)
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "dtype", "all_allowed", "max_moves", "allow_leader", "batch",
+        "engine", "polish", "leader", "n_topics",
+    ),
+)
+def session_packed_batched(
+    *args: Any,
+    dtype: Any,
+    all_allowed: bool,
+    max_moves: int,
+    allow_leader: bool,
+    batch: int,
+    engine: str = "xla",
+    polish: bool = False,
+    leader: bool = False,
+    n_topics: int = 0,
+):
+    """K independent same-signature instances as ONE device dispatch.
+
+    ``args`` is :func:`session_packed`'s argument tuple with every array
+    carrying a leading instance axis (the sweep's per-scenario stacking
+    layout, ``parallel.sweep.stack_instances``) and ``None`` positions
+    passed through. ``lax.map`` runs the instances sequentially on
+    device — one dispatch, one transfer each way, K move logs — and each
+    instance traces the IDENTICAL ``session_packed`` subprogram, so per
+    instance the packed log is bit-identical to a solo dispatch (pinned
+    by the serve differential tests). Returns ``[K, L]`` packed logs.
+    """
+    def one(xs: Tuple) -> Any:
+        return session_packed(
+            *xs, dtype=dtype, all_allowed=all_allowed, max_moves=max_moves,
+            allow_leader=allow_leader, batch=batch, engine=engine,
+            polish=polish, leader=leader, n_topics=n_topics,
+        )
+
+    return lax.map(one, args)
+
+
 def _dispatch_chunk(dp, cfg: RebalanceConfig, chunk: int, *a, **kw) -> "np.ndarray":
     """One chunk through the AOT dispatch policy (see :func:`packed_call`
-    for the argument assembly and the raw-numpy contract)."""
+    for the argument assembly and the raw-numpy contract). A thread with
+    a microbatch group installed offers the dispatch for cross-request
+    fusion first; a declined offer (or any group failure) runs solo."""
     from kafkabalancer_tpu.ops import aot
 
     args, statics = packed_call(dp, cfg, chunk, *a, **kw)
     obs.metrics.count("solver.chunks")
+    mb = microbatcher()
+    if mb is not None:
+        fused = mb.dispatch(args, statics)
+        if fused is not None:
+            obs.metrics.count("solver.microbatched_chunks")
+            return np.asarray(fused)
     with obs.span(
         "solver.dispatch_chunk",
         engine=statics["engine"], polish=statics["polish"],

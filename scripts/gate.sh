@@ -177,6 +177,75 @@ else
 fi
 rm -rf "$serve_tmp"
 
+step "serve throughput smoke (2 concurrent clients, lane attribution)"
+# The multi-lane/microbatch serving path end to end: daemon up (default
+# auto lanes + microbatching), TWO concurrent clients with DISTINCT
+# inputs, both must complete with served: true and serve.lanes >= 1 in
+# their -metrics-json — the stage that catches a scheduler wedge, a
+# fused-dispatch crash, or lost lane attribution before merge
+# (docs/serving.md).
+rps_tmp=$(mktemp -d)
+rps_sock="$rps_tmp/kb.sock"
+# distinct second input: same shape bucket, different content
+"$PYTHON" - "$rps_tmp" <<'PYEOF'
+import json, sys
+with open("tests/data/test.json") as f:
+    data = json.load(f)
+p0 = data["partitions"][0]
+p0["replicas"] = list(reversed(p0["replicas"]))
+with open(sys.argv[1] + "/variant.json", "w") as f:
+    json.dump(data, f)
+PYEOF
+JAX_PLATFORMS=cpu JAX_COMPILATION_CACHE_DIR="$rps_tmp" \
+  "$PYTHON" -m kafkabalancer_tpu -serve "-serve-socket=$rps_sock" \
+  -serve-idle-timeout=120 >"$rps_tmp/daemon.log" 2>&1 &
+rps_pid=$!
+rps_ready=0
+for _ in $(seq 1 60); do
+  if "$PYTHON" -c "import sys
+from kafkabalancer_tpu.serve.client import daemon_alive
+sys.exit(0 if daemon_alive('$rps_sock') else 1)" 2>/dev/null; then
+    rps_ready=1; break
+  fi
+  sleep 0.25
+done
+if [ "$rps_ready" = 1 ]; then
+  JAX_PLATFORMS=cpu "$PYTHON" -m kafkabalancer_tpu -input-json \
+    -input tests/data/test.json "-serve-socket=$rps_sock" \
+    "-metrics-json=$rps_tmp/m1.json" >/dev/null 2>&1 &
+  c1=$!
+  JAX_PLATFORMS=cpu "$PYTHON" -m kafkabalancer_tpu -input-json \
+    -input "$rps_tmp/variant.json" "-serve-socket=$rps_sock" \
+    "-metrics-json=$rps_tmp/m2.json" >/dev/null 2>&1 &
+  c2=$!
+  rps_ok=1
+  wait "$c1" || rps_ok=0
+  wait "$c2" || rps_ok=0
+  if [ "$rps_ok" = 1 ] && "$PYTHON" -c "import json, sys
+for p in ('$rps_tmp/m1.json', '$rps_tmp/m2.json'):
+    g = json.load(open(p)).get('gauges', {})
+    assert g.get('served') is True, (p, 'not served')
+    assert float(g.get('serve.lanes', 0)) >= 1, (p, 'no lane attribution')
+" 2>/dev/null; then
+    echo "concurrent served clients + lane attribution: OK"
+  else
+    echo "throughput smoke FAILED (clients rc=$rps_ok; see $rps_tmp)"; fail=1
+  fi
+  "$PYTHON" -c "from kafkabalancer_tpu.serve.client import request_shutdown
+request_shutdown('$rps_sock')" || true
+  if wait "$rps_pid"; then
+    echo "daemon clean shutdown: OK"
+  else
+    echo "daemon exited nonzero"; fail=1
+  fi
+else
+  echo "daemon never became ready (see $rps_tmp/daemon.log)"
+  tail -20 "$rps_tmp/daemon.log" 2>/dev/null
+  kill "$rps_pid" 2>/dev/null
+  fail=1
+fi
+rm -rf "$rps_tmp"
+
 if [ "$run_tests" = 1 ]; then
   step "tier-1 tests"
   JAX_PLATFORMS=cpu "$PYTHON" -m pytest tests/ -q -m 'not slow' \

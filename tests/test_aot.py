@@ -525,3 +525,80 @@ def test_sidecars_survive_orphan_sweep(cache_dir, monkeypatch):
     assert not os.path.exists(orphan)
     assert os.path.exists(os.path.join(d, "noload.json"))
     assert os.path.exists(os.path.join(d, "pallas_gate.json"))
+
+
+# --- per-lane execution pinning (serve device lanes) ----------------------
+
+
+class _FakeDev:
+    def __init__(self, id_):
+        self.id = id_
+
+
+def test_resident_key_carries_execution_device():
+    """The disk key stays device-free (one blob serves every lane); the
+    resident key carries the pinned device so one lane's deserialized
+    copy never answers for another's."""
+    assert aot._resident_key("abc") == "abc"
+    aot.set_execution_device(_FakeDev(3))
+    try:
+        assert aot._resident_key("abc") == "abc@dev3"
+    finally:
+        aot.set_execution_device(None)
+    assert aot._resident_key("abc") == "abc"
+
+
+def test_pinned_lanes_hold_separate_resident_copies(cache_dir):
+    """Two lane pins load the same stored blob into two resident slots;
+    the unpinned path keeps its own."""
+    fn = jax.jit(lambda a: a + 1, static_argnames=())
+    args = (np.arange(4.0),)
+    aot.maybe_save("lane_t", fn, args, {})
+    aot._loaded.clear()
+    base = aot.try_load("lane_t", args, {})
+    assert base is not None
+    dev0 = jax.devices()[0]
+    aot.set_execution_device(dev0)
+    try:
+        pinned = aot.try_load("lane_t", args, {})
+        assert pinned is not None
+        key = aot.aot_key("lane_t", args, {})
+        assert key in aot._loaded
+        assert f"{key}@dev{dev0.id}" in aot._loaded
+    finally:
+        aot.set_execution_device(None)
+
+
+def test_staging_cache_reuses_prestaged_buffers(cache_dir):
+    """stage_host_arrays ships arrays ahead of time; _stage_args then
+    CONSUMES the device-resident buffer by content digest (pop — staged
+    buffers are single-use) instead of paying a second transfer.
+    Content drift is a harmless miss."""
+    cache = {}
+    a = np.arange(16.0)
+    b = np.ones((4, 4), dtype=bool)
+    assert aot.stage_host_arrays(cache, (a, None, b)) == 2
+    prestaged_a = cache[aot._stage_key(a)]
+    aot.set_staging_cache(cache)
+    try:
+        staged = aot._stage_args((np.arange(16.0), None, b))
+        assert staged is not None
+        assert staged[0] is prestaged_a  # digest hit, no second transfer
+        assert staged[1] is None
+        # consumed: the cache no longer pins the device buffers
+        assert aot._stage_key(a) not in cache
+        assert cache == {}
+        # changed content: clean miss, fresh transfer
+        c = np.arange(16.0) * 3
+        staged2 = aot._stage_args((c,))
+        assert staged2 is not None
+        np.testing.assert_array_equal(np.asarray(staged2[0]), c)
+    finally:
+        aot.set_staging_cache(None)
+    # without the thread-local cache, _stage_args is the plain transfer
+    staged3 = aot._stage_args((a,))
+    assert staged3 is not None and staged3[0] is not prestaged_a
+    # mispredicted leftovers are dropped past the cap at the next stage
+    big = {("junk", i): object() for i in range(aot._STAGE_CACHE_CAP + 1)}
+    aot.stage_host_arrays(big, (a,))
+    assert len(big) == 1  # cleared, then the fresh entry staged

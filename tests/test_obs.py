@@ -393,3 +393,50 @@ def test_pprof_write_failure_logged_not_fatal(tmp_path):
     )
     assert rv == 0  # the plan must not fail on a profile-write failure
     assert "failed writing cpu profile" in err
+
+
+def test_shared_registry_mode_keeps_stores_and_refcounts_tracing():
+    """Multi-lane serving mode: begin_invocation keeps the
+    daemon-lifetime registry (no reset), and the tracer drops back to
+    the no-op fast path when the LAST tracing request finishes."""
+    from kafkabalancer_tpu import obs
+
+    obs.begin_invocation()  # clean slate (unshared reset)
+    obs.set_shared_registry(True)
+    try:
+        obs.metrics.count("x.requests")
+        obs.begin_invocation()  # shared: must NOT reset
+        assert obs.REGISTRY.counter_get("x.requests") == 1.0
+
+        assert not obs.tracer.enabled
+        obs.enable_tracing()  # request A (-stats)
+        obs.enable_tracing()  # request B (-metrics-json), concurrent
+        assert obs.tracer.enabled
+        obs.end_invocation()  # A finishes: B still tracing
+        assert obs.tracer.enabled
+        obs.end_invocation()  # B finishes: back to the no-op fast path
+        assert not obs.tracer.enabled
+        # recorded spans survive the disable (trim owns the bound)
+        obs.end_invocation()  # over-release is harmless
+        assert not obs.tracer.enabled
+    finally:
+        obs.set_shared_registry(False)
+        obs.begin_invocation()
+
+
+def test_tracer_trim_keeps_inflight_and_newest_spans():
+    from kafkabalancer_tpu.obs.trace import Tracer
+
+    tr = Tracer()
+    tr.enable()
+    open_span = tr.span("inflight")
+    open_span.__enter__()
+    for i in range(10):
+        with tr.span(f"s{i}"):
+            pass
+    tr.trim(cap=3)
+    names = [s["name"] for s in tr.snapshot()]
+    assert "inflight" in names  # in-flight spans are never dropped
+    assert len(names) == 3
+    assert names[-1] == "s9"  # oldest completed dropped first
+    open_span.__exit__(None, None, None)

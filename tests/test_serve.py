@@ -610,6 +610,557 @@ def test_served_client_path_never_imports_jax(daemon):
     assert proc.returncode == 0, proc.stderr[-2000:]
 
 
+# --- device lanes: the multi-lane scheduler -------------------------------
+
+
+def _mk_req(name, bucket=None):
+    from kafkabalancer_tpu.serve.daemon import PlanRequest
+
+    req = PlanRequest([name], None)
+    req.bucket = bucket
+    req.bucketed = True
+    return req
+
+
+def test_lane_scheduler_affinity_and_least_loaded_routing():
+    """Bucket affinity: the first request of a bucket routes to the
+    least-loaded lane and later same-bucket requests stick to it even
+    when the other lane is emptier."""
+    from kafkabalancer_tpu.serve.lanes import Lane, LaneScheduler
+
+    release = threading.Event()
+    handled = []  # (name, lane index)
+    lock = threading.Lock()
+
+    def handle(req, coalesced, lane, mb):
+        if req.argv[0].startswith("block"):
+            release.wait(20)
+        with lock:
+            handled.append((req.argv[0], lane.index))
+        req.response = {"ok": True}
+
+    buckets = {"block-a": (8, 2, 4, True), "a2": (8, 2, 4, True),
+               "b": (16, 2, 4, True)}
+    sched = LaneScheduler(
+        handle, lambda r: buckets.get(r.argv[0]),
+        [Lane(0), Lane(1)],
+    )
+    try:
+        results = []
+        threads = []
+
+        def submit(name, bucket):
+            req = _mk_req(name, bucket)
+            results.append(sched.submit(req))
+
+        # blocker claims a lane for bucket A
+        threads.append(
+            threading.Thread(target=submit, args=("block-a", buckets["block-a"]))
+        )
+        threads[0].start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not any(sched._active):
+            time.sleep(0.01)
+        # same-bucket follower must queue on the SAME lane (affinity),
+        # not the idle one; distinct bucket takes the idle lane
+        threads.append(
+            threading.Thread(target=submit, args=("a2", buckets["a2"]))
+        )
+        threads.append(threading.Thread(target=submit, args=("b", buckets["b"])))
+        for t in threads[1:]:
+            t.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and len(handled) < 2:
+            time.sleep(0.01)
+        release.set()
+        for t in threads:
+            t.join(10)
+        lanes_of = dict(handled)
+        assert lanes_of["block-a"] == lanes_of["a2"], handled
+        assert lanes_of["b"] != lanes_of["block-a"], handled
+        assert sched.busy() is False
+    finally:
+        release.set()
+        sched.stop()
+
+
+def test_lane_scheduler_steals_distinct_bucket_work():
+    """An idle lane steals queued work of a DIFFERENT bucket from a busy
+    lane's queue; a same-bucket run within the microbatch width stays
+    put (it will drain as one fused/coalesced group)."""
+    from kafkabalancer_tpu.serve.lanes import Lane, LaneScheduler
+
+    release = threading.Event()
+    handled = []
+    lock = threading.Lock()
+
+    def handle(req, coalesced, lane, mb):
+        if req.argv[0].startswith("block"):
+            release.wait(20)
+        with lock:
+            handled.append((req.argv[0], lane.index))
+        req.response = {"ok": True}
+
+    A, B = (8, 2, 4, True), (16, 2, 4, True)
+    sched = LaneScheduler(
+        handle, lambda r: None, [Lane(0), Lane(1)], microbatch=4
+    )
+    try:
+        results = []
+
+        def submit(req):
+            results.append(sched.submit(req))
+
+        # force everything onto lane 0 by pre-claiming affinity
+        with sched._cv:
+            sched._affinity[A] = 0
+            sched._affinity[B] = 0
+        threads = [
+            threading.Thread(target=submit, args=(_mk_req("block-1", A),))
+        ]
+        threads[0].start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not sched._active[0]:
+            time.sleep(0.01)
+        # queue a same-bucket follower + a distinct-bucket request on
+        # the busy lane; lane 1 is idle and may only steal the latter
+        for name, b in (("a2", A), ("b1", B)):
+            t = threading.Thread(target=submit, args=(_mk_req(name, b),))
+            t.start()
+            threads.append(t)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not any(
+            n == "b1" for n, _ln in handled
+        ):
+            time.sleep(0.01)
+        release.set()
+        for t in threads:
+            t.join(10)
+        lanes_of = dict(handled)
+        assert lanes_of["b1"] == 1, handled  # stolen by the idle lane
+        assert lanes_of["a2"] == 0, handled  # same-bucket run stayed
+        assert sched.steals == 1
+    finally:
+        release.set()
+        sched.stop()
+
+
+def test_multi_lane_daemon_not_idle_while_lane_in_flight(
+    sock_dir, monkeypatch
+):
+    """Idle-timeout vs in-flight lanes: a daemon with a long-running
+    request on one lane and empty queues elsewhere must NOT idle-shutdown
+    until all lanes drain — the 'long-running plan is not idleness'
+    guarantee extended to the multi-lane scheduler."""
+    from kafkabalancer_tpu import cli
+
+    started = threading.Event()
+    real_run = cli.run
+
+    def slow_run(i, o, e, args, **kw):
+        started.set()
+        time.sleep(2.5)
+        return real_run(i, o, e, args, **kw)
+
+    monkeypatch.setattr(cli, "run", slow_run)
+    sock = os.path.join(sock_dir, "kb.sock")
+    # microbatch=2 forces the LaneScheduler even on one visible device
+    d = Daemon(
+        sock, idle_timeout=1.0, warm=False, log=lambda _m: None,
+        lanes=0, microbatch=2,
+    )
+    rc_box = []
+    t = threading.Thread(
+        target=lambda: rc_box.append(d.serve_forever()), daemon=True
+    )
+    t.start()
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if sclient.daemon_alive(sock) is not None:
+            break
+        time.sleep(0.02)
+    else:
+        pytest.fail("daemon never became ready")
+    from kafkabalancer_tpu.serve.lanes import LaneScheduler
+
+    assert isinstance(d._coalescer, LaneScheduler)
+    result_box = []
+
+    def one():
+        result_box.append(
+            sclient.forward_plan(
+                sock, ["-no-daemon=true", "-input-json=true"],
+                open(FIXTURE).read(),
+            )
+        )
+
+    rt = threading.Thread(target=one)
+    rt.start()
+    assert started.wait(10), "request never started"
+    # the request sleeps well past the 1.0s idle timeout; the daemon
+    # must still be alive and must serve the request to completion
+    time.sleep(1.6)
+    assert t.is_alive(), "daemon idle-shutdown with a lane in flight"
+    rt.join(30)
+    assert result_box and result_box[0] is not None
+    assert result_box[0].rc == 0
+    t.join(15)  # now genuinely idle: the timeout may fire
+    assert rc_box == [0]
+
+
+# --- cross-request microbatching ------------------------------------------
+
+
+def test_microbatch_group_differential_bit_parity():
+    """The tentpole differential pin: two DISTINCT same-bucket instances
+    fused through the microbatch barrier produce byte-identical plans to
+    solo dispatches."""
+    import copy
+
+    from kafkabalancer_tpu.serve.lanes import MicrobatchGroup
+    from kafkabalancer_tpu.solvers import scan
+
+    def load(mutate=False):
+        from kafkabalancer_tpu.codecs import get_partition_list_from_reader
+        from kafkabalancer_tpu.models import default_rebalance_config
+
+        with open(FIXTURE) as fh:
+            pl = get_partition_list_from_reader(fh, True, [])
+        if mutate:  # distinct instance, same shape bucket
+            p0 = pl.partitions[0]
+            p0.replicas[0], p0.replicas[1] = p0.replicas[1], p0.replicas[0]
+        cfg = default_rebalance_config()
+        return pl, cfg
+
+    def emit(opl):
+        out = io.StringIO()
+        from kafkabalancer_tpu.codecs import write_partition_list
+
+        write_partition_list(out, opl)
+        return out.getvalue()
+
+    solo = []
+    for mutate in (False, True):
+        pl, cfg = load(mutate)
+        solo.append(emit(scan.plan(pl, cfg, 4, batch=4)))
+
+    mb = MicrobatchGroup(2)
+    fused = [None, None]
+
+    def member(idx, mutate):
+        pl, cfg = load(mutate)
+        with mb.member():
+            fused[idx] = emit(scan.plan(pl, cfg, 4, batch=4))
+
+    threads = [
+        threading.Thread(target=member, args=(0, False)),
+        threading.Thread(target=member, args=(1, True)),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert fused[0] == solo[0]
+    assert fused[1] == solo[1]
+    assert mb.fused_requests == 2
+    assert mb.fused_dispatches >= 1
+
+
+def test_microbatch_member_leaving_releases_the_barrier():
+    """A member that never dispatches (greedy request, error exit) must
+    not wedge the barrier: the remaining member's round completes and —
+    as a singleton — runs solo."""
+    from kafkabalancer_tpu.serve.lanes import MicrobatchGroup
+
+    mb = MicrobatchGroup(2)
+    out = []
+
+    def leaver():
+        with mb.member():
+            time.sleep(0.1)  # never dispatches
+
+    def dispatcher():
+        with mb.member():
+            out.append(
+                mb.dispatch(
+                    (None,), {"engine": "xla", "leader": False}
+                )
+            )
+
+    threads = [
+        threading.Thread(target=leaver),
+        threading.Thread(target=dispatcher),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(20)
+    assert out == [None]  # solo fallback, no deadlock
+
+
+def test_microbatch_declines_non_xla_and_leader_dispatches():
+    from kafkabalancer_tpu.serve.lanes import MicrobatchGroup
+
+    mb = MicrobatchGroup(1)
+    assert mb.dispatch((None,), {"engine": "pallas", "leader": False}) is None
+    assert mb.dispatch((None,), {"engine": "xla", "leader": True}) is None
+
+
+def test_served_microbatched_plans_byte_identical(sock_dir):
+    """End to end through a microbatching daemon: concurrent same-bucket
+    -fused requests fuse into batched dispatches and every response is
+    byte-identical to the in-process plan; a malformed request riding
+    alongside still error-exits identically."""
+    sock = os.path.join(sock_dir, "kb.sock")
+    d = Daemon(
+        sock, idle_timeout=60.0, warm=False, log=lambda _m: None,
+        lanes=0, microbatch=4,
+    )
+    rc_box = []
+    t = threading.Thread(
+        target=lambda: rc_box.append(d.serve_forever()), daemon=True
+    )
+    t.start()
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if sclient.daemon_alive(sock) is not None:
+            break
+        time.sleep(0.02)
+    else:
+        pytest.fail("daemon never became ready")
+    try:
+        args = ["-input-json", f"-input={FIXTURE}", "-fused",
+                "-fused-batch=4", "-max-reassign=4"]
+        want_rv, want_out, _ = run_cli(args + ["-no-daemon"])
+        bad_rv, bad_out, _ = run_cli(["-input-json", "-no-daemon"], "::x::")
+        # warm request: pays the compile so the concurrent wave below
+        # queues deep enough to fuse
+        rv0, out0, _ = run_cli(args + [f"-serve-socket={sock}"])
+        assert rv0 == want_rv == 0 and out0 == want_out
+
+        lock = threading.Lock()
+
+        def good(results):
+            r = run_cli(args + [f"-serve-socket={sock}"])
+            with lock:
+                results.append(("good", r))
+
+        def bad(results):
+            r = run_cli(
+                ["-input-json", f"-serve-socket={sock}"], "::x::"
+            )
+            with lock:
+                results.append(("bad", r))
+
+        # parity is asserted on EVERY wave; whether a wave actually
+        # fuses depends on thread scheduling (the group only forms if
+        # requests are co-queued at pop time), so waves repeat until
+        # fusion is observed — the determinstic bit-parity pin for the
+        # fused path itself is test_microbatch_group_differential_*
+        fused_seen = False
+        for _wave in range(4):
+            results: list = []
+            threads = [
+                threading.Thread(target=good, args=(results,))
+                for _ in range(4)
+            ]
+            threads.append(threading.Thread(target=bad, args=(results,)))
+            for x in threads:
+                x.start()
+            for x in threads:
+                x.join(120)
+            assert len(results) == 5
+            for kind, (rv, out, _err) in results:
+                if kind == "good":
+                    assert rv == 0 and out == want_out
+                else:
+                    assert rv == bad_rv == 2 and out == bad_out
+            stats = d._coalescer.stats()
+            assert stats["lanes"] >= 1.0
+            if stats["microbatched"] >= 2.0:
+                fused_seen = True
+                break
+        assert fused_seen, d._coalescer.stats()
+    finally:
+        sclient.request_shutdown(sock)
+        t.join(15)
+    assert rc_box == [0]
+
+
+# --- structured protocol error frames -------------------------------------
+
+
+def test_daemon_answers_bad_frames_with_error_frame(daemon):
+    """An oversized length prefix or an unparseable payload gets a
+    structured op-'error' response instead of a dropped connection."""
+    import socket as socket_mod
+    import struct
+
+    sock_path, _d = daemon
+    # oversized declared length
+    s = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+    s.connect(sock_path)
+    try:
+        s.sendall(struct.pack(">I", protocol.MAX_FRAME_BYTES + 1))
+        resp = protocol.read_frame(s)
+        assert resp is not None and resp.get("ok") is False
+        assert resp.get("op") == "error"
+        assert "exceeds" in resp["error"]
+    finally:
+        s.close()
+    # valid length, non-JSON payload
+    s = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+    s.connect(sock_path)
+    try:
+        body = b"\x00not json"
+        s.sendall(struct.pack(">I", len(body)) + body)
+        resp = protocol.read_frame(s)
+        assert resp is not None and resp.get("ok") is False
+        assert resp.get("op") == "error"
+    finally:
+        s.close()
+    # garbage argv in an otherwise valid plan frame
+    s = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+    s.connect(sock_path)
+    try:
+        protocol.write_frame(
+            s, {"v": protocol.PROTO_VERSION, "op": "plan", "argv": 42}
+        )
+        resp = protocol.read_frame(s)
+        assert resp is not None and resp.get("ok") is False
+        assert "argv" in resp["error"]
+    finally:
+        s.close()
+
+
+def test_client_logs_daemon_declined_reason(sock_dir):
+    """The client-side satellite pin: when the daemon positively
+    declines (error frame), the CLI logs the REASON and still plans
+    in-process with the correct result."""
+    import socket as socket_mod
+
+    from kafkabalancer_tpu import __version__
+
+    sock_path = os.path.join(sock_dir, "fake.sock")
+    srv = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+    srv.bind(sock_path)
+    srv.listen(4)
+    stop = threading.Event()
+
+    def serve():
+        while not stop.is_set():
+            try:
+                srv.settimeout(0.2)
+                conn, _ = srv.accept()
+            except socket_mod.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                while True:
+                    msg = protocol.read_frame(conn)
+                    if msg is None:
+                        break
+                    if msg.get("op") == "hello":
+                        protocol.write_frame(conn, {
+                            "v": protocol.PROTO_VERSION, "ok": True,
+                            "op": "hello", "version": __version__,
+                            "pid": os.getpid(),
+                        })
+                    else:  # decline every plan with a structured reason
+                        protocol.write_frame(conn, {
+                            "v": protocol.PROTO_VERSION, "ok": False,
+                            "op": "error",
+                            "error": "bad frame: synthetic refusal",
+                        })
+                        break
+            except Exception:
+                pass
+            finally:
+                conn.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    try:
+        rv_s, out_s, err_s = run_cli(
+            ["-input-json", f"-input={FIXTURE}", f"-serve-socket={sock_path}"]
+        )
+        rv_n, out_n, _ = run_cli(
+            ["-input-json", f"-input={FIXTURE}", "-no-daemon"]
+        )
+        assert rv_s == rv_n == 0
+        assert out_s == out_n  # fell back in-process, byte-identical
+        assert "daemon declined request (bad frame: synthetic refusal)" in err_s
+        assert "planning in-process" in err_s
+    finally:
+        stop.set()
+        srv.close()
+        t.join(5)
+
+
+# --- per-lane pinning seams ------------------------------------------------
+
+
+def test_lane_context_installs_and_clears_thread_seams():
+    from kafkabalancer_tpu.ops import aot
+    from kafkabalancer_tpu.ops.tensorize import row_cache, set_row_cache
+    from kafkabalancer_tpu.serve.cache import TensorizeRowCache
+    from kafkabalancer_tpu.serve.lanes import Lane
+
+    lane = Lane(0, device=None)
+    lane.row_cache = TensorizeRowCache()
+    assert aot.execution_device() is None
+    with lane.context():
+        assert aot.staging_cache() is lane.stage_cache
+        assert row_cache() is lane.row_cache
+    assert aot.staging_cache() is None
+    assert row_cache() is None
+    # the thread-local override shadows (and restores to) the global
+    global_cache = TensorizeRowCache()
+    set_row_cache(global_cache)
+    try:
+        with lane.context():
+            assert row_cache() is lane.row_cache
+        assert row_cache() is global_cache
+    finally:
+        set_row_cache(None)
+
+
+def test_stage_request_primes_lane_caches(sock_dir):
+    """The host-encode pipeline stage: staging a fused request fills the
+    lane's digest-keyed staging cache with device-resident tensors and
+    primes the lane's row cache, so the request's own dispatch reuses
+    both. Host-only requests stage nothing."""
+    from kafkabalancer_tpu.serve.cache import TensorizeRowCache
+    from kafkabalancer_tpu.serve.daemon import PlanRequest
+    from kafkabalancer_tpu.serve.lanes import Lane
+
+    d = Daemon(
+        os.path.join(sock_dir, "unused.sock"), warm=False,
+        log=lambda _m: None,
+    )
+    lane = Lane(0, device=None)
+    lane.row_cache = TensorizeRowCache()
+    with open(FIXTURE) as fh:
+        src = fh.read()
+    req = PlanRequest(
+        ["-no-daemon=true", "-input-json=true", "-fused=true",
+         "-max-reassign=4"],
+        src,
+    )
+    d._stage_request(req, lane)
+    assert len(lane.stage_cache) > 0
+    # the stage's tensorize pass primed the per-lane row cache
+    assert lane.row_cache._meta is not None
+    # a greedy request has no device dispatch to stage for
+    lane2 = Lane(1, device=None)
+    lane2.row_cache = TensorizeRowCache()
+    d._stage_request(
+        PlanRequest(["-no-daemon=true", "-input-json=true"], src), lane2
+    )
+    assert lane2.stage_cache == {}
+
+
 # --- the device-upload cache (scan._dev_cached_asarray) -------------------
 
 
