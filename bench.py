@@ -761,10 +761,37 @@ def _run_throughput_probe(n_parts: int, n_brokers: int) -> dict:
             res.setdefault("steals", {})[str(C)] = int(
                 hello1.get("steals", 0)
             ) - int(hello0.get("steals", 0))
+            # continuous-batching attribution across the level window:
+            # per-occupancy fused-dispatch histogram, padded-slot waste
+            # fraction (dead instance slots / all instance slots of the
+            # batched dispatches), and residency-pool hit deltas — all
+            # from the daemon-lifetime hello counters
+            occ0 = hello0.get("mb_occupancy", {}) or {}
+            occ1 = hello1.get("mb_occupancy", {}) or {}
+            occ = {
+                k: int(occ1[k]) - int(occ0.get(k, 0))
+                for k in occ1
+                if int(occ1[k]) - int(occ0.get(k, 0))
+            }
+            pad = int(hello1.get("mb_padded_slots", 0)) - int(
+                hello0.get("mb_padded_slots", 0)
+            )
+            slots = pad + mb
+            res.setdefault("occupancy", {})[str(C)] = occ
+            res.setdefault("padded_waste", {})[str(C)] = round(
+                pad / slots if slots else 0.0, 3
+            )
+            r0 = hello0.get("residency", {}) or {}
+            r1 = hello1.get("residency", {}) or {}
+            res.setdefault("residency_hits", {})[str(C)] = int(
+                r1.get("hits", 0)
+            ) - int(r0.get("hits", 0))
             log(
                 f"throughput[{tag}] C={C}: {rps:.2f} rps over {n} reqs "
                 f"(p50 {res['p50_s'][str(C)]}s, p95 {res['p95_s'][str(C)]}s, "
-                f"lanes={lanes}, util {util:.2f}, microbatched +{mb})"
+                f"lanes={lanes}, util {util:.2f}, microbatched +{mb}, "
+                f"occupancy {occ or '{}'}, waste "
+                f"{res['padded_waste'][str(C)]})"
             )
         return res
 
@@ -796,6 +823,40 @@ def _run_throughput_probe(n_parts: int, n_brokers: int) -> dict:
         out["served_lane_utilization"] = multi.get("lane_utilization", {})
         out["served_microbatched"] = multi.get("microbatched", {})
         out["served_steals"] = multi.get("steals", {})
+        out["served_mb_occupancy"] = multi.get("occupancy", {})
+        out["served_mb_padded_waste"] = multi.get("padded_waste", {})
+        out["served_residency_hits"] = multi.get("residency_hits", {})
+
+        # the SAME-RUN one-shot-barrier control: the identical level
+        # ladder against a -serve-batch-mode=oneshot daemon (the PR-5
+        # fixed-membership barrier), so the continuous-batching speedup
+        # is measured, not asserted — the acceptance ratio is
+        # served_throughput_vs_oneshot at the top concurrency level
+        sock_ctl = os.path.join(tmp, "kb-oneshot.sock")
+        daemon_ctl = _start_probe_daemon(
+            sock_ctl, env, f"{n_parts}x{n_brokers}",
+            ["-serve-batch-mode=oneshot"],
+        )
+        try:
+            if _wait_probe_daemon(sock_ctl, daemon_ctl, "oneshot control"):
+                warm_wall, warm_rc, _warm_served = one_request(sock_ctl, 0)
+                if warm_rc == 0:
+                    ctl = run_levels(sock_ctl, "oneshot")
+                    if ctl["rps"]:
+                        out["served_throughput_oneshot_rps"] = ctl["rps"]
+                        top = str(max(levels))
+                        if top in multi["rps"] and top in ctl["rps"]:
+                            speed = multi["rps"][top] / ctl["rps"][top]
+                            out["served_throughput_vs_oneshot"] = round(
+                                speed, 2
+                            )
+                            log(
+                                f"throughput speedup at C={top}: "
+                                f"{speed:.2f}x continuous vs one-shot "
+                                "barrier"
+                            )
+        finally:
+            _stop_probe_daemon(sock_ctl, daemon_ctl)
 
         if multi.get("lanes", 1) > 1:
             # the single-lane comparison daemon — the >2x-at-C>=4
@@ -1057,7 +1118,11 @@ def main() -> None:
                     "served_throughput_rps", "served_throughput_p50_s",
                     "served_throughput_p95_s", "served_throughput_lanes",
                     "served_lane_utilization", "served_microbatched",
-                    "served_steals", "served_throughput_single_lane_rps",
+                    "served_steals", "served_mb_occupancy",
+                    "served_mb_padded_waste", "served_residency_hits",
+                    "served_throughput_oneshot_rps",
+                    "served_throughput_vs_oneshot",
+                    "served_throughput_single_lane_rps",
                     "served_throughput_vs_single_lane",
                 ) if k in cold},
                 # before/after vs the pinned round-5 cold breakdown —

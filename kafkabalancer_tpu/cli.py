@@ -187,7 +187,8 @@ def _track_warm_thread(t: Any) -> None:
 # naming the path exactly as the user spelled it (stderr parity)
 _NO_FORWARD_FLAGS = frozenset((
     "serve", "serve-socket", "serve-idle-timeout", "serve-prewarm",
-    "serve-lanes", "serve-microbatch",
+    "serve-lanes", "serve-microbatch", "serve-batch-mode",
+    "serve-admission-hold",
     "no-daemon", "help", "pprof", "pprof-path", "jax-profile", "input",
 ))
 # flags whose value names a filesystem path the DAEMON will write — made
@@ -453,9 +454,27 @@ def _run_impl(
         f_serve_microbatch = f.int(
             "serve-microbatch",
             4,
-            "Daemon: fuse up to this many queued same-bucket requests "
-            "into one batched device dispatch (1 disables; results stay "
-            "byte-identical to solo dispatches)",
+            "Daemon: MAX OCCUPANCY of one fused device dispatch — up to "
+            "this many concurrent same-bucket requests share each "
+            "batched dispatch (1 disables; results stay byte-identical "
+            "to solo dispatches)",
+        )
+        f_serve_batch_mode = f.string(
+            "serve-batch-mode",
+            "continuous",
+            "Daemon: cross-request batching discipline — 'continuous' "
+            "re-forms the fused batch at every solver chunk round "
+            "(mid-flight admission into freed slots, variable-K padded "
+            "dispatch); 'oneshot' is the legacy fixed-membership "
+            "barrier, kept as the measured control (docs/serving.md)",
+        )
+        f_serve_admission_hold = f.int(
+            "serve-admission-hold",
+            0,
+            "Daemon: hold a lane's dispatch until this many same-bucket "
+            "batchable requests are queued (or a short window expires) "
+            "— deterministic batch forming for tests and benchmarks "
+            "(0 disables)",
         )
         f_no_daemon = f.bool(
             "no-daemon",
@@ -530,6 +549,14 @@ def _run_impl(
                 usage()
                 return 3
 
+            if f_serve_batch_mode.value not in ("continuous", "oneshot"):
+                log(
+                    f"unknown -serve-batch-mode "
+                    f"{f_serve_batch_mode.value!r} (continuous|oneshot)"
+                )
+                usage()
+                return 3
+
             if f_shard.value and not f_fused.value:
                 log("-fused-shard requires -fused")
                 usage()
@@ -585,6 +612,8 @@ def _run_impl(
                 log=log,
                 lanes=f_serve_lanes.value,
                 microbatch=f_serve_microbatch.value,
+                batch_mode=f_serve_batch_mode.value,
+                admission_hold=f_serve_admission_hold.value,
             ).serve_forever()
 
         if not f_no_daemon.value and not (f_pprof.value or f_jaxprof.value):

@@ -65,14 +65,28 @@ from kafkabalancer_tpu.parallel.mesh import (  # noqa: E402
 from kafkabalancer_tpu.solvers.scan import session  # noqa: E402
 
 
-def stack_instances(rows: "Sequence[np.ndarray]") -> "np.ndarray":
+def stack_instances(
+    rows: "Sequence[np.ndarray]",
+    pad_to: "Optional[int]" = None,
+    pad_row: "Optional[np.ndarray]" = None,
+) -> "np.ndarray":
     """Stack per-instance host arrays along a new leading axis — the
     sweep's per-scenario stacking layout. ONE definition shared by the
-    per-scenario sweep path below and the serve microbatcher
+    per-scenario sweep path below and the serve batcher
     (serve/lanes.py), which fuses K independent same-bucket requests
     into one padded batched dispatch exactly the way the sweep stacks
-    scenarios."""
-    return np.stack([np.asarray(r) for r in rows])
+    scenarios.
+
+    ``pad_to`` pads the instance axis up to that many rows by
+    replicating ``pad_row`` (default: the first row) — the serve
+    batcher's variable-K padding buckets, so ONE compiled batched
+    executable per bucket serves any occupancy (a padded slot replays a
+    no-op instance, ``solvers.scan.pad_instance_args``)."""
+    stacked = [np.asarray(r) for r in rows]
+    if pad_to is not None and len(stacked) < pad_to:
+        fill = stacked[0] if pad_row is None else np.asarray(pad_row)
+        stacked = stacked + [fill] * (pad_to - len(stacked))
+    return np.stack(stacked)
 
 
 @dataclass

@@ -14,12 +14,18 @@ package removes the fresh process from the hot path entirely:
   liveness handshake;
 - ``lanes`` — the multi-device executor: one pipelined worker lane per
   visible device (bucket-affinity routing, work stealing, per-lane
-  caches and staging) and cross-request microbatching (K same-bucket
-  requests fused into one batched device dispatch with bit-identical
-  per-request move logs); one visible device degrades to one lane, and
-  with microbatching also disabled (``-serve-lanes=1`` or
-  ``-serve-microbatch=1``) to the PR-4 single-lane dispatcher byte for
-  byte;
+  caches and staging) and iteration-level CONTINUOUS BATCHING —
+  same-bucket requests fuse into variable-K padded batched dispatches
+  whose membership re-forms at every solver chunk round (mid-flight
+  admission into slots freed by converged members; bit-identical
+  per-request move logs at every occupancy; the legacy one-shot barrier
+  stays as the ``-serve-batch-mode=oneshot`` control). One visible
+  device degrades to one lane, and with batching also disabled
+  (``-serve-lanes=1`` or ``-serve-microbatch=1``) to the PR-4
+  single-lane dispatcher byte for byte;
+- ``residency`` — the shared device-residency pool: one digest-keyed
+  refcounted pool of device arrays per lane, uploaded once and shared
+  by every concurrent request over the same content;
 - ``client`` — the thin, **jax-free** forwarding client embedded in the
   CLI: every normal invocation transparently forwards its parsed flags +
   input to a live daemon and falls back to the ordinary in-process path

@@ -224,6 +224,8 @@ class Daemon:
         warm: bool = True,
         lanes: int = 1,
         microbatch: int = 1,
+        batch_mode: str = "continuous",
+        admission_hold: int = 0,
     ) -> None:
         self.socket_path = socket_path
         self.idle_timeout = idle_timeout
@@ -231,10 +233,18 @@ class Daemon:
         self.warm = warm
         # lanes: 1 = today's single-lane Coalescer, byte for byte (and no
         # jax import before the warm thread); 0/negative = one lane per
-        # visible device; N>1 = min(N, devices). microbatch: max fused
-        # requests per device dispatch (1 disables fusion).
+        # visible device; N>1 = min(N, devices). microbatch: MAX
+        # OCCUPANCY of one fused device dispatch (1 disables fusion).
+        # batch_mode: "continuous" re-forms the fused batch at every
+        # solver chunk round (mid-flight admission, variable-K padded
+        # dispatch); "oneshot" keeps the fixed-membership barrier (the
+        # measured control). admission_hold: deterministic batch forming
+        # — a lane holds its pop until this many admission-predicted
+        # requests are queued or the hold window expires (0 disables).
         self.lanes = lanes
         self.microbatch = max(1, microbatch)
+        self.batch_mode = batch_mode
+        self.admission_hold = max(0, admission_hold)
         self._log: LogFn = log or (
             lambda msg: print(msg, file=sys.stderr, flush=True)
         )
@@ -408,10 +418,14 @@ class Daemon:
                 "serve.lane_busy_s": s["lane_busy_s"],
                 "serve.steals": s["steals"],
                 "serve.microbatched": s["microbatched"],
+                "serve.mb_occupancy_max": s["occupancy_max"],
+                "serve.mb_padded_slots": s["padded_slots"],
+                "serve.residency_hits": s["residency_hits"],
                 "serve.cache_hits": s["cache_hits"],
             })
         else:
             attrs["serve.lanes"] = 1.0
+            attrs["serve.residency_hits"] = 0.0
             attrs["serve.cache_hits"] = float(
                 self.tensorize_cache.stats()["hits"]
             )
@@ -518,7 +532,9 @@ class Daemon:
             self._lanes,
             microbatch=self.microbatch,
             stage=self._stage_request,
-            fusible=self._fusible_request,
+            admissible=self._admissible_request,
+            batch_mode=self.batch_mode,
+            admission_hold=self.admission_hold,
         )
         # concurrent request bodies share the daemon-lifetime registry:
         # a per-request reset would wipe an in-flight peer's attribution.
@@ -529,7 +545,7 @@ class Daemon:
         self._log(
             f"serve: {n_lanes} device lane{'s' if n_lanes != 1 else ''}"
             + (
-                f", microbatch up to {self.microbatch}"
+                f", {self.batch_mode} batching up to {self.microbatch}"
                 if self.microbatch > 1
                 else ""
             )
@@ -537,11 +553,13 @@ class Daemon:
         return scheduler
 
     @staticmethod
-    def _fusible_request(req: PlanRequest) -> bool:
-        """Will this request's planning reach the fusible dispatch (the
-        XLA fused session)? Only such requests join a fusion barrier —
-        see LaneScheduler._run_group. Conservative on purpose: a false
-        negative costs a missed fusion, a false positive stalls peers."""
+    def _admissible_request(req: PlanRequest) -> bool:
+        """ADMISSION prediction: will this request's planning reach the
+        fusible dispatch (the XLA fused session)? Only such requests are
+        admitted into the continuous batcher (or a one-shot fusion
+        group) — see LaneScheduler._run_group/_run_continuous.
+        Conservative on purpose: a false negative costs a missed fusion,
+        a false positive stalls the batch's live peers."""
         if _argv_value(req.argv, "fused") != "true":
             return False
         if _argv_value(req.argv, "rebalance-leader") == "true":
@@ -627,6 +645,13 @@ class Daemon:
             out["lanes"] = int(s["lanes"])
             out["steals"] = int(s["steals"])
             out["microbatched"] = int(s["microbatched"])
+            out["batch_mode"] = self.batch_mode
+            out["mb_occupancy"] = sched.occupancy_hist()
+            out["mb_padded_slots"] = int(s["padded_slots"])
+            out["residency"] = {
+                "hits": int(s["residency_hits"]),
+                "misses": int(s["residency_misses"]),
+            }
             out["lane_busy_s"] = [
                 round(ln.busy_s, 3) for ln in self._lanes
             ]

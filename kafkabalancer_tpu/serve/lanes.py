@@ -25,14 +25,30 @@ multi-device pipelined executor:
   tensors into the lane's staging cache (``ops.aot.stage_host_arrays``),
   so N+1's dispatch finds its inputs already resident — double-buffered:
   at most one request staged ahead per lane;
-- :class:`MicrobatchGroup` — cross-request microbatching: when a lane
-  pops a same-bucket run deeper than one request, up to K requests run
-  concurrently and their fused-session device dispatches are fused into
-  ONE padded batched dispatch (``solvers.scan.session_packed_batched``
-  over the sweep's per-scenario stacking layout). Today's coalescing
-  dedupes the *window*; this fuses *distinct* requests into one device
-  call, each still receiving its own bit-identical packed move log
-  (pinned by the differential tests in tests/test_serve.py).
+- :class:`ContinuousBatcher` — ITERATION-LEVEL continuous batching
+  (Orca, OSDI '22): the fused batch re-forms at every solver chunk
+  round instead of running a fixed membership to collective completion.
+  Members are ADMITTED dynamically — a request arriving while a batch
+  is in flight joins at the next round boundary, into a slot freed by a
+  converged member, instead of waiting out the whole window — and
+  dispatch is VARIABLE-K PADDED over a small set of padding buckets
+  (``PAD_BUCKETS``): live submissions stack along the leading instance
+  axis (``parallel.sweep.stack_instances``), padded slots replay a
+  no-op instance (``solvers.scan.pad_instance_args`` — budget zeroed),
+  so ONE compiled ``session_packed_batched`` executable per bucket
+  serves any occupancy. Each live request still receives its own
+  bit-identical packed move log versus a solo dispatch (pinned by the
+  differential tests in tests/test_serve.py, every occupancy 1..K);
+- :class:`MicrobatchGroup` — the legacy ONE-SHOT fusion barrier (fixed
+  membership, runs to collective completion), kept as the measured
+  control (``-serve-batch-mode=oneshot``; bench.py's continuous-vs-
+  oneshot throughput ratio comes from this pair) and as the shared base
+  of the continuous batcher;
+- shared device residency (serve/residency.py): each lane's staging
+  structure is a digest-keyed refcounted :class:`ResidencyPool` —
+  weights/allowed/validity arrays common across concurrent requests
+  upload once per lane and are shared by every member, so steady-state
+  staging traffic drops to the per-request delta rows.
 
 Layering: this module imports jax/numpy/solvers only lazily inside
 methods — constructing a scheduler with ``device=None`` lanes (tests)
@@ -59,6 +75,7 @@ from typing import (
 
 from kafkabalancer_tpu import obs
 from kafkabalancer_tpu.serve.protocol import PROTO_VERSION
+from kafkabalancer_tpu.serve.residency import ResidencyPool
 
 BucketKey = Tuple[int, int, int, bool]
 # handler contract (daemon._handle_plan): sets req.response, never sets
@@ -66,10 +83,10 @@ BucketKey = Tuple[int, int, int, bool]
 LaneHandler = Callable[[Any, bool, "Lane", Optional["MicrobatchGroup"]], None]
 BucketFn = Callable[[Any], Optional[BucketKey]]
 StageFn = Callable[[Any, "Lane"], None]
-# predicate: will this request's planning reach the fusible dispatch
-# (the XLA fused session)? Only such requests join a fusion barrier — a
-# member that never dispatches would stall its peers until its whole
-# request completes
+# admission predicate: will this request's planning reach the fusible
+# dispatch (the XLA fused session)? Only such requests are admitted into
+# a fusion batch — a member that never dispatches would stall its peers
+# until its whole request completes
 FusibleFn = Callable[[Any], bool]
 
 
@@ -91,6 +108,26 @@ def probe_bucket(req: Any, bucket_of: BucketFn) -> Optional[BucketKey]:
 # host-side head (parse + settle + tensorize), seconds at flagship scale
 MICROBATCH_WAIT_S = 120.0
 
+# variable-K padding buckets: a fused round's occupancy pads up to the
+# smallest bucket that holds it (no-op instances fill the dead slots),
+# so one compiled batched executable per bucket serves any occupancy —
+# occupancies past the largest bucket dispatch at their exact K
+PAD_BUCKETS = (1, 2, 4, 8)
+
+# -serve-admission-hold: how long a lane holds its pop waiting for the
+# requested batch depth to queue before dispatching what it has — the
+# bound that keeps a deterministic-batching daemon from wedging when
+# fewer clients than the hold depth ever arrive. Generous: hold daemons
+# are private test/bench tools where a missed batch costs a flaky run
+# and a held singleton costs only this window once
+ADMISSION_HOLD_WINDOW_S = 5.0
+
+# continuous admission tick: how often the drain loop re-polls the lane
+# queue for newly staged same-bucket requests while members are in
+# flight (retirements notify the batcher's condition immediately; the
+# tick only bounds the queue-poll latency)
+ADMISSION_TICK_S = 0.02
+
 
 class Lane:
     """One device lane: identity, pinned device, per-lane caches and
@@ -104,7 +141,12 @@ class Lane:
         self.index = index
         self.device = device
         self.row_cache: Any = None  # TensorizeRowCache, daemon-installed
-        self.stage_cache: Dict[Any, Any] = {}
+        # the lane's staging structure is the SHARED residency pool:
+        # digest-keyed device buffers uploaded once per lane, shared by
+        # every concurrent request over the same content, refcount-
+        # evicted (serve/residency.py) — PR 5's single-use staging dict
+        # generalized across requests
+        self.stage_cache: ResidencyPool = ResidencyPool()
         self.busy_s = 0.0
         self.requests = 0
 
@@ -134,12 +176,19 @@ class Lane:
             set_thread_row_cache(None)
             aot.set_staging_cache(None)
             aot.set_execution_device(None)
+            # one serving thread == one in-flight request: drop this
+            # request's pins on the shared pool so retired requests'
+            # universes become evictable
+            self.stage_cache.release_thread()
 
     def cache_stats(self) -> Dict[str, int]:
         if self.row_cache is None:
             return {"hits": 0, "misses": 0, "rows_reused": 0}
         stats: Dict[str, int] = self.row_cache.stats()
         return stats
+
+    def residency_stats(self) -> Dict[str, int]:
+        return self.stage_cache.stats()
 
 
 class _MbEntry:
@@ -169,7 +218,11 @@ def _mb_sig(args: Tuple, statics: Dict[str, Any]) -> Tuple[Any, ...]:
 
 
 class MicrobatchGroup:
-    """Fusion barrier for K concurrently-running same-bucket requests.
+    """ONE-SHOT fusion barrier for K concurrently-running same-bucket
+    requests — fixed membership decided at formation, run to collective
+    completion. Kept as the measured control for the continuous batcher
+    (``-serve-batch-mode=oneshot``; bench.py reports the throughput
+    ratio of the pair) and as its shared implementation base.
 
     Each member's request thread installs the group via :meth:`member`;
     ``solvers.scan._dispatch_chunk`` then offers every fused-session
@@ -190,6 +243,15 @@ class MicrobatchGroup:
         self._wait_s = wait_s
         self.fused_requests = 0
         self.fused_dispatches = 0
+        # occupancy histogram (live members per fused dispatch) and the
+        # padded-slot count — bench.py's occupancy/waste attribution
+        self.occupancy: Dict[int, int] = {}
+        self.padded_slots = 0
+        # stats sink (the owning scheduler): called (occupancy, padded)
+        # right after each fused dispatch commits, BEFORE the members'
+        # responses return — a stats() read taken the instant a client
+        # sees its response must already include its fusion
+        self.sink: Optional[Callable[[int, int], None]] = None
 
     @contextlib.contextmanager
     def member(self, req: Any = None) -> Iterator[None]:
@@ -268,7 +330,14 @@ class MicrobatchGroup:
         with self._cv:
             self._cv.notify_all()
 
+    def _pad_to(self, n: int) -> int:
+        """Instance-axis width for an occupancy-``n`` round. The
+        one-shot control dispatches at the exact K (the PR-5 behavior);
+        the continuous batcher overrides with the padding buckets."""
+        return n
+
     def _run_fused(self, entries: List[_MbEntry]) -> None:
+        n = len(entries)
         try:
             import numpy as np
 
@@ -276,13 +345,23 @@ class MicrobatchGroup:
             from kafkabalancer_tpu.parallel.sweep import stack_instances
             from kafkabalancer_tpu.solvers import scan
 
+            pad_k = max(n, self._pad_to(n))
+            pad_args = (
+                scan.pad_instance_args(entries[0].args) if pad_k > n else None
+            )
             stacked: List[Any] = []
             for pos in range(len(entries[0].args)):
                 vals = [e.args[pos] for e in entries]
                 stacked.append(
-                    None if vals[0] is None else stack_instances(vals)
+                    None
+                    if vals[0] is None
+                    else stack_instances(
+                        vals,
+                        pad_to=pad_k,
+                        pad_row=None if pad_args is None else pad_args[pos],
+                    )
                 )
-            with obs.span("serve.microbatch_dispatch", k=len(entries)):
+            with obs.span("serve.microbatch_dispatch", k=n, padded_k=pad_k):
                 out = np.asarray(
                     aot.call_or_compile(
                         "session_packed_batched",
@@ -296,9 +375,21 @@ class MicrobatchGroup:
                     if not e.solo:  # a timed-out member already went solo
                         e.result = out[k]
                         e.done = True
-                self.fused_requests += len(entries)
+                self.fused_requests += n
                 self.fused_dispatches += 1
-            obs.metrics.count("serve.microbatched", len(entries))
+                self.occupancy[n] = self.occupancy.get(n, 0) + 1
+                self.padded_slots += pad_k - n
+            obs.metrics.count("serve.microbatched", n)
+            if pad_k > n:
+                obs.metrics.count("serve.mb_padded_slots", pad_k - n)
+            if self.sink is not None:
+                # members are still parked at the barrier (the round's
+                # notify_all fires after this returns), so the sink's
+                # accounting is visible before any response is
+                try:
+                    self.sink(n, pad_k - n)
+                except Exception:
+                    pass
         except Exception:
             # fail open: every waiter runs its own solo dispatch
             with self._cv:
@@ -307,9 +398,79 @@ class MicrobatchGroup:
                         e.solo = True
 
 
+class ContinuousBatcher(MicrobatchGroup):
+    """Iteration-level continuous batching: the one-shot barrier with
+    DYNAMIC membership and variable-K padded dispatch.
+
+    Rounds work exactly like the base barrier — a round fires when every
+    live member has submitted or left — but membership is no longer
+    fixed at formation: the lane's drain loop :meth:`admit`\\ s a newly
+    staged same-bucket request the moment a slot frees (a converged
+    member leaving shrinks ``live``; the admit grows it back), so the
+    next round re-forms with the new member's chunk-1 dispatch fused
+    into its peers' chunk ``i+1`` instead of the request waiting out the
+    whole window. Occupancy therefore varies round to round, and
+    :meth:`_pad_to` pads each round up to the smallest ``PAD_BUCKETS``
+    bucket so one compiled batched executable per bucket serves them
+    all; a padded slot replays a no-op instance
+    (``solvers.scan.pad_instance_args``) and live slots keep their
+    bit-identical per-instance logs.
+    """
+
+    def __init__(
+        self,
+        max_k: int,
+        wait_s: float = MICROBATCH_WAIT_S,
+        pad_buckets: Sequence[int] = PAD_BUCKETS,
+    ) -> None:
+        super().__init__(0, wait_s)
+        self._max_k = max(1, max_k)
+        self._pad_buckets = tuple(sorted(set(int(b) for b in pad_buckets)))
+        self.admitted = 0
+
+    def admit(self) -> None:
+        """Grow the live membership by one — called by the lane's drain
+        loop BEFORE the member's request thread starts, so no round can
+        fire without the newcomer (it either dispatches into the next
+        round or leaves)."""
+        with self._cv:
+            self._live += 1
+            self.admitted += 1
+
+    def wait_change(self, timeout: float) -> None:
+        """Block until membership/round state changes (a member leaves
+        or submits) or ``timeout`` elapses — the drain loop's tick."""
+        with self._cv:
+            self._cv.wait(timeout)
+
+    def _leave(self) -> None:
+        super()._leave()
+        # wake the drain loop promptly: a departure frees a slot the
+        # next queued request can be admitted into
+        with self._cv:
+            self._cv.notify_all()
+
+    def _pad_to(self, n: int) -> int:
+        for b in self._pad_buckets:
+            if b >= n:
+                return b
+        return n  # past the largest bucket: exact K
+
+
 class LaneScheduler:
     """Multi-lane dispatcher with bucket affinity, work stealing and
-    optional microbatching; Coalescer-compatible interface."""
+    cross-request batching; Coalescer-compatible interface.
+
+    ``batch_mode`` selects the fusion discipline for same-bucket
+    admission-predicted requests: ``"continuous"`` (the default) runs
+    them through a :class:`ContinuousBatcher` — mid-flight admission
+    into freed slots, variable-K padded dispatch; ``"oneshot"`` keeps
+    the PR-5 fixed-membership :class:`MicrobatchGroup` (the measured
+    control). ``admission_hold`` (the deterministic admission latch,
+    ``-serve-admission-hold``) makes a lane hold its pop until that many
+    admission-predicted requests are queued — or the hold window
+    expires — so tests and benchmarks can form a full batch without
+    scheduler-timing luck."""
 
     def __init__(
         self,
@@ -318,21 +479,30 @@ class LaneScheduler:
         lanes: Sequence[Lane],
         microbatch: int = 1,
         stage: Optional[StageFn] = None,
-        fusible: Optional[FusibleFn] = None,
+        admissible: Optional[FusibleFn] = None,
+        batch_mode: str = "continuous",
+        admission_hold: int = 0,
     ) -> None:
         self._handle = handle
         self._bucket_of = bucket_of
         self.lanes = list(lanes)
         self._microbatch = max(1, microbatch)
         self._stage = stage
-        self._fusible = fusible
+        self._admissible = admissible
+        self._batch_mode = batch_mode
         self._cv = threading.Condition()
         self._queues: List[Deque[Any]] = [deque() for _ in self.lanes]
         self._active = [0] * len(self.lanes)
         self._affinity: Dict[BucketKey, int] = {}
         self._stop = False
+        self._hold_n = max(0, admission_hold)
+        self._hold_window_s = ADMISSION_HOLD_WINDOW_S
+        self._hold_since: List[Optional[float]] = [None] * len(self.lanes)
+        self._admission_tick_s = ADMISSION_TICK_S
         self.steals = 0
         self.microbatched = 0
+        self.padded_slots = 0
+        self._occupancy: Dict[int, int] = {}
         self._workers = [
             threading.Thread(
                 target=self._worker, args=(i,), name=f"serve-lane-{i}",
@@ -381,15 +551,42 @@ class LaneScheduler:
 
     def stats(self) -> Dict[str, float]:
         with self._cv:
+            residency = [ln.residency_stats() for ln in self.lanes]
             return {
                 "lanes": float(len(self.lanes)),
                 "steals": float(self.steals),
                 "microbatched": float(self.microbatched),
+                "padded_slots": float(self.padded_slots),
+                "occupancy_max": float(
+                    max(self._occupancy, default=0)
+                ),
+                "residency_hits": float(sum(r["hits"] for r in residency)),
+                "residency_misses": float(
+                    sum(r["misses"] for r in residency)
+                ),
                 "lane_busy_s": float(sum(ln.busy_s for ln in self.lanes)),
                 "cache_hits": float(
                     sum(ln.cache_stats()["hits"] for ln in self.lanes)
                 ),
             }
+
+    def occupancy_hist(self) -> Dict[str, int]:
+        """Fused dispatches by live occupancy (string keys: the dict
+        rides JSON hello responses)."""
+        with self._cv:
+            return {str(k): v for k, v in sorted(self._occupancy.items())}
+
+    def _note_fused(self, occupancy: int, padded: int) -> None:
+        """The batchers' stats sink: one fused dispatch of ``occupancy``
+        live members and ``padded`` dead slots landed. Called before the
+        members' responses release, so a stats() read racing a client's
+        completion already counts its fusion."""
+        with self._cv:
+            self.microbatched += occupancy
+            self.padded_slots += padded
+            self._occupancy[occupancy] = (
+                self._occupancy.get(occupancy, 0) + 1
+            )
 
     # -- routing ----------------------------------------------------------
     def _bucket(self, req: Any) -> Optional[BucketKey]:
@@ -439,6 +636,43 @@ class LaneScheduler:
                 return r
         return None
 
+    def _hold_locked(self, i: int) -> bool:
+        """The deterministic admission latch: True while lane ``i`` must
+        keep its queue intact waiting for ``_hold_n`` admission-predicted
+        (batchable) requests — or the hold window — only when the queue
+        HEAD is itself admission-predicted, so a plain request (greedy
+        solver, malformed input) never waits behind the latch, and only
+        BATCHABLE requests count toward the target (a greedy request
+        interleaving must not release a partial batch). Bucket equality
+        is NOT checked (the probe parses input; this runs under the
+        lock) — the deterministic-forming use case feeds same-shape
+        clients by construction, and the window bounds any mix-up.
+        Caller holds the lock; the argv-only admissibility predicate is
+        lock-safe."""
+        if self._hold_n <= 1 or self._stop or self._admissible is None:
+            self._hold_since[i] = None
+            return False
+        q = self._queues[i]
+
+        def batchable(r: Any) -> bool:
+            try:
+                return bool(self._admissible(r))
+            except Exception:
+                return False
+
+        if not batchable(q[0]):
+            self._hold_since[i] = None
+            return False
+        now = time.monotonic()
+        since = self._hold_since[i]
+        if since is None:
+            self._hold_since[i] = since = now
+        n_batchable = sum(1 for r in q if batchable(r))
+        if n_batchable >= self._hold_n or now - since >= self._hold_window_s:
+            self._hold_since[i] = None
+            return False
+        return True
+
     # -- the lane worker ---------------------------------------------------
     def _worker(self, i: int) -> None:
         lane = self.lanes[i]
@@ -448,6 +682,9 @@ class LaneScheduler:
             with self._cv:
                 while True:
                     if self._queues[i]:
+                        if self._hold_locked(i):
+                            self._cv.wait(0.02)
+                            continue
                         first = self._queues[i].popleft()
                         contended = bool(self._queues[i])
                         break
@@ -480,9 +717,14 @@ class LaneScheduler:
                                 self._queues[i].remove(r)
                             self._active[i] += len(taken)
                         group.extend(taken)
+            # ``claimed`` tracks every request this turn is responsible
+            # for — continuous admission pulls MORE from the queue while
+            # the batch runs, and each pull must ride the same answer-
+            # everything / active-count guarantees as the initial group
+            claimed = list(group)
             t0 = time.monotonic()
             try:
-                self._run_group(lane, group)
+                self._run_group(lane, group, claimed)
             except Exception as exc:
                 # the worker must SURVIVE anything a group throws
                 # (thread exhaustion in a fused run, a stage-thread
@@ -495,7 +737,7 @@ class LaneScheduler:
                     lane=lane.index,
                     error=type(exc).__name__,
                 )
-                for req in group:
+                for req in claimed:
                     if not req.done.is_set():
                         req.response = {
                             "v": PROTO_VERSION, "ok": False,
@@ -507,9 +749,9 @@ class LaneScheduler:
                         req.done.set()
             finally:
                 with self._cv:
-                    self._active[i] -= len(group)
+                    self._active[i] -= len(claimed)
                     lane.busy_s += time.monotonic() - t0
-                    lane.requests += len(group)
+                    lane.requests += len(claimed)
                     self._cv.notify_all()
 
     def _stage_ahead(self, lane: Lane) -> None:
@@ -540,36 +782,153 @@ class LaneScheduler:
         except Exception:
             pass  # no thread to spare: the overlap is skipped, that's all
 
-    def _run_group(self, lane: Lane, group: List[Any]) -> None:
+    def _run_group(
+        self, lane: Lane, group: List[Any], claimed: List[Any]
+    ) -> None:
         self._stage_ahead(lane)
         k = self._microbatch
-        if k > 1 and len(group) > 1 and self._fusible is not None:
-            # only PREDICTED-fusible requests join a fusion barrier: a
+        if k > 1 and len(group) > 1 and self._admissible is not None:
+            # only ADMISSION-PREDICTED requests join a fusion batch: a
             # member that never reaches the fusible dispatch (greedy
             # solver, kernel engine, leader session) would stall its
-            # peers until its entire request completed. Non-fusible
-            # riders run serially after, still coalesced in the window.
+            # peers until its entire request completed. Everything else
+            # runs serially after, still coalesced in the window.
             fusible: List[Any] = []
             rest: List[Any] = []
             for req in group:
                 try:
-                    (fusible if self._fusible(req) else rest).append(req)
+                    (fusible if self._admissible(req) else rest).append(req)
                 except Exception:
                     rest.append(req)
             first = True
-            for j in range(0, len(fusible), k):
-                run = fusible[j : j + k]
-                if len(run) == 1:
-                    self._run_one(lane, run[0], coalesced=not first)
-                else:
-                    self._run_fused(lane, run, first=first)
+            if fusible and self._batch_mode != "oneshot":
+                # non-batchable riders waiting in this window gate the
+                # feed: with `rest` pending, no new arrivals are pulled
+                # (the batch drains, the riders run, the worker re-pops)
+                # — mid-flight admission must never starve them
+                self._run_continuous(
+                    lane, fusible, claimed, first=first, feed=not rest
+                )
                 first = False
+            else:
+                # the one-shot control (-serve-batch-mode=oneshot): the
+                # PR-5 fixed-membership barrier, run to completion
+                for j in range(0, len(fusible), k):
+                    run = fusible[j : j + k]
+                    if len(run) == 1:
+                        self._run_one(lane, run[0], coalesced=not first)
+                    else:
+                        self._run_fused(lane, run, first=first)
+                    first = False
             for req in rest:
                 self._run_one(lane, req, coalesced=not first)
                 first = False
         else:
             for idx, req in enumerate(group):
                 self._run_one(lane, req, coalesced=idx > 0)
+
+    def _pull_admissible(
+        self, lane: Lane, bucket: Optional[BucketKey]
+    ) -> List[Any]:
+        """Claim the queue-HEAD PREFIX of same-bucket admission-predicted
+        requests from this lane's queue — the continuous batcher's
+        mid-flight admission feed. Prefix only, never a leapfrog: the
+        first non-batchable or different-bucket request stops the feed,
+        so under sustained fused traffic an older queued greedy/other-
+        bucket request is reached the moment the current batch drains
+        instead of starving behind an endless stream of newer
+        admissions. Probes run OUTSIDE the lock (they parse the
+        request's input; memoized per request), membership re-checked
+        under it (a stealer may have taken a snapshotted request —
+        stealing only removes, so the prefix property survives)."""
+        if bucket is None:
+            return []
+        i = lane.index
+        with self._cv:
+            if self._stop or not self._queues[i]:
+                return []
+            pending = list(self._queues[i])
+        want = []
+        for r in pending:
+            try:
+                if self._bucket(r) == bucket and (
+                    self._admissible is not None and self._admissible(r)
+                ):
+                    want.append(r)
+                else:
+                    break
+            except Exception:
+                break
+        if not want:
+            return []
+        with self._cv:
+            taken = [r for r in want if r in self._queues[i]]
+            for r in taken:
+                self._queues[i].remove(r)
+            self._active[i] += len(taken)
+        return taken
+
+    def _run_continuous(
+        self, lane: Lane, fusible: List[Any], claimed: List[Any],
+        first: bool, feed: bool = True,
+    ) -> None:
+        """The continuous-batching drain loop: admit up to K members
+        into one :class:`ContinuousBatcher`, reap members as their
+        requests retire (their slots free immediately), and — with
+        ``feed`` — keep admitting newly staged same-bucket requests into
+        the freed slots until both the batch and the feed drain (prefix
+        pulls only; ``feed=False`` when non-batchable riders wait in
+        this window). The batcher's rounds re-form at every solver chunk
+        boundary, so an admission mid-way through its peers' sessions
+        fuses its chunk 1 with their chunk i+1 — no request ever waits
+        out a whole window."""
+        cb = ContinuousBatcher(self._microbatch)
+        cb.sink = self._note_fused
+        waiting: Deque[Any] = deque(fusible)
+        bucket = (
+            fusible[0].bucket if feed and fusible[0].bucketed else None
+        )
+        running: Dict[Any, threading.Thread] = {}
+        n_started = 0
+        while True:
+            while waiting and len(running) < self._microbatch:
+                req = waiting.popleft()
+                coalesced = n_started > 0 or not first
+                cb.admit()
+                t = threading.Thread(
+                    target=self._run_one,
+                    args=(lane, req, coalesced, cb),
+                    name=f"serve-lane-{lane.index}-cb{n_started}",
+                )
+                n_started += 1
+                try:
+                    t.start()
+                except Exception:
+                    # can't start the member thread (thread exhaustion):
+                    # release its batcher slot so the live members'
+                    # rounds still complete, and run it inline, solo
+                    cb.abandon()
+                    self._run_one(lane, req, coalesced, None)
+                    continue
+                running[req] = t
+            for req in [r for r in running if r.done.is_set()]:
+                running.pop(req).join()
+            if (
+                len(running) + len(waiting) < self._microbatch
+                and not self._stop
+            ):
+                pulled = self._pull_admissible(lane, bucket)
+                if pulled:
+                    claimed.extend(pulled)
+                    waiting.extend(pulled)
+                    continue
+            if not running and not waiting:
+                break
+            if waiting and len(running) < self._microbatch:
+                continue
+            # members in flight and no free work to admit: wait for a
+            # retirement (notified by the batcher) or the next poll tick
+            cb.wait_change(self._admission_tick_s)
 
     def _run_one(
         self,
@@ -595,6 +954,7 @@ class LaneScheduler:
 
     def _run_fused(self, lane: Lane, run: List[Any], first: bool) -> None:
         mb = MicrobatchGroup(len(run))
+        mb.sink = self._note_fused
         started: List[threading.Thread] = []
         inline: List[Tuple[Any, bool]] = []
         for idx, req in enumerate(run):
@@ -618,5 +978,3 @@ class LaneScheduler:
             t.join()
         for req, coalesced in inline:
             self._run_one(lane, req, coalesced, None)
-        with self._cv:
-            self.microbatched += mb.fused_requests

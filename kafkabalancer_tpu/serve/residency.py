@@ -1,0 +1,185 @@
+"""Shared device residency: one pool of device-resident arrays per lane.
+
+PR 5's per-lane staging cache was SINGLE-USE: the stage thread shipped
+the next request's tensors ahead, the dispatch popped them, and that was
+the end of the buffer's life. But K concurrent requests over the same
+broker universe share most of their dense encoding byte-for-byte —
+weights, allowed masks, broker validity — and each one staged its own
+private copy of identical content (K transfers of the same bytes per
+batching round). This module generalizes ``solvers.scan
+._dev_cached_asarray``'s session-scoped digest reuse ACROSS requests and
+lanes, vLLM-style: device arrays are keyed by content digest, uploaded
+once per lane, and shared by every concurrent member, so steady-state
+staging traffic drops to the per-request delta rows (the arrays that
+actually differ between clusters).
+
+Eviction is refcounted: every lookup/insert on a request thread pins the
+entry for that thread (one serving thread == one in-flight request), and
+``release_thread`` — called when the lane context unwinds — drops the
+pins. Only UNREFERENCED entries are evicted, LRU past the cap, so a
+buffer can never be dropped out from under an in-flight dispatch's next
+chunk. Buffers already captured by a dispatched computation stay alive
+through jax's own references regardless; the refcount is about keeping
+the SHARED copies hot while any member of the lane's active set still
+plans over that universe.
+
+Layering: jax-free at import (buffers are opaque objects put here by the
+callers); safe to construct in tests with no backend at all.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, Set, Tuple
+
+from kafkabalancer_tpu import obs
+
+# entries with no holder beyond this many are evicted oldest-first; the
+# working set of a lane is a handful of arrays per shape bucket, so the
+# default is generous without letting a bucket-churning daemon pin
+# unbounded device memory through the pool
+DEFAULT_POOL_CAP = 64
+
+# one request thread pins at most this many entries at a time; past it
+# the OLDEST pins release (the entries stay pooled, merely evictable).
+# A session's genuinely shared arrays (weights/allowed/validity — well
+# under this) stay pinned because every chunk's lookup re-freshens
+# them, while per-round transients (post-commit replicas, each round's
+# freshly stacked batch args) age out of the pinned set instead of
+# accumulating unevictable device buffers for the whole request — a
+# long multi-chunk session would otherwise grow device memory linearly
+# with its round count
+THREAD_PIN_CAP = 16
+
+# (shape, dtype.str, content digest) — the same key layout as
+# ops.aot._stage_key, so the staging path and the pool cannot drift
+PoolKey = Tuple[Any, ...]
+
+
+class ResidencyPool:
+    """Digest-keyed, refcounted pool of device-resident arrays.
+
+    The pool replaces the single-use per-lane staging dict: lookups do
+    NOT consume (the whole point is that the next request over the same
+    universe hits the same buffer), and inserts from the dispatch path
+    mean request 2 skips the transfer request 1 already paid. Counters
+    feed the ``serve.residency_hits`` attribution gauge.
+    """
+
+    def __init__(self, cap: int = DEFAULT_POOL_CAP) -> None:
+        self._lock = threading.RLock()
+        # key -> device buffer; insertion order doubles as recency
+        self._entries: "OrderedDict[PoolKey, Any]" = OrderedDict()
+        # key -> thread idents currently pinning the entry
+        self._refs: Dict[PoolKey, Set[int]] = {}
+        # thread ident -> its pinned keys in pin order (the per-thread
+        # pin LRU behind THREAD_PIN_CAP)
+        self._pins: Dict[int, "OrderedDict[PoolKey, None]"] = {}
+        self._cap = cap
+        self.hits = 0
+        self.misses = 0
+        self.uploads = 0
+        self.evictions = 0
+
+    # -- mapping-ish surface (the staging call sites in ops/aot.py) -----
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def __contains__(self, key: PoolKey) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._refs.clear()
+            self._pins.clear()
+
+    # -- the shared-residency protocol ----------------------------------
+    def _pin_locked(self, key: PoolKey) -> None:
+        """Pin ``key`` for the calling thread, releasing the thread's
+        OLDEST pins past ``THREAD_PIN_CAP`` (released entries stay
+        pooled, merely evictable — see the cap's comment)."""
+        ident = threading.get_ident()
+        pins = self._pins.setdefault(ident, OrderedDict())
+        pins.pop(key, None)
+        pins[key] = None  # most-recent pin position
+        self._refs.setdefault(key, set()).add(ident)
+        while len(pins) > THREAD_PIN_CAP:
+            old, _ = pins.popitem(last=False)
+            self._unref_locked(old, ident)
+
+    def _unref_locked(self, key: PoolKey, ident: int) -> None:
+        refs = self._refs.get(key)
+        if refs is not None:
+            refs.discard(ident)
+            if not refs:
+                del self._refs[key]
+
+    def lookup(self, key: PoolKey, retain: bool = True) -> Any:
+        """The resident buffer for ``key`` (refreshing recency and, with
+        ``retain``, pinning it for the calling thread), or None."""
+        with self._lock:
+            buf = self._entries.pop(key, None)
+            if buf is None:
+                self.misses += 1
+                obs.metrics.count("serve.residency_misses")
+                return None
+            self._entries[key] = buf  # most-recent position
+            if retain:
+                self._pin_locked(key)
+            self.hits += 1
+        obs.metrics.count("serve.residency_hits")
+        return buf
+
+    def put(self, key: PoolKey, buf: Any, retain: bool = True) -> None:
+        """Insert (or refresh) a device-resident buffer, pinning it for
+        the calling thread when ``retain``; evicts unreferenced entries
+        LRU past the cap."""
+        with self._lock:
+            self._entries.pop(key, None)
+            self._entries[key] = buf
+            if retain:
+                self._pin_locked(key)
+            self.uploads += 1
+            self._evict_locked()
+        obs.metrics.count("serve.residency_uploads")
+
+    def release_thread(self) -> None:
+        """Drop every pin held by the calling thread (the lane context's
+        unwind — one serving thread is one in-flight request) and evict
+        past the cap."""
+        ident = threading.get_ident()
+        with self._lock:
+            for key in self._pins.pop(ident, {}):
+                self._unref_locked(key, ident)
+            self._evict_locked()
+
+    def _evict_locked(self) -> None:
+        if self._cap <= 0:
+            return
+        for key in list(self._entries):
+            if len(self._entries) <= self._cap:
+                break
+            if self._refs.get(key):
+                continue  # pinned by an in-flight request
+            del self._entries[key]
+            self.evictions += 1
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "uploads": self.uploads,
+                "evictions": self.evictions,
+                "entries": len(self._entries),
+                "referenced": sum(1 for r in self._refs.values() if r),
+            }
+
+    def hit_rate(self) -> float:
+        with self._lock:
+            seen = self.hits + self.misses
+            return self.hits / seen if seen else 0.0

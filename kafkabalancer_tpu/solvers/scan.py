@@ -909,13 +909,41 @@ def packed_call(
     return args, statics
 
 
-# --- serve microbatching seam --------------------------------------------
+# position of the dynamic move budget (``np.int32(chunk)``) in
+# :func:`packed_call`'s args tuple — the ONE dynamic input that turns a
+# whole session instance into a no-op when zeroed (the while_loop's
+# ``n < budget`` condition fails at iteration 0). The serve batcher's
+# variable-K padding keys off it; keep in sync with the tuple above.
+PACKED_BUDGET_ARG = 11
+
+
+def pad_instance_args(args: Tuple) -> Tuple:
+    """A NO-OP padding instance for the variable-K batched dispatch:
+    the same program signature (every leaf's shape/dtype identical, so
+    it stacks into the same compiled executable) with the dynamic move
+    budget zeroed — the padded slot's session while_loop exits at
+    iteration 0 and its move log is discarded by the batcher. This is
+    what lets one compiled :func:`session_packed_batched` executable per
+    padding bucket serve ANY occupancy: live slots keep their own args
+    (bit-identical per-instance logs, as ever), dead slots replay this."""
+    padded = list(args)
+    padded[PACKED_BUDGET_ARG] = np.zeros_like(
+        np.asarray(args[PACKED_BUDGET_ARG])
+    )
+    return tuple(padded)
+
+
+# --- serve batching seam ---------------------------------------------------
 # A multi-lane daemon (serve/lanes.py) fuses K independent same-bucket
 # requests into ONE padded batched device dispatch. The fusion point is
-# here: each request's thread installs its MicrobatchGroup, and
-# _dispatch_chunk offers the group its (args, statics) before falling
-# through to the ordinary solo dispatch. Thread-local so the stateless
-# CLI and single-lane daemon never see it.
+# here: each request's thread installs its batcher (the continuous
+# batcher, or the legacy one-shot MicrobatchGroup), and _dispatch_chunk
+# offers the batcher its (args, statics) at EVERY chunk round — the
+# iteration-boundary offer continuous batching re-forms the batch at: a
+# request admitted mid-flight fuses its chunk 1 with its peers' chunk
+# i+1, and a converged member's departure shrinks the next round instead
+# of holding the batch to collective completion. Thread-local so the
+# stateless CLI and single-lane daemon never see it.
 _mb_tls = threading.local()
 
 
@@ -959,6 +987,12 @@ def session_packed_batched(
     instance traces the IDENTICAL ``session_packed`` subprogram, so per
     instance the packed log is bit-identical to a solo dispatch (pinned
     by the serve differential tests). Returns ``[K, L]`` packed logs.
+
+    VARIABLE-K: the serve batcher pads the instance axis up to a small
+    set of padding buckets (serve/lanes.py ``PAD_BUCKETS``) with no-op
+    instances (:func:`pad_instance_args` — budget zeroed, loop exits at
+    iteration 0), so one compiled executable per bucket serves any
+    occupancy instead of one per exact K; live slots are unaffected.
     """
     def one(xs: Tuple) -> Any:
         return session_packed(
@@ -1014,14 +1048,30 @@ def _dev_cached_asarray(cache, name: str, arr):
     then skips the transfer entirely. Digest-keyed rather than
     identity-keyed because the arrays ARE new objects each chunk; a
     changed array (replicas after commits) simply misses and replaces
-    its slot, so staleness is impossible by construction."""
+    its slot, so staleness is impossible by construction.
+
+    ``cache`` may also be a SHARED residency pool
+    (``serve.residency.ResidencyPool`` — anything with a ``lookup``
+    method): the key then drops the slot name and becomes pure content
+    (shape, dtype, digest), so identical arrays are shared ACROSS
+    sessions, requests and slots instead of within one session's slot —
+    the serve lanes' cross-request generalization of this cache."""
     if arr is None:
         return None
     if cache is None:
         return jnp.asarray(arr)
     a = np.asarray(arr)
-    key = (name, a.shape, a.dtype.str)
     digest = hashlib.md5(np.ascontiguousarray(a).tobytes()).digest()
+    if hasattr(cache, "lookup"):
+        pkey = (a.shape, a.dtype.str, digest)
+        pooled = cache.lookup(pkey)
+        if pooled is not None:
+            obs.metrics.count("solver.dev_cache_hits")
+            return pooled
+        dev = jnp.asarray(a)
+        cache.put(pkey, dev)
+        return dev
+    key = (name, a.shape, a.dtype.str)
     hit = cache.get(key)
     if hit is not None and hit[0] == digest:
         obs.metrics.count("solver.dev_cache_hits")
@@ -1040,9 +1090,21 @@ def _prep_from_dp(dp, dtype, all_allowed=None, ew=None, dev_cache=None):
     is just the broker-validity row broadcast (the default FillDefaults
     outcome). ``dev_cache`` (a per-session dict) reuses already-device-
     resident buffers across chunks instead of re-uploading identical
-    content every re-tensorize (see :func:`_dev_cached_asarray`).
+    content every re-tensorize (see :func:`_dev_cached_asarray`). When
+    no explicit cache is passed and the calling thread has a serve
+    residency pool installed (a lane's request thread,
+    ``ops.aot.set_staging_cache``), the pool stands in — the session's
+    arrays then share the lane's cross-request device residency. An
+    EXPLICIT dict keeps its session-private semantics (plan_sharded's
+    mesh-sharded arrays must not mix into a single-device pool).
     Returns ``(all_allowed, (loads, weights, ncons, allowed_dev,
     ew_dev))``."""
+    if dev_cache is None:
+        from kafkabalancer_tpu.ops import aot
+
+        pool = aot.staging_cache()
+        if hasattr(pool, "lookup"):
+            dev_cache = pool
     if all_allowed is None:
         all_allowed = all_allowed_of(dp)
     return all_allowed, _device_prep(

@@ -19,7 +19,16 @@
 #   7. serve smoke      — start the planning daemon, plan through it,
 #                         assert byte parity with the in-process path,
 #                         clean shutdown (docs/serving.md)
-#   8. tier-1 tests     — the ROADMAP.md verify suite (skip: --no-tests)
+#   8. fused-shard      — byte parity of the sharded session vs the
+#      parity smoke       single-device plan, on real multi-device
+#                         hosts or a faked 2-device CPU mesh (skips on
+#                         a single non-CPU device)
+#   9. continuous       — K concurrent clients against a daemon with a
+#      batching smoke     deterministic admission hold: per-client
+#                         served attribution + byte parity vs
+#                         -no-daemon, fused occupancy > 1 via the
+#                         -metrics-json counters (docs/serving.md)
+#  10. tier-1 tests     — the ROADMAP.md verify suite (skip: --no-tests)
 #
 # Exit 0 only when every stage that ran passed. Optional tools that are
 # not installed SKIP with a notice instead of failing: the gate must be
@@ -245,6 +254,139 @@ else
   fail=1
 fi
 rm -rf "$rps_tmp"
+
+step "fused-shard parity smoke (sharded session vs single-device plan)"
+# MULTICHIP confirms healthy multi-device hosts, but nothing pre-merge
+# ever exercised the sharded session: pin `-fused-shard` byte parity
+# against the single-device plan. Real multi-device hosts use their
+# ambient devices; a single-CPU host fakes a 2-device mesh the way the
+# test suite does (conftest.py); a single non-CPU device skips cleanly.
+shard_tmp=$(mktemp -d)
+shard_probe=$(timeout 120 "$PYTHON" -c "import jax
+d = jax.devices()
+print(len(d), d[0].platform)" 2>/dev/null || echo "0 unknown")
+shard_ndev=${shard_probe%% *}
+shard_plat=${shard_probe##* }
+shard_run=1
+if [ "${shard_ndev:-0}" -ge 2 ] 2>/dev/null; then
+  shard_env="JAX_COMPILATION_CACHE_DIR=$shard_tmp"
+  echo "using $shard_ndev ambient $shard_plat devices"
+elif [ "$shard_plat" = "cpu" ] || [ "$shard_plat" = "unknown" ]; then
+  shard_env="JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=2 JAX_COMPILATION_CACHE_DIR=$shard_tmp"
+  echo "1 visible device — faking a 2-device CPU mesh"
+else
+  echo "single $shard_plat device — skipped (needs >= 2 devices)"
+  shard_run=0
+fi
+if [ "$shard_run" = 1 ]; then
+  sharded_out=$(env $shard_env "$PYTHON" -m kafkabalancer_tpu \
+    -input-json -input tests/data/test.json -fused -fused-shard \
+    -fused-batch=4 -max-reassign=4 -no-daemon 2>/dev/null)
+  single_out=$(env $shard_env "$PYTHON" -m kafkabalancer_tpu \
+    -input-json -input tests/data/test.json -fused \
+    -fused-batch=4 -max-reassign=4 -no-daemon 2>/dev/null)
+  if [ -n "$sharded_out" ] && [ "$sharded_out" = "$single_out" ]; then
+    echo "fused-shard byte parity: OK"
+  else
+    echo "fused-shard parity FAILED"; fail=1
+  fi
+fi
+rm -rf "$shard_tmp"
+
+step "continuous batching smoke (3 held clients, occupancy + parity)"
+# The continuous batcher end to end: a daemon with a deterministic
+# admission hold (-serve-admission-hold=3 — the lane keeps its queue
+# intact until the full batch arrives, no scheduler-timing luck), three
+# concurrent clients with DISTINCT same-bucket inputs. Every client
+# must be served (served: true), byte-identical to its own -no-daemon
+# plan, and the metrics counters must show a fused dispatch of
+# occupancy > 1 (serve.microbatched >= 2) plus the residency gauge —
+# the stage that catches an admission wedge, a padding regression, or
+# lost batching attribution before merge (docs/serving.md).
+cb_tmp=$(mktemp -d)
+cb_sock="$cb_tmp/kb.sock"
+"$PYTHON" - "$cb_tmp" <<'PYEOF'
+import json, sys
+with open("tests/data/test.json") as f:
+    data = json.load(f)
+for i in (1, 2, 3):
+    variant = json.loads(json.dumps(data))
+    # distinct content, same shape bucket: reverse a different row each
+    p = variant["partitions"][i]
+    p["replicas"] = list(reversed(p["replicas"]))
+    with open(f"{sys.argv[1]}/variant{i}.json", "w") as f:
+        json.dump(variant, f)
+PYEOF
+JAX_PLATFORMS=cpu JAX_COMPILATION_CACHE_DIR="$cb_tmp" \
+  "$PYTHON" -m kafkabalancer_tpu -serve "-serve-socket=$cb_sock" \
+  -serve-admission-hold=3 -serve-idle-timeout=180 \
+  >"$cb_tmp/daemon.log" 2>&1 &
+cb_pid=$!
+cb_ready=0
+for _ in $(seq 1 60); do
+  if "$PYTHON" -c "import sys
+from kafkabalancer_tpu.serve.client import daemon_alive
+sys.exit(0 if daemon_alive('$cb_sock') else 1)" 2>/dev/null; then
+    cb_ready=1; break
+  fi
+  sleep 0.25
+done
+if [ "$cb_ready" = 1 ]; then
+  # warm-up: pays the solo compile and establishes the bucket's lane
+  # affinity (held up to the hold window, by design)
+  JAX_PLATFORMS=cpu "$PYTHON" -m kafkabalancer_tpu -input-json \
+    -input "$cb_tmp/variant1.json" -fused -fused-batch=4 -max-reassign=4 \
+    "-serve-socket=$cb_sock" >/dev/null 2>&1
+  cb_ok=1
+  for i in 1 2 3; do
+    JAX_PLATFORMS=cpu JAX_COMPILATION_CACHE_DIR="$cb_tmp" \
+      "$PYTHON" -m kafkabalancer_tpu -input-json \
+      -input "$cb_tmp/variant$i.json" -fused -fused-batch=4 \
+      -max-reassign=4 -no-daemon >"$cb_tmp/local$i.out" 2>/dev/null
+  done
+  for i in 1 2 3; do
+    JAX_PLATFORMS=cpu "$PYTHON" -m kafkabalancer_tpu -input-json \
+      -input "$cb_tmp/variant$i.json" -fused -fused-batch=4 \
+      -max-reassign=4 "-serve-socket=$cb_sock" \
+      "-metrics-json=$cb_tmp/m$i.json" >"$cb_tmp/served$i.out" 2>/dev/null &
+    eval "cbc$i=\$!"
+  done
+  wait "$cbc1" || cb_ok=0
+  wait "$cbc2" || cb_ok=0
+  wait "$cbc3" || cb_ok=0
+  for i in 1 2 3; do
+    if ! cmp -s "$cb_tmp/served$i.out" "$cb_tmp/local$i.out"; then
+      echo "client $i parity FAILED"; cb_ok=0
+    fi
+  done
+  if [ "$cb_ok" = 1 ] && "$PYTHON" -c "import json, sys
+fused = 0
+for i in (1, 2, 3):
+    m = json.load(open(f'$cb_tmp/m{i}.json'))
+    g = m.get('gauges', {})
+    assert g.get('served') is True, (i, 'not served')
+    assert 'serve.residency_hits' in g, (i, 'no residency gauge')
+    fused = max(fused, m.get('counters', {}).get('serve.microbatched', 0))
+assert fused >= 2, f'no fused dispatch of occupancy > 1 (counter {fused})'
+" 2>/dev/null; then
+    echo "3 held clients: served + parity + fused occupancy > 1: OK"
+  else
+    echo "continuous batching smoke FAILED (see $cb_tmp)"; fail=1
+  fi
+  "$PYTHON" -c "from kafkabalancer_tpu.serve.client import request_shutdown
+request_shutdown('$cb_sock')" || true
+  if wait "$cb_pid"; then
+    echo "daemon clean shutdown: OK"
+  else
+    echo "daemon exited nonzero"; fail=1
+  fi
+else
+  echo "daemon never became ready (see $cb_tmp/daemon.log)"
+  tail -20 "$cb_tmp/daemon.log" 2>/dev/null
+  kill "$cb_pid" 2>/dev/null
+  fail=1
+fi
+rm -rf "$cb_tmp"
 
 if [ "$run_tests" = 1 ]; then
   step "tier-1 tests"

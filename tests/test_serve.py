@@ -907,10 +907,12 @@ def test_microbatch_declines_non_xla_and_leader_dispatches():
 
 
 def test_served_microbatched_plans_byte_identical(sock_dir):
-    """End to end through a microbatching daemon: concurrent same-bucket
-    -fused requests fuse into batched dispatches and every response is
-    byte-identical to the in-process plan; a malformed request riding
-    alongside still error-exits identically."""
+    """End to end through a continuously-batching daemon: concurrent
+    same-bucket -fused requests form ONE full batch — deterministically,
+    via the injectable admission hold (the lane holds its pop until the
+    batch depth is queued; no scheduler-timing luck, no wave retries) —
+    and every response is byte-identical to the in-process plan; a
+    malformed request riding alongside still error-exits identically."""
     sock = os.path.join(sock_dir, "kb.sock")
     d = Daemon(
         sock, idle_timeout=60.0, warm=False, log=lambda _m: None,
@@ -933,54 +935,556 @@ def test_served_microbatched_plans_byte_identical(sock_dir):
                 "-fused-batch=4", "-max-reassign=4"]
         want_rv, want_out, _ = run_cli(args + ["-no-daemon"])
         bad_rv, bad_out, _ = run_cli(["-input-json", "-no-daemon"], "::x::")
-        # warm request: pays the compile so the concurrent wave below
-        # queues deep enough to fuse
+        # warm request: pays the compile (and establishes the bucket's
+        # lane affinity) before the held batch forms
         rv0, out0, _ = run_cli(args + [f"-serve-socket={sock}"])
         assert rv0 == want_rv == 0 and out0 == want_out
 
-        lock = threading.Lock()
+        # the deterministic admission latch (satellite of the continuous
+        # batcher): the affinity lane holds its pop until all 4
+        # same-bucket requests are queued, so the batch forms fully on
+        # the first (and only) wave
+        sched = d._coalescer
+        sched._hold_window_s = 30.0
+        sched._hold_n = 4
 
-        def good(results):
+        lock = threading.Lock()
+        results: list = []
+
+        def good():
             r = run_cli(args + [f"-serve-socket={sock}"])
             with lock:
                 results.append(("good", r))
 
-        def bad(results):
-            r = run_cli(
-                ["-input-json", f"-serve-socket={sock}"], "::x::"
-            )
+        def bad():
+            r = run_cli(["-input-json", f"-serve-socket={sock}"], "::x::")
             with lock:
                 results.append(("bad", r))
 
-        # parity is asserted on EVERY wave; whether a wave actually
-        # fuses depends on thread scheduling (the group only forms if
-        # requests are co-queued at pop time), so waves repeat until
-        # fusion is observed — the determinstic bit-parity pin for the
-        # fused path itself is test_microbatch_group_differential_*
-        fused_seen = False
-        for _wave in range(4):
-            results: list = []
-            threads = [
-                threading.Thread(target=good, args=(results,))
-                for _ in range(4)
-            ]
-            threads.append(threading.Thread(target=bad, args=(results,)))
-            for x in threads:
-                x.start()
-            for x in threads:
-                x.join(120)
-            assert len(results) == 5
-            for kind, (rv, out, _err) in results:
-                if kind == "good":
-                    assert rv == 0 and out == want_out
-                else:
-                    assert rv == bad_rv == 2 and out == bad_out
-            stats = d._coalescer.stats()
-            assert stats["lanes"] >= 1.0
-            if stats["microbatched"] >= 2.0:
-                fused_seen = True
-                break
-        assert fused_seen, d._coalescer.stats()
+        threads = [threading.Thread(target=good) for _ in range(4)]
+        threads.append(threading.Thread(target=bad))
+        for x in threads:
+            x.start()
+        for x in threads:
+            x.join(120)
+        assert len(results) == 5
+        for kind, (rv, out, _err) in results:
+            if kind == "good":
+                assert rv == 0 and out == want_out
+            else:
+                assert rv == bad_rv == 2 and out == bad_out
+        stats = sched.stats()
+        assert stats["lanes"] >= 1.0
+        # the held batch fused: members rode batched dispatches, and the
+        # occupancy histogram saw a multi-member round
+        assert stats["microbatched"] >= 2.0, stats
+        assert stats["occupancy_max"] >= 2.0, stats
+    finally:
+        sclient.request_shutdown(sock)
+        t.join(15)
+    assert rc_box == [0]
+
+
+# --- continuous batching: variable-K padding + admission lifecycle --------
+
+
+def _load_variant(i=None):
+    """The fixture, optionally with partition ``i``'s replicas swapped —
+    a DISTINCT instance in the same shape bucket (what concurrent
+    clusters look like to the batcher)."""
+    from kafkabalancer_tpu.codecs import get_partition_list_from_reader
+    from kafkabalancer_tpu.models import default_rebalance_config
+
+    with open(FIXTURE) as fh:
+        pl = get_partition_list_from_reader(fh, True, [])
+    if i is not None:
+        p = pl.partitions[i % len(pl.partitions)]
+        p.replicas[0], p.replicas[1] = p.replicas[1], p.replicas[0]
+    return pl, default_rebalance_config()
+
+
+def _emit_plan(opl):
+    from kafkabalancer_tpu.codecs import write_partition_list
+
+    out = io.StringIO()
+    write_partition_list(out, opl)
+    return out.getvalue()
+
+
+def test_continuous_batcher_bit_parity_every_occupancy():
+    """The variable-K pin: at EVERY occupancy 1..K, each member's plan
+    through the continuous batcher is byte-identical to its solo plan —
+    padded slots (occupancy 3 rides the K=4 executable) change nothing
+    for live slots, and occupancy 1 degrades to the solo dispatch."""
+    from kafkabalancer_tpu.serve.lanes import ContinuousBatcher
+    from kafkabalancer_tpu.solvers import scan
+
+    K = 4
+    solo = []
+    for v in range(K):
+        pl, cfg = _load_variant(v if v else None)
+        solo.append(_emit_plan(scan.plan(pl, cfg, 4, batch=4)))
+
+    for n in range(1, K + 1):
+        cb = ContinuousBatcher(K)
+        fused = [None] * n
+
+        def member(idx):
+            pl, cfg = _load_variant(idx if idx else None)
+            with cb.member():
+                fused[idx] = _emit_plan(scan.plan(pl, cfg, 4, batch=4))
+
+        threads = [
+            threading.Thread(target=member, args=(idx,)) for idx in range(n)
+        ]
+        for t in threads:
+            cb.admit()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+        assert fused == solo[:n], f"occupancy {n}"
+        if n == 1:
+            assert cb.fused_dispatches == 0  # singleton round runs solo
+        else:
+            assert cb.fused_dispatches >= 1, f"occupancy {n}"
+            assert cb.occupancy.get(n) == 1, (n, cb.occupancy)
+            # occupancy 3 pads into the K=4 bucket; 2 and 4 fit exactly
+            assert cb.padded_slots == (1 if n == 3 else 0), (
+                n, cb.padded_slots,
+            )
+
+
+def test_continuous_batcher_bucket_boundary_promotion():
+    """The padding-bucket transition: a 3-member wave rides the K=4
+    bucket (1 padded slot), a later 5-member wave on the SAME batcher
+    promotes to K=8 (3 padded slots) — every member still byte-identical
+    to solo across the boundary."""
+    from kafkabalancer_tpu.serve.lanes import ContinuousBatcher
+    from kafkabalancer_tpu.solvers import scan
+
+    solo = []
+    for v in range(5):
+        pl, cfg = _load_variant(v if v else None)
+        solo.append(_emit_plan(scan.plan(pl, cfg, 2, batch=2)))
+
+    cb = ContinuousBatcher(8)
+    fused = {}
+    lock = threading.Lock()
+
+    def member(idx):
+        pl, cfg = _load_variant(idx if idx else None)
+        with cb.member():
+            out = _emit_plan(scan.plan(pl, cfg, 2, batch=2))
+        with lock:
+            fused[idx] = out
+
+    def wave(indices):
+        threads = [
+            threading.Thread(target=member, args=(i,)) for i in indices
+        ]
+        for _ in threads:
+            cb.admit()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(120)
+
+    wave(range(3))  # occupancy 3 -> K=4
+    assert cb.occupancy.get(3) == 1, cb.occupancy
+    assert cb.padded_slots == 1
+    wave(range(5))  # occupancy 5 -> K=8, same batcher, slots re-formed
+    assert cb.occupancy.get(5) == 1, cb.occupancy
+    assert cb.padded_slots == 1 + 3
+    for idx in range(5):
+        assert fused[idx] == solo[idx], f"member {idx}"
+
+
+def test_continuous_batcher_mid_session_admission():
+    """Iteration-level admission: member B is admitted AFTER member A's
+    chunk-1 round (A runs a 2-chunk session), so B's chunk 1 fuses with
+    A's chunk 2 — and both move logs stay byte-identical to their solo
+    dispatches. This is the barrier-removal pin: under the one-shot
+    barrier B would have waited for A's whole session."""
+    from kafkabalancer_tpu.serve.lanes import ContinuousBatcher
+    from kafkabalancer_tpu.solvers import scan
+
+    # A: max_reassign=6 at chunk_moves=2 -> two dispatch rounds;
+    # B: max_reassign=2 -> one round, same statics/shape signature
+    pl, cfg = _load_variant(None)
+    solo_a = _emit_plan(scan.plan(pl, cfg, 6, batch=4, chunk_moves=2))
+    pl, cfg = _load_variant(1)
+    solo_b = _emit_plan(scan.plan(pl, cfg, 2, batch=4, chunk_moves=2))
+
+    class FirstOfferSignal(ContinuousBatcher):
+        def __init__(self, max_k):
+            super().__init__(max_k)
+            self.first_offer_done = threading.Event()
+
+        def dispatch(self, args, statics):
+            out = super().dispatch(args, statics)
+            self.first_offer_done.set()
+            return out
+
+    cb = FirstOfferSignal(4)
+    fused = [None, None]
+
+    def run_a():
+        pl, cfg = _load_variant(None)
+        with cb.member():
+            fused[0] = _emit_plan(
+                scan.plan(pl, cfg, 6, batch=4, chunk_moves=2)
+            )
+
+    def run_b():
+        pl, cfg = _load_variant(1)
+        with cb.member():
+            fused[1] = _emit_plan(
+                scan.plan(pl, cfg, 2, batch=4, chunk_moves=2)
+            )
+
+    ta = threading.Thread(target=run_a)
+    cb.admit()
+    ta.start()
+    # A's chunk-1 offer fires as a singleton round (solo); only THEN is
+    # B admitted — a true mid-session arrival
+    assert cb.first_offer_done.wait(60), "A never offered chunk 1"
+    tb = threading.Thread(target=run_b)
+    cb.admit()
+    tb.start()
+    ta.join(120)
+    tb.join(120)
+    assert fused[0] == solo_a
+    assert fused[1] == solo_b
+    # the mid-flight admission really fused: one 2-member round
+    assert cb.fused_dispatches >= 1
+    assert cb.occupancy.get(2, 0) >= 1, cb.occupancy
+
+
+def test_lane_scheduler_admission_hold_forms_full_batch():
+    """The deterministic admission latch: with -serve-admission-hold=2
+    semantics installed, a lone admissible request is NOT dispatched
+    until a second one queues (or the hold window expires) — the seam
+    the e2e batching test and the gate smoke key off."""
+    from kafkabalancer_tpu.serve.lanes import Lane, LaneScheduler
+
+    handled = []
+    lock = threading.Lock()
+
+    def handle(req, coalesced, lane, mb):
+        with lock:
+            handled.append((req.argv[0], mb is not None))
+        req.response = {"ok": True}
+
+    B = (8, 2, 4, True)
+    sched = LaneScheduler(
+        handle, lambda r: B, [Lane(0)], microbatch=4,
+        admissible=lambda r: True, admission_hold=2,
+    )
+    sched._hold_window_s = 20.0
+    try:
+        results = []
+
+        def submit(name):
+            results.append(sched.submit(_mk_req(name, B)))
+
+        t1 = threading.Thread(target=submit, args=("r1",))
+        t1.start()
+        time.sleep(0.4)
+        # held: the lone request must still be queued, not dispatched
+        assert handled == [], handled
+        t2 = threading.Thread(target=submit, args=("r2",))
+        t2.start()
+        t1.join(20)
+        t2.join(20)
+        assert len(results) == 2 and all(r["ok"] for r in results)
+        # both members went through the batcher together
+        assert {n for n, _ in handled} == {"r1", "r2"}
+        assert all(got_mb for _n, got_mb in handled), handled
+    finally:
+        sched.stop()
+
+
+def test_admission_hold_counts_only_batchable_requests():
+    """A non-batchable request interleaving must not release the latch
+    as a phantom batch member: with hold=2 and [fused, greedy] queued,
+    the lane stays held until a SECOND batchable request arrives."""
+    from kafkabalancer_tpu.serve.lanes import Lane, LaneScheduler
+
+    handled = []
+    lock = threading.Lock()
+
+    def handle(req, coalesced, lane, mb):
+        with lock:
+            handled.append(req.argv[0])
+        req.response = {"ok": True}
+
+    B = (8, 2, 4, True)
+    sched = LaneScheduler(
+        handle, lambda r: B, [Lane(0)], microbatch=4,
+        admissible=lambda r: not r.argv[0].startswith("greedy"),
+        admission_hold=2,
+    )
+    sched._hold_window_s = 20.0
+    try:
+        results = []
+
+        def submit(name):
+            results.append(sched.submit(_mk_req(name, B)))
+
+        threads = [threading.Thread(target=submit, args=("fused-1",))]
+        threads[0].start()
+        time.sleep(0.15)
+        threads.append(threading.Thread(target=submit, args=("greedy-x",)))
+        threads[1].start()
+        time.sleep(0.4)
+        # [fused-1, greedy-x] queued: batchable count is 1 < 2 — held
+        assert handled == [], handled
+        threads.append(threading.Thread(target=submit, args=("fused-2",)))
+        threads[2].start()
+        for t in threads:
+            t.join(25)
+        assert len(results) == 3 and all(r["ok"] for r in results)
+        assert set(handled) == {"fused-1", "greedy-x", "fused-2"}
+    finally:
+        sched.stop()
+
+
+def test_continuous_pull_is_queue_head_prefix_only():
+    """FIFO fairness of mid-flight admission: the feed stops at the
+    first non-batchable/different-bucket request — a newer same-bucket
+    arrival queued BEHIND it is not leapfrogged into the running
+    batch."""
+    from kafkabalancer_tpu.serve.daemon import PlanRequest
+    from kafkabalancer_tpu.serve.lanes import Lane, LaneScheduler
+
+    B = (8, 2, 4, True)
+    sched = LaneScheduler(
+        lambda req, c, ln, mb: None, lambda r: r.bucket, [Lane(0)],
+        microbatch=4,
+        admissible=lambda r: not r.argv[0].startswith("greedy"),
+    )
+    try:
+        lane = sched.lanes[0]
+        a = _mk_req("fused-a", B)
+        g = _mk_req("greedy-x", B)
+        b = _mk_req("fused-b", B)
+        # stop the worker from draining while we inspect the pull
+        with sched._cv:
+            sched._stop = True
+        sched._queues[0].extend([a, g, b])
+        pulled = sched._pull_admissible(lane, B)
+        assert pulled == [], pulled  # _stop gates the feed entirely
+        sched._stop = False
+        pulled = sched._pull_admissible(lane, B)
+        # prefix only: fused-a comes out, greedy-x blocks fused-b
+        assert [r.argv[0] for r in pulled] == ["fused-a"]
+        assert [r.argv[0] for r in sched._queues[0]] == [
+            "greedy-x", "fused-b",
+        ]
+        with sched._cv:
+            sched._active[0] -= len(pulled)  # undo the claim accounting
+            sched._queues[0].clear()
+    finally:
+        sched.stop()
+
+
+def test_residency_pool_thread_pin_cap_releases_oldest():
+    """A long session's per-round transients must not pin unbounded
+    device memory: past THREAD_PIN_CAP pins, the oldest release (stay
+    pooled, evictable) while the freshest stay pinned."""
+    from kafkabalancer_tpu.serve.residency import (
+        THREAD_PIN_CAP,
+        ResidencyPool,
+    )
+
+    pool = ResidencyPool(cap=1000)
+    for i in range(THREAD_PIN_CAP + 8):
+        pool.put(("k", i), object())
+    stats = pool.stats()
+    assert stats["entries"] == THREAD_PIN_CAP + 8
+    assert stats["referenced"] == THREAD_PIN_CAP  # oldest 8 released
+    # the released (unpinned) prefix is evictable; the pinned tail is not
+    pool._cap = 4
+    pool._evict_locked()
+    assert pool.stats()["entries"] == THREAD_PIN_CAP
+    assert ("k", 0) not in pool
+    assert ("k", THREAD_PIN_CAP + 7) in pool
+    pool.release_thread()
+    pool._evict_locked()
+    assert pool.stats()["entries"] == 4
+
+
+def test_admission_hold_skips_non_admissible_head():
+    """A request the admission predictor rejects (greedy solver,
+    malformed input) never waits behind the latch."""
+    from kafkabalancer_tpu.serve.lanes import Lane, LaneScheduler
+
+    handled = threading.Event()
+
+    def handle(req, coalesced, lane, mb):
+        handled.set()
+        req.response = {"ok": True}
+
+    sched = LaneScheduler(
+        handle, lambda r: None, [Lane(0)], microbatch=4,
+        admissible=lambda r: False, admission_hold=4,
+    )
+    sched._hold_window_s = 20.0
+    try:
+        t0 = time.monotonic()
+        resp = sched.submit(_mk_req("plain", None))
+        assert resp["ok"] and handled.is_set()
+        assert time.monotonic() - t0 < 5.0  # no hold-window wait
+    finally:
+        sched.stop()
+
+
+# --- the shared residency pool (serve/residency.py) ------------------------
+
+
+def test_residency_pool_shares_across_requests_and_refcounts():
+    import numpy as np
+
+    from kafkabalancer_tpu.ops import aot
+    from kafkabalancer_tpu.serve.residency import ResidencyPool
+
+    pool = ResidencyPool(cap=8)
+    a = np.arange(32.0)
+    b = np.arange(8.0)
+    aot.set_staging_cache(pool)
+    try:
+        staged1 = aot._stage_args((a, None, b))
+        assert staged1 is not None and staged1[1] is None
+        assert pool.stats()["uploads"] == 2
+        # a SECOND request over identical content: hits, same buffers,
+        # no new uploads — the cross-request sharing the pool exists for
+        staged2 = aot._stage_args((np.arange(32.0), None, np.arange(8.0)))
+        assert staged2[0] is staged1[0]
+        assert staged2[2] is staged1[2]
+        assert pool.stats()["uploads"] == 2
+        assert pool.stats()["hits"] == 2
+    finally:
+        aot.set_staging_cache(None)
+    # this thread pinned the entries; a full cache may not evict them
+    pool._cap = 1
+    pool.put(("other",), object(), retain=False)
+    pool._evict_locked()
+    assert ("other",) not in pool  # the unpinned entry went first
+    assert pool.stats()["entries"] == 2  # pinned survivors
+    pool.release_thread()
+    assert pool.stats()["entries"] == 1  # now evictable past the cap
+
+
+def test_stage_host_arrays_publishes_into_pool_unpinned():
+    import numpy as np
+
+    from kafkabalancer_tpu.ops import aot
+    from kafkabalancer_tpu.serve.residency import ResidencyPool
+
+    pool = ResidencyPool()
+    a = np.arange(16.0)
+    assert aot.stage_host_arrays(pool, (a, None)) == 1
+    assert len(pool) == 1
+    assert pool.stats()["referenced"] == 0  # stage thread holds no pin
+    # re-staging identical content is a no-op
+    assert aot.stage_host_arrays(pool, (a,)) == 0
+
+
+def test_dev_cached_asarray_pool_is_content_keyed():
+    """The pool generalization of the per-session device cache: keys are
+    pure content, so identical arrays share one upload ACROSS slot names
+    (and thus across sessions/requests), unlike the dict cache."""
+    import numpy as np
+
+    from kafkabalancer_tpu.serve.residency import ResidencyPool
+    from kafkabalancer_tpu.solvers.scan import _dev_cached_asarray
+
+    pool = ResidencyPool()
+    a = np.arange(16.0)
+    dev1 = _dev_cached_asarray(pool, "weights", a)
+    dev2 = _dev_cached_asarray(pool, "ew", np.arange(16.0))
+    assert dev2 is dev1  # same content, different slot: one upload
+    assert pool.stats()["uploads"] == 1 and pool.stats()["hits"] == 1
+    dev3 = _dev_cached_asarray(pool, "weights", np.arange(16.0) * 3)
+    assert dev3 is not dev1
+    np.testing.assert_array_equal(np.asarray(dev3), np.arange(16.0) * 3)
+
+
+def test_served_requests_report_residency_gauge(sock_dir):
+    """The acceptance gauge: a served request through a lane daemon
+    carries serve.residency_hits in its -metrics-json line."""
+    sock = os.path.join(sock_dir, "kb.sock")
+    d = Daemon(
+        sock, idle_timeout=60.0, warm=False, log=lambda _m: None,
+        lanes=0, microbatch=4,
+    )
+    rc_box = []
+    t = threading.Thread(
+        target=lambda: rc_box.append(d.serve_forever()), daemon=True
+    )
+    t.start()
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if sclient.daemon_alive(sock) is not None:
+            break
+        time.sleep(0.02)
+    else:
+        pytest.fail("daemon never became ready")
+    try:
+        mpath = os.path.join(sock_dir, "res.metrics.json")
+        rv, _out, _err = run_cli(
+            ["-input-json", f"-input={FIXTURE}", "-fused",
+             "-max-reassign=2", f"-serve-socket={sock}",
+             f"-metrics-json={mpath}"]
+        )
+        assert rv == 0
+        with open(mpath) as f:
+            g = json.load(f)["gauges"]
+        assert g["served"] is True
+        assert "serve.residency_hits" in g
+        assert "serve.mb_padded_slots" in g
+        # hello carries the pool and occupancy attribution for operators
+        hello = sclient.daemon_alive(sock)
+        assert "residency" in hello and "hits" in hello["residency"]
+        assert "mb_occupancy" in hello
+        assert hello["batch_mode"] == "continuous"
+    finally:
+        sclient.request_shutdown(sock)
+        t.join(15)
+    assert rc_box == [0]
+
+
+def test_oneshot_batch_mode_keeps_fixed_membership_barrier(sock_dir):
+    """-serve-batch-mode=oneshot: the control daemon still serves and
+    fuses through the fixed-membership MicrobatchGroup (the measured
+    baseline bench.py compares continuous batching against)."""
+    sock = os.path.join(sock_dir, "kb.sock")
+    d = Daemon(
+        sock, idle_timeout=60.0, warm=False, log=lambda _m: None,
+        lanes=0, microbatch=4, batch_mode="oneshot",
+    )
+    rc_box = []
+    t = threading.Thread(
+        target=lambda: rc_box.append(d.serve_forever()), daemon=True
+    )
+    t.start()
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if sclient.daemon_alive(sock) is not None:
+            break
+        time.sleep(0.02)
+    else:
+        pytest.fail("daemon never became ready")
+    try:
+        args = ["-input-json", f"-input={FIXTURE}", "-fused",
+                "-fused-batch=4", "-max-reassign=4"]
+        want_rv, want_out, _ = run_cli(args + ["-no-daemon"])
+        rv0, out0, _ = run_cli(args + [f"-serve-socket={sock}"])
+        assert rv0 == want_rv == 0 and out0 == want_out
+        assert d._coalescer._batch_mode == "oneshot"
+        hello = sclient.daemon_alive(sock)
+        assert hello["batch_mode"] == "oneshot"
     finally:
         sclient.request_shutdown(sock)
         t.join(15)
@@ -1158,7 +1662,7 @@ def test_stage_request_primes_lane_caches(sock_dir):
     d._stage_request(
         PlanRequest(["-no-daemon=true", "-input-json=true"], src), lane2
     )
-    assert lane2.stage_cache == {}
+    assert len(lane2.stage_cache) == 0
 
 
 # --- the device-upload cache (scan._dev_cached_asarray) -------------------
