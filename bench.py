@@ -470,6 +470,54 @@ def _stop_probe_daemon(sock: str, proc) -> None:
         proc.kill()
 
 
+def _scrape_phase_breakdown(sock: str, tag: str) -> dict:
+    """The live daemon telemetry scrape (serve protocol ``stats`` op):
+    per-phase latency histogram summaries (count + p50/p95/p99),
+    request-count reconciliation, and the per-lane queue-depth /
+    batcher-occupancy series — the attribution block the acceptance
+    criteria pin in the bench artifact."""
+    from kafkabalancer_tpu.serve import client as serve_client
+
+    out: dict = {}
+    doc = serve_client.fetch_stats(sock)
+    if doc is None:
+        log(f"{tag}: stats scrape unavailable")
+        return out
+
+    def summarize(h: dict) -> dict:
+        return {
+            "count": h.get("count", 0),
+            "p50_s": h.get("p50", 0.0),
+            "p95_s": h.get("p95", 0.0),
+            "p99_s": h.get("p99", 0.0),
+        }
+
+    phases = {}
+    series = {}
+    for name, h in sorted(doc.get("hists", {}).items()):
+        if name.startswith("serve.phase.") or name == "serve.request_s":
+            phases[name] = summarize(h)
+        elif name.endswith("queue_depth") or name == "serve.cb_occupancy":
+            series[name] = {
+                "samples": h.get("count", 0),
+                "p50": h.get("p50", 0.0),
+                "p95": h.get("p95", 0.0),
+                "max": h.get("max", 0.0),
+            }
+    if phases:
+        out["served_phase_breakdown"] = phases
+        out["served_stats_requests"] = doc.get("requests")
+        total = phases.get("serve.request_s", {}).get("count")
+        if total is not None and total != doc.get("requests"):
+            log(
+                f"{tag}: request histogram count {total} != "
+                f"served requests {doc.get('requests')}"
+            )
+    if series:
+        out["served_queue_series"] = series
+    return out
+
+
 def _run_served_probe(n_parts: int, n_brokers: int) -> dict:
     """``served_single_move_s``: the single-move CLI invocation against a
     WARM planning daemon (serve/daemon.py) — the steady-state latency of
@@ -564,6 +612,11 @@ def _run_served_probe(n_parts: int, n_brokers: int) -> dict:
             f"{samples}): {vals[0]:.3f}s end-to-end "
             f"(served attribution {attribution})"
         )
+        # per-phase attribution from the daemon's LIVE stats scrape —
+        # the daemon-side histogram view (client read -> parse ->
+        # settle -> tensorize -> dispatch -> encode -> reply) replaces
+        # client-side wall clocks as the attribution source
+        out.update(_scrape_phase_breakdown(sock, "served probe"))
     finally:
         _stop_probe_daemon(sock, daemon)
         import shutil
@@ -809,6 +862,9 @@ def _run_throughput_probe(n_parts: int, n_brokers: int) -> dict:
             if warm_rc != 0:
                 return out
             multi = run_levels(sock, "auto")
+            # live scrape BEFORE shutdown: the occupancy/queue-depth
+            # series and phase histograms of the whole level ladder
+            scrape = _scrape_phase_breakdown(sock, "throughput probe")
         finally:
             _stop_probe_daemon(sock, daemon)
         if not multi["rps"]:
@@ -826,6 +882,10 @@ def _run_throughput_probe(n_parts: int, n_brokers: int) -> dict:
         out["served_mb_occupancy"] = multi.get("occupancy", {})
         out["served_mb_padded_waste"] = multi.get("padded_waste", {})
         out["served_residency_hits"] = multi.get("residency_hits", {})
+        for k, v in scrape.items():
+            # the throughput ladder's phase/series block; the
+            # single-move probe's breakdown keeps its own keys
+            out[f"throughput_{k}"] = v
 
         # the SAME-RUN one-shot-barrier control: the identical level
         # ladder against a -serve-batch-mode=oneshot daemon (the PR-5
@@ -1124,6 +1184,11 @@ def main() -> None:
                     "served_throughput_vs_oneshot",
                     "served_throughput_single_lane_rps",
                     "served_throughput_vs_single_lane",
+                    "served_phase_breakdown", "served_stats_requests",
+                    "served_queue_series",
+                    "throughput_served_phase_breakdown",
+                    "throughput_served_stats_requests",
+                    "throughput_served_queue_series",
                 ) if k in cold},
                 # before/after vs the pinned round-5 cold breakdown —
                 # only at the default scale, where the r05 pin was taken

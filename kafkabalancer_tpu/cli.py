@@ -32,7 +32,7 @@ import io
 import os
 import sys
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from kafkabalancer_tpu import obs
 from kafkabalancer_tpu.balancer import BalanceError, balance
@@ -105,15 +105,23 @@ class _TelemetryFlags:
     exporter can overlay them at export time: in the multi-lane daemon's
     shared-registry mode a CONCURRENT request's gauge writes would
     otherwise clobber this request's (e.g. its ``serve.lane``) between
-    stamping and export."""
+    stamping and export.
 
-    __slots__ = ("stats", "metrics_path", "trace_path", "attrs")
+    ``refresh`` (when the caller provides one) is re-evaluated AT EXPORT
+    TIME and its result overlays ``attrs``: the daemon uses it to
+    re-snapshot the scheduler's fusion/residency gauges after the
+    request's own fused dispatch has committed — start-of-request
+    snapshots could never show a request its own fusion (the PR-6
+    gap)."""
+
+    __slots__ = ("stats", "metrics_path", "trace_path", "attrs", "refresh")
 
     def __init__(self) -> None:
         self.stats = False
         self.metrics_path = ""
         self.trace_path = ""
         self.attrs: Dict[str, Any] = {}
+        self.refresh: "Optional[Callable[[], Dict[str, Any]]]" = None
 
     def any(self) -> bool:
         return bool(self.stats or self.metrics_path or self.trace_path)
@@ -128,6 +136,12 @@ def _export_telemetry(
         return
     from kafkabalancer_tpu.obs import export as obs_export
 
+    if tel.refresh is not None:
+        # export-time gauge re-snapshot (see _TelemetryFlags.refresh)
+        try:
+            tel.attrs = {**tel.attrs, **tel.refresh()}
+        except Exception as exc:
+            logger.printf(f"failed refreshing attribution gauges: {exc}")
     if tel.stats:
         try:
             be.write(
@@ -188,7 +202,8 @@ def _track_warm_thread(t: Any) -> None:
 _NO_FORWARD_FLAGS = frozenset((
     "serve", "serve-socket", "serve-idle-timeout", "serve-prewarm",
     "serve-lanes", "serve-microbatch", "serve-batch-mode",
-    "serve-admission-hold",
+    "serve-admission-hold", "serve-slow-ms",
+    "serve-stats", "serve-stats-json", "serve-dump-trace", "metrics-prom",
     "no-daemon", "help", "pprof", "pprof-path", "jax-profile", "input",
 ))
 # flags whose value names a filesystem path the DAEMON will write — made
@@ -222,8 +237,82 @@ def _forward_argv(f: FlagSet) -> List[str]:
     return argv
 
 
+def _write_text(o, path: str, text: str) -> bool:
+    """Scrape output to ``path`` (``-`` = stdout); False on a write
+    failure (the caller logs and error-exits)."""
+    if path == "-":
+        o.write(text)
+        return True
+    try:
+        with open(path, "w") as f:
+            f.write(text)
+    except OSError:
+        return False
+    return True
+
+
+def _run_scrape(
+    o, log, socket_flag: str,
+    stats: bool, stats_json: bool, dump_path: str, prom_path: str,
+) -> int:
+    """The jax-free live-daemon scrape verbs: ``-serve-stats`` /
+    ``-serve-stats-json`` (pretty / one-line JSON of the daemon's
+    ``stats`` document), ``-metrics-prom`` (Prometheus text exposition
+    of the same scrape), and ``-serve-dump-trace`` (the flight
+    recorder's Perfetto export). All of them are pure protocol clients
+    (serve/client.py) — an operator can scrape a hot daemon mid-traffic
+    without pausing planning, and the no-jax client pin extends to
+    every verb (tests/test_serve.py). Exit 3 when no live,
+    version-compatible daemon answers; exit 4 when the daemon answered
+    but the LOCAL output path is unwritable (the exit-code contract's
+    output-write-failure code — a monitoring wrapper must not
+    misdiagnose a full disk as a dead daemon)."""
+    import json as json_mod
+
+    from kafkabalancer_tpu.obs import export as obs_export
+    from kafkabalancer_tpu.serve import client as serve_client
+    from kafkabalancer_tpu.serve.protocol import resolve_socket_path
+
+    sock = resolve_socket_path(socket_flag)
+    if stats or stats_json or prom_path:
+        doc = serve_client.fetch_stats(sock)
+        if doc is None:
+            log(f"no live daemon on {sock}")
+            return 3
+        if stats_json:
+            o.write(
+                json_mod.dumps(
+                    doc, sort_keys=True, separators=(",", ":"),
+                    default=str,
+                )
+                + "\n"
+            )
+        if stats:
+            o.write(obs_export.render_serve_stats(doc))
+        if prom_path:
+            if not _write_text(
+                o, prom_path, obs_export.render_prometheus(doc)
+            ):
+                log(f"failed writing Prometheus exposition to {prom_path}")
+                return 4
+    if dump_path:
+        resp = serve_client.fetch_trace(sock)
+        if resp is None or not isinstance(resp.get("trace"), dict):
+            log(f"no live daemon on {sock}")
+            return 3
+        text = json_mod.dumps(resp["trace"], default=str)
+        if not _write_text(o, dump_path, text + "\n"):
+            log(f"failed writing flight trace to {dump_path}")
+            return 4
+        if dump_path != "-":
+            log(f"flight trace written to {dump_path}")
+    return 0
+
+
 def run(
-    i, o, e, args: List[str], *, attrs: "Optional[Dict[str, Any]]" = None
+    i, o, e, args: List[str], *,
+    attrs: "Optional[Dict[str, Any]]" = None,
+    refresh_attrs: "Optional[Callable[[], Dict[str, Any]]]" = None,
 ) -> int:
     """Testable CLI body; reference ``run`` (kafkabalancer.go:72-242).
     Wraps :func:`_run_impl` with the telemetry lifecycle: fresh
@@ -232,7 +321,9 @@ def run(
     ``attrs`` seeds the fresh metrics registry with invocation-scoped
     gauges — the planning daemon (serve/daemon.py) stamps its
     ``served: true`` / ``serve.*`` attribution through this seam so a
-    served request's ``-metrics-json`` line is attributable."""
+    served request's ``-metrics-json`` line is attributable.
+    ``refresh_attrs`` re-snapshots the volatile subset at EXPORT time
+    (see _TelemetryFlags)."""
     be = BufferingWriter(e)
     logger = Logger(be)
     tel = _TelemetryFlags()
@@ -241,6 +332,7 @@ def run(
         tel.attrs = dict(attrs)
         for k, v in attrs.items():
             obs.metrics.gauge(k, v)
+    tel.refresh = refresh_attrs
     rc = -1  # sentinel: an uncaught exception exports rc=-1
     try:
         rc = _run_impl(i, o, be, logger, tel, args)
@@ -476,6 +568,40 @@ def _run_impl(
             "— deterministic batch forming for tests and benchmarks "
             "(0 disables)",
         )
+        f_serve_slow_ms = f.float(
+            "serve-slow-ms",
+            0.0,
+            "Daemon: auto-dump the flight recorder (Perfetto trace + "
+            "request log) when a served request exceeds this many "
+            "milliseconds (0 disables)",
+        )
+        f_serve_stats = f.bool(
+            "serve-stats",
+            False,
+            "Scrape a live daemon's telemetry (per-phase latency "
+            "histograms, queue depth, occupancy) and print a human "
+            "summary — never pauses planning (docs/observability.md)",
+        )
+        f_serve_stats_json = f.bool(
+            "serve-stats-json",
+            False,
+            "Scrape a live daemon's telemetry as one line of "
+            "schema-versioned JSON (kafkabalancer-tpu.serve-stats/1)",
+        )
+        f_serve_dump_trace = f.string(
+            "serve-dump-trace",
+            "",
+            "Export a live daemon's flight recorder (recent spans + "
+            "request log) as Perfetto-loadable JSON to this path "
+            "('-' = stdout)",
+        )
+        f_metrics_prom = f.string(
+            "metrics-prom",
+            "",
+            "Scrape a live daemon and write Prometheus text exposition "
+            "(counters, gauges, histogram summaries) to this path "
+            "('-' = stdout)",
+        )
         f_no_daemon = f.bool(
             "no-daemon",
             False,
@@ -514,6 +640,38 @@ def _run_impl(
         if f_help.value:
             usage()
             return 0
+
+        if (
+            f_serve_stats.value
+            or f_serve_stats_json.value
+            or f_serve_dump_trace.value != ""
+            or f_metrics_prom.value != ""
+        ):
+            # live-daemon scrape verbs: pure jax-free protocol clients,
+            # handled before any input/planning machinery. Combining
+            # them with -serve or an input source is a contradiction —
+            # refuse it loudly instead of silently scraping and
+            # discarding the rest of the invocation
+            if f_serve.value:
+                log(
+                    "the scrape verbs (-serve-stats[-json], "
+                    "-serve-dump-trace, -metrics-prom) query a live "
+                    "daemon; they cannot be combined with -serve"
+                )
+                usage()
+                return 3
+            if f_input.value != "" or f_zk.value != "":
+                log(
+                    "the scrape verbs take no input: they query a live "
+                    "daemon, they do not plan"
+                )
+                usage()
+                return 3
+            return _run_scrape(
+                o, log, f_serve_socket.value,
+                f_serve_stats.value, f_serve_stats_json.value,
+                f_serve_dump_trace.value, f_metrics_prom.value,
+            )
 
         with obs.span("validate_flags"):
             brokers: Optional[List[int]] = None
@@ -614,6 +772,7 @@ def _run_impl(
                 microbatch=f_serve_microbatch.value,
                 batch_mode=f_serve_batch_mode.value,
                 admission_hold=f_serve_admission_hold.value,
+                slow_ms=f_serve_slow_ms.value,
             ).serve_forever()
 
         if not f_no_daemon.value and not (f_pprof.value or f_jaxprof.value):

@@ -11,8 +11,16 @@ This package makes an invocation observable end to end:
   that absorbed ``ops.aot.stats``, the coldstart prefetch markers, the
   pallas gate verdicts and the solver/session counters;
 - obs/export.py — the ``-stats`` human summary, the schema-versioned
-  single-line metrics JSON, and the Chrome trace-event / Perfetto
-  timeline.
+  single-line metrics JSON, the Chrome trace-event / Perfetto timeline,
+  and the Prometheus text exposition of a live ``stats`` scrape;
+- ``obs.hist`` (obs/hist.py) — streaming log-bucketed histograms with
+  lifetime + windowed views and p50/p95/p99 extraction, registered in
+  the metrics registry (``obs.metrics.hist_observe``) — the
+  daemon-lifetime distribution store behind the ``stats`` scrape op;
+- ``obs.flight`` (obs/flight.py) — the always-on bounded flight
+  recorder (completed-span ring + per-request summaries) fed through
+  the tracer's observer hook; dumps Perfetto traces on slow requests,
+  daemon-side crashes, or an operator's ``-serve-dump-trace``.
 
 HARD CONSTRAINT: nothing under this package imports jax (directly or
 transitively beyond the package ``__init__``'s model/codec layer) — the
@@ -25,6 +33,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Optional
 
+from kafkabalancer_tpu.obs import flight, hist  # noqa: F401
 from kafkabalancer_tpu.obs.metrics import (  # noqa: F401
     REGISTRY,
     SCHEMA,

@@ -1,4 +1,7 @@
-"""Telemetry exporters: ``-stats``, ``-metrics-json``, ``-trace``.
+"""Telemetry exporters: ``-stats``, ``-metrics-json``, ``-trace``,
+plus the renderers behind the live-daemon scrape verbs
+(``-serve-stats`` pretty text and ``-metrics-prom`` Prometheus text
+exposition over a ``stats`` scrape document).
 
 Three renderings of one invocation's tracer + registry state:
 
@@ -25,6 +28,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 from typing import Any, Dict, List, Optional, Set, TextIO
 
 from kafkabalancer_tpu.obs.metrics import SCHEMA, MetricsRegistry
@@ -172,4 +176,120 @@ def render_stats(
                 f"{k}={v}" for k, v in ev.items() if k not in ("kind", "t")
             )
             lines.append(f"    {ev['kind']}: {detail}")
+    return "\n".join(lines) + "\n"
+
+
+# --- live-daemon scrape renderers ----------------------------------------
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_]")
+_PROM_PREFIX = "kafkabalancer_tpu_"
+
+# scrape-document scalars worth exposing, with their Prometheus type
+_PROM_SCALARS = (
+    ("uptime_s", "gauge"),
+    ("requests", "counter"),
+    ("coalesced", "counter"),
+    ("requests_inflight", "gauge"),
+    ("slow_requests", "counter"),
+    ("crashed_requests", "counter"),
+    ("lanes", "gauge"),
+    ("steals", "counter"),
+    ("microbatched", "counter"),
+    ("mb_padded_slots", "counter"),
+)
+
+
+def _prom_name(name: str) -> str:
+    return _PROM_PREFIX + _PROM_BAD.sub("_", name)
+
+
+def _prom_value(v: float) -> str:
+    """Exact exposition: integers stay integers (a %g-rounded counter
+    reads as frozen between scrapes once it passes 6 digits and breaks
+    rate()); non-integral floats use repr (full precision)."""
+    f = float(v)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+def render_prometheus(doc: Dict[str, Any]) -> str:
+    """A ``stats`` scrape document as Prometheus text exposition:
+    daemon scalars as counters/gauges, each streaming histogram as a
+    summary (quantiles from the log-bucketed percentile extraction,
+    plus ``_sum``/``_count``). Metric names are the scrape keys with
+    non-word characters folded to ``_`` under the
+    ``kafkabalancer_tpu_`` prefix (docs/observability.md)."""
+    lines: List[str] = []
+    for key, typ in _PROM_SCALARS:
+        v = doc.get(key)
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            continue
+        m = _prom_name(key)
+        lines.append(f"# TYPE {m} {typ}")
+        lines.append(f"{m} {_prom_value(v)}")
+    cache = doc.get("cache")
+    if isinstance(cache, dict):
+        for key in ("hits", "misses", "rows_reused"):
+            if isinstance(cache.get(key), (int, float)):
+                m = _prom_name(f"tensorize_cache_{key}")
+                lines.append(f"# TYPE {m} counter")
+                lines.append(f"{m} {_prom_value(cache[key])}")
+    for name, h in sorted(doc.get("hists", {}).items()):
+        if not isinstance(h, dict):
+            continue
+        m = _prom_name(name)
+        lines.append(f"# TYPE {m} summary")
+        for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+            lines.append(
+                f'{m}{{quantile="{q}"}} {_prom_value(h.get(key, 0))}'
+            )
+        lines.append(f"{m}_sum {_prom_value(h.get('sum', 0))}")
+        lines.append(f"{m}_count {int(h.get('count', 0))}")
+    return "\n".join(lines) + "\n"
+
+
+def render_serve_stats(doc: Dict[str, Any]) -> str:
+    """The ``-serve-stats`` human rendering of a scrape document: the
+    daemon identity line, lane/cache attribution, then one line per
+    histogram (lifetime count + p50/p95/p99 and the windowed recent
+    view), and the flight-recorder occupancy tail."""
+    lines = [
+        f"-- serve stats (pid {doc.get('pid')}, version "
+        f"{doc.get('version')}, uptime {doc.get('uptime_s', 0):.1f}s)",
+        f"  requests: {doc.get('requests', 0)} "
+        f"({doc.get('coalesced', 0)} coalesced, "
+        f"{doc.get('requests_inflight', 0)} in flight, "
+        f"{doc.get('slow_requests', 0)} slow, "
+        f"{doc.get('crashed_requests', 0)} crashed, batch mode "
+        f"{doc.get('batch_mode', '?')})",
+    ]
+    if "lanes" in doc:
+        lines.append(
+            f"  lanes: {doc['lanes']} (steals {doc.get('steals', 0)}, "
+            f"microbatched {doc.get('microbatched', 0)}, occupancy "
+            f"{doc.get('mb_occupancy', {})}, padded slots "
+            f"{doc.get('mb_padded_slots', 0)})"
+        )
+    cache = doc.get("cache")
+    if isinstance(cache, dict):
+        lines.append(
+            f"  tensorize cache: {cache.get('hits', 0)} hits / "
+            f"{cache.get('misses', 0)} misses"
+        )
+    for name, h in sorted(doc.get("hists", {}).items()):
+        if not isinstance(h, dict):
+            continue
+        w = h.get("window", {})
+        lines.append(
+            f"  hist {name}: n={h.get('count', 0)} "
+            f"p50={h.get('p50', 0):.4g} p95={h.get('p95', 0):.4g} "
+            f"p99={h.get('p99', 0):.4g} "
+            f"(window n={w.get('count', 0)} p95={w.get('p95', 0):.4g})"
+        )
+    fl = doc.get("flight")
+    if isinstance(fl, dict):
+        lines.append(
+            f"  flight: {fl.get('spans', 0)}/{fl.get('span_cap', 0)} "
+            f"spans, {fl.get('requests', 0)}/{fl.get('request_cap', 0)} "
+            f"requests, {fl.get('autodumps', 0)} autodumps"
+        )
     return "\n".join(lines) + "\n"

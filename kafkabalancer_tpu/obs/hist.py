@@ -1,0 +1,184 @@
+"""Streaming log-bucketed histograms: daemon-lifetime latency/occupancy
+distributions with a windowed recent view.
+
+The serving daemon (serve/daemon.py) is a long-lived multi-lane process;
+"what did requests cost" is a DISTRIBUTION question (Clipper, NSDI '17:
+batching is only a safe throughput knob while tail latency is
+continuously measured), not the single-invocation phase timings the
+``-metrics-json`` trio answers. A :class:`StreamingHist` holds:
+
+- **lifetime** state: count / sum / min / max plus log-bucketed counts
+  (``SUBBUCKETS`` buckets per octave — ~19% relative resolution at the
+  default 4 — in a sparse dict, so a hist over any value range stays a
+  few hundred ints);
+- a **windowed** view: a ring of ``ring`` sub-epoch bucket dicts, each
+  covering ``window_s / ring`` seconds; reads merge the live slots, so
+  "p95 over the last minute" survives hours of uptime without ever
+  storing samples;
+- **percentile extraction** (p50/p95/p99) from the bucket counts: the
+  reported value is the matched bucket's upper bound, so percentiles
+  are conservative within one bucket's relative error.
+
+Everything is O(1) per observation behind one per-hist lock, allocates
+no per-sample memory, and imports no jax — histograms ride the always-on
+``obs.metrics`` registry (``hist_observe``) and are scraped live through
+the daemon's ``stats`` op (docs/observability.md).
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Any, Callable, Dict, Iterable, List, Tuple
+
+# buckets per octave (power of two): 4 gives bucket upper bounds at
+# 2^(i/4) — ~19% relative width, 40 buckets per 1000x of dynamic range
+SUBBUCKETS = 4
+
+# the windowed view: a ring of RING sub-epochs spanning WINDOW_S seconds
+WINDOW_S = 60.0
+RING = 6
+
+# the underflow bucket: values <= 0 (occupancy hists legitimately
+# observe 0) land here; its upper bound reports as 0.0
+UNDERFLOW = -(1 << 30)
+
+
+def bucket_index(value: float) -> int:
+    """The sparse bucket for ``value``: the smallest ``i`` with
+    ``value <= 2**(i / SUBBUCKETS)``; ``UNDERFLOW`` for values <= 0."""
+    if value <= 0.0 or value != value:  # 0, negatives, NaN
+        return UNDERFLOW
+    return math.ceil(math.log2(value) * SUBBUCKETS)
+
+
+def bucket_le(index: int) -> float:
+    """The inclusive upper bound of bucket ``index`` (0.0 for the
+    underflow bucket)."""
+    if index == UNDERFLOW:
+        return 0.0
+    return 2.0 ** (index / SUBBUCKETS)
+
+
+def merge_buckets(parts: Iterable[Dict[int, int]]) -> Dict[int, int]:
+    """Sum sparse bucket dicts — the aggregation primitive behind the
+    windowed view and any cross-lane rollup."""
+    out: Dict[int, int] = {}
+    for part in parts:
+        for idx, n in part.items():
+            out[idx] = out.get(idx, 0) + n
+    return out
+
+
+def percentile_from_buckets(buckets: Dict[int, int], q: float) -> float:
+    """The ``q``-quantile (0..1) from sparse bucket counts: the upper
+    bound of the first bucket whose cumulative count reaches the rank.
+    0.0 for an empty histogram."""
+    total = sum(buckets.values())
+    if total <= 0:
+        return 0.0
+    rank = max(1, math.ceil(q * total))
+    seen = 0
+    for idx in sorted(buckets):
+        seen += buckets[idx]
+        if seen >= rank:
+            return bucket_le(idx)
+    return bucket_le(max(buckets))
+
+
+class StreamingHist:
+    """One thread-safe streaming histogram; see the module docstring."""
+
+    __slots__ = (
+        "_lock", "_count", "_sum", "_min", "_max", "_buckets",
+        "_ring", "_slot_s", "_ring_n", "_now",
+    )
+
+    def __init__(
+        self,
+        window_s: float = WINDOW_S,
+        ring: int = RING,
+        now: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self._lock = threading.Lock()
+        self._count = 0
+        self._sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+        self._buckets: Dict[int, int] = {}
+        self._ring_n = max(1, int(ring))
+        self._slot_s = max(1e-9, float(window_s)) / self._ring_n
+        # each slot: [epoch, sparse bucket dict, count]
+        self._ring: List[List[Any]] = [
+            [-1, {}, 0] for _ in range(self._ring_n)
+        ]
+        self._now = now
+
+    # -- writers ---------------------------------------------------------
+    def observe(self, value: float) -> None:
+        idx = bucket_index(value)
+        v = float(value)
+        with self._lock:
+            self._count += 1
+            self._sum += v
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+            self._buckets[idx] = self._buckets.get(idx, 0) + 1
+            slot = self._slot_locked(int(self._now() / self._slot_s))
+            slot[1][idx] = slot[1].get(idx, 0) + 1
+            slot[2] += 1
+
+    def _slot_locked(self, epoch: int) -> List[Any]:
+        slot = self._ring[epoch % self._ring_n]
+        if slot[0] != epoch:  # slot aged a full ring out: recycle it
+            slot[0] = epoch
+            slot[1] = {}
+            slot[2] = 0
+        return slot
+
+    # -- readers ---------------------------------------------------------
+    def _window_locked(self) -> Tuple[Dict[int, int], int]:
+        """Merged buckets + count of the slots still inside the window."""
+        epoch = int(self._now() / self._slot_s)
+        live = [
+            s for s in self._ring if 0 <= epoch - s[0] < self._ring_n
+        ]
+        return merge_buckets(s[1] for s in live), sum(s[2] for s in live)
+
+    def percentile(self, q: float) -> float:
+        with self._lock:
+            buckets = dict(self._buckets)
+        return percentile_from_buckets(buckets, q)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The export/scrape view: lifetime stats + percentiles, the
+        windowed recent view, and the sparse buckets as [le, count]
+        pairs (sorted, underflow first)."""
+        with self._lock:
+            buckets = dict(self._buckets)
+            count, total = self._count, self._sum
+            lo = self._min if self._count else 0.0
+            hi = self._max if self._count else 0.0
+            wbuckets, wcount = self._window_locked()
+        return {
+            "count": count,
+            "sum": round(total, 6),
+            "min": round(lo, 9),
+            "max": round(hi, 9),
+            "p50": round(percentile_from_buckets(buckets, 0.50), 9),
+            "p95": round(percentile_from_buckets(buckets, 0.95), 9),
+            "p99": round(percentile_from_buckets(buckets, 0.99), 9),
+            "window": {
+                "count": wcount,
+                "span_s": round(self._slot_s * self._ring_n, 3),
+                "p50": round(percentile_from_buckets(wbuckets, 0.50), 9),
+                "p95": round(percentile_from_buckets(wbuckets, 0.95), 9),
+                "p99": round(percentile_from_buckets(wbuckets, 0.99), 9),
+            },
+            "buckets": [
+                [bucket_le(idx), buckets[idx]] for idx in sorted(buckets)
+            ],
+        }

@@ -14,7 +14,17 @@ gate verdicts, and the solver/session counters. Four metric families:
   that name alive as a read-only alias;
 - **events** — a bounded append-only log of discrete happenings
   (evictions, corrupt-entry drops, pallas gate verdicts, kernel
-  fallbacks) with wall-clock stamps.
+  fallbacks) with wall-clock stamps;
+- **histograms** — streaming log-bucketed distributions (obs/hist.py):
+  per-phase served latency, queue depth, batch occupancy. Unlike the
+  other families these are PROCESS-LIFETIME: :meth:`reset` (the
+  per-invocation epoch boundary) leaves them alone, because their whole
+  point is the daemon-lifetime distribution a live ``stats`` scrape
+  reads mid-traffic; tests reset them explicitly via
+  :meth:`reset_hists`. Excluded from :meth:`snapshot` on purpose — the
+  ``kafkabalancer-tpu.metrics/1`` schema is golden-pinned, and the
+  scrape document (``kafkabalancer-tpu.serve-stats/1``) is the
+  histograms' export seam.
 
 The registry is ALWAYS on (its cost is the dict writes the old bare
 ``stats`` dict already paid, now lock-protected); only the tracer
@@ -26,6 +36,8 @@ from __future__ import annotations
 import threading
 import time
 from typing import Any, Dict, Iterator, List, Mapping
+
+from kafkabalancer_tpu.obs.hist import StreamingHist
 
 SCHEMA_VERSION = 1
 SCHEMA = f"kafkabalancer-tpu.metrics/{SCHEMA_VERSION}"
@@ -47,6 +59,7 @@ class MetricsRegistry:
         self._phases: Dict[str, Dict[str, float]] = {}
         self._events: List[Dict[str, Any]] = []
         self._dropped_events = 0
+        self._hists: Dict[str, StreamingHist] = {}
 
     # -- writers ---------------------------------------------------------
     def count(self, name: str, delta: float = 1.0) -> None:
@@ -66,6 +79,19 @@ class MetricsRegistry:
             return self._phases.setdefault(group, {}).setdefault(
                 key, float(value)
             )
+
+    def hist(self, name: str) -> StreamingHist:
+        """Get-or-create the named streaming histogram. The registry
+        lock covers only the lookup; observations go through the hist's
+        own lock, so hot observers never contend with snapshot()."""
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = StreamingHist()
+            return h
+
+    def hist_observe(self, name: str, value: float) -> None:
+        self.hist(name).observe(value)
 
     def event(self, kind: str, **fields: Any) -> None:
         with self._lock:
@@ -96,8 +122,19 @@ class MetricsRegistry:
                 "events_dropped": self._dropped_events,
             }
 
+    def hist_snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Every histogram's export view — the ``stats`` scrape's
+        payload (deliberately NOT part of :meth:`snapshot`: the
+        metrics/1 schema is golden-pinned)."""
+        with self._lock:
+            hists = dict(self._hists)
+        return {name: h.snapshot() for name, h in sorted(hists.items())}
+
     # -- lifecycle -------------------------------------------------------
     def reset(self) -> None:
+        """Per-invocation epoch boundary. Histograms survive on purpose:
+        they are process/daemon-lifetime distributions (module
+        docstring); ``reset_hists`` clears them explicitly."""
         with self._lock:
             self._counters.clear()
             self._gauges.clear()
@@ -108,6 +145,10 @@ class MetricsRegistry:
     def reset_phases(self) -> None:
         with self._lock:
             self._phases.clear()
+
+    def reset_hists(self) -> None:
+        with self._lock:
+            self._hists.clear()
 
 
 class PhasesView(Mapping[str, Dict[str, float]]):
@@ -153,8 +194,12 @@ gauge = REGISTRY.gauge
 phase_set = REGISTRY.phase_set
 phase_setdefault = REGISTRY.phase_setdefault
 event = REGISTRY.event
+hist = REGISTRY.hist
+hist_observe = REGISTRY.hist_observe
+hist_snapshot = REGISTRY.hist_snapshot
 phase_get = REGISTRY.phase_get
 counter_get = REGISTRY.counter_get
 snapshot = REGISTRY.snapshot
 reset = REGISTRY.reset
 reset_phases = REGISTRY.reset_phases
+reset_hists = REGISTRY.reset_hists

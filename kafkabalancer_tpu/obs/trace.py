@@ -25,6 +25,15 @@ Spans are registered at START (under the id lock, so list order is
 start-ordered and timestamps are monotone in it); an export that runs
 while background threads are still working reports those spans as
 in-flight (``done: false``) instead of losing them.
+
+The serving daemon adds an always-on OBSERVER seam (:meth:`Tracer.
+set_observer`): with an observer installed, span sites time themselves
+and hand each COMPLETED span to the observer even while recording is
+disabled — nothing is appended to the span list, so the daemon's
+flight recorder and streaming histograms (obs/flight.py, obs/hist.py)
+see every span at fixed memory cost without the flag trio. The
+stateless CLI never installs one, so its disabled fast path is the
+same shared no-op singleton as before.
 """
 
 from __future__ import annotations
@@ -32,7 +41,7 @@ from __future__ import annotations
 import threading
 import time
 from types import TracebackType
-from typing import Any, Dict, List, Optional, Type, Union
+from typing import Any, Callable, Dict, List, Optional, Type, Union
 
 
 class Span:
@@ -73,7 +82,7 @@ class Span:
         tb: Optional[TracebackType],
     ) -> None:
         self.t1_ns = time.perf_counter_ns()
-        self._tracer._pop(self)
+        self._tracer._finish(self)
 
 
 class _NoopSpan:
@@ -103,6 +112,7 @@ class Tracer:
         self._spans: List[Span] = []
         self._next_sid = 1
         self._tls = threading.local()
+        self._observer: Optional[Callable[[Span], None]] = None
         self.base_ns = time.perf_counter_ns()
         self.epoch = time.time()
 
@@ -117,6 +127,16 @@ class Tracer:
         """Back to the no-op fast path without dropping recorded spans
         (shared-registry mode's last-tracing-request-out hook)."""
         self._enabled = False
+
+    def set_observer(
+        self, observer: Optional[Callable[[Span], None]] = None
+    ) -> None:
+        """Install (or with None remove) the always-on completed-span
+        observer — the daemon's flight-recorder/histogram feed. With an
+        observer installed, span sites allocate real timed spans even
+        while recording is disabled; the observer must be cheap and
+        must not raise (it is wrapped defensively regardless)."""
+        self._observer = observer
 
     def reset(self, enabled: Optional[bool] = None) -> None:
         """Start a fresh invocation: drop recorded spans, rebase the
@@ -167,15 +187,23 @@ class Tracer:
     ) -> SpanLike:
         """A new span; parents to ``parent`` when given (cross-thread),
         else to this thread's innermost open span. Use as a context
-        manager. Returns the shared no-op singleton when disabled."""
+        manager. Returns the shared no-op singleton when disabled —
+        unless an observer is installed, in which case a real span is
+        timed for the observer only (sid 0, never appended to the
+        recorded list)."""
         if not self._enabled:
-            return NOOP_SPAN
+            if self._observer is None:
+                return NOOP_SPAN
+            return Span(self, 0, None, name, dict(attrs))
         psid: Optional[int]
+        # sid 0 marks an observer-only span (never recorded): a recorded
+        # child must not export a dangling parent_sid=0 — treat it as a
+        # root instead (`or None` also covers the no-op singleton)
         if parent is not None:
-            psid = parent.sid
+            psid = parent.sid or None
         else:
             cur = self.current()
-            psid = cur.sid if cur is not None else None
+            psid = (cur.sid or None) if cur is not None else None
         with self._lock:
             sid = self._next_sid
             self._next_sid += 1
@@ -200,6 +228,17 @@ class Tracer:
             stack.pop()
         elif sp in stack:  # unbalanced exit (generator teardown etc.)
             stack.remove(sp)
+
+    def _finish(self, sp: Span) -> None:
+        """Span exit: unstack, then hand the completed span to the
+        observer (which must never be able to break a span site)."""
+        self._pop(sp)
+        observer = self._observer
+        if observer is not None:
+            try:
+                observer(sp)
+            except Exception:
+                pass
 
     def snapshot(self) -> List[Dict[str, Any]]:
         """Recorded spans as export dicts, start-ordered; spans still in

@@ -161,6 +161,52 @@ def forward_plan(
         sock.close()
 
 
+def _scrape(
+    path: str, op: str, timeout: float
+) -> Optional[Dict[str, Any]]:
+    """One non-plan op round trip (``stats`` / ``dump-trace``) with the
+    same hello version gate as forwarding — None on any failure (the
+    caller reports "no live daemon")."""
+    sock = _connect(path, CONNECT_TIMEOUT_S)
+    if sock is None:
+        return None
+    try:
+        write_frame(sock, {"v": PROTO_VERSION, "op": "hello"})
+        if not _hello_ok(read_frame(sock)):
+            return None
+        sock.settimeout(timeout)
+        write_frame(sock, {"v": PROTO_VERSION, "op": op})
+        resp = read_frame(sock)
+        if (
+            not isinstance(resp, dict)
+            or not resp.get("ok")
+            or resp.get("v") != PROTO_VERSION
+        ):
+            return None
+        return resp
+    except Exception:
+        return None
+    finally:
+        sock.close()
+
+
+def fetch_stats(
+    path: str, timeout: float = 10.0
+) -> Optional[Dict[str, Any]]:
+    """The live telemetry scrape (``-serve-stats[-json]`` /
+    ``-metrics-prom``): the daemon's stats document, or None when no
+    live, version-compatible daemon answers on ``path``."""
+    return _scrape(path, "stats", timeout)
+
+
+def fetch_trace(
+    path: str, timeout: float = 60.0
+) -> Optional[Dict[str, Any]]:
+    """The flight-recorder export (``-serve-dump-trace``): a response
+    whose ``trace`` key is a Perfetto-loadable document, or None."""
+    return _scrape(path, "dump-trace", timeout)
+
+
 def request_shutdown(path: str, timeout: float = 10.0) -> bool:
     """Ask the daemon at ``path`` to exit; True when acknowledged."""
     sock = _connect(path, timeout)

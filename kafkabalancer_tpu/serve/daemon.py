@@ -33,7 +33,17 @@ Structure:
 Observability: daemon-lifetime counters ride into every request's
 metrics as gauges (``served: true``, ``serve.requests``,
 ``serve.coalesced``, ``serve.cache_hits``), so a ``-metrics-json`` line
-from a served invocation is attributable at a glance.
+from a served invocation is attributable at a glance. Fusion/residency
+gauges are RE-SNAPSHOTTED at export time (the ``refresh_attrs`` seam in
+cli.run) so a request's own fused dispatch shows in its own line.
+Beyond per-request attribution the daemon records ALWAYS-ON live
+telemetry: every span site feeds the tracer's observer hook
+(obs/trace.py) into streaming per-phase histograms (obs/hist.py —
+``serve.phase.*``, ``serve.request_s``) and the bounded flight recorder
+(obs/flight.py), scraped live through the ``stats`` / ``dump-trace``
+protocol ops WITHOUT touching the plan dispatcher, and auto-dumped on
+daemon-side crashes or requests over ``-serve-slow-ms``
+(docs/observability.md).
 """
 
 from __future__ import annotations
@@ -49,8 +59,11 @@ from collections import deque
 from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 from kafkabalancer_tpu import __version__, obs
+from kafkabalancer_tpu.obs.flight import PHASE_OF_SPAN, FlightRecorder
+from kafkabalancer_tpu.obs.trace import Span
 from kafkabalancer_tpu.serve.protocol import (
     PROTO_VERSION,
+    STATS_SCHEMA,
     pidfile_path,
     read_frame,
     write_frame,
@@ -85,7 +98,7 @@ class PlanRequest:
 
     __slots__ = (
         "argv", "stdin", "done", "response", "bucket", "bucketed", "staged",
-        "mb_entered",
+        "mb_entered", "t_submit",
     )
 
     def __init__(self, argv: List[str], stdin: Optional[str]) -> None:
@@ -97,6 +110,7 @@ class PlanRequest:
         self.bucketed = False  # probe memo (None is a valid "no bucket")
         self.staged = False  # lane pipelining: host-encode stage fired
         self.mb_entered = False  # joined its microbatch barrier
+        self.t_submit: Optional[float] = None  # queue-wait hist anchor
 
 
 class Coalescer:
@@ -226,11 +240,19 @@ class Daemon:
         microbatch: int = 1,
         batch_mode: str = "continuous",
         admission_hold: int = 0,
+        slow_ms: float = 0.0,
+        flight_dir: str = "",
     ) -> None:
         self.socket_path = socket_path
         self.idle_timeout = idle_timeout
         self.prewarm_shapes = prewarm_shapes
         self.warm = warm
+        # slow_ms: a served request slower than this (milliseconds)
+        # auto-dumps the flight recorder (0 disables); flight_dir
+        # overrides the dump directory (default: the system tempdir)
+        self.slow_ms = max(0.0, slow_ms)
+        self.flight_dir = flight_dir
+        self.flight = FlightRecorder()
         # lanes: 1 = today's single-lane Coalescer, byte for byte (and no
         # jax import before the warm thread); 0/negative = one lane per
         # visible device; N>1 = min(N, devices). microbatch: MAX
@@ -253,6 +275,13 @@ class Daemon:
         self._lock = threading.Lock()
         self._requests = 0
         self._coalesced = 0
+        self._inflight = 0
+        # daemon-lifetime outcome counters: the registry counters of the
+        # same names are wiped by the next request's begin_invocation in
+        # single-lane (per-invocation-epoch) mode, so the scrape reads
+        # THESE, never the registry
+        self._slow = 0
+        self._crashed = 0
         self._started = time.monotonic()
         self._last_activity = time.monotonic()
         self._seq = 0
@@ -336,6 +365,24 @@ class Daemon:
             self._touch()
             self._warm_done.set()
 
+    # -- live telemetry ---------------------------------------------------
+    def _observe_span(self, sp: Span) -> None:
+        """The tracer's always-on observer (obs/trace.py): every
+        completed span — tracing flags or not — lands in the flight
+        recorder ring, and phase-chain spans feed the streaming
+        per-phase histograms. Cheap by construction: one ring append +
+        at most one histogram observation, no locks shared with the
+        dispatcher."""
+        t1 = sp.t1_ns if sp.t1_ns is not None else sp.t0_ns
+        self.flight.note_span(
+            sp.name, sp.t0_ns, t1, sp.thread_name, sp.tid, sp.attrs
+        )
+        phase = PHASE_OF_SPAN.get(sp.name)
+        if phase is not None:
+            obs.metrics.hist_observe(
+                f"serve.phase.{phase}", (t1 - sp.t0_ns) / 1e9
+            )
+
     # -- request handling ------------------------------------------------
     def _parse_request(
         self, req: PlanRequest
@@ -396,6 +443,12 @@ class Daemon:
     ) -> None:
         from kafkabalancer_tpu import cli
 
+        t_start = time.perf_counter()
+        if req.t_submit is not None:
+            # queue wait: accept-thread submit to dispatcher pickup
+            obs.metrics.hist_observe(
+                "serve.phase.queue", t_start - req.t_submit
+            )
         with self._lock:
             self._requests += 1
             if coalesced:
@@ -429,6 +482,24 @@ class Daemon:
             attrs["serve.cache_hits"] = float(
                 self.tensorize_cache.stats()["hits"]
             )
+
+        def refresh() -> Dict[str, Any]:
+            # the PR-6 gap, fixed: scheduler gauges were snapshotted at
+            # request START, so a request's own fusion never showed in
+            # its own -metrics-json line. cli.run calls this at export
+            # time (after the fused dispatch committed — the batcher's
+            # sink runs before member responses release), so the
+            # re-snapshot includes it.
+            sched2 = self._coalescer
+            if lane is None or not hasattr(sched2, "stats"):
+                return {}
+            s2 = sched2.stats()
+            return {
+                "serve.mb_occupancy_max": s2["occupancy_max"],
+                "serve.mb_padded_slots": s2["padded_slots"],
+                "serve.residency_hits": s2["residency_hits"],
+            }
+
         i = io.StringIO(req.stdin or "")
         out, err = io.StringIO(), io.StringIO()
         rc_box: List[int] = []
@@ -445,39 +516,86 @@ class Daemon:
                     cli.run(
                         i, out, err, ["kafkabalancer"] + req.argv,
                         attrs=attrs,
+                        refresh_attrs=refresh if lane is not None else None,
                     )
                 )
 
         # a named thread per request: the request's telemetry spans get
-        # their own track ("serve-req-N") in -stats / -trace output
-        t = threading.Thread(target=body, name=f"serve-req-{seq}")
-        t.start()
-        t.join()
-        if not rc_box:
-            # cli.run raised: a daemon-side crash must NOT masquerade as
-            # one of the CLI's documented exit codes — an ok:false
-            # response makes the client fall back and plan in-process
-            self._log(f"serve: request {seq} crashed (see traceback above)")
-            if mb is not None and not req.mb_entered:
-                # the body died BEFORE joining its microbatch barrier
-                # (lane-context entry failure): release the slot, or the
-                # healthy peers stall at the barrier until its timeout
-                mb.abandon()
-            req.response = {
-                "v": PROTO_VERSION,
-                "ok": False,
-                "error": "internal error: planner thread died",
-            }
+        # their own track ("serve-req-N") in -stats / -trace output,
+        # and the flight recorder attributes phase spans to it by name
+        thread_name = f"serve-req-{seq}"
+        t = threading.Thread(target=body, name=thread_name)
+        try:
+            t.start()
+            t.join()
+            rc: Optional[int] = rc_box[0] if rc_box else None
+            if rc is None:
+                # cli.run raised: a daemon-side crash must NOT
+                # masquerade as one of the CLI's documented exit codes —
+                # an ok:false response makes the client fall back and
+                # plan in-process
+                self._log(
+                    f"serve: request {seq} crashed (see traceback above)"
+                )
+                if mb is not None and not req.mb_entered:
+                    # the body died BEFORE joining its microbatch
+                    # barrier (lane-context entry failure): release the
+                    # slot, or the healthy peers stall at the barrier
+                    # until its timeout
+                    mb.abandon()
+                req.response = {
+                    "v": PROTO_VERSION,
+                    "ok": False,
+                    "error": "internal error: planner thread died",
+                }
+            else:
+                req.response = {
+                    "v": PROTO_VERSION,
+                    "ok": True,
+                    "rc": rc,
+                    "stdout": out.getvalue(),
+                    "stderr": err.getvalue(),
+                }
             self._touch()
-            return
-        req.response = {
-            "v": PROTO_VERSION,
-            "ok": True,
-            "rc": rc_box[0],
-            "stdout": out.getvalue(),
-            "stderr": err.getvalue(),
-        }
-        self._touch()
+        finally:
+            # the flight-recorder request summary + the reconciliation
+            # histogram: EVERY _handle_plan call (crash paths included)
+            # lands exactly one serve.request_s observation, so a
+            # post-traffic scrape's hist count equals serve.requests
+            wall = time.perf_counter() - t_start
+            obs.metrics.hist_observe("serve.request_s", wall)
+            phases = self.flight.pop_request_phases(thread_name)
+            rc_val = rc_box[0] if rc_box else None
+            self.flight.record_request({
+                "req": seq,
+                "t": round(time.time(), 3),
+                "lane": lane.index if lane is not None else 0,
+                "bucket": list(req.bucket) if req.bucket else None,
+                "rc": rc_val,
+                "coalesced": coalesced,
+                "wall_s": round(wall, 6),
+                "phases": {k: round(v, 6) for k, v in sorted(
+                    phases.items()
+                )},
+            })
+            if rc_val is None:
+                with self._lock:
+                    self._crashed += 1
+                obs.metrics.count("serve.crashed_requests")
+                self.flight.autodump(
+                    f"crash-req-{seq}",
+                    directory=self.flight_dir or None,
+                    log=self._log,
+                )
+            elif self.slow_ms > 0 and wall * 1000.0 >= self.slow_ms:
+                with self._lock:
+                    self._slow += 1
+                obs.metrics.count("serve.slow_requests")
+                self.flight.autodump(
+                    f"slow-req-{seq}",
+                    directory=self.flight_dir or None,
+                    log=self._log,
+                )
 
     # -- lanes -----------------------------------------------------------
     def _resolve_lanes(self) -> int:
@@ -625,18 +743,24 @@ class Daemon:
         obs.metrics.count("serve.staged_requests")
         obs.metrics.gauge("serve.last_staged_arrays", float(staged))
 
-    def _hello(self) -> Dict[str, Any]:
+    def _core_snapshot(self) -> Dict[str, Any]:
+        """The ONE daemon-state snapshot both ``hello`` and ``stats``
+        render from — the two scrape paths cannot drift (the satellite
+        pin in tests/test_serve.py compares them key for key)."""
         with self._lock:
-            n, n_coal = self._requests, self._coalesced
+            n, n_coal, inflight = (
+                self._requests, self._coalesced, self._inflight,
+            )
+            slow, crashed = self._slow, self._crashed
         out: Dict[str, Any] = {
-            "v": PROTO_VERSION,
-            "ok": True,
-            "op": "hello",
             "pid": os.getpid(),
             "version": __version__,
             "uptime_s": round(time.monotonic() - self._started, 3),
             "requests": n,
             "coalesced": n_coal,
+            "requests_inflight": inflight,
+            "slow_requests": slow,
+            "crashed_requests": crashed,
             "cache": self.tensorize_cache.stats(),
         }
         sched = self._coalescer
@@ -667,6 +791,29 @@ class Daemon:
             }
         return out
 
+    def _hello(self) -> Dict[str, Any]:
+        return {
+            "v": PROTO_VERSION, "ok": True, "op": "hello",
+            **self._core_snapshot(),
+        }
+
+    def _stats_doc(self) -> Dict[str, Any]:
+        """The ``stats`` scrape document (``STATS_SCHEMA``): the shared
+        core snapshot plus every streaming histogram and the flight
+        recorder's occupancy. Built entirely from locks the plan
+        dispatcher never holds across a dispatch, so a scrape cannot
+        pause planning."""
+        doc: Dict[str, Any] = {
+            "v": PROTO_VERSION, "ok": True, "op": "stats",
+            "schema": STATS_SCHEMA,
+            "ts_epoch": round(time.time(), 3),
+            **self._core_snapshot(),
+        }
+        doc["batch_mode"] = self.batch_mode
+        doc["hists"] = obs.metrics.hist_snapshot()
+        doc["flight"] = self.flight.stats()
+        return doc
+
     def _touch(self) -> None:
         self._last_activity = time.monotonic()
 
@@ -675,7 +822,9 @@ class Daemon:
             conn.settimeout(PLAN_CONNECTION_TIMEOUT_S)
             while True:
                 try:
+                    t_read0 = time.perf_counter()
                     msg = read_frame(conn)
+                    read_s = time.perf_counter() - t_read0
                 except ValueError as exc:
                     # a structured refusal instead of a dropped
                     # connection: an oversized length prefix or an
@@ -702,10 +851,23 @@ class Daemon:
                     })
                     return
                 op = msg.get("op")
-                self._touch()
+                # NOTE: only PLAN work resets the idle clock. hello and
+                # the scrape ops are passive — a periodic monitoring
+                # scraper (-metrics-prom on a cron) must not pin an
+                # otherwise-idle daemon alive past -serve-idle-timeout
                 if op == "hello":
                     write_frame(conn, self._hello())
+                elif op == "stats":
+                    # answered HERE, on the connection thread: a live
+                    # scrape must never queue behind (or pause) planning
+                    write_frame(conn, self._stats_doc())
+                elif op == "dump-trace":
+                    write_frame(conn, {
+                        "v": PROTO_VERSION, "ok": True, "op": "dump-trace",
+                        "trace": self.flight.to_perfetto(),
+                    })
                 elif op == "plan":
+                    self._touch()
                     raw_argv = msg.get("argv", [])
                     if not isinstance(raw_argv, list):
                         write_frame(conn, {
@@ -713,6 +875,10 @@ class Daemon:
                             "error": "plan payload: argv is not a list",
                         })
                         return
+                    # the wire half of the served phase chain: how long
+                    # the daemon spent reading this plan frame off the
+                    # socket (client encode + transfer)
+                    obs.metrics.hist_observe("serve.phase.read", read_s)
                     argv = [str(a) for a in raw_argv]
                     stdin = msg.get("stdin")
                     req = PlanRequest(
@@ -728,7 +894,20 @@ class Daemon:
                             "error": "daemon dispatcher not ready",
                         })
                         return
-                    write_frame(conn, dispatcher.submit(req))
+                    req.t_submit = time.perf_counter()
+                    with self._lock:
+                        self._inflight += 1
+                    try:
+                        resp = dispatcher.submit(req)
+                    finally:
+                        with self._lock:
+                            self._inflight -= 1
+                    t_reply0 = time.perf_counter()
+                    write_frame(conn, resp)
+                    obs.metrics.hist_observe(
+                        "serve.phase.reply",
+                        time.perf_counter() - t_reply0,
+                    )
                 elif op == "shutdown":
                     write_frame(conn, {"v": PROTO_VERSION, "ok": True})
                     self._stop.set()
@@ -792,6 +971,15 @@ class Daemon:
 
         from kafkabalancer_tpu.ops.tensorize import set_row_cache
 
+        # the always-on live-telemetry feed: every completed span — with
+        # or without the flag trio — lands in the flight recorder and
+        # the per-phase streaming histograms (fixed memory, no jax).
+        # Histograms reset HERE so they are daemon-lifetime: the stats
+        # scrape's reconciliation invariant (serve.request_s count ==
+        # serve.requests) holds exactly from request 1
+        obs.metrics.reset_hists()
+        obs.tracer.set_observer(self._observe_span)
+
         if self.warm:
             # the dispatcher is built on the warm thread (its lane
             # resolution pays the backend attach) so the accept loop
@@ -848,6 +1036,7 @@ class Daemon:
             listener.close()
             if self._coalescer is not None:
                 self._coalescer.stop()
+            obs.tracer.set_observer(None)
             obs.set_shared_registry(False)
             set_row_cache(None)
             for sig, handler in old_handlers:

@@ -891,6 +891,17 @@ class LaneScheduler:
         running: Dict[Any, threading.Thread] = {}
         n_started = 0
         while True:
+            # per-round live-telemetry samples (obs/hist.py): this
+            # lane's queue depth and the batcher's live occupancy —
+            # the Orca-style time series the stats scrape exposes
+            with self._cv:
+                depth = len(self._queues[lane.index])
+            obs.metrics.hist_observe(
+                f"serve.lane{lane.index}.queue_depth", float(depth)
+            )
+            obs.metrics.hist_observe(
+                "serve.cb_occupancy", float(len(running))
+            )
             while waiting and len(running) < self._microbatch:
                 req = waiting.popleft()
                 coalesced = n_started > 0 or not first

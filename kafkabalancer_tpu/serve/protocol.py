@@ -14,11 +14,21 @@ plan). Requests carry ``"op"``:
   re-forwards) plus ``stdin`` (the input text when no ``-input``/
   ``-from-zk`` names a source). The response carries ``rc``/``stdout``/
   ``stderr`` verbatim;
+- ``stats``    — live telemetry scrape: the daemon's shared snapshot
+  (requests/inflight/lane attribution) plus every streaming histogram's
+  lifetime + windowed percentiles, as a schema-versioned document
+  (``STATS_SCHEMA``). Answered on the connection thread, NEVER through
+  the plan dispatcher — a scrape must not pause planning;
+- ``dump-trace`` — the flight recorder's span ring + request log as a
+  Perfetto-loadable Chrome trace document (the client writes the file);
 - ``shutdown`` — orderly daemon exit (acknowledged before the listener
   closes).
 
 Nothing in this module (or ``serve.client``) imports jax: the client
-side of a forwarded invocation must stay as light as an error exit.
+side of a forwarded invocation must stay as light as an error exit —
+and that pin extends to the scrape verbs (``-serve-stats[-json]``,
+``-serve-dump-trace``, ``-metrics-prom``), which are pure protocol
+clients.
 """
 
 from __future__ import annotations
@@ -31,6 +41,11 @@ import tempfile
 from typing import Any, Dict, Optional
 
 PROTO_VERSION = 1
+
+# the stats scrape document's schema id — versioned independently of the
+# wire protocol (adding a scrape field bumps this, not PROTO_VERSION)
+STATS_SCHEMA_VERSION = 1
+STATS_SCHEMA = f"kafkabalancer-tpu.serve-stats/{STATS_SCHEMA_VERSION}"
 
 # a frame larger than this is a protocol error, not a payload: the
 # biggest legitimate frame is a -full-output plan for a very large

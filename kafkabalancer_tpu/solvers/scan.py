@@ -1281,9 +1281,10 @@ def _leader_plan(
     ``batch > 1`` selects the convergent batched-transfer extension
     (solvers/leader.py module docstring); ``batch=1`` replays the
     reference trajectory."""
-    repaired, budget = _settle_head(
-        pl, cfg, max_reassign, include_reassign_leaders=False
-    )
+    with obs.span("settle"):
+        repaired, budget = _settle_head(
+            pl, cfg, max_reassign, include_reassign_leaders=False
+        )
     opl.append(*repaired)
     if dtype is None:
         dtype = default_dtype()
@@ -1475,7 +1476,8 @@ def plan(
             pl, cfg, max_reassign, dtype, chunk_moves, opl, batch=batch
         )
 
-    repaired, budget = _settle_head(pl, cfg, max_reassign)
+    with obs.span("settle"):
+        repaired, budget = _settle_head(pl, cfg, max_reassign)
     opl.append(*repaired)
     if dtype is None:
         dtype = default_dtype()

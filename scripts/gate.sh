@@ -24,10 +24,16 @@
 #                         hosts or a faked 2-device CPU mesh (skips on
 #                         a single non-CPU device)
 #   9. continuous       — K concurrent clients against a daemon with a
-#      batching smoke     deterministic admission hold: per-client
-#                         served attribution + byte parity vs
-#                         -no-daemon, fused occupancy > 1 via the
-#                         -metrics-json counters (docs/serving.md)
+#      batching +         deterministic admission hold: per-client
+#      live-scrape        served attribution + byte parity vs
+#      smoke              -no-daemon, fused occupancy > 1 via the
+#                         export-time re-snapshotted -metrics-json
+#                         gauges; PLUS the live telemetry scrape —
+#                         -serve-stats-json mid- and post-traffic
+#                         (phase histograms present, request counts
+#                         reconciling exactly with serve.requests) and
+#                         -serve-dump-trace producing valid Perfetto
+#                         JSON (docs/observability.md)
 #  10. tier-1 tests     — the ROADMAP.md verify suite (skip: --no-tests)
 #
 # Exit 0 only when every stage that ran passed. Optional tools that are
@@ -293,7 +299,7 @@ if [ "$shard_run" = 1 ]; then
 fi
 rm -rf "$shard_tmp"
 
-step "continuous batching smoke (3 held clients, occupancy + parity)"
+step "continuous batching + live-scrape smoke (3 held clients)"
 # The continuous batcher end to end: a daemon with a deterministic
 # admission hold (-serve-admission-hold=3 — the lane keeps its queue
 # intact until the full batch arrives, no scheduler-timing luck), three
@@ -351,6 +357,22 @@ if [ "$cb_ready" = 1 ]; then
       "-metrics-json=$cb_tmp/m$i.json" >"$cb_tmp/served$i.out" 2>/dev/null &
     eval "cbc$i=\$!"
   done
+  # live scrape MID-TRAFFIC: the stats op answers on the connection
+  # thread, never through the dispatcher — it must return while the
+  # held batch is still forming/in flight, with the phase histograms
+  # from the earlier requests already present (docs/observability.md)
+  if "$PYTHON" -m kafkabalancer_tpu "-serve-socket=$cb_sock" \
+      -serve-stats-json 2>/dev/null | "$PYTHON" -c '
+import json, sys
+p = json.loads(sys.stdin.read())
+assert p["schema"] == "kafkabalancer-tpu.serve-stats/1", p.get("schema")
+assert "serve.request_s" in p["hists"], sorted(p["hists"])
+assert "serve.phase.parse" in p["hists"], sorted(p["hists"])
+'; then
+    echo "mid-traffic stats scrape: OK"
+  else
+    echo "mid-traffic stats scrape FAILED"; cb_ok=0
+  fi
   wait "$cbc1" || cb_ok=0
   wait "$cbc2" || cb_ok=0
   wait "$cbc3" || cb_ok=0
@@ -366,12 +388,51 @@ for i in (1, 2, 3):
     g = m.get('gauges', {})
     assert g.get('served') is True, (i, 'not served')
     assert 'serve.residency_hits' in g, (i, 'no residency gauge')
-    fused = max(fused, m.get('counters', {}).get('serve.microbatched', 0))
-assert fused >= 2, f'no fused dispatch of occupancy > 1 (counter {fused})'
+    # the export-time re-snapshot (PR 8): each client's OWN gauges now
+    # include the fusion it rode, so the gauge — not the counter
+    # workaround — is the reader
+    fused = max(fused, g.get('serve.mb_occupancy_max', 0))
+assert fused >= 2, f'no fused dispatch of occupancy > 1 (gauge {fused})'
 " 2>/dev/null; then
     echo "3 held clients: served + parity + fused occupancy > 1: OK"
   else
     echo "continuous batching smoke FAILED (see $cb_tmp)"; fail=1
+  fi
+  # POST-TRAFFIC scrape: phase histogram request counts must reconcile
+  # EXACTLY with serve.requests (the acceptance invariant), and the
+  # flight recorder must export a Perfetto-loadable trace of the
+  # requests just served
+  if "$PYTHON" -m kafkabalancer_tpu "-serve-socket=$cb_sock" \
+      -serve-stats-json 2>/dev/null | "$PYTHON" -c '
+import json, sys
+p = json.loads(sys.stdin.read())
+assert p["requests"] >= 4, p["requests"]
+assert p["hists"]["serve.request_s"]["count"] == p["requests"], (
+    p["hists"]["serve.request_s"]["count"], p["requests"])
+for name in ("serve.phase.read", "serve.phase.queue", "serve.phase.parse",
+             "serve.phase.tensorize", "serve.phase.dispatch",
+             "serve.phase.encode", "serve.phase.reply"):
+    assert name in p["hists"], (name, sorted(p["hists"]))
+    assert p["hists"][name]["p99"] >= 0.0
+'; then
+    echo "post-traffic scrape reconciliation: OK"
+  else
+    echo "post-traffic scrape reconciliation FAILED"; fail=1
+  fi
+  if "$PYTHON" -m kafkabalancer_tpu "-serve-socket=$cb_sock" \
+      "-serve-dump-trace=$cb_tmp/flight.trace.json" >/dev/null 2>&1 \
+    && "$PYTHON" -c '
+import json, sys
+doc = json.load(open("'"$cb_tmp"'/flight.trace.json"))
+xs = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+assert xs, "no spans in the flight trace"
+for e in xs:
+    assert {"name", "ts", "dur", "pid", "tid"} <= set(e), e
+assert doc["otherData"]["requests"], "no request log"
+'; then
+    echo "flight-recorder dump-trace: OK"
+  else
+    echo "flight-recorder dump-trace FAILED"; fail=1
   fi
   "$PYTHON" -c "from kafkabalancer_tpu.serve.client import request_shutdown
 request_shutdown('$cb_sock')" || true

@@ -440,3 +440,275 @@ def test_tracer_trim_keeps_inflight_and_newest_spans():
     assert len(names) == 3
     assert names[-1] == "s9"  # oldest completed dropped first
     open_span.__exit__(None, None, None)
+
+
+# --- streaming histograms (obs/hist.py) -----------------------------------
+
+
+def test_hist_bucket_boundaries():
+    """Bucket math pin: every value lands in the smallest bucket whose
+    upper bound holds it, at SUBBUCKETS buckets per octave; values <= 0
+    go to the underflow bucket (upper bound 0.0)."""
+    from kafkabalancer_tpu.obs import hist as obs_hist
+
+    for v in (1e-6, 0.0013, 0.5, 1.0, 3.0, 1000.0, 7e6):
+        i = obs_hist.bucket_index(v)
+        assert obs_hist.bucket_le(i) >= v, v
+        assert obs_hist.bucket_le(i - 1) < v, v
+    assert obs_hist.bucket_index(1.0) == 0  # 2**0 is a bucket boundary
+    assert obs_hist.bucket_le(0) == 1.0
+    for v in (0.0, -1.0, float("nan")):
+        assert obs_hist.bucket_index(v) == obs_hist.UNDERFLOW
+    assert obs_hist.bucket_le(obs_hist.UNDERFLOW) == 0.0
+
+
+def test_hist_percentiles_within_one_bucket():
+    """p50/p95/p99 of a known distribution come back as the true value's
+    bucket upper bound — conservative within one bucket's ~19% width."""
+    from kafkabalancer_tpu.obs.hist import StreamingHist, bucket_index, bucket_le
+
+    h = StreamingHist()
+    for ms in range(1, 101):
+        h.observe(ms / 1000.0)
+    s = h.snapshot()
+    assert s["count"] == 100
+    assert s["min"] == 0.001 and s["max"] == 0.1
+    assert abs(s["sum"] - sum(ms / 1000.0 for ms in range(1, 101))) < 1e-6
+    for q, true in (("p50", 0.050), ("p95", 0.095), ("p99", 0.099)):
+        le = bucket_le(bucket_index(true))
+        assert true <= s[q] <= le * 1.20, (q, s[q])
+    assert s["buckets"] and all(n >= 1 for _le, n in s["buckets"])
+    assert [le for le, _n in s["buckets"]] == sorted(
+        le for le, _n in s["buckets"]
+    )
+
+
+def test_hist_merge_buckets_matches_combined_stream():
+    from kafkabalancer_tpu.obs.hist import (
+        StreamingHist,
+        merge_buckets,
+        percentile_from_buckets,
+    )
+
+    a, b, both = StreamingHist(), StreamingHist(), StreamingHist()
+    for v in (0.001, 0.002, 0.004):
+        a.observe(v)
+        both.observe(v)
+    for v in (0.1, 0.2, 0.4, 0.8):
+        b.observe(v)
+        both.observe(v)
+    merged = merge_buckets([a._buckets, b._buckets])
+    assert sum(merged.values()) == 7
+    for q in (0.5, 0.95, 0.99):
+        assert percentile_from_buckets(merged, q) == both.percentile(q)
+
+
+def test_hist_windowed_rotation():
+    """The ring of sub-epoch buckets: observations age out of the
+    windowed view after window_s while the lifetime view keeps them."""
+    from kafkabalancer_tpu.obs.hist import StreamingHist
+
+    clock = [0.0]
+    h = StreamingHist(window_s=60.0, ring=6, now=lambda: clock[0])
+    h.observe(1.0)
+    clock[0] = 30.0
+    h.observe(2.0)
+    s = h.snapshot()
+    assert s["count"] == 2 and s["window"]["count"] == 2
+    clock[0] = 70.0  # the t=0 slot aged out; t=30 still inside
+    s = h.snapshot()
+    assert s["count"] == 2 and s["window"]["count"] == 1
+    clock[0] = 500.0  # everything aged out; lifetime survives
+    s = h.snapshot()
+    assert s["count"] == 2 and s["window"]["count"] == 0
+    assert s["window"]["span_s"] == 60.0
+
+
+def test_registry_hist_family_is_process_lifetime():
+    """Registry integration: hist_observe feeds a named streaming hist;
+    reset() (the per-invocation epoch) leaves histograms alone — they
+    are daemon-lifetime by design — and snapshot() excludes them (the
+    metrics/1 golden schema must not move); reset_hists clears."""
+    from kafkabalancer_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+    reg.hist_observe("x.latency", 0.5)
+    reg.hist_observe("x.latency", 1.5)
+    reg.count("n")
+    assert "histograms" not in reg.snapshot()
+    assert "hists" not in reg.snapshot()
+    snap = reg.hist_snapshot()
+    assert snap["x.latency"]["count"] == 2
+    reg.reset()
+    assert reg.counter_get("n") == 0.0
+    assert reg.hist_snapshot()["x.latency"]["count"] == 2  # survived
+    reg.reset_hists()
+    assert reg.hist_snapshot() == {}
+
+
+def test_registry_hist_concurrent_observers():
+    from kafkabalancer_tpu.obs.metrics import MetricsRegistry
+
+    reg = MetricsRegistry()
+
+    def body(k):
+        for i in range(500):
+            reg.hist_observe("shared", float(i % 7 + 1))
+            reg.hist_observe(f"own{k}", 1.0)
+
+    threads = [threading.Thread(target=body, args=(k,)) for k in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    snap = reg.hist_snapshot()
+    assert snap["shared"]["count"] == 4000
+    assert all(snap[f"own{k}"]["count"] == 500 for k in range(8))
+
+
+# --- tracer observer seam (the daemon's always-on feed) -------------------
+
+
+def test_tracer_observer_times_spans_without_recording():
+    """With an observer installed and recording DISABLED, span sites
+    time real spans and hand them to the observer at exit — innermost
+    first — while the recorded span list stays empty; removing the
+    observer restores the shared no-op singleton."""
+    from kafkabalancer_tpu.obs.trace import Tracer
+
+    tr = Tracer()
+    seen = []
+    tr.set_observer(lambda sp: seen.append((sp.name, sp.t1_ns)))
+    with tr.span("outer"):
+        with tr.span("inner"):
+            pass
+    assert [n for n, _t1 in seen] == ["inner", "outer"]
+    assert all(t1 is not None for _n, t1 in seen)
+    assert tr.snapshot() == []  # observer-only spans are never recorded
+    tr.set_observer(None)
+    assert tr.span("after") is NOOP_SPAN
+
+
+def test_tracer_observer_exceptions_never_break_span_sites():
+    from kafkabalancer_tpu.obs.trace import Tracer
+
+    tr = Tracer()
+    tr.set_observer(lambda sp: (_ for _ in ()).throw(RuntimeError("boom")))
+    try:
+        with tr.span("guarded"):
+            pass  # must not raise
+    finally:
+        tr.set_observer(None)
+
+
+def test_observer_only_span_never_becomes_recorded_parent():
+    """Mid-flight enable: a span recorded while an observer-only span
+    (sid 0) is still open on the thread stack exports as a ROOT, not
+    with a dangling parent_sid=0."""
+    from kafkabalancer_tpu.obs.trace import Tracer
+
+    tr = Tracer()
+    tr.set_observer(lambda sp: None)
+    try:
+        with tr.span("observer-only"):
+            tr.enable()  # a concurrent -trace request switched it on
+            with tr.span("recorded"):
+                pass
+    finally:
+        tr.set_observer(None)
+        tr.disable()
+    spans = {s["name"]: s for s in tr.snapshot()}
+    assert set(spans) == {"recorded"}
+    assert spans["recorded"]["parent"] is None
+
+
+def test_tracer_observer_also_sees_enabled_spans():
+    from kafkabalancer_tpu.obs.trace import Tracer
+
+    tr = Tracer()
+    tr.enable()
+    seen = []
+    tr.set_observer(lambda sp: seen.append(sp.name))
+    try:
+        with tr.span("both"):
+            pass
+    finally:
+        tr.set_observer(None)
+    assert seen == ["both"]
+    assert [s["name"] for s in tr.snapshot()] == ["both"]
+
+
+# --- flight recorder (obs/flight.py) --------------------------------------
+
+
+def test_flight_span_ring_wraparound():
+    from kafkabalancer_tpu.obs.flight import FlightRecorder
+
+    fr = FlightRecorder(span_cap=8, request_cap=4)
+    for i in range(20):
+        fr.note_span(f"s{i}", i * 1000, i * 1000 + 500, "worker", 7, None)
+    assert fr.stats()["spans"] == 8
+    doc = fr.to_perfetto()
+    xs = [ev for ev in doc["traceEvents"] if ev["ph"] == "X"]
+    names = [ev["name"] for ev in xs]
+    assert len(names) == 8 and names[-1] == "s19" and "s0" not in names
+    for ev in xs:
+        assert {"name", "ph", "ts", "dur", "pid", "tid"} <= set(ev)
+        assert ev["ts"] >= 0 and ev["dur"] >= 0
+    # thread_name metadata track present for the span tid
+    assert any(
+        ev["ph"] == "M" and ev["name"] == "thread_name" and ev["tid"] == 7
+        for ev in doc["traceEvents"]
+    )
+
+
+def test_flight_request_ring_wraparound():
+    from kafkabalancer_tpu.obs.flight import FlightRecorder
+
+    fr = FlightRecorder(span_cap=8, request_cap=4)
+    for i in range(9):
+        fr.record_request({"req": i})
+    assert [r["req"] for r in fr.request_log()] == [5, 6, 7, 8]
+    assert fr.to_perfetto()["otherData"]["requests"][-1]["req"] == 8
+
+
+def test_flight_phase_accumulation_by_request_thread():
+    """Spans on a serve-req-N thread accumulate into that request's
+    phase map (dispatch rounds SUM); other threads accumulate nothing;
+    pop clears."""
+    from kafkabalancer_tpu.obs.flight import FlightRecorder
+
+    fr = FlightRecorder()
+    fr.note_span("parse_input", 0, 2_000_000, "serve-req-3", 1, None)
+    fr.note_span("solver.dispatch_chunk", 0, 1_000_000, "serve-req-3", 1, None)
+    fr.note_span("solver.dispatch_chunk", 0, 3_000_000, "serve-req-3", 1, None)
+    fr.note_span("parse_input", 0, 9_000_000, "MainThread", 2, None)
+    fr.note_span("unmapped_span", 0, 9_000_000, "serve-req-3", 1, None)
+    phases = fr.pop_request_phases("serve-req-3")
+    assert abs(phases["parse"] - 0.002) < 1e-9
+    assert abs(phases["dispatch"] - 0.004) < 1e-9
+    assert set(phases) == {"parse", "dispatch"}
+    assert fr.pop_request_phases("serve-req-3") == {}  # popped
+    assert fr.pop_request_phases("MainThread") == {}
+
+
+def test_flight_autodump_writes_perfetto_and_caps(tmp_path):
+    from kafkabalancer_tpu.obs import flight as obs_flight
+
+    fr = obs_flight.FlightRecorder(span_cap=16, request_cap=4)
+    fr.note_span("tensorize", 0, 5_000_000, "serve-req-1", 3, {"k": 1})
+    fr.record_request({"req": 1, "rc": 0, "wall_s": 0.005})
+    logs = []
+    path = fr.autodump("slow-req-1", directory=str(tmp_path), log=logs.append)
+    assert path and os.path.exists(path)
+    assert "slow-req-1" in path
+    with open(path) as f:
+        doc = json.load(f)
+    assert [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert doc["otherData"]["requests"][0]["req"] == 1
+    assert any("dumped" in m for m in logs)
+    # the per-process cap: past MAX_AUTODUMPS, dumps are refused
+    for i in range(obs_flight.MAX_AUTODUMPS):
+        fr.autodump(f"r{i}", directory=str(tmp_path))
+    assert fr.autodump("over", directory=str(tmp_path)) is None
+    assert fr.stats()["autodumps"] == obs_flight.MAX_AUTODUMPS

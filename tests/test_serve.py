@@ -1687,3 +1687,424 @@ def test_dev_cached_asarray_reuses_equal_content():
     # None passes through; no cache is a plain asarray
     assert _dev_cached_asarray(cache, "x", None) is None
     assert _dev_cached_asarray(None, "w", a1) is not None
+
+
+# --- live daemon telemetry: the stats / dump-trace scrape ops --------------
+
+GOLDEN_STATS = os.path.join(
+    os.path.dirname(__file__), "data", "serve_stats_schema_v1.json"
+)
+
+
+def test_hello_and_stats_render_from_one_snapshot(daemon):
+    """The satellite pin: hello and stats are two renderings of ONE
+    shared snapshot helper — every hello state key appears in the stats
+    document with the same meaning, and hello carries the new
+    uptime_s/requests_inflight gauges."""
+    sock, _d = daemon
+    hello = sclient.daemon_alive(sock)
+    assert hello["uptime_s"] >= 0.0
+    assert hello["requests_inflight"] == 0
+    doc = sclient.fetch_stats(sock)
+    assert doc is not None
+    shared = set(hello) - {"v", "ok", "op"}
+    assert shared <= set(doc), shared - set(doc)
+    # idle daemon: the shared counters agree between the two scrapes
+    for key in ("requests", "coalesced", "requests_inflight", "pid",
+                "version"):
+        assert hello[key] == doc[key], key
+
+
+def test_stats_scrape_reconciles_with_served_requests(daemon):
+    """Acceptance pin: after traffic, the serve.request_s histogram's
+    count equals serve.requests exactly, and the per-phase chain
+    (read/queue/parse/plan/encode/reply) is present."""
+    sock, d = daemon
+    for _ in range(2):
+        rv, _out, _err = run_cli(
+            ["-input-json", f"-input={FIXTURE}", f"-serve-socket={sock}"]
+        )
+        assert rv == 0
+    doc = sclient.fetch_stats(sock)
+    assert doc["requests"] == d._requests == 2
+    hists = doc["hists"]
+    assert hists["serve.request_s"]["count"] == doc["requests"]
+    for name in ("serve.phase.read", "serve.phase.queue",
+                 "serve.phase.parse", "serve.phase.plan",
+                 "serve.phase.encode", "serve.phase.reply"):
+        assert name in hists, sorted(hists)
+        assert hists[name]["count"] >= 1
+        assert hists[name]["p50"] >= 0.0
+        assert hists[name]["window"]["count"] >= 1  # just-served: in window
+    # a -fused request adds the device-path phases
+    rv, _out, _err = run_cli(
+        ["-input-json", f"-input={FIXTURE}", "-fused", "-max-reassign=2",
+         f"-serve-socket={sock}"]
+    )
+    assert rv == 0
+    hists = sclient.fetch_stats(sock)["hists"]
+    for name in ("serve.phase.settle", "serve.phase.tensorize",
+                 "serve.phase.dispatch"):
+        assert name in hists, sorted(hists)
+    # and the flight recorder holds the request summaries with phases
+    resp = sclient.fetch_trace(sock)
+    reqs = resp["trace"]["otherData"]["requests"]
+    assert len(reqs) == 3
+    assert all(r["rc"] == 0 for r in reqs)
+    assert "parse" in reqs[-1]["phases"]
+    assert "dispatch" in reqs[-1]["phases"]
+
+
+def test_stats_scrape_never_blocks_on_inflight_plan(sock_dir, monkeypatch):
+    """The tentpole's no-pause pin: with a plan request WEDGED in the
+    dispatcher, stats and dump-trace still answer promptly (they run on
+    the connection thread, never through the dispatcher) and report the
+    request as in flight."""
+    from kafkabalancer_tpu import cli as cli_mod
+
+    started = threading.Event()
+    release = threading.Event()
+    real_run = cli_mod.run
+
+    def slow_run(i, o, e, args, **kw):
+        started.set()
+        release.wait(30)
+        return real_run(i, o, e, args, **kw)
+
+    monkeypatch.setattr(cli_mod, "run", slow_run)
+    sock = os.path.join(sock_dir, "kb.sock")
+    d = Daemon(sock, idle_timeout=60.0, warm=False, log=lambda _m: None)
+    rc_box = []
+    t = threading.Thread(
+        target=lambda: rc_box.append(d.serve_forever()), daemon=True
+    )
+    t.start()
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if sclient.daemon_alive(sock) is not None:
+            break
+        time.sleep(0.02)
+    else:
+        pytest.fail("daemon never became ready")
+    try:
+        result_box = []
+
+        def one():
+            result_box.append(
+                sclient.forward_plan(
+                    sock, ["-no-daemon=true", "-input-json=true"],
+                    open(FIXTURE).read(),
+                )
+            )
+
+        rt = threading.Thread(target=one)
+        rt.start()
+        assert started.wait(10), "request never started"
+        t0 = time.monotonic()
+        doc = sclient.fetch_stats(sock)
+        trace = sclient.fetch_trace(sock)
+        elapsed = time.monotonic() - t0
+        assert doc is not None and trace is not None
+        assert elapsed < 5.0, f"scrape stalled {elapsed:.1f}s"
+        assert doc["requests_inflight"] >= 1
+        release.set()
+        rt.join(30)
+        assert result_box and result_box[0] is not None
+        assert result_box[0].rc == 0
+        assert (sclient.fetch_stats(sock) or {})["requests_inflight"] == 0
+    finally:
+        release.set()
+        sclient.request_shutdown(sock)
+        t.join(15)
+    assert rc_box == [0]
+
+
+def test_serve_stats_json_schema_golden(daemon):
+    """Golden-file pin: the stats document's top-level keys, histogram
+    entry keys and flight keys are VERSIONED
+    (kafkabalancer-tpu.serve-stats/1) — changing any requires a schema
+    bump and a new golden."""
+    sock, _d = daemon
+    rv, _out, _err = run_cli(
+        ["-input-json", f"-input={FIXTURE}", f"-serve-socket={sock}"]
+    )
+    assert rv == 0
+    doc = sclient.fetch_stats(sock)
+    with open(GOLDEN_STATS) as f:
+        golden = json.load(f)
+    assert doc["schema"] == golden["schema"]
+    base = set(golden["top_level_keys"])
+    lane = set(golden["lane_keys"])
+    assert base <= set(doc) <= base | lane, sorted(doc)
+    for name, h in doc["hists"].items():
+        assert set(h) == set(golden["hist_keys"]), name
+        assert set(h["window"]) == set(golden["hist_window_keys"]), name
+        for le, n in h["buckets"]:
+            assert le >= 0.0 and n >= 1
+    assert set(doc["flight"]) == set(golden["flight_keys"])
+
+
+def test_scrape_cli_verbs_roundtrip(daemon, sock_dir):
+    """-serve-stats[-json], -metrics-prom and -serve-dump-trace: the
+    jax-free operator verbs over a live daemon, and exit 3 with a named
+    reason when none is reachable."""
+    sock, _d = daemon
+    rv, _out, _err = run_cli(
+        ["-input-json", f"-input={FIXTURE}", f"-serve-socket={sock}"]
+    )
+    assert rv == 0
+    rv, out, _err = run_cli([f"-serve-socket={sock}", "-serve-stats-json"])
+    assert rv == 0
+    doc = json.loads(out)
+    assert doc["schema"] == "kafkabalancer-tpu.serve-stats/1"
+    assert doc["hists"]["serve.request_s"]["count"] == doc["requests"]
+    rv, out, _err = run_cli([f"-serve-socket={sock}", "-serve-stats"])
+    assert rv == 0
+    assert "serve stats" in out and "hist serve.request_s" in out
+    rv, out, _err = run_cli([f"-serve-socket={sock}", "-metrics-prom=-"])
+    assert rv == 0
+    assert "# TYPE kafkabalancer_tpu_requests counter" in out
+    assert 'quantile="0.99"' in out
+    assert "kafkabalancer_tpu_serve_request_s_count 1" in out
+    prom_path = os.path.join(sock_dir, "m.prom")
+    rv, _out, _err = run_cli(
+        [f"-serve-socket={sock}", f"-metrics-prom={prom_path}"]
+    )
+    assert rv == 0 and "kafkabalancer_tpu_" in open(prom_path).read()
+    tpath = os.path.join(sock_dir, "flight.trace.json")
+    rv, _out, err = run_cli(
+        [f"-serve-socket={sock}", f"-serve-dump-trace={tpath}"]
+    )
+    assert rv == 0 and "flight trace written" in err
+    with open(tpath) as f:
+        trace = json.load(f)
+    xs = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+    assert xs and all({"name", "ts", "dur", "pid", "tid"} <= set(e) for e in xs)
+    # no daemon: a named error exit, not a crash or a silent 0
+    gone = os.path.join(sock_dir, "absent.sock")
+    for args in (["-serve-stats-json"], ["-serve-stats"],
+                 ["-metrics-prom=-"], ["-serve-dump-trace=-"]):
+        rv, out, err = run_cli([f"-serve-socket={gone}"] + args)
+        assert rv == 3 and "no live daemon" in err, (args, rv, err)
+    # live daemon but an unwritable LOCAL path: the output-write-failure
+    # code (4), NOT the daemon-unreachable code — a monitoring wrapper
+    # must not misdiagnose a full disk as a dead daemon
+    bad = os.path.join(sock_dir, "no-such-dir", "out.txt")
+    for flag in (f"-metrics-prom={bad}", f"-serve-dump-trace={bad}"):
+        rv, _out, err = run_cli([f"-serve-socket={sock}", flag])
+        assert rv == 4 and "failed writing" in err, (flag, rv, err)
+    # contradictory combinations refuse loudly instead of silently
+    # scraping and discarding the rest of the invocation
+    rv, _out, err = run_cli(["-serve", f"-serve-socket={sock}",
+                             "-serve-stats"])
+    assert rv == 3 and "cannot be combined with -serve" in err
+    rv, _out, err = run_cli(["-input-json", f"-input={FIXTURE}",
+                             f"-serve-socket={sock}", "-serve-stats-json"])
+    assert rv == 3 and "take no input" in err
+
+
+def test_prometheus_exposition_keeps_counters_exact():
+    """%g would round a 7-digit counter (rate() reads it as frozen);
+    the exposition must emit integers exactly and floats at full
+    precision."""
+    from kafkabalancer_tpu.obs import export as obs_export
+
+    text = obs_export.render_prometheus({
+        "requests": 1234567,
+        "uptime_s": 2.5,
+        "hists": {
+            "serve.request_s": {
+                "count": 9999999, "sum": 1234567.25,
+                "p50": 0.5, "p95": 1.0, "p99": 2.0,
+            },
+        },
+    })
+    assert "kafkabalancer_tpu_requests 1234567\n" in text
+    assert "kafkabalancer_tpu_uptime_s 2.5\n" in text
+    assert "kafkabalancer_tpu_serve_request_s_count 9999999" in text
+    assert "kafkabalancer_tpu_serve_request_s_sum 1234567.25" in text
+    assert "e+06" not in text
+    # the incident-signal counters ride the exposition and the human
+    # rendering — write-only crash/slow attribution helps nobody
+    text = obs_export.render_prometheus(
+        {"requests": 4, "slow_requests": 2, "crashed_requests": 1}
+    )
+    assert "kafkabalancer_tpu_slow_requests 2\n" in text
+    assert "kafkabalancer_tpu_crashed_requests 1\n" in text
+    human = obs_export.render_serve_stats(
+        {"requests": 4, "slow_requests": 2, "crashed_requests": 1}
+    )
+    assert "2 slow" in human and "1 crashed" in human
+
+
+def test_scrapes_do_not_reset_idle_clock(sock_dir):
+    """Monitoring must stay passive: a daemon under periodic stats
+    scrapes (and hellos) still idle-times-out; only plan work pins it
+    alive."""
+    sock = os.path.join(sock_dir, "kb.sock")
+    d = Daemon(sock, idle_timeout=1.0, warm=False, log=lambda _m: None)
+    rc_box = []
+    t = threading.Thread(
+        target=lambda: rc_box.append(d.serve_forever()), daemon=True
+    )
+    t.start()
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if sclient.daemon_alive(sock) is not None:
+            break
+        time.sleep(0.02)
+    else:
+        pytest.fail("daemon never became ready")
+    # scrape well past the idle timeout; the daemon must still exit
+    deadline = time.monotonic() + 20
+    while t.is_alive() and time.monotonic() < deadline:
+        sclient.fetch_stats(sock)
+        time.sleep(0.2)
+    assert not t.is_alive(), "scrapes pinned the daemon alive"
+    assert rc_box == [0]
+
+
+def test_scrape_verbs_never_import_jax(daemon):
+    """The no-jax client pin extended to the scrape verbs: a process
+    that scrapes a live daemon (stats JSON + trace dump) exits without
+    importing jax, numpy or the solver stack."""
+    sock, _d = daemon
+    code = (
+        "import io, sys\n"
+        "from kafkabalancer_tpu.cli import run\n"
+        "out = io.StringIO()\n"
+        "rc = run(io.StringIO(), out, io.StringIO(),\n"
+        f"         ['kafkabalancer', '-serve-socket={sock}',\n"
+        "          '-serve-stats-json', '-serve-dump-trace=-',\n"
+        "          '-metrics-prom=-'])\n"
+        "assert rc == 0, f'exit {rc}'\n"
+        "assert out.getvalue(), 'no scrape output'\n"
+        "bad = [m for m in sys.modules if m == 'jax' "
+        "or m.startswith('jax.')]\n"
+        "assert not bad, f'jax imported on the scrape path: {bad[:3]}'\n"
+        "assert 'kafkabalancer_tpu.solvers.scan' not in sys.modules\n"
+        "assert 'numpy' not in sys.modules, 'numpy on the scrape path'\n"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        capture_output=True,
+        text=True,
+        timeout=120,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+
+
+def test_slow_request_autodump(sock_dir):
+    """-serve-slow-ms: a served request over the threshold auto-dumps a
+    Perfetto flight trace (request log riding in otherData) into the
+    daemon's flight dir, and the counter says so."""
+    from kafkabalancer_tpu import obs
+
+    sock = os.path.join(sock_dir, "kb.sock")
+    d = Daemon(
+        sock, idle_timeout=60.0, warm=False, log=lambda _m: None,
+        slow_ms=0.001, flight_dir=sock_dir,
+    )
+    rc_box = []
+    t = threading.Thread(
+        target=lambda: rc_box.append(d.serve_forever()), daemon=True
+    )
+    t.start()
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if sclient.daemon_alive(sock) is not None:
+            break
+        time.sleep(0.02)
+    else:
+        pytest.fail("daemon never became ready")
+    try:
+        rv, _out, _err = run_cli(
+            ["-input-json", f"-input={FIXTURE}", f"-serve-socket={sock}"]
+        )
+        assert rv == 0
+        dumps = [
+            f for f in os.listdir(sock_dir)
+            if f.startswith("kafkabalancer-flight-") and "slow-req" in f
+        ]
+        assert dumps, os.listdir(sock_dir)
+        with open(os.path.join(sock_dir, dumps[0])) as f:
+            doc = json.load(f)
+        assert doc["traceEvents"]
+        assert doc["otherData"]["requests"]
+        assert d.flight.stats()["autodumps"] >= 1
+        # the DURABLE outcome counter rides the scrape (daemon-lifetime
+        # field — the registry counter of the same name is wiped by the
+        # next request's begin_invocation in single-lane mode)
+        stats = sclient.fetch_stats(sock)
+        assert stats["slow_requests"] >= 1
+        assert stats["crashed_requests"] == 0
+        assert obs.REGISTRY.counter_get("serve.slow_requests") >= 1.0
+    finally:
+        sclient.request_shutdown(sock)
+        t.join(15)
+    assert rc_box == [0]
+
+
+def test_request_gauges_resnapshot_include_own_fusion(sock_dir):
+    """The PR-6 gap, fixed: a request's -metrics-json gauges are
+    re-snapshotted at EXPORT time, so its own fused dispatch shows in
+    its own serve.mb_occupancy_max — start-of-request snapshots could
+    never see it."""
+    sock = os.path.join(sock_dir, "kb.sock")
+    d = Daemon(
+        sock, idle_timeout=60.0, warm=False, log=lambda _m: None,
+        lanes=0, microbatch=4,
+    )
+    rc_box = []
+    t = threading.Thread(
+        target=lambda: rc_box.append(d.serve_forever()), daemon=True
+    )
+    t.start()
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if sclient.daemon_alive(sock) is not None:
+            break
+        time.sleep(0.02)
+    else:
+        pytest.fail("daemon never became ready")
+    try:
+        args = ["-input-json", f"-input={FIXTURE}", "-fused",
+                "-fused-batch=4", "-max-reassign=4"]
+        # warm request: compile + bucket affinity, before the held batch
+        rv0, _out0, _err0 = run_cli(args + [f"-serve-socket={sock}"])
+        assert rv0 == 0
+        sched = d._coalescer
+        sched._hold_window_s = 30.0
+        sched._hold_n = 2
+
+        lock = threading.Lock()
+        gauge_lines = []
+
+        def member(idx):
+            mpath = os.path.join(sock_dir, f"fusion-{idx}.json")
+            rv, _out, _err = run_cli(
+                args + [f"-serve-socket={sock}", f"-metrics-json={mpath}"]
+            )
+            with open(mpath) as f:
+                payload = json.load(f)
+            with lock:
+                gauge_lines.append((rv, payload["gauges"]))
+
+        threads = [
+            threading.Thread(target=member, args=(i,)) for i in range(2)
+        ]
+        for x in threads:
+            x.start()
+        for x in threads:
+            x.join(120)
+        assert len(gauge_lines) == 2
+        for rv, g in gauge_lines:
+            assert rv == 0
+            assert g["served"] is True
+            # EACH member's own line already shows the fusion it rode
+            assert g["serve.mb_occupancy_max"] >= 2.0, g
+    finally:
+        sclient.request_shutdown(sock)
+        t.join(15)
+    assert rc_box == [0]
