@@ -1094,6 +1094,60 @@ def main() -> None:
             f"{flagship_outliers}"
         )
 
+    # --- convergence profile: one more warm flagship run with the
+    # -explain recorder installed (obs/convergence.py) — the artifact
+    # gains the EXPLANATORY layer (moves-to-converge, unbalance
+    # improvement curve, masked-candidate totals) and the acceptance
+    # number: the explain overhead vs the warm median. Alternatives are
+    # disabled (alt_budget=0): the profile wants the trajectory, not
+    # per-move rankings, and the in-wall feeds stay O(1) appends.
+    from kafkabalancer_tpu.obs import convergence as _conv
+
+    _rec = _conv.ConvergenceRecorder(alt_budget=0)
+    _conv.install(_rec)
+    try:
+        _conv.clear_outcome()
+        pl, cfg = fresh(allow_leader=True)
+        _rec.attach(
+            pl, cfg, mode="fused", solver="tpu", engine=engine,
+            batch=batch, max_reassign=budget,
+        )
+        t0 = time.perf_counter()
+        plan(
+            pl, cfg, budget, batch=batch,
+            dtype=jnp.float32,  # jaxlint: disable=R4 — flagship throughput dtype
+            engine=engine, polish=True,
+        )
+        t_explain = time.perf_counter() - t0
+        explain_doc = _rec.finalize()
+    finally:
+        _conv.uninstall()
+        _conv.clear_outcome()
+    _curve = [m["unbalance_after"] for m in explain_doc["moves"]]
+    if len(_curve) > 64:  # decimate: the artifact wants the shape
+        _step = max(1, len(_curve) // 64)
+        _curve = _curve[::_step] + [_curve[-1]]
+    convergence_profile = {
+        "moves_to_converge": explain_doc["moves_emitted"],
+        "rounds": explain_doc["rounds"]["count"],
+        "unbalance_initial": explain_doc["unbalance_initial"],
+        "unbalance_final": explain_doc["unbalance_final"],
+        "improvement_curve": [float(f"{v:.6e}") for v in _curve],
+        "candidates_scored": explain_doc["candidates"]["scored"],
+        "masked_candidates": explain_doc["candidates"]["masked"],
+        "stop_reason": explain_doc["stop"].get("reason"),
+        "explain_converge_wall_s": round(t_explain, 4),
+        # the <5% acceptance number: recorder-on wall vs the warm median
+        "explain_overhead_frac": round(t_explain / t_tpu - 1.0, 4),
+    }
+    log(
+        f"convergence profile: {convergence_profile['moves_to_converge']} "
+        f"moves over {convergence_profile['rounds']} round(s), explain "
+        f"wall {t_explain:.3f}s "
+        f"({convergence_profile['explain_overhead_frac']:+.1%} vs warm "
+        f"median)"
+    )
+
     est_mid = t_move * max(1, n_ref)
     est_lo = greedy_times[0] * max(1, n_ref)
     est_hi = greedy_times[-1] * max(1, n_ref)
@@ -1154,6 +1208,10 @@ def main() -> None:
                     else None
                 ),
                 "flagship_warm_samples": [round(v, 4) for v in warm],
+                # the solver's explanatory layer (ISSUE 9): what the
+                # perf trajectory MEANS — moves-to-converge, the
+                # improvement curve, and which constraints masked what
+                "convergence_profile": convergence_profile,
                 **(
                     {
                         "flagship_outliers": [

@@ -33,6 +33,7 @@ from kafkabalancer_tpu.balancer.costmodel import (
 from kafkabalancer_tpu.models import Partition, PartitionList, RebalanceConfig
 from kafkabalancer_tpu.models.config import HOST_FLOAT_DTYPE
 from kafkabalancer_tpu.models.partition import single_partition_list
+from kafkabalancer_tpu.obs import convergence
 
 
 class BalanceError(Exception):
@@ -277,7 +278,99 @@ def greedy_move(
         p, r, b = best
         return replace_replica(p, r, b)
 
+    # the decline is the observable the metrics line lacked: a
+    # below-threshold exit vs a converged one vs an infeasible instance
+    # (convergence.note_outcome is a thread-local dict store — always
+    # on). Feasibility is deliberately NOT checked here: this decline
+    # fires on EVERY balance() call once a movable class converges
+    # (MoveLeaders keeps declining for the rest of a long per-move
+    # session), and an O(P) existence pass per call would tax the hot
+    # loop for a value only the FINAL decline's consumer needs — the
+    # CLI refines already_balanced → no_feasible_candidate lazily on
+    # zero-move exits (the feasible_unknown marker), and
+    # classify_no_move does the full job for the fused path.
+    if best is not None and cu < su:
+        convergence.note_outcome(
+            "below_threshold", unbalance=su, best_unbalance=cu,
+            min_unbalance=cfg.min_unbalance,
+        )
+    else:
+        convergence.note_outcome(
+            "already_balanced", unbalance=su,
+            min_unbalance=cfg.min_unbalance, feasible_unknown=True,
+        )
     return None
+
+
+def _any_feasible_candidate(
+    pl: PartitionList, cfg: RebalanceConfig, leaders: bool
+) -> bool:
+    """Cheap existence check: is there ANY (partition, movable replica,
+    target) the scan would score at all? Early-exits on the first hit
+    (the common case on any rebalanceable input); used only to
+    distinguish ``no_feasible_candidate`` from ``already_balanced`` on
+    declining calls."""
+    universe = set()
+    for p in pl.iter_partitions():
+        universe.update(p.replicas)
+    universe.update(cfg.brokers or [])
+    allowed_memo: dict = {}
+    for p in pl.iter_partitions():
+        if p.num_replicas < cfg.min_replicas_for_rebalancing:
+            continue
+        movable = p.replicas[0:1] if leaders else p.replicas[1:]
+        if not movable:
+            continue
+        key = id(p.brokers)
+        bset = allowed_memo.get(key)
+        if bset is None:
+            bset = allowed_memo[key] = universe.intersection(p.brokers or ())
+        if bset.difference(p.replicas):
+            return True
+    return False
+
+
+def classify_no_move(pl: PartitionList, cfg: RebalanceConfig) -> dict:
+    """Classify why no (further) move is available on the CURRENT state
+    — the fused session's host-side answer to the question its device
+    early-exit cannot report (the while_loop only says "no candidate
+    cleared the threshold", not which constraint was binding). Returns a
+    ``convergence.note_outcome``-shaped dict.
+
+    Cost: one vectorized :func:`scan_moves` pass (plus the leader pass
+    under ``allow_leader_rebalancing``) — run lazily: on zero-move
+    exits ONLY when a telemetry consumer exists
+    (-stats/-metrics-json/-explain; the CLI resolves the session's
+    ``classify_pending`` marker), and on ``-explain`` finalization.
+    Never per round, and never on the served steady state of a
+    converged cluster.
+    """
+    loads = get_broker_load(pl)
+    for bid in cfg.brokers or []:
+        if bid not in loads:
+            loads[bid] = 0.0
+    bl = get_bl(loads)
+    su = get_unbalance_bl(bl)
+    feasible = _any_feasible_candidate(pl, cfg, False) or (
+        cfg.allow_leader_rebalancing
+        and _any_feasible_candidate(pl, cfg, True)
+    )
+    if not feasible:
+        return {"reason": "no_feasible_candidate", "unbalance": su}
+    parts = list(pl.iter_partitions())
+    cu, best = su, None
+    if cfg.allow_leader_rebalancing:
+        cu, best, _ = scan_moves(parts, bl, cu, best, cfg, True)
+    cu, best, _ = scan_moves(parts, bl, cu, best, cfg, False)
+    if best is not None and cu < su:
+        return {
+            "reason": "below_threshold", "unbalance": su,
+            "best_unbalance": cu, "min_unbalance": cfg.min_unbalance,
+        }
+    return {
+        "reason": "already_balanced", "unbalance": su,
+        "min_unbalance": cfg.min_unbalance,
+    }
 
 
 def scan_partition_move(
@@ -373,6 +466,13 @@ def scan_moves(
     bl_bids = np.array([cell[0] for cell in bl], dtype=np.int64)
     bid_to_idx = {int(b): i for i, b in enumerate(bl_bids)}
 
+    # -explain candidate accounting (recorder installed on this thread
+    # only when the flag asked for it; a handful of integer adds here)
+    rec = convergence.recorder()
+    entry_cu = cu
+    n_scored = n_mask_allow = n_mask_member = n_mask_minrep = 0
+    n_improving = n_clearing = 0
+
     # -- enumerate candidates (the scalar scan's exact order) -------------
     src_l: List[np.ndarray] = []
     tgt_l: List[np.ndarray] = []
@@ -381,18 +481,24 @@ def scan_moves(
     r_l: List[np.ndarray] = []
     allowed_memo: dict = {}  # brokers-list identity -> bl eligibility mask
     for pos, p in enumerate(parts):
-        if p.num_replicas < cfg.min_replicas_for_rebalancing:
-            continue
         movable = p.replicas[0:1] if leaders else p.replicas[1:]
+        if p.num_replicas < cfg.min_replicas_for_rebalancing:
+            if rec is not None:
+                n_mask_minrep += len(movable) * nb
+            continue
         if not movable:
             continue
         am = allowed_memo.get(id(p.brokers))
         if am is None:
             am = np.isin(bl_bids, np.asarray(list(p.brokers), dtype=np.int64))
             allowed_memo[id(p.brokers)] = am
-        elig = np.nonzero(
-            am & ~np.isin(bl_bids, np.asarray(p.replicas, dtype=np.int64))
-        )[0]
+        mem = np.isin(bl_bids, np.asarray(p.replicas, dtype=np.int64))
+        elig = np.nonzero(am & ~mem)[0]
+        if rec is not None:
+            n_mov = len(movable)
+            n_mask_allow += n_mov * int((~am).sum())
+            n_mask_member += n_mov * int((am & mem).sum())
+            n_scored += n_mov * len(elig)
         for r in movable:
             ridx = bid_to_idx.get(r)
             if ridx is None:
@@ -408,6 +514,10 @@ def scan_moves(
             pos_l.append(np.full(n, pos, dtype=np.int64))
             r_l.append(np.full(n, r, dtype=np.int64))
     if not tgt_l:
+        if rec is not None:
+            rec.note_scan(
+                n_scored, n_mask_allow, n_mask_member, n_mask_minrep
+            )
         return cu, best, -1
     src = np.concatenate(src_l)
     tgt = np.concatenate(tgt_l)
@@ -434,6 +544,11 @@ def scan_moves(
                 rel = mat[:, j] / avg - 1.0
                 sq = rel * rel
                 u = u + np.where(rel > 0, sq, sq / 2)
+        if rec is not None:
+            # threshold accounting: improving candidates that do not
+            # clear min_unbalance are "masked by the threshold"
+            n_improving += int(np.sum(u < entry_cu))
+            n_clearing += int(np.sum(u < entry_cu - cfg.min_unbalance))
         finite = u[~np.isnan(u)]
         if finite.size == 0:
             continue  # all-NaN objectives never beat cu (NaN < cu is False)
@@ -442,6 +557,9 @@ def scan_moves(
             cu = mn
             k = lo + int(np.flatnonzero(u == mn)[0])
             winner = k
+    if rec is not None:
+        rec.note_scan(n_scored, n_mask_allow, n_mask_member, n_mask_minrep)
+        rec.note_scores(n_improving, n_clearing)
     if winner < 0:
         return cu, best, -1
     pos = int(ppos[winner])
@@ -470,6 +588,10 @@ def distribute_leaders(
 
     su = get_unbalance_bl(bl)
     if su < cfg.min_unbalance:
+        convergence.note_outcome(
+            "below_threshold", unbalance=su,
+            min_unbalance=cfg.min_unbalance,
+        )
         return None
 
     heavy = bl[-1][0]
@@ -479,6 +601,7 @@ def distribute_leaders(
             continue
         return replace_replica(p, p.replicas[0], bl[0][0])
 
+    convergence.note_outcome("no_feasible_candidate", unbalance=su)
     return None
 
 

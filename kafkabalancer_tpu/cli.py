@@ -35,6 +35,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 from kafkabalancer_tpu import obs
+from kafkabalancer_tpu.obs import convergence
 from kafkabalancer_tpu.balancer import BalanceError, balance
 from kafkabalancer_tpu.codecs import (
     CodecError,
@@ -86,13 +87,24 @@ def apply_assignment(pl: PartitionList, changed: Partition) -> Partition:
     if src is not None:
         for p in pl.iter_partitions():
             if p is src:
-                p.replicas[:] = changed.replicas
-                return p
+                return _apply_replicas(p, changed)
     for p in pl.iter_partitions():
         if p.compare(changed):
-            p.replicas[:] = changed.replicas
-            return p
+            return _apply_replicas(p, changed)
     raise BalanceError(f"changed partition {changed} not in input list")
+
+
+def _apply_replicas(p: Partition, changed: Partition) -> Partition:
+    """The one mutation point for per-move/repair changes — also the
+    ``-explain`` provenance hook: with a convergence recorder installed
+    on this thread, the old/new replica lists are captured around the
+    write (O(1); scoring happens at finalize, never here)."""
+    rec = convergence.recorder()
+    old = list(p.replicas) if rec is not None else None
+    p.replicas[:] = changed.replicas
+    if rec is not None:
+        rec.record_change(p, old, list(p.replicas), origin="step")
+    return p
 
 
 class _TelemetryFlags:
@@ -207,8 +219,11 @@ _NO_FORWARD_FLAGS = frozenset((
     "no-daemon", "help", "pprof", "pprof-path", "jax-profile", "input",
 ))
 # flags whose value names a filesystem path the DAEMON will write — made
-# absolute against the client's cwd ("-" = stdout stays as-is)
-_PATH_VALUE_FLAGS = frozenset(("metrics-json", "trace"))
+# absolute against the client's cwd ("-" = stdout stays as-is). -explain
+# forwards like any other flag: the daemon writes the document (or
+# appends it to the relayed stdout with "-") and the plan bytes are
+# pinned unchanged either way.
+_PATH_VALUE_FLAGS = frozenset(("metrics-json", "trace", "explain"))
 
 
 def _forward_argv(f: FlagSet) -> List[str]:
@@ -361,6 +376,7 @@ def _run_impl(
     log = logger.printf
     profiler = None
     jaxprof = None
+    explain_installed = False
 
     try:
         defaults = default_rebalance_config()
@@ -509,6 +525,16 @@ def _run_impl(
             "this path (one track per thread; overlay with the "
             "-jax-profile device trace)",
         )
+        f_explain = f.string(
+            "explain",
+            "",
+            "Write a schema-versioned plan-explanation document "
+            "(kafkabalancer-tpu.explain/1) to this path ('-' = stdout, "
+            "after the plan): per-move provenance (loads before/after, "
+            "oracle-exact score deltas, top-k alternatives), "
+            "masked-candidate breakdown, and an explicit no-move reason; "
+            "a human summary prints to stderr (docs/observability.md)",
+        )
         f_serve = f.bool(
             "serve",
             False,
@@ -586,7 +612,7 @@ def _run_impl(
             "serve-stats-json",
             False,
             "Scrape a live daemon's telemetry as one line of "
-            "schema-versioned JSON (kafkabalancer-tpu.serve-stats/1)",
+            "schema-versioned JSON (kafkabalancer-tpu.serve-stats/2)",
         )
         f_serve_dump_trace = f.string(
             "serve-dump-trace",
@@ -973,6 +999,28 @@ def _run_impl(
 
         log(f"rebalance config: {_fmt_cfg(cfg)}")
 
+        # the outcome slot must be fresh per invocation: in the daemon a
+        # request thread is reused, and a stale decline must not leak
+        # into this invocation's plan.no_move_reason gauge
+        convergence.clear_outcome()
+        explain_rec: Optional[convergence.ConvergenceRecorder] = None
+        if f_explain.value != "":
+            explain_rec = convergence.ConvergenceRecorder()
+            convergence.install(explain_rec)
+            explain_installed = True
+            explain_rec.attach(
+                pl, cfg,
+                mode=(
+                    "fused-shard" if f_shard.value
+                    else "fused" if f_fused.value
+                    else "per-move"
+                ),
+                solver=f_solver.value,
+                engine=f_engine.value if f_fused.value else None,
+                batch=f_batch.value if f_fused.value else None,
+                max_reassign=f_max.value,
+            )
+
         if f_jaxprof.value:
             import jax
 
@@ -1102,16 +1150,35 @@ def _run_impl(
                     apply_assignment(pl, changed) for changed in ppl.partitions
                 ]
                 obs.metrics.count("cli.moves", len(lives))
+                # outcome epoch: a successful iteration clears the slot,
+                # so only the FINAL (declining) balance call's reason
+                # survives as the plan's stop/no-move gauge — an earlier
+                # step's decline (MoveLeaders passing to MoveNonLeaders)
+                # must not masquerade as the stop reason
+                convergence.clear_outcome()
 
                 if not completing:
                     opl.append(*lives)
                 else:
                     stop = False
-                    for changed, live in zip(ppl.partitions, lives):
+                    for idx, (changed, live) in enumerate(
+                        zip(ppl.partitions, lives)
+                    ):
                         if c_partition.compare(changed):
                             opl.append(live)
                         else:
                             log(f"Partition {changed} did not compare.")
+                            if explain_rec is not None:
+                                # the probe move WAS applied to the live
+                                # list (reference aliasing) but stays
+                                # out of the plan: flag it — and any
+                                # applied-after peers — so the explain
+                                # document's emitted count matches the
+                                # plan (applied count keeps the
+                                # trajectory replay exact)
+                                explain_rec.mark_last_unemitted(
+                                    len(lives) - idx
+                                )
                             stop = True
                             break
                     if stop:
@@ -1128,6 +1195,65 @@ def _run_impl(
                         c_partition = ppl.partitions[-1]
                         completing = True
                         log(f"Forcing complete of Partition: {c_partition}")
+
+        # --- plan outcome attribution (plan.stop_reason /
+        # plan.no_move_reason gauges): the solver steps note WHY they
+        # declined (obs/convergence.py outcome slot); surface it so a
+        # below-threshold exit is distinguishable from a converged one
+        # in -stats and -metrics-json (docs/observability.md)
+        n_planned = len(opl)
+        outcome = convergence.last_outcome()
+        if outcome is None:
+            stop_reason = (
+                "no_budget" if f_max.value == 0
+                else "budget_exhausted" if n_planned else "converged"
+            )
+        else:
+            stop_reason = str(outcome.get("reason", "converged"))
+            if outcome.get("classify_pending") and (
+                tel.any() or explain_rec is not None
+            ):
+                # the fused session deferred the zero-move
+                # classification (scan.py _note_session_outcome):
+                # resolve it ONCE, and only because a telemetry
+                # consumer exists — the served steady state of a
+                # converged cluster must not pay a host candidate scan
+                # per request for gauges nobody exports
+                from kafkabalancer_tpu.balancer.steps import (
+                    classify_no_move,
+                )
+
+                refined = classify_no_move(pl, cfg)
+                stop_reason = str(refined["reason"])
+                convergence.note_outcome(**refined)
+                outcome = convergence.last_outcome()
+            if outcome.get("feasible_unknown") and n_planned == 0:
+                # lazy feasibility refinement: the per-move decline
+                # sites note cheaply (they fire every iteration); the
+                # O(P) existence pass runs ONCE, here, and only for the
+                # zero-move exit where the distinction matters
+                from kafkabalancer_tpu.balancer.steps import (
+                    _any_feasible_candidate,
+                )
+
+                feasible = _any_feasible_candidate(pl, cfg, False) or (
+                    cfg.allow_leader_rebalancing
+                    and _any_feasible_candidate(pl, cfg, True)
+                )
+                if not feasible:
+                    stop_reason = "no_feasible_candidate"
+                detail = {
+                    k: v for k, v in outcome.items()
+                    if k not in ("reason", "feasible_unknown")
+                }
+                if stop_reason == "no_feasible_candidate":
+                    detail.pop("min_unbalance", None)
+                convergence.note_outcome(stop_reason, **detail)
+        obs.metrics.gauge("plan.stop_reason", stop_reason)
+        tel.attrs.setdefault("plan.stop_reason", stop_reason)
+        if n_planned == 0:
+            obs.metrics.gauge("plan.no_move_reason", stop_reason)
+            tel.attrs.setdefault("plan.no_move_reason", stop_reason)
 
         if jaxprof is not None:
             jaxprof.profiler.stop_trace()
@@ -1151,8 +1277,38 @@ def _run_impl(
                 log(f"failed writing partition list: {exc}")
                 return 4
 
+        if explain_rec is not None:
+            # the explain document rides AFTER the plan (the plan's
+            # bytes are pinned unchanged); the replay/ranking work all
+            # happens here in finalize, outside the converge wall
+            import json as json_mod
+
+            with obs.span("explain"):
+                explain_doc = explain_rec.finalize()
+            line = json_mod.dumps(
+                explain_doc, sort_keys=True, separators=(",", ":"),
+                default=str,
+            ) + "\n"
+            if f_explain.value == "-":
+                o.write(line)
+            else:
+                try:
+                    with open(f_explain.value, "w") as fh:
+                        fh.write(line)
+                except OSError as exc:
+                    log(
+                        "failed writing explain document to "
+                        f"{f_explain.value}: {exc}"
+                    )
+                    return 4
+            be.write(convergence.render_explain(explain_doc))
+
         return 0
     finally:
+        if explain_installed:
+            # never leak a recorder into the next request on this
+            # thread (daemon request threads are reused)
+            convergence.uninstall()
         if jaxprof is not None:  # early-return path with an active trace
             try:
                 jaxprof.profiler.stop_trace()

@@ -163,6 +163,13 @@ def render_stats(
         lines.append(f"  counter {name}: {snap['counters'][name]:g}")
     for name in sorted(snap["gauges"]):
         lines.append(f"  gauge {name}: {snap['gauges'][name]}")
+    # streaming histograms (process-lifetime: AOT compile/deserialize
+    # walls in any process, the serve.phase.* chain inside a daemon)
+    for name, h in registry.hist_snapshot().items():
+        lines.append(
+            f"  hist {name}: n={h['count']} p50={h['p50']:.4g} "
+            f"p95={h['p95']:.4g} p99={h['p99']:.4g}"
+        )
     n_ev = len(snap["events"])
     if n_ev:
         shown = snap["events"][-5:]
@@ -233,6 +240,30 @@ def render_prometheus(doc: Dict[str, Any]) -> str:
                 m = _prom_name(f"tensorize_cache_{key}")
                 lines.append(f"# TYPE {m} counter")
                 lines.append(f"{m} {_prom_value(cache[key])}")
+    # per-lane device-memory attribution (the stats doc's "memory"
+    # block): one labeled gauge per lane so a scraper can chart HBM
+    # live bytes and residency-pool bytes per device
+    mem = doc.get("memory")
+    if isinstance(mem, list):
+        samples: Dict[str, List[str]] = {}
+        for entry in mem:
+            if not isinstance(entry, dict):
+                continue
+            lane = entry.get("lane", 0)
+            for key in (
+                "hbm_bytes_in_use", "hbm_bytes_limit",
+                "residency_bytes", "residency_entries",
+            ):
+                v = entry.get(key)
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    continue
+                samples.setdefault(_prom_name(f"lane_{key}"), []).append(
+                    f'{{lane="{lane}"}} {_prom_value(v)}'
+                )
+        for m in sorted(samples):
+            lines.append(f"# TYPE {m} gauge")
+            for s in samples[m]:
+                lines.append(f"{m}{s}")
     for name, h in sorted(doc.get("hists", {}).items()):
         if not isinstance(h, dict):
             continue
@@ -275,6 +306,21 @@ def render_serve_stats(doc: Dict[str, Any]) -> str:
             f"  tensorize cache: {cache.get('hits', 0)} hits / "
             f"{cache.get('misses', 0)} misses"
         )
+    mem = doc.get("memory")
+    if isinstance(mem, list):
+        for entry in mem:
+            if not isinstance(entry, dict):
+                continue
+            hbm = entry.get("hbm_bytes_in_use")
+            hbm_s = (
+                f"{hbm / 1e6:.1f}MB" if isinstance(hbm, (int, float))
+                and not isinstance(hbm, bool) else "n/a"
+            )
+            lines.append(
+                f"  memory lane{entry.get('lane', 0)}: hbm {hbm_s}, "
+                f"residency {entry.get('residency_bytes', 0) / 1e6:.1f}MB "
+                f"({entry.get('residency_entries', 0)} entries)"
+            )
     for name, h in sorted(doc.get("hists", {}).items()):
         if not isinstance(h, dict):
             continue

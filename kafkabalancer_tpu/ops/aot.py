@@ -964,6 +964,10 @@ def try_load(
         dt = time.perf_counter() - t0
         obs.metrics.phase_set(name, "load_s", dt)
         obs.metrics.phase_set(name, "blob_mb", len(blob) / 1e6)
+        # streaming distribution of blob-read + deserialize wall — the
+        # device-residency cost a daemon pays per (program, lane); rides
+        # the stats scrape / -metrics-prom (docs/observability.md)
+        obs.metrics.hist_observe("aot.deserialize_s", dt)
         obs.metrics.count("aot.loads")
         _log(f"load {name} {len(blob) / 1e6:.1f}MB {dt:.2f}s")
         return compiled
@@ -1149,7 +1153,13 @@ def maybe_save(
         from jax.experimental.serialize_executable import serialize
 
         with obs.span("aot.save", parent=trace_parent, program=name):
+            t0 = time.perf_counter()
             compiled = fn.lower(*args, **statics).compile()
+            # the real AOT compile wall (lower+compile, store-keyed
+            # separately from the jit call path) as a streaming hist
+            obs.metrics.hist_observe(
+                "aot.compile_s", time.perf_counter() - t0
+            )
             blob, _in_tree, _out_tree = serialize(compiled)
             path = _write_blob(
                 d, key, name, _key_parts(name, args, statics), blob,
@@ -1298,6 +1308,9 @@ def call_or_compile(
             out = fn(*args, **statics)
     jit_s = time.perf_counter() - t0
     obs.metrics.phase_set(name, "jit_s", jit_s)
+    # jit-dispatch wall (trace + compile-or-cache-hit + execute): the
+    # distribution companion of aot.compile_s for the non-AOT path
+    obs.metrics.hist_observe("aot.jit_s", jit_s)
     obs.metrics.count("aot.jit_dispatches")
     _log(f"jit-path {name} {jit_s:.2f}s")
     save_async(name, fn, args, statics)

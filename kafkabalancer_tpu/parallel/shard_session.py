@@ -539,11 +539,13 @@ def plan_sharded(
     from kafkabalancer_tpu.ops import tensorize
     from kafkabalancer_tpu.ops.runtime import next_bucket
 
+    from kafkabalancer_tpu.obs import convergence
     from kafkabalancer_tpu.solvers.scan import (
         _cfg_broker_mask,
         _decode_packed,
         _dev_cached_asarray,
         _dispatch_chunk,
+        _note_session_outcome,
         _pack_log,
         _prep_from_dp,
         _settle_head,
@@ -684,6 +686,13 @@ def plan_sharded(
             _prep_from_dp(dp, dtype, dev_cache=dev_cache)
         )
         chunk = min(remaining, chunk_moves)
+        _conv_rec = convergence.recorder()
+        if _conv_rec is not None:
+            # -explain candidate-space stats (same dense encoding the
+            # sharded round scores; one numpy pass, no device sync)
+            _conv_rec.note_round(
+                dp, cfg, chunk=chunk, engine=f"shard-{engine}"
+            )
         if anti_colocation:
             # same topic-count bucketing as plan(): compiled programs
             # survive topic-cardinality drift
@@ -816,4 +825,5 @@ def plan_sharded(
         remaining -= n
         if n < chunk:
             break
+    _note_session_outcome(pl, cfg, opl, remaining)
     return opl

@@ -61,6 +61,7 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 from kafkabalancer_tpu import __version__, obs
 from kafkabalancer_tpu.obs.flight import PHASE_OF_SPAN, FlightRecorder
 from kafkabalancer_tpu.obs.trace import Span
+from kafkabalancer_tpu.serve.devmem import device_memory_stats
 from kafkabalancer_tpu.serve.protocol import (
     PROTO_VERSION,
     STATS_SCHEMA,
@@ -475,7 +476,18 @@ class Daemon:
                 "serve.mb_padded_slots": s["padded_slots"],
                 "serve.residency_hits": s["residency_hits"],
                 "serve.cache_hits": s["cache_hits"],
+                "serve.residency_bytes": float(
+                    lane.stage_cache.device_bytes()
+                ),
             })
+            hbm0 = (
+                device_memory_stats(lane.device)
+                if lane.device is not None else None
+            )
+            if hbm0 is not None and "bytes_in_use" in hbm0:
+                attrs["serve.hbm_bytes_in_use"] = float(
+                    hbm0["bytes_in_use"]
+                )
         else:
             attrs["serve.lanes"] = 1.0
             attrs["serve.residency_hits"] = 0.0
@@ -494,11 +506,23 @@ class Daemon:
             if lane is None or not hasattr(sched2, "stats"):
                 return {}
             s2 = sched2.stats()
-            return {
+            out2 = {
                 "serve.mb_occupancy_max": s2["occupancy_max"],
                 "serve.mb_padded_slots": s2["padded_slots"],
                 "serve.residency_hits": s2["residency_hits"],
+                "serve.residency_bytes": float(
+                    lane.stage_cache.device_bytes()
+                ),
             }
+            hbm2 = (
+                device_memory_stats(lane.device)
+                if lane.device is not None else None
+            )
+            if hbm2 is not None and "bytes_in_use" in hbm2:
+                out2["serve.hbm_bytes_in_use"] = float(
+                    hbm2["bytes_in_use"]
+                )
+            return out2
 
         i = io.StringIO(req.stdin or "")
         out, err = io.StringIO(), io.StringIO()
@@ -743,6 +767,45 @@ class Daemon:
         obs.metrics.count("serve.staged_requests")
         obs.metrics.gauge("serve.last_staged_arrays", float(staged))
 
+    def _memory_snapshot(self) -> List[Dict[str, Any]]:
+        """Per-lane device-memory attribution: HBM live bytes (via the
+        jax-free-safe ``serve.devmem`` seam — null until the backend has
+        attached, and on backends without memory introspection) plus
+        the residency pool's device bytes. One entry per lane; the
+        single-lane Coalescer reports lane 0 with no pool."""
+        out: List[Dict[str, Any]] = []
+        if self._lanes:
+            for ln in self._lanes:
+                # a device-less lane must not fall into the no-device
+                # query (which could block on a backend attach)
+                hbm = (
+                    device_memory_stats(ln.device)
+                    if ln.device is not None else None
+                ) or {}
+                out.append({
+                    "lane": ln.index,
+                    "hbm_bytes_in_use": hbm.get("bytes_in_use"),
+                    "hbm_bytes_limit": hbm.get("bytes_limit"),
+                    "residency_bytes": ln.stage_cache.device_bytes(),
+                    "residency_entries": len(ln.stage_cache),
+                })
+        else:
+            # no-device query ONLY once the backend is known-attached:
+            # during the warm window jax may be imported but unattached,
+            # and jax.devices() would block this (connection) thread on
+            # the attach — hello must keep answering instantly
+            hbm = (
+                device_memory_stats() if self._warm_done.is_set() else None
+            ) or {}
+            out.append({
+                "lane": 0,
+                "hbm_bytes_in_use": hbm.get("bytes_in_use"),
+                "hbm_bytes_limit": hbm.get("bytes_limit"),
+                "residency_bytes": 0,
+                "residency_entries": 0,
+            })
+        return out
+
     def _core_snapshot(self) -> Dict[str, Any]:
         """The ONE daemon-state snapshot both ``hello`` and ``stats``
         render from — the two scrape paths cannot drift (the satellite
@@ -762,6 +825,7 @@ class Daemon:
             "slow_requests": slow,
             "crashed_requests": crashed,
             "cache": self.tensorize_cache.stats(),
+            "memory": self._memory_snapshot(),
         }
         sched = self._coalescer
         if self._lanes and hasattr(sched, "stats"):

@@ -1,0 +1,60 @@
+"""Device-memory attribution behind a jax-free-safe seam.
+
+The serving daemon wants per-lane HBM gauges (how many live bytes do the
+resident executables + residency pool hold?) in ``hello``/``stats``/
+``-metrics-prom`` — but those scrape paths answer on connection threads
+that may run BEFORE the backend warm thread has imported jax, and a
+scrape must never pay (or block on) a backend attach. The seam:
+:func:`device_memory_stats` only queries a device when jax is ALREADY
+imported in this process (``sys.modules`` check — importing jax here is
+forbidden), and degrades to ``None`` on backends that expose no memory
+introspection (XLA:CPU returns nothing useful; TPU/GPU report
+``bytes_in_use``/``bytes_limit``).
+
+Lives under ``serve/`` (not ``ops/``) deliberately: the ``ops`` package
+``__init__`` imports the jax cost model, and this module must be
+importable by the daemon BEFORE its warm thread pays the backend
+attach.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, Dict, Optional
+
+# the memory_stats keys worth exporting, when the backend reports them
+_KEYS = ("bytes_in_use", "bytes_limit", "peak_bytes_in_use")
+
+
+def device_memory_stats(device: Any = None) -> Optional[Dict[str, int]]:
+    """Live memory stats for ``device`` (default: device 0), or None.
+
+    None means "not knowable right now": jax not yet imported (the
+    jax-free-safe contract — this function NEVER triggers the import),
+    no device, or a backend without memory introspection. Never raises.
+
+    CALLER CONTRACT for ``device=None``: only call once the backend is
+    known-attached (the daemon gates on its warm-done latch) —
+    ``jax.devices()`` on a merely-imported jax would BLOCK the calling
+    thread on the backend attach, exactly the stall the scrape paths
+    must never pay (a hello during the warm window would stop
+    answering). An explicit ``device`` is always safe: holding the
+    object means someone already paid the attach.
+    """
+    if "jax" not in sys.modules:
+        return None
+    try:
+        import jax  # already imported per the guard above
+
+        dev = device if device is not None else jax.devices()[0]
+        stats = dev.memory_stats()
+        if not stats:
+            return None
+        out: Dict[str, int] = {}
+        for key in _KEYS:
+            v = stats.get(key)
+            if isinstance(v, int) and not isinstance(v, bool):
+                out[key] = v
+        return out or None
+    except Exception:
+        return None

@@ -43,8 +43,9 @@ from typing import Any, Dict, Optional
 PROTO_VERSION = 1
 
 # the stats scrape document's schema id — versioned independently of the
-# wire protocol (adding a scrape field bumps this, not PROTO_VERSION)
-STATS_SCHEMA_VERSION = 1
+# wire protocol (adding a scrape field bumps this, not PROTO_VERSION).
+# v2: + "memory" (per-lane HBM/residency-pool attribution)
+STATS_SCHEMA_VERSION = 2
 STATS_SCHEMA = f"kafkabalancer-tpu.serve-stats/{STATS_SCHEMA_VERSION}"
 
 # a frame larger than this is a protocol error, not a payload: the

@@ -168,6 +168,20 @@ class ResidencyPool:
             del self._entries[key]
             self.evictions += 1
 
+    def device_bytes(self) -> int:
+        """Total device bytes held by pooled entries — the
+        residency-pool half of the per-lane memory attribution
+        (``hello``/``stats``/``-metrics-prom``). Keys carry the host
+        array's (shape, dtype) so jax-array ``nbytes`` is exact; opaque
+        test buffers without ``nbytes`` count 0."""
+        with self._lock:
+            total = 0
+            for buf in self._entries.values():
+                n = getattr(buf, "nbytes", 0)
+                if isinstance(n, int):
+                    total += n
+            return total
+
     def stats(self) -> Dict[str, int]:
         with self._lock:
             return {

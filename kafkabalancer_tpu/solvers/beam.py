@@ -688,13 +688,28 @@ def beam_move(
     depth 1, which can only yield an improving move or nothing."""
     from kafkabalancer_tpu.balancer import costmodel
     from kafkabalancer_tpu.balancer.steps import replace_replica
+    from kafkabalancer_tpu.obs import convergence
+
+    def _decline() -> None:
+        # the stop-reason observable (plan.stop_reason /
+        # plan.no_move_reason): beam's search does not expose a
+        # below-threshold-vs-balanced split, so the note is the generic
+        # "converged" and feasibility is refined lazily by the CLI —
+        # without this, a converged beam plan fell through to the
+        # budget_exhausted fallback heuristic
+        convergence.note_outcome(
+            "converged", min_unbalance=cfg.min_unbalance,
+            feasible_unknown=True,
+        )
 
     for depth in (int(cfg.beam_depth), 1):
         found = _search_once(pl, cfg, depth=depth)
         if found is None:
+            _decline()
             return None
         dp, seq = found
         if not seq:
+            _decline()
             return None
         p_row, slot, t_dense = seq[0]
         part = dp.partitions[p_row]

@@ -44,6 +44,7 @@ from functools import partial
 from typing import Any, List, Optional, Tuple
 
 from kafkabalancer_tpu import obs
+from kafkabalancer_tpu.obs import convergence
 from kafkabalancer_tpu.models import Partition, PartitionList, RebalanceConfig
 from kafkabalancer_tpu.models.config import (
     ENGINES,
@@ -1169,6 +1170,7 @@ def _decode_packed(
     mslot = packed[ml : ml + n]
     mtgt = packed[2 * ml : 2 * ml + n]
     keep = _superseded_mask(mp, mslot) if drop_superseded else None
+    rec = convergence.recorder()  # -explain provenance (thread-local)
     emitted = 0
     for i in range(n):
         part = dp.partitions[int(mp[i])]
@@ -1181,12 +1183,17 @@ def _decode_packed(
             # broker is a net no-op — emitting it would burn a real
             # reassignment cycle on zero data movement
             continue
+        old = list(part.replicas) if rec is not None else None
         if slot == SWAP_SLOT:
             j = part.replicas.index(tgt)
             part.replicas[j] = part.replicas[0]
             part.replicas[0] = tgt
         else:
             part.replicas[slot] = tgt
+        if rec is not None:
+            # O(1) append; the trajectory replay happens at finalize,
+            # never inside the converge wall
+            rec.record_change(part, old, list(part.replicas), "session")
         opl.append(part)
         emitted += 1
     # committed vs emitted is the churn-elision attribution (-stats):
@@ -1296,6 +1303,9 @@ def _leader_plan(
             dp = tensorize(pl, cfg)
         all_allowed = all_allowed_of(dp)
         chunk = min(remaining, chunk_moves)
+        rec = convergence.recorder()
+        if rec is not None:
+            rec.note_round(dp, cfg, chunk=chunk, engine="leader")
         packed = _dispatch_chunk(
             dp, cfg, chunk, dtype, batch, "xla",
             polish=False, leader=True, all_allowed=all_allowed,
@@ -1305,7 +1315,43 @@ def _leader_plan(
         remaining -= n
         if n < chunk:
             break
+    _note_leader_outcome(pl, cfg, opl, remaining)
     return opl
+
+
+def _note_leader_outcome(
+    pl: PartitionList, cfg: RebalanceConfig, opl: PartitionList,
+    remaining: int,
+) -> None:
+    """Outcome note for the fused leader session (the reference's
+    ``distributeLeaders`` gate semantics, steps.go:249-253: it bails
+    outright when total unbalance is below ``min_unbalance``)."""
+    if opl.partitions:
+        convergence.note_outcome(
+            "budget_exhausted" if remaining <= 0 else "converged"
+        )
+        return
+    from kafkabalancer_tpu.balancer.costmodel import (
+        get_bl,
+        get_broker_load,
+        get_unbalance_bl,
+    )
+
+    loads = get_broker_load(pl)
+    for bid in cfg.brokers or []:
+        if bid not in loads:
+            loads[bid] = 0.0
+    su = get_unbalance_bl(get_bl(loads))
+    if su != su:  # NaN objective (all-zero loads): Go's no-candidate exit
+        convergence.note_outcome("already_balanced", unbalance=su)
+    elif su < cfg.min_unbalance:
+        convergence.note_outcome(
+            "below_threshold", unbalance=su,
+            min_unbalance=cfg.min_unbalance,
+        )
+    else:
+        convergence.note_outcome("no_feasible_candidate", unbalance=su)
+    return
 
 
 def resolve_engine(engine: str) -> str:
@@ -1504,6 +1550,12 @@ def plan(
         # kernel mode stores no [P, B] matrix and has a far higher ceiling)
         all_allowed = all_allowed_of(dp)
         chunk = min(remaining, chunk_moves)
+        rec = convergence.recorder()
+        if rec is not None:
+            # -explain candidate-space stats, from the dense encoding
+            # this round already materialized (one numpy pass, no
+            # device sync)
+            rec.note_round(dp, cfg, chunk=chunk, engine=engine)
         if engine == "pallas" and not pallas_session_fits(
             dp, dtype, all_allowed, cfg.allow_leader_rebalancing,
             next_bucket(chunk, 128),
@@ -1595,4 +1647,29 @@ def plan(
         remaining -= n
         if n < chunk:
             break
+    _note_session_outcome(pl, cfg, opl, remaining)
     return opl
+
+
+def _note_session_outcome(
+    pl: PartitionList, cfg: RebalanceConfig, opl: PartitionList,
+    remaining: int,
+) -> None:
+    """Record WHY the fused session stopped (the convergence outcome
+    slot behind the ``plan.stop_reason``/``plan.no_move_reason``
+    gauges). The device early-exit only says "no candidate cleared the
+    threshold"; WHICH constraint was binding takes a host
+    ``steps.classify_no_move`` scan, so zero-move exits note a
+    ``classify_pending`` marker instead of paying it here — the CLI
+    resolves it ONCE, and only when a telemetry consumer exists
+    (-stats/-metrics-json/-explain). A converged cluster's served
+    steady state is exactly a stream of zero-move requests; an
+    unconditional full candidate scan per request would tax it for
+    telemetry nobody asked for. ``-explain`` refines converged-with-
+    moves runs at finalize (outside the converge wall)."""
+    if not opl.partitions:
+        convergence.note_outcome("converged", classify_pending=True)
+    elif remaining <= 0:
+        convergence.note_outcome("budget_exhausted")
+    else:
+        convergence.note_outcome("converged")

@@ -76,6 +76,7 @@ import jax.numpy as jnp  # noqa: E402
 
 from kafkabalancer_tpu.balancer import costmodel  # noqa: E402
 from kafkabalancer_tpu.balancer.steps import greedy_move, replace_replica  # noqa: E402
+from kafkabalancer_tpu.obs import convergence  # noqa: E402
 from kafkabalancer_tpu.ops import cost, tensorize  # noqa: E402
 from kafkabalancer_tpu.ops.tensorize import DensePlan, all_allowed_of  # noqa: E402
 
@@ -316,6 +317,12 @@ def find_best_move(
     )
     statics = dict(leaders=leaders, all_allowed=all_allowed)
 
+    rec = convergence.recorder()
+    if rec is not None:
+        # -explain candidate-space stats from the dense encoding this
+        # pass already built (one numpy pass, no device sync)
+        rec.note_round(dp, cfg, chunk=1, engine="tpu-score")
+
     # --- tiered device scoring: f32 filter, f64 on window overflow -------
     # The device pass only FILTERS candidates; acceptance and ordering are
     # decided by the host-exact oracle rescan below, so precision buys
@@ -346,6 +353,11 @@ def find_best_move(
             # underflow the f32 cast to a spurious 0/0 NaN, and the
             # pre-tiering scorer (always f64) handled such inputs
             if npdt is np.float64:  # jaxlint: disable=R4 — tier ladder
+                convergence.note_outcome(
+                    "no_feasible_candidate" if np.isinf(u_min)
+                    else "already_balanced",
+                    unbalance=float(su_dev),
+                )
                 return None
             continue
         # window tolerance = a sound bound on the tier's perpart error
@@ -380,6 +392,8 @@ def find_best_move(
             break
     if rows is None:
         raise TieOverflow
+    if rec is not None:
+        rec.note_tie_window(int(len(rows)))
 
     # replay the ORACLE's own per-partition scan over just the flagged
     # rows — same bl table, same candidate order, same
@@ -394,6 +408,18 @@ def find_best_move(
     best_row = int(rows[wpos]) if wpos >= 0 else -1
 
     if best is None or not (cu < su - cfg.min_unbalance):
+        # the decline classification the metrics line surfaces as
+        # plan.no_move_reason (see balancer/steps.greedy_move)
+        if best is not None and cu < su:
+            convergence.note_outcome(
+                "below_threshold", unbalance=su, best_unbalance=cu,
+                min_unbalance=cfg.min_unbalance,
+            )
+        else:
+            convergence.note_outcome(
+                "already_balanced", unbalance=su,
+                min_unbalance=cfg.min_unbalance,
+            )
         return None
     _p, r_id, t_id = best
     return best_row, int(r_id), int(t_id)

@@ -16,6 +16,11 @@
 #      smoke               example input; the metrics line must parse
 #                         and carry the schema version + lifecycle spans
 #                         (docs/observability.md)
+#   6b. explain smoke   — -explain on the example input: plan bytes
+#                         pinned unchanged, the explain/1 document
+#                         schema-valid and internally reconciled, and a
+#                         forced no-move exit classified in both the
+#                         document and the plan.no_move_reason gauge
 #   7. serve smoke      — start the planning daemon, plan through it,
 #                         assert byte parity with the in-process path,
 #                         clean shutdown (docs/serving.md)
@@ -133,6 +138,68 @@ assert {"parse_input", "plan", "emit"} <= names, sorted(names)
 else
   echo "observability smoke FAILED"; fail=1
 fi
+
+step "explain smoke (-explain: schema, reconciliation, plan-byte parity)"
+# The plan-explanation document end to end (docs/observability.md):
+# a fused plan with -explain must (a) leave the plan bytes untouched,
+# (b) emit a schema-valid kafkabalancer-tpu.explain/1 document whose
+# per-move scores reconcile internally (score_delta == after - before,
+# src/dst load deltas consistent), and (c) classify a no-move exit
+# (plan.no_move_reason) instead of leaving it indistinguishable from a
+# converged one. The new modules (obs/convergence.py, serve/devmem.py)
+# ride the jaxlint/annotation/mypy sweeps above by location.
+ex_tmp=$(mktemp -d)
+ex_plain=$(JAX_PLATFORMS=cpu "$PYTHON" -m kafkabalancer_tpu -input-json \
+  -input tests/data/test.json -fused -fused-batch=4 -max-reassign=4 \
+  -no-daemon 2>/dev/null)
+ex_out=$(JAX_PLATFORMS=cpu "$PYTHON" -m kafkabalancer_tpu -input-json \
+  -input tests/data/test.json -fused -fused-batch=4 -max-reassign=4 \
+  -no-daemon "-explain=$ex_tmp/explain.json" 2>/dev/null)
+if [ -n "$ex_plain" ] && [ "$ex_plain" = "$ex_out" ]; then
+  echo "plan-byte parity with -explain: OK"
+else
+  echo "plan-byte parity with -explain FAILED"; fail=1
+fi
+if "$PYTHON" - "$ex_tmp/explain.json" <<'PYEOF'
+import json, sys
+doc = json.load(open(sys.argv[1]))
+assert doc["schema"] == "kafkabalancer-tpu.explain/1", doc.get("schema")
+assert doc["moves_applied"] == len(doc["moves"]) > 0, doc["moves_applied"]
+assert doc["moves_emitted"] == sum(m["emitted"] for m in doc["moves"]) > 0
+for m in doc["moves"]:
+    assert m["score_delta"] == m["unbalance_after"] - m["unbalance_before"]
+    for k in ("topic", "partition", "kind", "src", "dst",
+              "unbalance_before", "unbalance_after"):
+        assert k in m, (k, sorted(m))
+assert doc["no_move_reason"] is None
+assert doc["stop"]["reason"], doc["stop"]
+assert doc["candidates"]["scored"] > 0, doc["candidates"]
+PYEOF
+then
+  echo "explain document schema + reconciliation: OK"
+else
+  echo "explain document validation FAILED"; fail=1
+fi
+# no-move exit: a sky-high threshold must classify as below_threshold
+# in BOTH the explain stanza and the -metrics-json gauge
+JAX_PLATFORMS=cpu "$PYTHON" -m kafkabalancer_tpu -input-json \
+  -input tests/data/test.json -fused -fused-batch=4 -max-reassign=4 \
+  -min-unbalance=999999 -no-daemon "-explain=$ex_tmp/nomove.json" \
+  "-metrics-json=$ex_tmp/nomove.metrics.json" >/dev/null 2>&1
+if "$PYTHON" - "$ex_tmp" <<'PYEOF'
+import json, sys
+doc = json.load(open(sys.argv[1] + "/nomove.json"))
+assert doc["moves_emitted"] == 0, doc["moves_emitted"]
+assert doc["no_move_reason"]["reason"] == "below_threshold", doc["no_move_reason"]
+m = json.load(open(sys.argv[1] + "/nomove.metrics.json"))
+assert m["gauges"]["plan.no_move_reason"] == "below_threshold", m["gauges"]
+PYEOF
+then
+  echo "no-move classification (explain + metrics gauge): OK"
+else
+  echo "no-move classification FAILED"; fail=1
+fi
+rm -rf "$ex_tmp"
 
 step "serve smoke (daemon parity + clean shutdown)"
 # The persistent planning daemon end to end: start it on a private
@@ -365,9 +432,10 @@ if [ "$cb_ready" = 1 ]; then
       -serve-stats-json 2>/dev/null | "$PYTHON" -c '
 import json, sys
 p = json.loads(sys.stdin.read())
-assert p["schema"] == "kafkabalancer-tpu.serve-stats/1", p.get("schema")
+assert p["schema"] == "kafkabalancer-tpu.serve-stats/2", p.get("schema")
 assert "serve.request_s" in p["hists"], sorted(p["hists"])
 assert "serve.phase.parse" in p["hists"], sorted(p["hists"])
+assert isinstance(p["memory"], list) and p["memory"], p.get("memory")
 '; then
     echo "mid-traffic stats scrape: OK"
   else

@@ -1,0 +1,506 @@
+"""The ``-explain`` plan-explanation document (obs/convergence.py).
+
+Load-bearing pins:
+
+- **oracle reconciliation** (the acceptance criterion): every emitted
+  move's ``unbalance_before/after`` and src/dst loads must agree BIT-
+  EXACTLY with an independent scalar replay of the emitted plan through
+  the oracle's ``get_unbalance_bl`` — same contribution rule (leader
+  premium on slot 0, utils.go:96-101), same dynamic broker-table
+  membership, same float-op order;
+- **plan-byte parity**: enabling ``-explain`` changes no plan bytes;
+- **golden schema**: the document layout is versioned
+  (``kafkabalancer-tpu.explain/1``); changing keys requires a bump and
+  a new golden;
+- **no-move classification**: a below-threshold exit, a converged one
+  and an infeasible one are distinguishable — in the document AND in
+  the ``plan.no_move_reason`` metrics gauge (the satellite).
+"""
+
+import io
+import json
+import os
+import random
+
+import pytest
+
+from kafkabalancer_tpu import cli
+from kafkabalancer_tpu.balancer.costmodel import get_bl, get_unbalance_bl
+from kafkabalancer_tpu.models import RebalanceConfig
+from kafkabalancer_tpu.obs import convergence
+from tests.helpers import random_partition_list
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "data", "test.json")
+GOLDEN = os.path.join(
+    os.path.dirname(__file__), "data", "explain_schema_v1.json"
+)
+
+
+def run_cli(args, stdin=""):
+    out, err = io.StringIO(), io.StringIO()
+    rv = cli.run(io.StringIO(stdin), out, err, ["kafkabalancer"] + args)
+    return rv, out.getvalue(), err.getvalue()
+
+
+def _fused_doc(pl, cfg, max_reassign=50, batch=4, **plan_kw):
+    """Run the fused session with a recorder installed; returns
+    (opl, doc)."""
+    from kafkabalancer_tpu.solvers.scan import plan
+
+    rec = convergence.ConvergenceRecorder()
+    convergence.install(rec)
+    try:
+        convergence.clear_outcome()
+        rec.attach(
+            pl, cfg, mode="fused", solver="tpu", engine="xla",
+            batch=batch, max_reassign=max_reassign,
+        )
+        opl = plan(pl, cfg, max_reassign, batch=batch, **plan_kw)
+        doc = rec.finalize()
+    finally:
+        convergence.uninstall()
+        convergence.clear_outcome()
+    return opl, doc
+
+
+# --- the independent oracle replay (the differential pin) ------------------
+
+
+def _replay_and_check(initial_replicas, parts, cfg, doc):
+    """Replay the document's move list from the pre-plan assignment,
+    scoring each step with the scalar oracle — every comparison below
+    is EXACT equality (bit-for-bit), not a tolerance."""
+    loads, counts = {}, {}
+    state = [list(r) for r in initial_replicas]
+    weights = [p.weight for p in parts]
+    ncons = [p.num_consumers for p in parts]
+
+    def shift(reps, w, nc, sign):
+        n = len(reps)
+        for i, b in enumerate(reps):
+            c = w * (n + nc) if i == 0 else w
+            loads[b] = loads.get(b, 0.0) + (sign * c)
+            counts[b] = counts.get(b, 0) + sign
+
+    for row, reps in enumerate(state):
+        shift(reps, weights[row], ncons[row], 1)
+    always = set(cfg.brokers or [])
+    for b in always:
+        loads.setdefault(b, 0.0)
+
+    def unbalance():
+        live = {
+            b: v for b, v in loads.items()
+            if counts.get(b, 0) > 0 or b in always
+        }
+        return get_unbalance_bl(get_bl(live))
+
+    u = unbalance()
+    assert doc["unbalance_initial"] == u
+    for m in doc["moves"]:
+        row = m["row"]
+        reps = state[row]
+        old = list(reps)
+        kind, slot = m["kind"], m["slot"]
+        if kind == "move":
+            assert reps[slot] == m["src"]
+            reps[slot] = m["dst"]
+        elif kind == "swap":
+            j = reps.index(m["dst"])
+            assert reps[slot] == m["src"]
+            reps[slot], reps[j] = m["dst"], m["src"]
+        elif kind == "add":
+            reps.insert(slot, m["dst"])
+        elif kind == "remove":
+            reps.remove(m["src"])
+        else:
+            pytest.fail(f"unexpected kind {kind!r}")
+        assert m["unbalance_before"] == u
+        if m["src"] is not None:
+            assert m["src_load_before"] == loads.get(m["src"])
+        if m["dst"] is not None:
+            assert m["dst_load_before"] == loads.get(m["dst"], 0.0)
+        shift(old, weights[row], ncons[row], -1)
+        shift(reps, weights[row], ncons[row], 1)
+        u = unbalance()
+        assert m["unbalance_after"] == u
+        assert m["score_delta"] == u - m["unbalance_before"]
+        if m["src"] is not None:
+            assert m["src_load_after"] == loads.get(m["src"])
+        if m["dst"] is not None:
+            assert m["dst_load_after"] == loads.get(m["dst"])
+    assert doc["unbalance_final"] == u
+    # the emitted plan's final state must agree with the replayed state
+    for row, p in enumerate(parts):
+        assert list(p.replicas) == state[row], row
+
+
+@pytest.mark.parametrize("seed", [1, 7, 23])
+def test_explain_reconciles_with_scalar_oracle_fused(seed):
+    rng = random.Random(seed)
+    pl = random_partition_list(
+        rng, 24, 6, weighted=True, with_consumers=True, filled=True
+    )
+    parts = list(pl.iter_partitions())
+    initial = [list(p.replicas) for p in parts]
+    cfg = RebalanceConfig(
+        min_unbalance=1e-9, min_replicas_for_rebalancing=1,
+        allow_leader_rebalancing=bool(seed % 2), solver="tpu",
+    )
+    opl, doc = _fused_doc(pl, cfg, max_reassign=40, batch=4)
+    assert doc["schema"] == "kafkabalancer-tpu.explain/1"
+    assert doc["moves_emitted"] == len(doc["moves"]) == len(opl)
+    assert doc["moves_emitted"] > 0
+    # JSON round trip preserves every float bit (repr round trip)
+    doc = json.loads(json.dumps(doc, sort_keys=True, default=str))
+    _replay_and_check(initial, parts, cfg, doc)
+
+
+def test_explain_reconciles_restricted_brokers():
+    rng = random.Random(99)
+    pl = random_partition_list(
+        rng, 20, 5, restrict_brokers=True, filled=True
+    )
+    parts = list(pl.iter_partitions())
+    initial = [list(p.replicas) for p in parts]
+    cfg = RebalanceConfig(
+        min_unbalance=1e-9, min_replicas_for_rebalancing=1, solver="tpu",
+    )
+    _opl, doc = _fused_doc(pl, cfg, max_reassign=30, batch=4)
+    _replay_and_check(initial, parts, cfg, doc)
+    # restricted allowlists must show up in the masking breakdown
+    assert doc["candidates"]["masked"]["broker_allowlist"] > 0
+
+
+def test_explain_reconciles_leader_session_swaps():
+    """The fused rebalance-leaders session emits leadership SWAPS
+    (SWAP_SLOT) — the replay must score the premium transfer exactly."""
+    rng = random.Random(5)
+    pl = random_partition_list(rng, 16, 4, filled=True, max_rf=3)
+    parts = list(pl.iter_partitions())
+    initial = [list(p.replicas) for p in parts]
+    cfg = RebalanceConfig(
+        min_unbalance=1e-9, min_replicas_for_rebalancing=1,
+        rebalance_leaders=True, solver="tpu",
+    )
+    _opl, doc = _fused_doc(pl, cfg, max_reassign=20, batch=1)
+    _replay_and_check(initial, parts, cfg, doc)
+
+
+# --- schema golden ---------------------------------------------------------
+
+
+def test_explain_schema_golden():
+    rv, out, _err = run_cli(
+        ["-input-json", f"-input={FIXTURE}", "-fused", "-fused-batch=4",
+         "-max-reassign=4", "-no-daemon", "-explain=-"]
+    )
+    assert rv == 0
+    doc = json.loads(out.strip().splitlines()[-1])
+    with open(GOLDEN) as f:
+        golden = json.load(f)
+    assert doc["schema"] == golden["schema"]
+    assert set(doc) == set(golden["top_level_keys"]), sorted(doc)
+    assert set(doc["config"]) == set(golden["config_keys"])
+    assert set(doc["rounds"]) == set(golden["rounds_keys"])
+    assert set(doc["candidates"]) == set(golden["candidates_keys"])
+    assert set(doc["candidates"]["masked"]) == set(golden["masked_keys"])
+    assert doc["moves"], "fixture plan should emit moves"
+    for m in doc["moves"]:
+        assert set(m) == set(golden["move_keys"]), sorted(m)
+        for alt in m["alternatives"] or ():
+            assert set(alt) == set(golden["alternative_keys"])
+
+
+# --- plan-byte parity ------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "extra",
+    [[], ["-fused", "-fused-batch=4"], ["-solver=tpu"]],
+    ids=["greedy", "fused", "tpu"],
+)
+def test_explain_changes_no_plan_bytes(tmp_path, extra):
+    args = ["-input-json", f"-input={FIXTURE}", "-max-reassign=3",
+            "-no-daemon"] + extra
+    rv1, out1, _ = run_cli(args)
+    path = str(tmp_path / "explain.json")
+    rv2, out2, err2 = run_cli(args + [f"-explain={path}"])
+    assert (rv1, out1) == (rv2, out2)
+    assert "plan explanation" in err2  # the human stderr rendering
+    doc = json.load(open(path))
+    assert doc["moves_applied"] == len(doc["moves"])
+    assert doc["moves_emitted"] == sum(m["emitted"] for m in doc["moves"])
+    # with "-": the plan bytes precede the document, byte-identical
+    rv3, out3, _ = run_cli(args + ["-explain=-"])
+    assert rv3 == rv1
+    assert out3.startswith(out1)
+    tail = out3[len(out1):]
+    assert json.loads(tail)["schema"] == "kafkabalancer-tpu.explain/1"
+
+
+def test_explain_before_metrics_json_line(tmp_path):
+    """-metrics-json='-' stays the LAST stdout line (its documented
+    contract); the explain line rides between plan and metrics."""
+    rv, out, _ = run_cli(
+        ["-input-json", f"-input={FIXTURE}", "-fused", "-max-reassign=2",
+         "-no-daemon", "-explain=-", "-metrics-json", "-"]
+    )
+    assert rv == 0
+    lines = out.strip().splitlines()
+    assert json.loads(lines[-1])["schema"] == "kafkabalancer-tpu.metrics/1"
+    assert (
+        json.loads(lines[-2])["schema"] == "kafkabalancer-tpu.explain/1"
+    )
+
+
+def test_complete_partition_probe_marked_applied_not_emitted():
+    """The reference's complete-partition probe move is APPLIED to the
+    live list (slice aliasing, kafkabalancer.go:193-207) but kept out
+    of the plan when its compare fails — the document must show both:
+    the trajectory replay needs the applied move, the plan does not
+    contain it."""
+    # default -complete-partition with -max-reassign=1: the follow-up
+    # balance call proposes a DIFFERENT partition, which fails the
+    # compare — one emitted move, two applied
+    rv, out, err = run_cli(
+        ["-input-json", f"-input={FIXTURE}", "-max-reassign=1",
+         "-no-daemon", "-explain=-"]
+    )
+    assert rv == 0
+    assert "did not compare" in err
+    doc = json.loads(out.strip().splitlines()[-1])
+    assert doc["moves_applied"] == 2
+    assert doc["moves_emitted"] == 1
+    assert [m["emitted"] for m in doc["moves"]] == [True, False]
+    assert "[applied, not emitted]" in err
+    # the plan itself carries exactly the emitted move
+    plan = json.loads(out.strip().splitlines()[0])
+    assert len(plan["partitions"]) == doc["moves_emitted"]
+
+
+def test_explain_unwritable_path_exits_4(tmp_path):
+    rv, _out, err = run_cli(
+        ["-input-json", f"-input={FIXTURE}", "-fused", "-max-reassign=2",
+         "-no-daemon", f"-explain={tmp_path}/no/such/dir/x.json"]
+    )
+    assert rv == 4
+    assert "failed writing explain document" in err
+
+
+# --- no-move classification (the plan.no_move_reason satellite) ------------
+
+
+def _gauges(args):
+    rv, out, _err = run_cli(args + ["-metrics-json", "-"])
+    assert rv == 0
+    return json.loads(out.strip().splitlines()[-1])["gauges"]
+
+
+@pytest.mark.parametrize("mode", [[], ["-fused"], ["-solver=tpu"]],
+                         ids=["greedy", "fused", "tpu"])
+def test_no_move_reason_below_threshold(mode):
+    g = _gauges(
+        ["-input-json", f"-input={FIXTURE}", "-max-reassign=2",
+         "-min-unbalance=999999", "-no-daemon"] + mode
+    )
+    assert g["plan.no_move_reason"] == "below_threshold"
+    assert g["plan.stop_reason"] == "below_threshold"
+
+
+@pytest.mark.parametrize("mode", [[], ["-fused"]], ids=["greedy", "fused"])
+def test_no_move_reason_no_feasible_candidate(mode):
+    # min-replicas above every partition's RF: nothing is movable
+    g = _gauges(
+        ["-input-json", f"-input={FIXTURE}", "-max-reassign=2",
+         "-min-replicas=9", "-no-daemon"] + mode
+    )
+    assert g["plan.no_move_reason"] == "no_feasible_candidate"
+
+
+def test_beam_converged_plan_not_misreported_as_budget_exhausted():
+    """Review fix: beam's decline notes an outcome too — a converged
+    -solver=beam plan must not fall through to the budget_exhausted
+    fallback heuristic."""
+    g = _gauges(
+        ["-input-json", f"-input={FIXTURE}", "-solver=beam",
+         "-max-reassign=50", "-no-daemon"]
+    )
+    assert g["plan.stop_reason"] == "converged"
+    assert "plan.no_move_reason" not in g
+    # and a zero-move beam decline classifies (lazy feasibility)
+    g = _gauges(
+        ["-input-json", f"-input={FIXTURE}", "-solver=beam",
+         "-max-reassign=2", "-min-replicas=9", "-no-daemon"]
+    )
+    assert g["plan.no_move_reason"] == "no_feasible_candidate"
+
+
+def test_stop_reason_budget_exhausted():
+    g = _gauges(
+        ["-input-json", f"-input={FIXTURE}", "-max-reassign=1",
+         "-no-daemon"]
+    )
+    assert g["plan.stop_reason"] == "budget_exhausted"
+    assert "plan.no_move_reason" not in g
+
+
+def test_converged_plan_reports_stop_reason():
+    # budget far above need: the plan converges and says why it stopped
+    g = _gauges(
+        ["-input-json", f"-input={FIXTURE}", "-max-reassign=50",
+         "-no-daemon"]
+    )
+    assert g["plan.stop_reason"] in ("already_balanced", "below_threshold")
+    # moves were emitted, so this was not a no-move exit: gauge absent
+    assert "plan.no_move_reason" not in g
+
+
+def test_no_move_doc_stanza_and_stats_render():
+    path_args = [
+        "-input-json", f"-input={FIXTURE}", "-fused", "-max-reassign=2",
+        "-min-unbalance=999999", "-no-daemon", "-explain=-",
+    ]
+    rv, out, err = run_cli(path_args + ["-stats"])
+    assert rv == 0
+    doc = json.loads(out.strip().splitlines()[-1])
+    nm = doc["no_move_reason"]
+    assert nm["reason"] == "below_threshold"
+    assert nm["best_unbalance"] < nm["unbalance"]
+    assert "no move emitted: below_threshold" in err
+    # the gauge renders in the -stats human summary too
+    assert "gauge plan.no_move_reason: below_threshold" in err
+
+
+# --- alternatives ----------------------------------------------------------
+
+
+def test_alternatives_ranked_and_chosen_is_rank0():
+    rng = random.Random(3)
+    pl = random_partition_list(rng, 12, 4, filled=True)
+    cfg = RebalanceConfig(
+        min_unbalance=1e-9, min_replicas_for_rebalancing=1, solver="tpu",
+    )
+    _opl, doc = _fused_doc(pl, cfg, max_reassign=10, batch=1)
+    assert doc["moves"]
+    for m in doc["moves"]:
+        alts = m["alternatives"]
+        assert alts, m
+        deltas = [a["delta"] for a in alts]
+        assert deltas == sorted(deltas)
+        # batch=1 takes the globally best single move: the chosen move
+        # must be the rank-0 alternative (rank-1 scoring agrees with the
+        # oracle's ordering away from exact ties)
+        assert (alts[0]["row"], alts[0]["dst"]) == (m["row"], m["dst"])
+    assert doc["alternatives_truncated"] is False
+    assert doc["alternatives_moves_covered"] == doc["moves_emitted"]
+
+
+def test_alternatives_budget_truncates_loudly():
+    rng = random.Random(4)
+    pl = random_partition_list(rng, 12, 4, filled=True)
+    parts = list(pl.iter_partitions())
+    initial = [list(p.replicas) for p in parts]
+    cfg = RebalanceConfig(
+        min_unbalance=1e-9, min_replicas_for_rebalancing=1, solver="tpu",
+    )
+    from kafkabalancer_tpu.solvers.scan import plan
+
+    rec = convergence.ConvergenceRecorder(alt_budget=1)  # nothing fits
+    convergence.install(rec)
+    try:
+        convergence.clear_outcome()
+        rec.attach(pl, cfg, mode="fused", max_reassign=10)
+        plan(pl, cfg, 10, batch=4)
+        doc = rec.finalize()
+    finally:
+        convergence.uninstall()
+        convergence.clear_outcome()
+    assert doc["moves_emitted"] > 0
+    assert all(m["alternatives"] is None for m in doc["moves"])
+    assert doc["alternatives_truncated"] is True
+    assert doc["alternatives_moves_covered"] == 0
+    # the trajectory pin is budget-independent
+    _replay_and_check(initial, parts, cfg, doc)
+
+
+# --- masking + rounds ------------------------------------------------------
+
+
+def test_masking_min_replicas_counted():
+    rng = random.Random(11)
+    pl = random_partition_list(rng, 10, 4, max_rf=2, filled=True)
+    # allow_leader makes rf-1 partitions movable (their leader slot), so
+    # min_replicas=2 visibly masks them; followers-only would leave rf-1
+    # partitions with zero movable slots and nothing to count
+    cfg = RebalanceConfig(
+        min_unbalance=1e-9, min_replicas_for_rebalancing=2,
+        allow_leader_rebalancing=True, solver="tpu",
+    )
+    _opl, doc = _fused_doc(pl, cfg, max_reassign=10, batch=4)
+    masked = doc["candidates"]["masked"]
+    # rf-1 partitions exist with overwhelming probability at max_rf=2
+    assert masked["min_replicas"] > 0
+    assert doc["rounds"]["count"] >= 1
+    assert doc["rounds"]["samples"]
+
+
+def test_tie_window_recorded_for_tpu_solver(monkeypatch):
+    """The tpu per-move solver feeds tie-window sizes; force the device
+    path by dropping the small-instance routing floor."""
+    from kafkabalancer_tpu.solvers import tpu as tpu_mod
+
+    monkeypatch.setattr(tpu_mod, "MIN_DEVICE_CANDIDATES", 0)
+    rng = random.Random(13)
+    pl = random_partition_list(rng, 16, 4, filled=True)
+    cfg = RebalanceConfig(
+        min_unbalance=1e-9, min_replicas_for_rebalancing=1, solver="tpu",
+    )
+    from kafkabalancer_tpu.balancer import balance
+    from kafkabalancer_tpu.cli import apply_assignment
+
+    rec = convergence.ConvergenceRecorder()
+    convergence.install(rec)
+    try:
+        convergence.clear_outcome()
+        rec.attach(pl, cfg, mode="per-move", solver="tpu", max_reassign=3)
+        for _ in range(3):
+            ppl = balance(pl, cfg)
+            if len(ppl) == 0:
+                break
+            for changed in ppl.partitions:
+                apply_assignment(pl, changed)
+        doc = rec.finalize()
+    finally:
+        convergence.uninstall()
+        convergence.clear_outcome()
+    assert doc["rounds"]["tie_window_count"] >= 1
+    assert doc["rounds"]["tie_windows"]
+    assert doc["moves_emitted"] >= 1
+    assert all(m["origin"] == "step" for m in doc["moves"])
+
+
+def test_per_move_greedy_masking_and_threshold_counts():
+    """The greedy scan's recorder feeds: scored/masked totals and the
+    min_unbalance threshold bucket (improving-but-not-clearing)."""
+    rng = random.Random(17)
+    pl = random_partition_list(rng, 10, 4, filled=True)
+    cfg = RebalanceConfig(
+        min_unbalance=1e6, min_replicas_for_rebalancing=1, solver="greedy",
+    )
+    from kafkabalancer_tpu.balancer import balance
+
+    rec = convergence.ConvergenceRecorder()
+    convergence.install(rec)
+    try:
+        convergence.clear_outcome()
+        rec.attach(pl, cfg, mode="per-move", solver="greedy", max_reassign=1)
+        ppl = balance(pl, cfg)
+        assert len(ppl) == 0  # threshold blocks everything
+        doc = rec.finalize()
+    finally:
+        convergence.uninstall()
+        convergence.clear_outcome()
+    assert doc["candidates"]["scored"] > 0
+    assert doc["candidates"]["masked"]["min_unbalance"] > 0
+    assert doc["no_move_reason"]["reason"] == "below_threshold"

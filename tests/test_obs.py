@@ -712,3 +712,97 @@ def test_flight_autodump_writes_perfetto_and_caps(tmp_path):
         fr.autodump(f"r{i}", directory=str(tmp_path))
     assert fr.autodump("over", directory=str(tmp_path)) is None
     assert fr.stats()["autodumps"] == obs_flight.MAX_AUTODUMPS
+
+
+# --- Prometheus exposition: histogram _count/_sum + memory gauges ----------
+
+
+def test_prometheus_summaries_carry_count_and_sum():
+    """The satellite pin: every histogram summary must emit BOTH
+    ``_count`` and ``_sum`` lines (without them ``rate()`` over phase
+    totals is impossible in standard scrapers)."""
+    doc = {
+        "requests": 3,
+        "uptime_s": 1.5,
+        "hists": {
+            "serve.phase.parse": {
+                "count": 3, "sum": 0.123456, "p50": 0.01, "p95": 0.02,
+                "p99": 0.03,
+            },
+            "serve.request_s": {
+                "count": 3, "sum": 1.5, "p50": 0.4, "p95": 0.6, "p99": 0.7,
+            },
+        },
+    }
+    text = obs_export.render_prometheus(doc)
+    for name in ("serve_phase_parse", "serve_request_s"):
+        m = f"kafkabalancer_tpu_{name}"
+        assert f"# TYPE {m} summary" in text
+        assert f"{m}_count 3" in text, text
+        assert f"{m}_sum " in text, text
+        for q in ("0.5", "0.95", "0.99"):
+            assert f'{m}{{quantile="{q}"}}' in text
+
+
+def test_prometheus_memory_gauges_labeled_per_lane():
+    doc = {
+        "requests": 1,
+        "memory": [
+            {"lane": 0, "hbm_bytes_in_use": 1024, "hbm_bytes_limit": 4096,
+             "residency_bytes": 512, "residency_entries": 2},
+            {"lane": 1, "hbm_bytes_in_use": None, "hbm_bytes_limit": None,
+             "residency_bytes": 0, "residency_entries": 0},
+        ],
+        "hists": {},
+    }
+    text = obs_export.render_prometheus(doc)
+    assert '# TYPE kafkabalancer_tpu_lane_hbm_bytes_in_use gauge' in text
+    assert 'kafkabalancer_tpu_lane_hbm_bytes_in_use{lane="0"} 1024' in text
+    # null stats (backend without introspection) are omitted, not 0
+    assert 'lane_hbm_bytes_in_use{lane="1"}' not in text
+    assert 'kafkabalancer_tpu_lane_residency_bytes{lane="1"} 0' in text
+
+
+def test_serve_stats_human_rendering_shows_memory():
+    doc = {
+        "pid": 1, "version": "x", "uptime_s": 2.0, "requests": 1,
+        "coalesced": 0, "requests_inflight": 0, "slow_requests": 0,
+        "crashed_requests": 0, "batch_mode": "continuous",
+        "memory": [
+            {"lane": 0, "hbm_bytes_in_use": 2_500_000,
+             "hbm_bytes_limit": None, "residency_bytes": 1_000_000,
+             "residency_entries": 3},
+        ],
+        "hists": {},
+    }
+    text = obs_export.render_serve_stats(doc)
+    assert "memory lane0: hbm 2.5MB, residency 1.0MB (3 entries)" in text
+
+
+def test_render_stats_includes_streaming_hists():
+    reg = MetricsRegistry()
+    reg.hist_observe("aot.compile_s", 0.25)
+    reg.hist_observe("aot.compile_s", 0.5)
+    text = obs_export.render_stats(reg, Tracer())
+    assert "hist aot.compile_s: n=2" in text
+
+
+def test_aot_jit_path_observes_compile_hists(tmp_path, monkeypatch):
+    """The device-memory/compile attribution tentpole: the AOT dispatch
+    policy feeds streaming histograms (aot.jit_s on the jit path;
+    aot.compile_s on the AOT lower+compile; aot.deserialize_s on blob
+    loads) that ride the stats scrape and -metrics-prom."""
+    jax = pytest.importorskip("jax")
+    import numpy as np
+
+    from kafkabalancer_tpu.ops import aot
+
+    monkeypatch.setenv("KAFKABALANCER_TPU_AOT_SYNC_SAVE", "1")
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", str(tmp_path))
+    obs.metrics.reset_hists()
+    fn = jax.jit(lambda x: x + 1)
+    out = aot.call_or_compile("hist_probe", fn, (np.arange(4),), {})
+    assert np.asarray(out).tolist() == [1, 2, 3, 4]
+    snap = obs.metrics.hist_snapshot()
+    assert "aot.jit_s" in snap and snap["aot.jit_s"]["count"] >= 1
+    obs.metrics.reset_hists()

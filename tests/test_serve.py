@@ -1692,7 +1692,7 @@ def test_dev_cached_asarray_reuses_equal_content():
 # --- live daemon telemetry: the stats / dump-trace scrape ops --------------
 
 GOLDEN_STATS = os.path.join(
-    os.path.dirname(__file__), "data", "serve_stats_schema_v1.json"
+    os.path.dirname(__file__), "data", "serve_stats_schema_v2.json"
 )
 
 
@@ -1822,7 +1822,7 @@ def test_stats_scrape_never_blocks_on_inflight_plan(sock_dir, monkeypatch):
 def test_serve_stats_json_schema_golden(daemon):
     """Golden-file pin: the stats document's top-level keys, histogram
     entry keys and flight keys are VERSIONED
-    (kafkabalancer-tpu.serve-stats/1) — changing any requires a schema
+    (kafkabalancer-tpu.serve-stats/2) — changing any requires a schema
     bump and a new golden."""
     sock, _d = daemon
     rv, _out, _err = run_cli(
@@ -1842,6 +1842,50 @@ def test_serve_stats_json_schema_golden(daemon):
         for le, n in h["buckets"]:
             assert le >= 0.0 and n >= 1
     assert set(doc["flight"]) == set(golden["flight_keys"])
+    # v2: per-lane device-memory attribution, one entry per lane
+    assert isinstance(doc["memory"], list) and doc["memory"]
+    for entry in doc["memory"]:
+        assert set(entry) == set(golden["memory_keys"]), entry
+        assert entry["residency_bytes"] >= 0
+        assert entry["residency_entries"] >= 0
+
+
+def test_served_explain_forwards_and_matches(daemon, sock_dir, tmp_path):
+    """-explain forwards like any other flag: the daemon writes the
+    document to the client's (absolutized) path, the plan bytes relay
+    byte-identical to -no-daemon, and the document matches the one an
+    in-process run produces (modulo the timestamp)."""
+    sock, _d = daemon
+    served_path = os.path.join(sock_dir, "served.explain.json")
+    rv_s, out_s, _ = run_cli(
+        ["-input-json", f"-input={FIXTURE}", "-fused", "-max-reassign=3",
+         f"-serve-socket={sock}", f"-explain={served_path}"]
+    )
+    local_path = str(tmp_path / "local.explain.json")
+    rv_l, out_l, _ = run_cli(
+        ["-input-json", f"-input={FIXTURE}", "-fused", "-max-reassign=3",
+         "-no-daemon", f"-explain={local_path}"]
+    )
+    assert (rv_s, out_s) == (rv_l, out_l)
+    served = json.load(open(served_path))
+    local = json.load(open(local_path))
+    served.pop("ts_epoch"), local.pop("ts_epoch")
+    assert served == local
+    assert served["moves_emitted"] == len(served["moves"]) > 0
+
+
+def test_core_snapshot_memory_block(daemon):
+    """Per-lane device-memory attribution rides hello AND stats (the
+    shared snapshot); warm=False daemon: jax never imported, so the
+    jax-free-safe seam reports null HBM rather than importing it."""
+    sock, _d = daemon
+    hello = sclient.daemon_alive(sock)
+    doc = sclient.fetch_stats(sock)
+    for scrape in (hello, doc):
+        mem = scrape["memory"]
+        assert isinstance(mem, list) and len(mem) >= 1
+        assert mem[0]["lane"] == 0
+        assert mem[0]["residency_bytes"] == 0
 
 
 def test_scrape_cli_verbs_roundtrip(daemon, sock_dir):
@@ -1856,7 +1900,7 @@ def test_scrape_cli_verbs_roundtrip(daemon, sock_dir):
     rv, out, _err = run_cli([f"-serve-socket={sock}", "-serve-stats-json"])
     assert rv == 0
     doc = json.loads(out)
-    assert doc["schema"] == "kafkabalancer-tpu.serve-stats/1"
+    assert doc["schema"] == "kafkabalancer-tpu.serve-stats/2"
     assert doc["hists"]["serve.request_s"]["count"] == doc["requests"]
     rv, out, _err = run_cli([f"-serve-socket={sock}", "-serve-stats"])
     assert rv == 0
