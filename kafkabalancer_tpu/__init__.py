@@ -29,12 +29,38 @@ JAX is imported lazily (only when a TPU solver/codepath is requested) so the
 default greedy CLI path has no JAX import cost.
 """
 
+from typing import Any
+
 from kafkabalancer_tpu.models import (  # noqa: F401
     Partition,
     PartitionList,
     RebalanceConfig,
     default_rebalance_config,
 )
-from kafkabalancer_tpu.balancer import Balance, BalanceError  # noqa: F401
 
 __version__ = "0.1.0"
+
+# star-import and dir() fall back to __all__ for lazily-exported names
+__all__ = [
+    "Balance",
+    "BalanceError",
+    "Partition",
+    "PartitionList",
+    "RebalanceConfig",
+    "default_rebalance_config",
+]
+
+
+def __getattr__(name: str) -> Any:
+    """Lazy re-exports (PEP 562): ``Balance``/``BalanceError`` keep
+    their public home here, but importing the package no longer pulls
+    the whole step pipeline — a forwarded daemon invocation (the
+    jax-free client, serve/client.py) never plans locally, and the
+    ~20 ms of balancer imports were pure startup tax on its hot path."""
+    if name in ("Balance", "BalanceError"):
+        from kafkabalancer_tpu import balancer
+
+        return getattr(balancer, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )

@@ -148,12 +148,19 @@ def remove_extra_replicas(
     and test are authoritative, SURVEY.md §2.5.) May remove the leader,
     promoting the first follower. No MinReplicas gate.
     """
-    loads = get_broker_load(pl)
+    # the load table is only read once a partition actually needs the
+    # repair; on a compliant input this step must cost one O(P) length
+    # scan, not an O(P·R) load accumulation (the per-move pipeline runs
+    # it on EVERY balance() call — a resident-session daemon's entire
+    # steady state)
+    loads = None
 
     for p in pl.iter_partitions():
         if p.num_replicas >= len(p.replicas):
             continue
 
+        if loads is None:
+            loads = get_broker_load(pl)
         for b in get_broker_list_by_load(loads, p.brokers):
             if b in p.replicas:
                 return replace_replica(p, b, -1)
@@ -172,12 +179,14 @@ def add_missing_replicas(
     ``idx--`` loop, steps.go:102-106) and adds a replica on the first broker
     not already holding one — i.e. the most-loaded eligible non-member.
     """
-    loads = get_broker_load(pl)
+    loads = None  # lazy, like remove_extra_replicas
 
     for p in pl.iter_partitions():
         if p.num_replicas <= len(p.replicas):
             continue
 
+        if loads is None:
+            loads = get_broker_load(pl)
         for b in reversed(get_broker_list_by_load(loads, p.brokers)):
             if b not in p.replicas:
                 return add_replica(p, b)
@@ -198,8 +207,7 @@ def move_disallowed_replicas(
     ``cfg.brokers`` (unlike ``move``), so a brand-new empty broker can never
     be the target of a disallowed-replica move (SURVEY.md §2.5).
     """
-    loads = get_broker_load(pl)
-    bl = get_bl(loads)
+    bl = None  # lazy: built only once a violation actually exists
 
     # fast path: a replica's broker always appears in the observed-load
     # table (it holds that replica), so membership in the filtered
@@ -219,6 +227,8 @@ def move_disallowed_replicas(
         if all(rid in bset for rid in p.replicas):
             continue
 
+        if bl is None:
+            bl = get_bl(get_broker_load(pl))
         brokers_by_load = get_broker_list_by_load_bl(bl, p.brokers)
         for rid in p.replicas:
             if rid in brokers_by_load:

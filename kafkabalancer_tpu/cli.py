@@ -36,7 +36,6 @@ from typing import Any, Callable, Dict, List, Optional
 
 from kafkabalancer_tpu import obs
 from kafkabalancer_tpu.obs import convergence
-from kafkabalancer_tpu.balancer import BalanceError, balance
 from kafkabalancer_tpu.codecs import (
     CodecError,
     filter_partition_list,
@@ -83,6 +82,8 @@ def apply_assignment(pl: PartitionList, changed: Partition) -> Partition:
     aliasing); duplicate topic+partition entries are legal input (that is
     what ``-unique`` exists for), so a key-based match would be ambiguous.
     """
+    from kafkabalancer_tpu.balancer import BalanceError
+
     src = getattr(changed, "_source", None)
     if src is not None:
         for p in pl.iter_partitions():
@@ -104,6 +105,12 @@ def _apply_replicas(p: Partition, changed: Partition) -> Partition:
     p.replicas[:] = changed.replicas
     if rec is not None:
         rec.record_change(p, old, list(p.replicas), origin="step")
+    tap = convergence.mutation_tap()
+    if tap is not None:
+        # resident-session raw-row shadow (serve/sessions.py): mirror
+        # the applied change so the daemon can predict the client's
+        # next observed state
+        tap.change(p)
     return p
 
 
@@ -216,6 +223,7 @@ _NO_FORWARD_FLAGS = frozenset((
     "serve-lanes", "serve-microbatch", "serve-batch-mode",
     "serve-admission-hold", "serve-slow-ms",
     "serve-stats", "serve-stats-json", "serve-dump-trace", "metrics-prom",
+    "serve-session", "serve-no-session",
     "no-daemon", "help", "pprof", "pprof-path", "jax-profile", "input",
 ))
 # flags whose value names a filesystem path the DAEMON will write — made
@@ -328,6 +336,7 @@ def run(
     i, o, e, args: List[str], *,
     attrs: "Optional[Dict[str, Any]]" = None,
     refresh_attrs: "Optional[Callable[[], Dict[str, Any]]]" = None,
+    session: "Optional[Any]" = None,
 ) -> int:
     """Testable CLI body; reference ``run`` (kafkabalancer.go:72-242).
     Wraps :func:`_run_impl` with the telemetry lifecycle: fresh
@@ -338,7 +347,11 @@ def run(
     ``served: true`` / ``serve.*`` attribution through this seam so a
     served request's ``-metrics-json`` line is attributable.
     ``refresh_attrs`` re-snapshots the volatile subset at EXPORT time
-    (see _TelemetryFlags)."""
+    (see _TelemetryFlags). ``session`` is the daemon's resident
+    cluster-session seam (serve/sessions.py PlanSessionContext): when
+    it supplies a resident partition list, input parsing is skipped
+    entirely; when the CLI parses, the session snapshots the raw rows
+    at the only moment they are observable (post-parse, pre-settle)."""
     be = BufferingWriter(e)
     logger = Logger(be)
     tel = _TelemetryFlags()
@@ -350,7 +363,7 @@ def run(
     tel.refresh = refresh_attrs
     rc = -1  # sentinel: an uncaught exception exports rc=-1
     try:
-        rc = _run_impl(i, o, be, logger, tel, args)
+        rc = _run_impl(i, o, be, logger, tel, args, session=session)
         return rc
     finally:
         try:
@@ -371,7 +384,7 @@ def run(
 
 def _run_impl(
     i, o, be: BufferingWriter, logger: Logger, tel: _TelemetryFlags,
-    args: List[str],
+    args: List[str], session: "Optional[Any]" = None,
 ) -> int:
     log = logger.printf
     profiler = None
@@ -601,6 +614,21 @@ def _run_impl(
             "request log) when a served request exceeds this many "
             "milliseconds (0 disables)",
         )
+        f_serve_session = f.string(
+            "serve-session",
+            "",
+            "Name the resident cluster session this invocation belongs "
+            "to (protocol v2 daemons keep the parsed/settled state "
+            "resident per session, so the outer loop's steady-state "
+            "request ships a digest instead of the cluster; default: "
+            "derived from the input path — docs/serving.md)",
+        )
+        f_serve_no_session = f.bool(
+            "serve-no-session",
+            False,
+            "Never use resident cluster sessions when forwarding to a "
+            "daemon; every request ships and re-parses the full state",
+        )
         f_serve_stats = f.bool(
             "serve-stats",
             False,
@@ -612,7 +640,7 @@ def _run_impl(
             "serve-stats-json",
             False,
             "Scrape a live daemon's telemetry as one line of "
-            "schema-versioned JSON (kafkabalancer-tpu.serve-stats/2)",
+            "schema-versioned JSON (kafkabalancer-tpu.serve-stats/3)",
         )
         f_serve_dump_trace = f.string(
             "serve-dump-trace",
@@ -837,10 +865,53 @@ def _run_impl(
                     stdin_text = i.read()
             if forwardable:
                 declined: List[str] = []
+                session_spec = None
+                if (
+                    stdin_text is not None
+                    and not f_serve_no_session.value
+                    and f_zk.value == ""
+                ):
+                    # the resident-session identity: an explicit
+                    # -serve-session name, else the input path ("-"
+                    # for true stdin). A v2 daemon keys its resident
+                    # state per (tenant, planning-flags signature);
+                    # v1 daemons ignore all of this.
+                    tenant = f_serve_session.value or (
+                        os.path.abspath(f_input.value)
+                        if f_input.value != "" else "-"
+                    )
+                    session_spec = serve_client.SessionSpec(
+                        tenant=tenant,
+                        text=stdin_text,
+                        is_json=f_json.value,
+                        topics=[
+                            t for t in f_topics.value.split(",")
+                            if len(t) >= 1
+                        ],
+                    )
+
+                def _note_fallback(reason: str) -> None:
+                    # attributable fallbacks: the reason lands as a
+                    # counter in THIS invocation's registry. For every
+                    # fall-back-to-in-process reason (daemon_down,
+                    # handshake_mismatch, frame_cap, declined,
+                    # transport_error) the invocation ends planning
+                    # locally, so the counter reaches its own
+                    # -stats/-metrics-json export. Session-resync notes
+                    # observed mid-forward on a request that ends up
+                    # SERVED are deliberately not re-exported here (the
+                    # daemon's export is the authoritative one); the
+                    # daemon counts them in its scrape's "fallbacks"
+                    # block. stderr stays byte-identical to a
+                    # daemon-less build either way.
+                    obs.metrics.count(f"serve.fallbacks.{reason}")
+
                 with obs.span("serve.forward", socket=sock):
                     served = serve_client.forward_plan(
                         sock, _forward_argv(f), stdin_text,
                         on_fallback=declined.append,
+                        session=session_spec,
+                        note=_note_fallback,
                     )
                 if served is None and declined:
                     # the daemon POSITIVELY declined (structured error
@@ -870,38 +941,62 @@ def _run_impl(
                     # are simply re-opened below)
                     i = io.StringIO(stdin_text)
 
-        in_stream = i
-        close_input = False
-        if f_input.value != "":
-            try:
-                in_stream = open(f_input.value, "r")
-                close_input = True
-            except OSError as exc:
-                log(f"failed opening file {f_input.value}: {exc}")
-                return 1
-
         topics = [t for t in f_topics.value.split(",") if len(t) >= 1]
 
-        try:
-            with obs.span(
-                "parse_input",
-                source="zookeeper" if f_zk.value != "" else "reader",
-            ):
+        resident_pl = None
+        if (
+            session is not None
+            and session.kind != "register"
+            and f_input.value == ""
+            and f_zk.value == ""
+        ):
+            # resident cluster session (serve/sessions.py): the daemon
+            # already holds this client's state — the delta fast path
+            # skips input transfer AND parse entirely; the rebuild
+            # paths reconstruct from the resident raw shadow inside
+            # this span (honest parse-phase attribution). The register
+            # kind never opens this span: it parses below, and a second
+            # near-zero span would double-count the parse-phase
+            # histogram sample.
+            with obs.span("parse_input", source=f"session-{session.kind}"):
+                resident_pl = session.resident()
+        if resident_pl is not None:
+            pl = resident_pl
+        else:
+            in_stream = i
+            close_input = False
+            if f_input.value != "":
                 try:
-                    if f_zk.value != "":
-                        pl = get_partition_list_from_zookeeper(
-                            f_zk.value, topics
-                        )
-                    else:
-                        pl = get_partition_list_from_reader(
-                            in_stream, f_json.value, topics
-                        )
-                except CodecError as exc:
-                    log(f"failed getting partition list: {exc}")
-                    return 2
-        finally:
-            if close_input:
-                in_stream.close()
+                    in_stream = open(f_input.value, "r")
+                    close_input = True
+                except OSError as exc:
+                    log(f"failed opening file {f_input.value}: {exc}")
+                    return 1
+
+            try:
+                with obs.span(
+                    "parse_input",
+                    source="zookeeper" if f_zk.value != "" else "reader",
+                ):
+                    try:
+                        if f_zk.value != "":
+                            pl = get_partition_list_from_zookeeper(
+                                f_zk.value, topics
+                            )
+                        else:
+                            pl = get_partition_list_from_reader(
+                                in_stream, f_json.value, topics
+                            )
+                    except CodecError as exc:
+                        log(f"failed getting partition list: {exc}")
+                        return 2
+            finally:
+                if close_input:
+                    in_stream.close()
+            if session is not None:
+                # register path: shadow the raw rows NOW — after parse,
+                # before settle/fill_defaults mutates anything
+                session.on_parsed(pl)
 
         if f_fused.value or f_solver.value in ("tpu", "beam"):
             # Overlap the one-time device-attach costs AND the AOT
@@ -978,6 +1073,12 @@ def _run_impl(
                     )
                     _warm.start()
                 _track_warm_thread(_warm)
+
+        # the planning machinery is imported HERE, past the forwarding
+        # branch: a served invocation (and every argument/input error
+        # exit) never pays the step-pipeline import — part of the
+        # jax-free client's startup budget (serve/client.py)
+        from kafkabalancer_tpu.balancer import BalanceError, balance
 
         # complete_partition is deliberately NOT copied into cfg: the
         # reference builds its RebalanceConfig without it
@@ -1168,15 +1269,21 @@ def _run_impl(
                             opl.append(live)
                         else:
                             log(f"Partition {changed} did not compare.")
+                            # the probe move WAS applied to the live
+                            # list (reference aliasing) but stays out
+                            # of the plan: flag it — and any
+                            # applied-after peers — so the explain
+                            # document's emitted count matches the
+                            # plan (applied count keeps the trajectory
+                            # replay exact), and so a resident session
+                            # can revert it (the cluster never sees an
+                            # unemitted move — serve/sessions.py)
                             if explain_rec is not None:
-                                # the probe move WAS applied to the live
-                                # list (reference aliasing) but stays
-                                # out of the plan: flag it — and any
-                                # applied-after peers — so the explain
-                                # document's emitted count matches the
-                                # plan (applied count keeps the
-                                # trajectory replay exact)
                                 explain_rec.mark_last_unemitted(
+                                    len(lives) - idx
+                                )
+                            if session is not None:
+                                session.mark_last_unemitted(
                                     len(lives) - idx
                                 )
                             stop = True

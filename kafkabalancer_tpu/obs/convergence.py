@@ -90,6 +90,30 @@ def recorder() -> "Optional[ConvergenceRecorder]":
     return getattr(_tls, "rec", None)
 
 
+# --- the mutation tap ------------------------------------------------------
+#
+# A second, lighter thread-local hook on the SAME two emit sites the
+# recorder instruments (cli._apply_replicas, scan._decode_packed): the
+# planning daemon's resident cluster sessions (serve/sessions.py)
+# install a tap to mirror every applied replica change into the
+# session's raw-row shadow — that shadow is what predicts the client's
+# next observed state. O(1) per move; None (the default) costs one
+# attribute read at the emit site. Fail-safe by design: a mutation the
+# tap misses makes the session's next digest comparison MISMATCH, which
+# degrades to a re-sync, never to a wrong plan.
+
+
+def set_mutation_tap(tap: "Optional[Any]") -> None:
+    """Install (or, with None, clear) THIS thread's mutation tap — an
+    object with a ``change(partition)`` method called after every
+    applied replica mutation."""
+    _tls.tap = tap
+
+
+def mutation_tap() -> "Optional[Any]":
+    return getattr(_tls, "tap", None)
+
+
 # --- the always-on outcome slot -------------------------------------------
 
 

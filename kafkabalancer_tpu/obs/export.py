@@ -240,6 +240,36 @@ def render_prometheus(doc: Dict[str, Any]) -> str:
                 m = _prom_name(f"tensorize_cache_{key}")
                 lines.append(f"# TYPE {m} counter")
                 lines.append(f"{m} {_prom_value(cache[key])}")
+    # resident cluster sessions (serve-stats/3 "sessions" block):
+    # gauges for the resident footprint, counters for the hit/resync
+    # ladder — the delta-hit rate IS the steady-state health signal
+    sessions = doc.get("sessions")
+    if isinstance(sessions, dict):
+        for key, typ in (
+            ("count", "gauge"), ("bytes", "gauge"), ("cap", "gauge"),
+            ("registered", "counter"), ("delta_hits", "counter"),
+            ("resyncs_rows", "counter"), ("resyncs_full", "counter"),
+            ("released", "counter"), ("evicted_lru", "counter"),
+            ("expired_idle", "counter"),
+        ):
+            v = sessions.get(key)
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            m = _prom_name(f"sessions_{key}")
+            lines.append(f"# TYPE {m} {typ}")
+            lines.append(f"{m} {_prom_value(v)}")
+    # daemon-observed fallback/resync reasons, one labeled counter —
+    # a degraded fleet (clients silently planning in-process) shows up
+    # as a rate() here instead of requiring log archaeology
+    fallbacks = doc.get("fallbacks")
+    if isinstance(fallbacks, dict) and fallbacks:
+        m = _prom_name("serve_fallbacks")
+        lines.append(f"# TYPE {m} counter")
+        for reason in sorted(fallbacks):
+            v = fallbacks[reason]
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            lines.append(f'{m}{{reason="{reason}"}} {_prom_value(v)}')
     # per-lane device-memory attribution (the stats doc's "memory"
     # block): one labeled gauge per lane so a scraper can chart HBM
     # live bytes and residency-pool bytes per device
@@ -306,6 +336,23 @@ def render_serve_stats(doc: Dict[str, Any]) -> str:
             f"  tensorize cache: {cache.get('hits', 0)} hits / "
             f"{cache.get('misses', 0)} misses"
         )
+    sessions = doc.get("sessions")
+    if isinstance(sessions, dict):
+        lines.append(
+            f"  sessions: {sessions.get('count', 0)} resident "
+            f"({sessions.get('bytes', 0) / 1e6:.1f}MB, cap "
+            f"{sessions.get('cap', 0)}): {sessions.get('delta_hits', 0)} "
+            f"delta hits, {sessions.get('resyncs_rows', 0)} row / "
+            f"{sessions.get('resyncs_full', 0)} full resyncs, "
+            f"{sessions.get('evicted_lru', 0)} evicted, "
+            f"{sessions.get('expired_idle', 0)} expired"
+        )
+    fallbacks = doc.get("fallbacks")
+    if isinstance(fallbacks, dict) and fallbacks:
+        rendered = ", ".join(
+            f"{k}={fallbacks[k]}" for k in sorted(fallbacks)
+        )
+        lines.append(f"  fallbacks: {rendered}")
     mem = doc.get("memory")
     if isinstance(mem, list):
         for entry in mem:

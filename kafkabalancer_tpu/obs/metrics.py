@@ -23,7 +23,7 @@ gate verdicts, and the solver/session counters. Four metric families:
   reads mid-traffic; tests reset them explicitly via
   :meth:`reset_hists`. Excluded from :meth:`snapshot` on purpose — the
   ``kafkabalancer-tpu.metrics/1`` schema is golden-pinned, and the
-  scrape document (``kafkabalancer-tpu.serve-stats/2``) is the
+  scrape document (``kafkabalancer-tpu.serve-stats/3``) is the
   histograms' export seam.
 
 The registry is ALWAYS on (its cost is the dict writes the old bare

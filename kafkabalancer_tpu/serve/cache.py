@@ -59,6 +59,30 @@ _ARRAY_FIELDS = (
 )
 
 
+def row_key_of(
+    p: Partition, brokers_fp: Dict[int, Tuple[int, ...]]
+) -> RowKey:
+    """One partition's content key (see :func:`row_keys`); the
+    ``brokers_fp`` identity memo is shared across calls so the shared
+    post-FillDefaults brokers list tuple-ifies once."""
+    if p.brokers is None:
+        bfp: Optional[Tuple[int, ...]] = None
+    else:
+        ident = id(p.brokers)
+        bfp = brokers_fp.get(ident)
+        if bfp is None:
+            bfp = brokers_fp[ident] = tuple(p.brokers)
+    return (
+        p.topic,
+        p.partition,
+        tuple(p.replicas),
+        p.weight,
+        p.num_replicas,
+        p.num_consumers,
+        bfp,
+    )
+
+
 def row_keys(parts: List[Partition]) -> List[RowKey]:
     """Per-partition content keys over every field tensorize encodes.
 
@@ -67,25 +91,7 @@ def row_keys(parts: List[Partition]) -> List[RowKey]:
     tuple-ification cost is paid once per distinct list, not per row.
     """
     brokers_fp: Dict[int, Tuple[int, ...]] = {}
-    keys: List[RowKey] = []
-    for p in parts:
-        if p.brokers is None:
-            bfp: Optional[Tuple[int, ...]] = None
-        else:
-            ident = id(p.brokers)
-            bfp = brokers_fp.get(ident)
-            if bfp is None:
-                bfp = brokers_fp[ident] = tuple(p.brokers)
-        keys.append((
-            p.topic,
-            p.partition,
-            tuple(p.replicas),
-            p.weight,
-            p.num_replicas,
-            p.num_consumers,
-            bfp,
-        ))
-    return keys
+    return [row_key_of(p, brokers_fp) for p in parts]
 
 
 class TensorizeRowCache:
@@ -102,6 +108,40 @@ class TensorizeRowCache:
         self.hits = 0
         self.misses = 0
         self.rows_reused = 0
+        # trusted-delta mode (resident sessions, serve/sessions.py):
+        # when enabled, the owner promises to mark_changed() every row
+        # mutated since the last prime/patch, and lookup() skips the
+        # O(P) key scan entirely — at 10k partitions the scan costs
+        # MORE than the full encode, so the resident steady state must
+        # not pay it. None = disabled (every pre-existing caller).
+        self._pending: Optional[set] = None
+
+    def enable_trusted_deltas(self) -> None:
+        """Turn on the trusted changed-row feed. Only the resident
+        session machinery calls this — it owns the ONLY mutation sites
+        (cli._apply_replicas / scan._decode_packed taps) and serializes
+        requests per session, so the promise holds by construction."""
+        with self._lock:
+            if self._pending is None:
+                self._pending = set()
+
+    def mark_changed(self, idx: int) -> None:
+        """Note that row ``idx`` of the cached encoding's partition
+        list has been mutated since the last prime/patch."""
+        with self._lock:
+            if self._pending is not None:
+                self._pending.add(idx)
+
+    def approx_bytes(self) -> int:
+        """Rough resident footprint of the cached encoding (the numpy
+        masters dominate; keys estimated per row) — feeds the session
+        memory accounting in the stats scrape."""
+        with self._lock:
+            total = sum(int(a.nbytes) for a in self._arrays.values())
+            total += len(self._keys) * 120
+            if self._ids is not None:
+                total += int(self._ids.nbytes)
+            return total
 
     def _encode_row(
         self, p: Partition, ids: np.ndarray, B: int
@@ -135,22 +175,38 @@ class TensorizeRowCache:
         when the cached encoding covers this input, else None (caller
         runs the full encode and calls :meth:`prime`).
         """
-        keys = row_keys(parts)
         with self._lock:
             meta = (ids.tobytes(), P, R, B)
             if (
                 self._meta != meta
-                or len(keys) != len(self._keys)
+                or len(parts) != len(self._keys)
                 or self._ids is None
             ):
                 self.misses += 1
                 return None
-            changed = [
-                i for i, k in enumerate(keys) if k != self._keys[i]
-            ]
+            nrows = len(parts)
+            if self._pending is not None:
+                # trusted-delta mode: the owner marked every mutated
+                # row, so the per-row key scan (which at 10k rows costs
+                # more than the full encode) is skipped; only the
+                # marked rows re-key and patch
+                changed = sorted(self._pending)
+                if changed and changed[-1] >= nrows:
+                    self.misses += 1
+                    return None
+                brokers_fp: Dict[int, Tuple[int, ...]] = {}
+                keys: Dict[int, RowKey] = {
+                    i: row_key_of(parts[i], brokers_fp) for i in changed
+                }
+            else:
+                full = row_keys(parts)
+                changed = [
+                    i for i, k in enumerate(full) if k != self._keys[i]
+                ]
+                keys = {i: full[i] for i in changed}
             if len(changed) > max(
                 _MIN_CHANGED_ALLOWANCE,
-                int(len(keys) * _MAX_CHANGED_FRACTION),
+                int(nrows * _MAX_CHANGED_FRACTION),
             ):
                 self.misses += 1
                 return None
@@ -176,11 +232,13 @@ class TensorizeRowCache:
                 a["allowed"][i, :] = allowed_row
                 a["topic_id"][i] = tid
                 self._keys[i] = keys[i]
+            if self._pending is not None:
+                self._pending = set()
             self.hits += 1
-            self.rows_reused += len(keys) - len(changed)
+            self.rows_reused += nrows - len(changed)
             obs.metrics.count("tensorize.cache_hits")
             obs.metrics.count(
-                "tensorize.rows_reused", len(keys) - len(changed)
+                "tensorize.rows_reused", nrows - len(changed)
             )
             return {
                 "arrays": {f: a[f].copy() for f in _ARRAY_FIELDS},
@@ -207,6 +265,10 @@ class TensorizeRowCache:
             self._arrays = {f: arrays[f].copy() for f in _ARRAY_FIELDS}
             self._topics = list(topics)
             self._topic_idx = {t: i for i, t in enumerate(topics)}
+            if self._pending is not None:
+                # a full encode re-primed everything; the trusted
+                # changed-set starts fresh
+                self._pending = set()
 
     def stats(self) -> Dict[str, int]:
         with self._lock:

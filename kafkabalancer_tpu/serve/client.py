@@ -17,14 +17,17 @@ from __future__ import annotations
 
 import os
 import socket
-from typing import Any, Callable, Dict, List, NamedTuple, Optional
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
 
 from kafkabalancer_tpu import __version__
 from kafkabalancer_tpu.serve.protocol import (
+    PROTO_V2,
     PROTO_VERSION,
     read_frame,
+    read_frame2,
     resolve_socket_path,  # noqa: F401  — re-exported for the CLI
     write_frame,
+    write_frame2,
 )
 
 # connect + handshake must be near-free when a daemon exists and exactly
@@ -40,6 +43,24 @@ class ServedResult(NamedTuple):
     rc: int
     stdout: str
     stderr: str
+
+
+class SessionSpec(NamedTuple):
+    """What the client needs for the resident-session exchange with a
+    protocol-v2 daemon: the session identity plus the raw input (for
+    the digest and, on a full re-sync, the register payload)."""
+
+    tenant: str
+    text: str
+    is_json: bool
+    topics: List[str]
+
+
+# a row-resync whose diff exceeds this never beats re-registering: past
+# ~25% changed rows the daemon's patch path falls back to a full encode
+# anyway (serve/cache.py), so ship the whole state once instead
+_MAX_RESYNC_ROWS_FRACTION = 0.25
+_MIN_RESYNC_ROWS = 64
 
 
 def socket_exists(path: str) -> bool:
@@ -98,6 +119,8 @@ def forward_plan(
     connect_timeout: float = CONNECT_TIMEOUT_S,
     plan_timeout: float = PLAN_TIMEOUT_S,
     on_fallback: Optional[Callable[[str], None]] = None,
+    session: Optional[SessionSpec] = None,
+    note: Optional[Callable[[str], None]] = None,
 ) -> Optional[ServedResult]:
     """Forward one invocation to the daemon at ``path``.
 
@@ -111,9 +134,20 @@ def forward_plan(
     payload, unparseable frame) or the payload exceeds the protocol's
     frame cap client-side, so the CLI can log why it planned in-process
     instead of a generic silent fallback. Silent failure modes (no
-    daemon, dead socket, version skew) deliberately stay silent — the
-    daemon-down path must remain byte-identical to a build without a
-    daemon.
+    daemon, dead socket, version skew) deliberately stay silent on
+    stderr — the daemon-down path must remain byte-identical to a build
+    without a daemon — but every one of them reports its reason through
+    ``note`` (daemon_down, handshake_mismatch, frame_cap, declined,
+    transport_error, session_digest_mismatch), which the CLI turns into
+    ``serve.fallbacks.<reason>`` counters so a degraded fleet is
+    diagnosable from metrics instead of log archaeology.
+
+    ``session`` opts this invocation into the resident-session exchange
+    when the daemon negotiates protocol v2: steady state sends only a
+    state digest (``plan-delta``); a mismatch ships just the changed
+    rows (``plan-rows``); structural drift re-registers the full state.
+    A v1 daemon — or ``session=None`` — gets the exact v1 byte sequence
+    this function always sent.
     """
 
     def _declined(reason: str) -> None:
@@ -123,23 +157,43 @@ def forward_plan(
             except Exception:
                 pass
 
+    def _note(reason: str) -> None:
+        if note is not None:
+            try:
+                note(reason)
+            except Exception:
+                pass
+
     sock = _connect(path, connect_timeout)
     if sock is None:
+        _note("daemon_down")
         return None
     try:
-        write_frame(sock, {"v": PROTO_VERSION, "op": "hello"})
-        if not _hello_ok(read_frame(sock)):
+        write_frame(
+            sock, {"v": PROTO_VERSION, "op": "hello", "max_v": PROTO_V2}
+        )
+        hello = read_frame(sock)
+        if not _hello_ok(hello):
+            _note("handshake_mismatch")
             return None
+        assert isinstance(hello, dict)
+        max_v = hello.get("max_v")
+        v2 = isinstance(max_v, int) and max_v >= PROTO_V2
+        sock.settimeout(plan_timeout)
+        if v2:
+            return _forward_v2(
+                sock, argv, stdin_text, session, _declined, _note
+            )
         req: Dict[str, Any] = {"v": PROTO_VERSION, "op": "plan", "argv": argv}
         if stdin_text is not None:
             req["stdin"] = stdin_text
-        sock.settimeout(plan_timeout)
         try:
             write_frame(sock, req)
         except ValueError as exc:
             # the input is too large for one protocol frame — a positive
             # local refusal, not a daemon failure
             _declined(f"request exceeds the protocol frame cap: {exc}")
+            _note("frame_cap")
             return None
         resp = read_frame(sock)
         if (
@@ -149,6 +203,9 @@ def forward_plan(
         ):
             if isinstance(resp, dict) and resp.get("error"):
                 _declined(str(resp["error"]))
+                _note("declined")
+            else:
+                _note("transport_error")
             return None
         return ServedResult(
             rc=int(resp["rc"]),
@@ -156,9 +213,139 @@ def forward_plan(
             stderr=str(resp.get("stderr", "")),
         )
     except Exception:
+        _note("transport_error")
         return None
     finally:
         sock.close()
+
+
+def _v2_result(
+    resp: "Optional[Tuple[Dict[str, Any], bytes]]",
+    _declined: Callable[[str], None],
+    _note: Callable[[str], None],
+) -> Optional[ServedResult]:
+    """Decode a v2 plan response (stdout rides in the blob, everything
+    else in the header); None on any shape the caller must fall back
+    from."""
+    if resp is None:
+        _note("transport_error")
+        return None
+    hdr, blob = resp
+    if not hdr.get("ok") or hdr.get("v") != PROTO_V2:
+        if hdr.get("error"):
+            _declined(str(hdr["error"]))
+            _note("declined")
+        else:
+            _note("transport_error")
+        return None
+    return ServedResult(
+        rc=int(hdr["rc"]),
+        stdout=blob.decode("utf-8", errors="replace"),
+        stderr=str(hdr.get("stderr", "")),
+    )
+
+
+def _forward_v2(
+    sock: socket.socket,
+    argv: List[str],
+    stdin_text: Optional[str],
+    session: Optional[SessionSpec],
+    _declined: Callable[[str], None],
+    _note: Callable[[str], None],
+) -> Optional[ServedResult]:
+    """The v2 exchange after a successful hello negotiation: the
+    session ladder (plan-delta -> plan-rows -> register) when a session
+    spec is usable, else a plain v2 ``plan`` with the input as a raw
+    blob (no JSON string escaping either way)."""
+    from kafkabalancer_tpu.serve import state as sstate
+
+    state = None
+    if session is not None:
+        # parse + digest through the very codecs reader the planner
+        # uses; None (unusual input) falls through to the full-state
+        # path and the daemon surfaces any real error normally
+        state = sstate.client_state(
+            session.text, session.is_json, session.topics
+        )
+    if state is None or session is None:
+        hdr: Dict[str, Any] = {
+            "v": PROTO_V2, "op": "plan", "argv": argv,
+            "has_stdin": stdin_text is not None,
+        }
+        blob = stdin_text.encode("utf-8") if stdin_text is not None else b""
+        try:
+            write_frame2(sock, hdr, blob)
+        except ValueError as exc:
+            _declined(f"request exceeds the protocol frame cap: {exc}")
+            _note("frame_cap")
+            return None
+        return _v2_result(read_frame2(sock), _declined, _note)
+
+    write_frame2(sock, {
+        "v": PROTO_V2, "op": "plan-delta", "tenant": session.tenant,
+        "digest": state.digest, "nrows": len(state.canon), "argv": argv,
+    })
+    resp = read_frame2(sock)
+    if resp is None:
+        _note("transport_error")
+        return None
+    hdr2, blob2 = resp
+    resync = hdr2.get("resync")
+    if resync == "rows":
+        _note("session_digest_mismatch")
+        try:
+            theirs = sstate.unpack_hash_table(blob2)
+        except ValueError:
+            theirs = None
+        # per-row hashes are computed HERE, lazily: only a mismatch
+        # pays them (the steady state digests the canonical bytes once)
+        changed = (
+            sstate.diff_rows(sstate.hashes_of(state.canon), theirs)
+            if theirs is not None else None
+        )
+        if changed is not None and len(changed) <= max(
+            _MIN_RESYNC_ROWS,
+            int(len(state.canon) * _MAX_RESYNC_ROWS_FRACTION),
+        ):
+            rows_blob = sstate.pack_rows(
+                [(i, state.rows[i]) for i in changed]
+            )
+            try:
+                write_frame2(sock, {
+                    "v": PROTO_V2, "op": "plan-rows",
+                    "tenant": session.tenant, "digest": state.digest,
+                    "argv": argv,
+                }, rows_blob)
+            except ValueError as exc:
+                _declined(
+                    f"request exceeds the protocol frame cap: {exc}"
+                )
+                _note("frame_cap")
+                return None
+            resp = read_frame2(sock)
+            if resp is None:
+                _note("transport_error")
+                return None
+            hdr2, blob2 = resp
+            if not hdr2.get("resync"):
+                return _v2_result((hdr2, blob2), _declined, _note)
+        resync = "full"
+    if resync:
+        # structural drift (or the daemon could not use the rows):
+        # re-register the full state — the blob is the raw text, so
+        # even this worst case skips the JSON escape pass
+        _note("session_resync_full")
+        try:
+            write_frame2(sock, {
+                "v": PROTO_V2, "op": "register", "tenant": session.tenant,
+                "argv": argv, "has_stdin": True,
+            }, session.text.encode("utf-8"))
+        except ValueError as exc:
+            _declined(f"request exceeds the protocol frame cap: {exc}")
+            _note("frame_cap")
+            return None
+        return _v2_result(read_frame2(sock), _declined, _note)
+    return _v2_result((hdr2, blob2), _declined, _note)
 
 
 def _scrape(
@@ -205,6 +392,39 @@ def fetch_trace(
     """The flight-recorder export (``-serve-dump-trace``): a response
     whose ``trace`` key is a Perfetto-loadable document, or None."""
     return _scrape(path, "dump-trace", timeout)
+
+
+def release_session(
+    path: str, tenant: str, timeout: float = 10.0
+) -> Optional[int]:
+    """Drop a tenant's resident sessions on a live v2 daemon; the
+    number released, or None when no v2 daemon answers."""
+    sock = _connect(path, CONNECT_TIMEOUT_S)
+    if sock is None:
+        return None
+    try:
+        write_frame(
+            sock, {"v": PROTO_VERSION, "op": "hello", "max_v": PROTO_V2}
+        )
+        hello = read_frame(sock)
+        if not _hello_ok(hello):
+            return None
+        assert isinstance(hello, dict)
+        max_v = hello.get("max_v")
+        if not (isinstance(max_v, int) and max_v >= PROTO_V2):
+            return None
+        sock.settimeout(timeout)
+        write_frame2(
+            sock, {"v": PROTO_V2, "op": "release", "tenant": tenant}
+        )
+        resp = read_frame2(sock)
+        if resp is None or not resp[0].get("ok"):
+            return None
+        return int(resp[0].get("released", 0))
+    except Exception:
+        return None
+    finally:
+        sock.close()
 
 
 def request_shutdown(path: str, timeout: float = 10.0) -> bool:

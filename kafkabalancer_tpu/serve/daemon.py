@@ -63,11 +63,14 @@ from kafkabalancer_tpu.obs.flight import PHASE_OF_SPAN, FlightRecorder
 from kafkabalancer_tpu.obs.trace import Span
 from kafkabalancer_tpu.serve.devmem import device_memory_stats
 from kafkabalancer_tpu.serve.protocol import (
+    PROTO_V2,
     PROTO_VERSION,
     STATS_SCHEMA,
     pidfile_path,
     read_frame,
+    read_frame2,
     write_frame,
+    write_frame2,
 )
 
 BucketKey = Tuple[int, int, int, bool]
@@ -94,12 +97,24 @@ def _argv_value(argv: List[str], name: str) -> Optional[str]:
     return val
 
 
+def _argv_brokers(argv: List[str]) -> Optional[List[int]]:
+    """The ``-broker-ids`` list of a canonical argv (None = auto) —
+    the ONE parse shared by the bucket probe (via ``_parse_request``)
+    and the session bucket memoization, so the two can never drift."""
+    from kafkabalancer_tpu.utils.flags import go_atoi
+
+    raw = _argv_value(argv, "broker-ids")
+    if not raw or raw == "auto":
+        return None
+    return [go_atoi(b) for b in raw.split(",")]
+
+
 class PlanRequest:
     """One queued ``plan`` request plus its completion latch."""
 
     __slots__ = (
         "argv", "stdin", "done", "response", "bucket", "bucketed", "staged",
-        "mb_entered", "t_submit",
+        "mb_entered", "t_submit", "session_ctx",
     )
 
     def __init__(self, argv: List[str], stdin: Optional[str]) -> None:
@@ -112,6 +127,9 @@ class PlanRequest:
         self.staged = False  # lane pipelining: host-encode stage fired
         self.mb_entered = False  # joined its microbatch barrier
         self.t_submit: Optional[float] = None  # queue-wait hist anchor
+        # resident-session context (serve/sessions.py
+        # PlanSessionContext) for the protocol-v2 session ops
+        self.session_ctx: Optional[Any] = None
 
 
 class Coalescer:
@@ -243,6 +261,8 @@ class Daemon:
         admission_hold: int = 0,
         slow_ms: float = 0.0,
         flight_dir: str = "",
+        session_cap: int = 64,
+        session_idle_s: float = 3600.0,
     ) -> None:
         self.socket_path = socket_path
         self.idle_timeout = idle_timeout
@@ -287,8 +307,16 @@ class Daemon:
         self._last_activity = time.monotonic()
         self._seq = 0
         from kafkabalancer_tpu.serve.cache import TensorizeRowCache
+        from kafkabalancer_tpu.serve.sessions import SessionStore
 
         self.tensorize_cache = TensorizeRowCache()
+        # resident cluster sessions (protocol v2; serve/sessions.py):
+        # LRU-capped per-tenant parsed/settled state + primed row cache
+        self.sessions = SessionStore(cap=session_cap, idle_s=session_idle_s)
+        # daemon-observed client fallback/resync reasons, scraped as
+        # the stats doc's "fallbacks" block (satellite: a degraded
+        # fleet is diagnosable without log archaeology)
+        self._fallbacks: Dict[str, int] = {}
         self._coalescer: Optional[Any] = None
         self._dispatcher_ready = threading.Event()
         self._lanes: "List[Any]" = []
@@ -405,24 +433,31 @@ class Daemon:
         else:
             return None
         from kafkabalancer_tpu.codecs import get_partition_list_from_reader
-        from kafkabalancer_tpu.utils.flags import go_atoi
 
         as_json = _argv_value(req.argv, "input-json") == "true"
         topics_raw = _argv_value(req.argv, "topics") or ""
         topics = [t for t in topics_raw.split(",") if len(t) >= 1]
         pl = get_partition_list_from_reader(io.StringIO(text), as_json, topics)
-        brokers: Optional[List[int]] = None
-        brokers_raw = _argv_value(req.argv, "broker-ids")
-        if brokers_raw and brokers_raw != "auto":
-            brokers = [go_atoi(b) for b in brokers_raw.split(",")]
-        return pl, brokers
+        return pl, _argv_brokers(req.argv)
+
+    def _count_fallback(self, reason: str) -> None:
+        with self._lock:
+            self._fallbacks[reason] = self._fallbacks.get(reason, 0) + 1
 
     def _bucket_of(self, req: PlanRequest) -> Optional[BucketKey]:
         """Jax-free shape-bucket probe of one queued request — the same
         ``prefetch_hints`` arithmetic the coldstart predictor uses, so
         two requests coalesce exactly when they would reuse one padded
         executable. None (= never coalesced) for zookeeper inputs and
-        anything that fails to parse (the real run surfaces the error)."""
+        anything that fails to parse (the real run surfaces the error).
+        Resident-session requests carry no input text; their bucket is
+        the session's memoized one (computed once, after its first
+        request)."""
+        ctx = req.session_ctx
+        if ctx is not None:
+            bucket: Optional[BucketKey] = ctx.session.bucket
+            if bucket is not None or req.stdin is None:
+                return bucket
         parsed = self._parse_request(req)
         if parsed is None:
             return None
@@ -463,6 +498,14 @@ class Daemon:
             "serve.requests": float(n),
             "serve.coalesced": float(n_coal),
         }
+        ctx = req.session_ctx
+        if ctx is not None:
+            ss = self.sessions.stats()
+            attrs["serve.sessions"] = float(ss["count"])
+            attrs["serve.session_bytes"] = float(ss["bytes"])
+            attrs["serve.delta_hits"] = float(ss["delta_hits"])
+            if ctx.kind in ("delta", "rebuild"):
+                attrs["serve.delta_hit"] = True
         sched = self._coalescer
         if lane is not None and hasattr(sched, "stats"):
             s = sched.stats()
@@ -493,6 +536,7 @@ class Daemon:
             attrs["serve.residency_hits"] = 0.0
             attrs["serve.cache_hits"] = float(
                 self.tensorize_cache.stats()["hits"]
+                + self.sessions.cache_stats()["hits"]
             )
 
         def refresh() -> Dict[str, Any]:
@@ -534,6 +578,12 @@ class Daemon:
             with contextlib.ExitStack() as st:
                 if lane is not None:
                     st.enter_context(lane.context())
+                if ctx is not None:
+                    # session activation AFTER the lane context: the
+                    # session's trusted-delta row cache overrides the
+                    # lane's, and the mutation tap mirrors every
+                    # applied move into the session's raw shadow
+                    st.enter_context(ctx.activate())
                 if mb is not None:
                     st.enter_context(mb.member(req))
                 rc_box.append(
@@ -541,6 +591,7 @@ class Daemon:
                         i, out, err, ["kafkabalancer"] + req.argv,
                         attrs=attrs,
                         refresh_attrs=refresh if lane is not None else None,
+                        session=ctx,
                     )
                 )
 
@@ -590,6 +641,43 @@ class Daemon:
             obs.metrics.hist_observe("serve.request_s", wall)
             phases = self.flight.pop_request_phases(thread_name)
             rc_val = rc_box[0] if rc_box else None
+            if ctx is not None:
+                # revert the unemitted complete-partition probe
+                # applies (post-run: the output already aliased them),
+                # fold the tapped mutations into the session's
+                # predicted digest (or poison it on failure), refresh
+                # the byte estimate, and memoize the shape bucket once
+                # — the connection thread still holds the session lock
+                ctx.apply_unemitted_reverts()
+                ctx.session.finish(rc_val)
+                if ctx.session.bucket is None and ctx.session.raw:
+                    try:
+                        from kafkabalancer_tpu.models.partition import (
+                            PartitionList,
+                        )
+                        from kafkabalancer_tpu.ops.coldstart import (
+                            prefetch_hints,
+                        )
+
+                        # hints run on the RAW shadow (pre-settle
+                        # semantics, moves applied): the bucket must
+                        # equal what the probe computes on the next
+                        # request's freshly parsed input, or session
+                        # requests would never coalesce with stateless
+                        # same-cluster peers
+                        hints = prefetch_hints(
+                            PartitionList(
+                                version=ctx.session.version,
+                                partitions=ctx.session.raw,
+                            ),
+                            _argv_brokers(req.argv),
+                        )
+                        ctx.session.bucket = (
+                            int(hints["P"]), int(hints["R"]),
+                            int(hints["B"]), bool(hints["all_allowed"]),
+                        )
+                    except Exception:
+                        pass  # bucket stays unmemoized; probe-only loss
             self.flight.record_request({
                 "req": seq,
                 "t": round(time.time(), 3),
@@ -815,6 +903,16 @@ class Daemon:
                 self._requests, self._coalesced, self._inflight,
             )
             slow, crashed = self._slow, self._crashed
+            fallbacks = dict(self._fallbacks)
+        # tensorize-cache attribution: the process-wide cache plus every
+        # resident session's trusted-delta cache (retired sessions
+        # folded in, so the counters stay monotone)
+        sess_cache = self.sessions.cache_stats()
+        base_cache = self.tensorize_cache.stats()
+        cache = {
+            k: base_cache.get(k, 0) + sess_cache.get(k, 0)
+            for k in ("hits", "misses", "rows_reused")
+        }
         out: Dict[str, Any] = {
             "pid": os.getpid(),
             "version": __version__,
@@ -824,8 +922,13 @@ class Daemon:
             "requests_inflight": inflight,
             "slow_requests": slow,
             "crashed_requests": crashed,
-            "cache": self.tensorize_cache.stats(),
+            "cache": cache,
             "memory": self._memory_snapshot(),
+            # resident cluster sessions (serve/sessions.py): count,
+            # resident bytes, delta hits/resyncs — serve-stats/3
+            "sessions": self.sessions.stats(),
+            # daemon-observed fallback/resync reasons, by name
+            "fallbacks": fallbacks,
         }
         sched = self._coalescer
         if self._lanes and hasattr(sched, "stats"):
@@ -845,11 +948,13 @@ class Daemon:
             ]
             out["lane_requests"] = [ln.requests for ln in self._lanes]
             out["cache"] = {
-                "hits": sum(ln.cache_stats()["hits"] for ln in self._lanes),
-                "misses": sum(
+                "hits": sess_cache["hits"] + sum(
+                    ln.cache_stats()["hits"] for ln in self._lanes
+                ),
+                "misses": sess_cache["misses"] + sum(
                     ln.cache_stats()["misses"] for ln in self._lanes
                 ),
-                "rows_reused": sum(
+                "rows_reused": sess_cache["rows_reused"] + sum(
                     ln.cache_stats()["rows_reused"] for ln in self._lanes
                 ),
             }
@@ -858,6 +963,9 @@ class Daemon:
     def _hello(self) -> Dict[str, Any]:
         return {
             "v": PROTO_VERSION, "ok": True, "op": "hello",
+            # v2 negotiation: always advertised; only clients that
+            # ALSO advertised it switch the connection's framing
+            "max_v": PROTO_V2,
             **self._core_snapshot(),
         }
 
@@ -881,6 +989,235 @@ class Daemon:
     def _touch(self) -> None:
         self._last_activity = time.monotonic()
 
+    def _dispatch_plan(self, req: PlanRequest) -> Optional[Dict[str, Any]]:
+        """Route one plan request through the dispatcher (waiting out
+        the startup race), with the in-flight gauge held; None when the
+        dispatcher never became ready."""
+        self._dispatcher_ready.wait(DISPATCHER_WAIT_S)
+        dispatcher = self._coalescer
+        if dispatcher is None:
+            return None
+        req.t_submit = time.perf_counter()
+        with self._lock:
+            self._inflight += 1
+        try:
+            return dispatcher.submit(req)
+        finally:
+            with self._lock:
+                self._inflight -= 1
+
+    # -- protocol v2: session ops ----------------------------------------
+    def _v2_plan_resp(
+        self, resp: Optional[Dict[str, Any]]
+    ) -> Tuple[Dict[str, Any], bytes]:
+        """A dispatcher response as a v2 frame: stdout rides the blob
+        (no JSON escaping), the rest in the header."""
+        if resp is None:
+            return {
+                "v": PROTO_V2, "ok": False, "op": "error",
+                "error": "daemon dispatcher not ready",
+            }, b""
+        if not resp.get("ok"):
+            return {
+                "v": PROTO_V2, "ok": False, "op": "error",
+                "error": str(resp.get("error", "request failed")),
+            }, b""
+        return {
+            "v": PROTO_V2, "ok": True, "rc": int(resp.get("rc", -1)),
+            "stderr": str(resp.get("stderr", "")),
+        }, str(resp.get("stdout", "")).encode("utf-8")
+
+    def _session_op(
+        self, op: str, hdr: Dict[str, Any], blob: bytes, argv: List[str]
+    ) -> Tuple[Dict[str, Any], bytes]:
+        """One v2 plan-family op (``plan``/``register``/``plan-delta``/
+        ``plan-rows``) — the resident-session ladder of
+        serve/sessions.py. Returns the response (header, blob)."""
+        from kafkabalancer_tpu.serve import state as sstate
+        from kafkabalancer_tpu.serve.sessions import (
+            ClusterSession,
+            PlanSessionContext,
+            flags_signature,
+        )
+
+        def _resync_full() -> Tuple[Dict[str, Any], bytes]:
+            return {
+                "v": PROTO_V2, "ok": True, "op": op, "resync": "full",
+            }, b""
+
+        if op == "plan":
+            stdin = (
+                blob.decode("utf-8", errors="replace")
+                if hdr.get("has_stdin") else None
+            )
+            return self._v2_plan_resp(
+                self._dispatch_plan(PlanRequest(argv, stdin))
+            )
+
+        tenant = str(hdr.get("tenant", ""))
+        key = (tenant, flags_signature(argv))
+        if op == "register":
+            text = blob.decode("utf-8", errors="replace")
+            sess = ClusterSession(tenant, key[1])
+            ctx = PlanSessionContext("register", sess)
+            # the fresh session is private until put(); hold its lock
+            # anyway so the store can never hand it out half-built
+            with sess.lock:
+                sess.in_use = True
+                try:
+                    req = PlanRequest(argv, text)
+                    req.session_ctx = ctx
+                    resp = self._dispatch_plan(req)
+                finally:
+                    sess.in_use = False
+            if (
+                resp is not None
+                and resp.get("ok")
+                and resp.get("rc") == 0
+                and ctx.snapshotted
+            ):
+                self.sessions.put(key, sess)
+            return self._v2_plan_resp(resp)
+
+        if op == "plan-delta":
+            digest = str(hdr.get("digest", ""))
+            sess, busy = self.sessions.checkout(key)
+            if sess is None:
+                self._count_fallback(
+                    "session_busy" if busy else "session_absent"
+                )
+                return _resync_full()
+            try:
+                if sess.digest is not None and digest == sess.digest:
+                    kind = "rebuild" if sess.universe_dirty else "delta"
+                    ctx = PlanSessionContext(
+                        kind, sess,
+                        resident_pl=sess.pl if kind == "delta" else None,
+                    )
+                    self.sessions.count_delta_hit()
+                    req = PlanRequest(argv, None)
+                    req.session_ctx = ctx
+                    return self._v2_plan_resp(self._dispatch_plan(req))
+                # mismatch: offer the row-level diff — the client ships
+                # only the rows whose hashes differ
+                self._count_fallback("session_digest_mismatch")
+                table = sess.hash_table()
+                return {
+                    "v": PROTO_V2, "ok": True, "op": op,
+                    "resync": "rows", "nrows": len(sess.raw),
+                }, table
+            finally:
+                self.sessions.checkin(sess)
+
+        if op == "plan-rows":
+            digest = str(hdr.get("digest", ""))
+            sess, busy = self.sessions.checkout(key)
+            if sess is None:
+                self._count_fallback(
+                    "session_busy" if busy else "session_absent"
+                )
+                return _resync_full()
+            try:
+                try:
+                    patches = sstate.unpack_rows(blob)
+                except ValueError:
+                    self._count_fallback("session_rows_invalid")
+                    self.sessions.count_resync_full()
+                    return _resync_full()
+                if not sess.apply_row_patches(patches):
+                    self._count_fallback("session_rows_mismatch")
+                    self.sessions.count_resync_full()
+                    return _resync_full()
+                if sess.digest != digest:
+                    # the diff was computed against a table an
+                    # interleaved request has since invalidated;
+                    # re-register from ground truth
+                    self._count_fallback("session_rows_mismatch")
+                    self.sessions.count_resync_full()
+                    return _resync_full()
+                self.sessions.count_resync_rows()
+                ctx = PlanSessionContext("rows", sess)
+                req = PlanRequest(argv, None)
+                req.session_ctx = ctx
+                return self._v2_plan_resp(self._dispatch_plan(req))
+            finally:
+                self.sessions.checkin(sess)
+
+        return {
+            "v": PROTO_V2, "ok": False, "op": "error",
+            "error": f"unknown op {op!r}",
+        }, b""
+
+    def _serve_v2(self, conn: socket.socket) -> None:
+        """The per-connection loop after a v2 hello negotiation: same
+        ops as v1 plus the session family, all in binary frames."""
+        while True:
+            try:
+                t_read0 = time.perf_counter()
+                frame = read_frame2(conn)
+                read_s = time.perf_counter() - t_read0
+            except ValueError as exc:
+                self._count_fallback("bad_frame")
+                self._log(f"serve: refused v2 frame: {exc}")
+                try:
+                    write_frame2(conn, {
+                        "v": PROTO_V2, "ok": False, "op": "error",
+                        "error": f"bad frame: {exc}",
+                    })
+                except Exception:
+                    pass
+                return
+            except Exception:
+                return
+            if frame is None:
+                return
+            hdr, blob = frame
+            if hdr.get("v") != PROTO_V2:
+                self._count_fallback("version_mismatch")
+                write_frame2(conn, {
+                    "v": PROTO_V2, "ok": False, "op": "error",
+                    "error": f"protocol version {hdr.get('v')!r}",
+                })
+                return
+            op = str(hdr.get("op", ""))
+            if op == "hello":
+                write_frame2(conn, {**self._hello(), "v": PROTO_V2})
+            elif op == "stats":
+                write_frame2(conn, {**self._stats_doc(), "v": PROTO_V2})
+            elif op == "release":
+                n = self.sessions.release(str(hdr.get("tenant", "")))
+                write_frame2(conn, {
+                    "v": PROTO_V2, "ok": True, "op": "release",
+                    "released": n,
+                })
+            elif op == "shutdown":
+                write_frame2(conn, {"v": PROTO_V2, "ok": True})
+                self._stop.set()
+                return
+            elif op in ("plan", "register", "plan-delta", "plan-rows"):
+                self._touch()
+                raw_argv = hdr.get("argv", [])
+                if not isinstance(raw_argv, list):
+                    self._count_fallback("plan_invalid")
+                    write_frame2(conn, {
+                        "v": PROTO_V2, "ok": False, "op": "error",
+                        "error": "plan payload: argv is not a list",
+                    })
+                    return
+                obs.metrics.hist_observe("serve.phase.read", read_s)
+                argv = [str(a) for a in raw_argv]
+                resp_hdr, resp_blob = self._session_op(op, hdr, blob, argv)
+                t_reply0 = time.perf_counter()
+                write_frame2(conn, resp_hdr, resp_blob)
+                obs.metrics.hist_observe(
+                    "serve.phase.reply", time.perf_counter() - t_reply0
+                )
+            else:
+                write_frame2(conn, {
+                    "v": PROTO_V2, "ok": False,
+                    "error": f"unknown op {op!r}",
+                })
+
     def _serve_connection(self, conn: socket.socket) -> None:
         try:
             conn.settimeout(PLAN_CONNECTION_TIMEOUT_S)
@@ -895,6 +1232,7 @@ class Daemon:
                     # unparseable payload gets an op-"error" frame with
                     # the reason, so the client can log WHY it fell back
                     # in-process instead of a generic fallback
+                    self._count_fallback("bad_frame")
                     self._log(f"serve: refused frame: {exc}")
                     try:
                         write_frame(conn, {
@@ -909,6 +1247,7 @@ class Daemon:
                 if msg is None:
                     return
                 if msg.get("v") != PROTO_VERSION:
+                    self._count_fallback("version_mismatch")
                     write_frame(conn, {
                         "v": PROTO_VERSION, "ok": False, "op": "error",
                         "error": f"protocol version {msg.get('v')!r}",
@@ -921,6 +1260,14 @@ class Daemon:
                 # otherwise-idle daemon alive past -serve-idle-timeout
                 if op == "hello":
                     write_frame(conn, self._hello())
+                    mv = msg.get("max_v")
+                    if isinstance(mv, int) and mv >= PROTO_V2:
+                        # both sides advertised v2: every further frame
+                        # on this connection is binary-framed. A v1
+                        # client never sends max_v, so its byte
+                        # sequences mean exactly what they always did.
+                        self._serve_v2(conn)
+                        return
                 elif op == "stats":
                     # answered HERE, on the connection thread: a live
                     # scrape must never queue behind (or pause) planning
@@ -950,22 +1297,13 @@ class Daemon:
                     )
                     # startup race: the dispatcher is built on the warm
                     # thread; a plan arriving first waits for it
-                    self._dispatcher_ready.wait(DISPATCHER_WAIT_S)
-                    dispatcher = self._coalescer
-                    if dispatcher is None:
+                    resp = self._dispatch_plan(req)
+                    if resp is None:
                         write_frame(conn, {
                             "v": PROTO_VERSION, "ok": False, "op": "error",
                             "error": "daemon dispatcher not ready",
                         })
                         return
-                    req.t_submit = time.perf_counter()
-                    with self._lock:
-                        self._inflight += 1
-                    try:
-                        resp = dispatcher.submit(req)
-                    finally:
-                        with self._lock:
-                            self._inflight -= 1
                     t_reply0 = time.perf_counter()
                     write_frame(conn, resp)
                     obs.metrics.hist_observe(
@@ -1071,6 +1409,7 @@ class Daemon:
         self._touch()
         try:
             while not self._stop.is_set():
+                self.sessions.sweep()
                 if (
                     self.idle_timeout > 0
                     and self._warm_done.is_set()

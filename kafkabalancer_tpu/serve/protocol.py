@@ -1,10 +1,27 @@
-"""The daemon wire protocol: versioned length-prefixed JSON frames.
+"""The daemon wire protocol: versioned length-prefixed frames.
 
-One frame = a 4-byte big-endian payload length followed by that many
-bytes of UTF-8 JSON. Every message carries ``{"v": PROTO_VERSION}``; a
-peer speaking a different version is treated as unreachable (the client
-falls back to the in-process path rather than risk a half-understood
-plan). Requests carry ``"op"``:
+**v1** (the baseline every peer speaks): one frame = a 4-byte big-endian
+payload length followed by that many bytes of UTF-8 JSON. Every message
+carries ``{"v": PROTO_VERSION}``; a peer speaking a different version is
+treated as unreachable (the client falls back to the in-process path
+rather than risk a half-understood plan).
+
+**v2** (negotiated at hello, see below): one frame = an 8-byte header
+(two 4-byte big-endian lengths: JSON header, raw blob) followed by the
+UTF-8 JSON header and then the raw binary blob. The blob carries bulk
+payloads — the full input text on ``register``/``plan``, the packed
+changed-row records on ``plan-rows``, the plan stdout on responses —
+WITHOUT JSON string escaping, so a megabyte of cluster state costs one
+memcpy instead of an escape/unescape pass on each side.
+
+Negotiation: a v2-capable client adds ``"max_v": PROTO_V2`` to its v1
+``hello``; the daemon always answers with its own ``max_v``. When BOTH
+sides advertised v2, every subsequent frame on that connection (both
+directions) is a v2 frame. A v1 client never sends ``max_v``, so the
+daemon keeps v1 framing for it and every pre-v2 byte sequence means
+exactly what it always did.
+
+Requests carry ``"op"``:
 
 - ``hello``    — liveness/identity handshake; the response carries the
   daemon pid, package version, uptime and request counters, and is what
@@ -24,6 +41,22 @@ plan). Requests carry ``"op"``:
 - ``shutdown`` — orderly daemon exit (acknowledged before the listener
   closes).
 
+v2-only session ops (serve/sessions.py, docs/serving.md):
+
+- ``register``   — create/replace a resident cluster session for
+  ``(tenant, flags signature)``: the blob is the raw input text; the
+  daemon parses it once, plans, and keeps the parsed + settled state
+  resident. The response IS the plan result.
+- ``plan-delta`` — the steady-state request: tenant + the client's
+  state digest + argv, NO state payload. On a digest match the daemon
+  plans from the resident session (parse/settle/encode all skipped);
+  on a mismatch it answers ``resync: "rows"`` with its row-hash table
+  (or ``resync: "full"`` when no compatible session exists).
+- ``plan-rows``  — the row-level re-sync: the blob is the packed
+  changed-row records (serve/state.py); the daemon patches its
+  resident raw rows, re-settles, and plans.
+- ``release``    — drop a tenant's resident sessions.
+
 Nothing in this module (or ``serve.client``) imports jax: the client
 side of a forwarded invocation must stay as light as an error exit —
 and that pin extends to the scrape verbs (``-serve-stats[-json]``,
@@ -38,14 +71,20 @@ import os
 import socket
 import struct
 import tempfile
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
 PROTO_VERSION = 1
+# the binary-frame extension, negotiated per connection at hello; the
+# baseline PROTO_VERSION stays 1 so every existing peer's handshake and
+# plan exchange is byte-identical (see module docstring)
+PROTO_V2 = 2
 
 # the stats scrape document's schema id — versioned independently of the
 # wire protocol (adding a scrape field bumps this, not PROTO_VERSION).
 # v2: + "memory" (per-lane HBM/residency-pool attribution)
-STATS_SCHEMA_VERSION = 2
+# v3: + "sessions" (resident cluster sessions: count/bytes/delta hits)
+#     + "fallbacks" (daemon-observed client fallback/resync reasons)
+STATS_SCHEMA_VERSION = 3
 STATS_SCHEMA = f"kafkabalancer-tpu.serve-stats/{STATS_SCHEMA_VERSION}"
 
 # a frame larger than this is a protocol error, not a payload: the
@@ -119,3 +158,52 @@ def read_frame(sock: socket.socket) -> Optional[Dict[str, Any]]:
     if not isinstance(obj, dict):
         raise ValueError("frame payload is not a JSON object")
     return obj
+
+
+# --- v2 binary frames ------------------------------------------------------
+
+_LEN2 = struct.Struct(">II")
+
+
+def write_frame2(
+    sock: socket.socket, obj: Dict[str, Any], blob: bytes = b""
+) -> None:
+    """One v2 frame: JSON header + raw binary blob, each length-capped
+    like a v1 frame. The blob is shipped as-is — no JSON escaping."""
+    header = json.dumps(obj, separators=(",", ":")).encode("utf-8")
+    if len(header) > MAX_FRAME_BYTES:
+        raise ValueError(f"frame header too large: {len(header)} bytes")
+    if len(blob) > MAX_FRAME_BYTES:
+        raise ValueError(f"frame blob too large: {len(blob)} bytes")
+    # the blob is sent as-is, never concatenated: a register payload is
+    # the whole cluster text, and building one joined bytes object
+    # would re-copy the very megabytes this framing exists not to touch
+    sock.sendall(_LEN2.pack(len(header), len(blob)) + header)
+    if blob:
+        sock.sendall(blob)
+
+
+def read_frame2(
+    sock: socket.socket,
+) -> Optional[Tuple[Dict[str, Any], bytes]]:
+    """One v2 frame as ``(header, blob)``, or None on clean EOF at a
+    frame boundary. Raises on truncation, oversized lengths, or a
+    non-JSON header — exactly the v1 error model."""
+    head = _recv_exact(sock, _LEN2.size)
+    if head is None:
+        return None
+    hn, bn = _LEN2.unpack(head)
+    if hn > MAX_FRAME_BYTES or bn > MAX_FRAME_BYTES:
+        raise ValueError(
+            f"frame lengths {hn}+{bn} exceed {MAX_FRAME_BYTES}"
+        )
+    header = _recv_exact(sock, hn) if hn else b""
+    if header is None:
+        raise ConnectionError("EOF after v2 frame header lengths")
+    blob = _recv_exact(sock, bn) if bn else b""
+    if blob is None:
+        raise ConnectionError("EOF inside v2 frame blob")
+    obj = json.loads(header.decode("utf-8"))
+    if not isinstance(obj, dict):
+        raise ValueError("v2 frame header is not a JSON object")
+    return obj, blob

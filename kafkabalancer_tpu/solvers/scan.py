@@ -1171,6 +1171,7 @@ def _decode_packed(
     mtgt = packed[2 * ml : 2 * ml + n]
     keep = _superseded_mask(mp, mslot) if drop_superseded else None
     rec = convergence.recorder()  # -explain provenance (thread-local)
+    tap = convergence.mutation_tap()  # resident-session raw-row shadow
     emitted = 0
     for i in range(n):
         part = dp.partitions[int(mp[i])]
@@ -1194,6 +1195,8 @@ def _decode_packed(
             # O(1) append; the trajectory replay happens at finalize,
             # never inside the converge wall
             rec.record_change(part, old, list(part.replicas), "session")
+        if tap is not None:
+            tap.change(part)
         opl.append(part)
         emitted += 1
     # committed vs emitted is the churn-elision attribution (-stats):

@@ -39,7 +39,12 @@
 #                         reconciling exactly with serve.requests) and
 #                         -serve-dump-trace producing valid Perfetto
 #                         JSON (docs/observability.md)
-#  10. tier-1 tests     — the ROADMAP.md verify suite (skip: --no-tests)
+#  10. resident-session — the protocol-v2 session ladder end to end:
+#      smoke              register, two outer-loop delta moves (byte
+#                         parity vs -no-daemon at every step),
+#                         serve.delta_hits >= 1 and session bytes
+#                         present via -serve-stats-json
+#  11. tier-1 tests     — the ROADMAP.md verify suite (skip: --no-tests)
 #
 # Exit 0 only when every stage that ran passed. Optional tools that are
 # not installed SKIP with a notice instead of failing: the gate must be
@@ -432,7 +437,7 @@ if [ "$cb_ready" = 1 ]; then
       -serve-stats-json 2>/dev/null | "$PYTHON" -c '
 import json, sys
 p = json.loads(sys.stdin.read())
-assert p["schema"] == "kafkabalancer-tpu.serve-stats/2", p.get("schema")
+assert p["schema"] == "kafkabalancer-tpu.serve-stats/3", p.get("schema")
 assert "serve.request_s" in p["hists"], sorted(p["hists"])
 assert "serve.phase.parse" in p["hists"], sorted(p["hists"])
 assert isinstance(p["memory"], list) and p["memory"], p.get("memory")
@@ -516,6 +521,87 @@ else
   fail=1
 fi
 rm -rf "$cb_tmp"
+
+step "resident-session smoke (register + 2 delta moves, parity + attribution)"
+# The protocol-v2 resident-session ladder end to end (docs/serving.md):
+# an outer loop registers its cluster once, then applies each emitted
+# move to the input and re-invokes — the steady-state requests must hit
+# the delta fast path (serve.delta_hits through -serve-stats-json, with
+# session bytes accounted), and EVERY step's plan must be byte-identical
+# to a fresh -no-daemon run on the same state.
+ss_tmp=$(mktemp -d "${TMPDIR:-/tmp}/kb-gate-sess.XXXXXX")
+ss_sock="$ss_tmp/kb.sock"
+cp tests/data/test.json "$ss_tmp/cluster.json"
+JAX_PLATFORMS=cpu JAX_COMPILATION_CACHE_DIR="$ss_tmp" \
+  "$PYTHON" -m kafkabalancer_tpu -serve "-serve-socket=$ss_sock" \
+  -serve-idle-timeout=180 >"$ss_tmp/daemon.log" 2>&1 &
+ss_pid=$!
+ss_ready=0
+for _ in $(seq 1 60); do
+  if "$PYTHON" -c "import sys
+from kafkabalancer_tpu.serve.client import daemon_alive
+sys.exit(0 if daemon_alive('$ss_sock') else 1)" 2>/dev/null; then
+    ss_ready=1; break
+  fi
+  sleep 0.25
+done
+if [ "$ss_ready" = 1 ]; then
+  ss_ok=1
+  for stp in 0 1 2; do
+    JAX_PLATFORMS=cpu JAX_COMPILATION_CACHE_DIR="$ss_tmp" \
+      "$PYTHON" -m kafkabalancer_tpu -input-json \
+      -input "$ss_tmp/cluster.json" -solver=tpu -max-reassign=1 \
+      -no-daemon >"$ss_tmp/local$stp.out" 2>/dev/null
+    JAX_PLATFORMS=cpu "$PYTHON" -m kafkabalancer_tpu -input-json \
+      -input "$ss_tmp/cluster.json" -solver=tpu -max-reassign=1 \
+      "-serve-socket=$ss_sock" >"$ss_tmp/served$stp.out" 2>/dev/null
+    if ! cmp -s "$ss_tmp/served$stp.out" "$ss_tmp/local$stp.out"; then
+      echo "session step $stp parity FAILED"; ss_ok=0
+    fi
+    # the outer loop's half of the contract: apply the emitted moves
+    "$PYTHON" - "$ss_tmp" "$stp" <<'PYEOF'
+import json, sys
+tmp, stp = sys.argv[1], sys.argv[2]
+state = json.load(open(f"{tmp}/cluster.json"))
+plan = json.load(open(f"{tmp}/local{stp}.out"))
+for entry in plan.get("partitions") or []:
+    for row in state["partitions"]:
+        if (row["topic"] == entry["topic"]
+                and row["partition"] == entry["partition"]):
+            row["replicas"] = list(entry["replicas"])
+            break
+json.dump(state, open(f"{tmp}/cluster.json", "w"))
+PYEOF
+  done
+  if [ "$ss_ok" = 1 ] && "$PYTHON" -m kafkabalancer_tpu \
+      "-serve-socket=$ss_sock" -serve-stats-json 2>/dev/null \
+      | "$PYTHON" -c '
+import json, sys
+p = json.loads(sys.stdin.read())
+s = p["sessions"]
+assert s["count"] >= 1, s
+assert s["delta_hits"] >= 1, s
+assert s["bytes"] > 0, s
+assert isinstance(p["fallbacks"], dict)
+'; then
+    echo "register + 2 delta moves: parity + delta_hits + session bytes: OK"
+  else
+    echo "resident-session smoke FAILED (see $ss_tmp)"; fail=1
+  fi
+  "$PYTHON" -c "from kafkabalancer_tpu.serve.client import request_shutdown
+request_shutdown('$ss_sock')" || true
+  if wait "$ss_pid"; then
+    echo "daemon clean shutdown: OK"
+  else
+    echo "daemon exited nonzero"; fail=1
+  fi
+else
+  echo "daemon never became ready (see $ss_tmp/daemon.log)"
+  tail -20 "$ss_tmp/daemon.log" 2>/dev/null
+  kill "$ss_pid" 2>/dev/null
+  fail=1
+fi
+rm -rf "$ss_tmp"
 
 if [ "$run_tests" = 1 ]; then
   step "tier-1 tests"

@@ -571,7 +571,14 @@ def test_served_fused_plan_uses_tensorize_cache(daemon):
     rv2, out2, _ = run_cli(args + [f"-serve-socket={sock}"])
     assert rv1 == rv2 == want_rv == 0
     assert out1 == want_out and out2 == want_out
-    assert d.tensorize_cache.stats()["hits"] >= 1
+    # hits land in the resident session's trusted-delta cache (the
+    # -input requests negotiate a v2 session); the daemon aggregates
+    # them with the process-wide cache for attribution
+    aggregated = (
+        d.tensorize_cache.stats()["hits"]
+        + d.sessions.cache_stats()["hits"]
+    )
+    assert aggregated >= 1
 
 
 # --- the no-jax client pin ------------------------------------------------
@@ -1692,7 +1699,7 @@ def test_dev_cached_asarray_reuses_equal_content():
 # --- live daemon telemetry: the stats / dump-trace scrape ops --------------
 
 GOLDEN_STATS = os.path.join(
-    os.path.dirname(__file__), "data", "serve_stats_schema_v2.json"
+    os.path.dirname(__file__), "data", "serve_stats_schema_v3.json"
 )
 
 
@@ -1707,7 +1714,8 @@ def test_hello_and_stats_render_from_one_snapshot(daemon):
     assert hello["requests_inflight"] == 0
     doc = sclient.fetch_stats(sock)
     assert doc is not None
-    shared = set(hello) - {"v", "ok", "op"}
+    # max_v is negotiation metadata (protocol v2), not snapshot state
+    shared = set(hello) - {"v", "ok", "op", "max_v"}
     assert shared <= set(doc), shared - set(doc)
     # idle daemon: the shared counters agree between the two scrapes
     for key in ("requests", "coalesced", "requests_inflight", "pid",
@@ -1822,7 +1830,7 @@ def test_stats_scrape_never_blocks_on_inflight_plan(sock_dir, monkeypatch):
 def test_serve_stats_json_schema_golden(daemon):
     """Golden-file pin: the stats document's top-level keys, histogram
     entry keys and flight keys are VERSIONED
-    (kafkabalancer-tpu.serve-stats/2) — changing any requires a schema
+    (kafkabalancer-tpu.serve-stats/3) — changing any requires a schema
     bump and a new golden."""
     sock, _d = daemon
     rv, _out, _err = run_cli(
@@ -1848,6 +1856,11 @@ def test_serve_stats_json_schema_golden(daemon):
         assert set(entry) == set(golden["memory_keys"]), entry
         assert entry["residency_bytes"] >= 0
         assert entry["residency_entries"] >= 0
+    # v3: resident sessions + daemon-observed fallback reasons
+    assert set(doc["sessions"]) == set(golden["sessions_keys"])
+    assert doc["sessions"]["count"] >= 1  # the -input request registered
+    assert doc["sessions"]["bytes"] > 0
+    assert isinstance(doc["fallbacks"], dict)
 
 
 def test_served_explain_forwards_and_matches(daemon, sock_dir, tmp_path):
@@ -1900,7 +1913,7 @@ def test_scrape_cli_verbs_roundtrip(daemon, sock_dir):
     rv, out, _err = run_cli([f"-serve-socket={sock}", "-serve-stats-json"])
     assert rv == 0
     doc = json.loads(out)
-    assert doc["schema"] == "kafkabalancer-tpu.serve-stats/2"
+    assert doc["schema"] == "kafkabalancer-tpu.serve-stats/3"
     assert doc["hists"]["serve.request_s"]["count"] == doc["requests"]
     rv, out, _err = run_cli([f"-serve-socket={sock}", "-serve-stats"])
     assert rv == 0

@@ -1,0 +1,679 @@
+"""Resident cluster sessions + protocol v2 (serve/sessions.py,
+serve/state.py, the v2 frame layer and the daemon's session ops).
+
+The load-bearing pins:
+
+- the client-computed state digest equals the daemon's prediction after
+  applying the daemon's own emitted moves — the entire fast path hangs
+  on these two independent computations agreeing;
+- the DELTA-path plan (no state shipped at all) is byte-identical to a
+  full-state ``-no-daemon`` plan of the same cluster state, for every
+  solver mode;
+- a digest mismatch NEVER produces a wrong answer: row-level and full
+  re-syncs both land byte-identical plans;
+- v1 clients keep working against a v2 daemon, byte for byte;
+- the session store's LRU cap and idle expiry hold under thousands of
+  registered clusters.
+"""
+
+import io
+import json
+import os
+import re
+import shutil
+import socket as socket_mod
+import tempfile
+import threading
+import time
+
+import pytest
+
+from kafkabalancer_tpu import cli
+from kafkabalancer_tpu.codecs import get_partition_list_from_reader
+from kafkabalancer_tpu.serve import client as sclient
+from kafkabalancer_tpu.serve import protocol
+from kafkabalancer_tpu.serve import state as sstate
+from kafkabalancer_tpu.serve.daemon import Daemon
+from kafkabalancer_tpu.serve.sessions import (
+    ClusterSession,
+    SessionStore,
+    flags_signature,
+)
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "data", "test.json")
+
+_TS = re.compile(r"^\d{4}/\d{2}/\d{2} \d{2}:\d{2}:\d{2} ", re.M)
+
+
+def run_cli(args, stdin=""):
+    out, err = io.StringIO(), io.StringIO()
+    rv = cli.run(io.StringIO(stdin), out, err, ["kafkabalancer"] + args)
+    return rv, out.getvalue(), err.getvalue()
+
+
+def strip_ts(err: str) -> str:
+    return _TS.sub("", err)
+
+
+@pytest.fixture
+def sock_dir():
+    d = tempfile.mkdtemp(prefix="kbss-")
+    yield d
+    shutil.rmtree(d, ignore_errors=True)
+
+
+@pytest.fixture
+def daemon(sock_dir):
+    sock = os.path.join(sock_dir, "kb.sock")
+    d = Daemon(sock, idle_timeout=60.0, warm=False, log=lambda _m: None)
+    rc_box = []
+    t = threading.Thread(
+        target=lambda: rc_box.append(d.serve_forever()), daemon=True
+    )
+    t.start()
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if sclient.daemon_alive(sock) is not None:
+            break
+        time.sleep(0.02)
+    else:
+        pytest.fail("daemon never became ready")
+    yield sock, d
+    sclient.request_shutdown(sock)
+    t.join(15)
+    assert rc_box == [0], rc_box
+
+
+def _fixture_state() -> dict:
+    with open(FIXTURE) as f:
+        return json.load(f)
+
+
+def _apply_plan(state: dict, plan_stdout: str) -> int:
+    """The outer loop's half of the contract: apply every emitted move
+    to the cluster state by topic+partition. Returns the move count."""
+    plan = json.loads(plan_stdout)
+    moves = plan.get("partitions") or []
+    for entry in moves:
+        for row in state["partitions"]:
+            if (
+                row["topic"] == entry["topic"]
+                and row["partition"] == entry["partition"]
+            ):
+                row["replicas"] = list(entry["replicas"])
+                break
+        else:
+            raise AssertionError(f"emitted move not in state: {entry}")
+    return len(moves)
+
+
+# --- serve/state.py: canonical digests + packed rows -----------------------
+
+
+def test_client_digest_matches_daemon_snapshot():
+    """The two ends of the digest handshake — the client's fast parse
+    and the daemon's Partition-object snapshot — agree on every field
+    the reader produces."""
+    text = json.dumps({"version": 1, "partitions": [
+        {"topic": "a", "partition": 0, "replicas": [1, 2]},
+        {"topic": "a", "partition": 1, "replicas": [2, 3], "weight": 2.5},
+        {"topic": "b", "partition": 0, "replicas": [3], "num_replicas": 2,
+         "brokers": [1, 2, 3], "num_consumers": 7},
+        {"topic": "b", "partition": 1, "replicas": [1], "weight": 3},
+    ]})
+    st = sstate.client_state(text, True, [])
+    assert st is not None
+    pl = get_partition_list_from_reader(text, True, [])
+    sess = ClusterSession("t", "")
+    sess.snapshot_from(pl)
+    assert sess.digest == st.digest
+    assert sess.canon == st.canon
+
+
+def test_client_digest_describe_format_and_topics_filter():
+    text = (
+        "\tTopic: foo\tPartition: 0\tLeader: 1\tReplicas: 1,2\tIsr: 1,2\n"
+        "\tTopic: bar\tPartition: 0\tLeader: 2\tReplicas: 2,3\tIsr: 2,3\n"
+        "noise line\n"
+    )
+    st_all = sstate.client_state(text, False, [])
+    st_foo = sstate.client_state(text, False, ["foo"])
+    assert st_all is not None and st_foo is not None
+    assert st_all.digest != st_foo.digest
+    pl = get_partition_list_from_reader(text, False, ["foo"])
+    sess = ClusterSession("t", "")
+    sess.snapshot_from(pl)
+    assert sess.digest == st_foo.digest
+
+
+def test_client_digest_bails_on_bad_input():
+    assert sstate.client_state("::x::", True, []) is None
+    assert sstate.client_state("", False, []) is None  # empty list
+
+
+def test_fast_json_path_mirrors_reader_semantics():
+    """The raw-dict fast path and the codecs reader must agree row for
+    row — including the reader's oddest corners: null-vs-absent
+    brokers, null replicas, int weights coerced to float, and every
+    type violation the reader rejects."""
+    good = json.dumps({"version": 1, "partitions": [
+        {"topic": "t", "partition": 0, "replicas": None, "weight": 2},
+        {"topic": "t", "partition": 1, "replicas": [1], "brokers": None},
+        {"topic": "t", "partition": 2, "replicas": [1, 2]},
+    ]})
+    st = sstate.client_state(good, True, [])
+    assert st is not None
+    pl = get_partition_list_from_reader(good, True, [])
+    sess = ClusterSession("t", "")
+    sess.snapshot_from(pl)
+    assert sess.digest == st.digest
+    assert sess.canon == st.canon
+    # null replicas -> [] but null brokers -> [] (NOT None): the two
+    # defaults differ in the reader and must differ in the digest
+    assert st.rows[0][2] == [] and st.rows[1][5] == [] and st.rows[2][5] \
+        is None
+    # every reader rejection is a fast-path None
+    for bad in (
+        {"version": 1, "partitions": [{"topic": 1}]},
+        {"version": 1, "partitions": [{"weight": True}]},
+        {"version": 1, "partitions": [{"replicas": [True]}]},
+        {"version": 1, "partitions": [{"partition": "x"}]},
+        {"version": 2, "partitions": [{}]},
+        {"version": True, "partitions": [{}]},
+        {"version": 1, "partitions": "nope"},
+        {"version": 1},
+        {"version": 1, "partitions": []},
+        [1, 2],
+    ):
+        text = json.dumps(bad)
+        assert sstate.client_state(text, True, []) is None, bad
+        with pytest.raises(Exception):
+            pl2 = get_partition_list_from_reader(text, True, [])
+            assert len(pl2) == 0  # unreachable: reader raises first
+
+
+def test_prediction_matches_next_client_read(tmp_path):
+    """The core fast-path invariant: snapshot + tap(change) + finish
+    predicts exactly the digest of the outer loop's next read (the
+    same input with only the moved row's replicas changed)."""
+    text = open(FIXTURE).read()
+    pl = get_partition_list_from_reader(text, True, [])
+    sess = ClusterSession("t", "")
+    sess.snapshot_from(pl)
+    part = pl.partitions[3]
+    part.replicas[:] = [2, 3]
+    sess.change(part)
+    sess.finish(0)
+    state = _fixture_state()
+    for row in state["partitions"]:
+        if row["topic"] == part.topic and row["partition"] == part.partition:
+            row["replicas"] = [2, 3]
+    st = sstate.client_state(json.dumps(state), True, [])
+    assert st is not None and st.digest == sess.digest
+
+
+def test_failed_request_poisons_prediction():
+    pl = get_partition_list_from_reader(open(FIXTURE).read(), True, [])
+    sess = ClusterSession("t", "")
+    sess.snapshot_from(pl)
+    sess.finish(3)
+    assert sess.digest is None
+
+
+def test_untracked_mutation_poisons_prediction():
+    from kafkabalancer_tpu.models import Partition
+
+    pl = get_partition_list_from_reader(open(FIXTURE).read(), True, [])
+    sess = ClusterSession("t", "")
+    sess.snapshot_from(pl)
+    sess.change(Partition(topic="ghost", partition=9, replicas=[1]))
+    assert sess.digest is None
+
+
+def test_universe_dirty_on_vacated_broker():
+    """A move draining a broker's last replica flips universe_dirty —
+    the resident settled list would keep a stale defaulted allowed
+    set, so the session must rebuild even on a digest match."""
+    text = json.dumps({"version": 1, "partitions": [
+        {"topic": "a", "partition": 0, "replicas": [1, 2]},
+        {"topic": "a", "partition": 1, "replicas": [1, 3]},
+    ]})
+    pl = get_partition_list_from_reader(text, True, [])
+    sess = ClusterSession("t", "")
+    sess.snapshot_from(pl)
+    assert not sess.universe_dirty
+    part = pl.partitions[1]
+    part.replicas[:] = [1, 2]  # broker 3 vacated
+    sess.change(part)
+    assert sess.universe_dirty
+    rebuilt = sess.rebuild_pl()
+    assert not sess.universe_dirty
+    assert [p.replicas for p in rebuilt.iter_partitions()] == [[1, 2], [1, 2]]
+
+
+def test_pack_unpack_rows_roundtrip():
+    rows = [
+        (0, ("topic-α", 3, [1, 2, 9999999999], 1.5, 3, None, 0)),
+        (7, ("t", 0, [], 0.0, 0, [4, 5], 2)),
+    ]
+    blob = sstate.pack_rows(rows)
+    assert sstate.unpack_rows(blob) == rows
+    with pytest.raises(ValueError):
+        sstate.unpack_rows(blob[:-3])
+
+
+def test_hash_table_and_diff():
+    hashes = [b"12345678", b"abcdefgh", b"ABCDEFGH"]
+    blob = sstate.pack_hash_table(hashes)
+    assert sstate.unpack_hash_table(blob) == hashes
+    with pytest.raises(ValueError):
+        sstate.unpack_hash_table(blob[:-1])
+    theirs = [b"12345678", b"xxxxxxxx", b"ABCDEFGH"]
+    assert sstate.diff_rows(hashes, theirs) == [1]
+    assert sstate.diff_rows(hashes, theirs[:2]) is None  # row count drift
+
+
+def test_flags_signature_excludes_output_flags():
+    a = ["-no-daemon=true", "-fused=true", "-max-reassign=4",
+         "-metrics-json=/x", "-stats=true", "-full-output=true"]
+    b = ["-no-daemon=true", "-fused=true", "-max-reassign=4"]
+    assert flags_signature(a) == flags_signature(b)
+    assert flags_signature(a) != flags_signature(b + ["-solver=tpu"])
+
+
+# --- protocol v2 frames ----------------------------------------------------
+
+
+def test_frame2_roundtrip_and_caps():
+    a, b = socket_mod.socketpair()
+    try:
+        protocol.write_frame2(a, {"v": 2, "op": "x"}, b"\x00\x01raw")
+        got = protocol.read_frame2(b)
+        assert got == ({"v": 2, "op": "x"}, b"\x00\x01raw")
+        protocol.write_frame2(a, {"v": 2})
+        assert protocol.read_frame2(b) == ({"v": 2}, b"")
+        a.close()
+        assert protocol.read_frame2(b) is None  # clean EOF
+    finally:
+        b.close()
+    with pytest.raises(ValueError):
+        protocol.write_frame2(
+            None, {"v": 2}, b"x" * (protocol.MAX_FRAME_BYTES + 1)
+        )
+
+
+# --- SessionStore: LRU, idle expiry, release -------------------------------
+
+
+def test_store_lru_cap_under_thousands():
+    store = SessionStore(cap=32, idle_s=0)
+    for i in range(2000):
+        s = ClusterSession(f"tenant-{i}", "")
+        s.approx_bytes = 100
+        store.put((f"tenant-{i}", ""), s)
+    st = store.stats()
+    assert st["count"] == 32
+    assert st["evicted_lru"] == 2000 - 32
+    assert st["registered"] == 2000
+    assert st["bytes"] == 32 * 100
+    # most-recent survivors
+    assert store.get(("tenant-1999", "")) is not None
+    assert store.get(("tenant-0", "")) is None
+
+
+def test_store_idle_expiry_and_in_use_protection():
+    store = SessionStore(cap=10, idle_s=5.0)
+    s1 = ClusterSession("a", "")
+    s2 = ClusterSession("b", "")
+    store.put(("a", ""), s1)
+    store.put(("b", ""), s2)
+    got, busy = store.checkout(("a", ""))
+    assert got is s1 and not busy
+    # second checkout of the same session reports busy, not a block
+    none, busy2 = store.checkout(("a", ""))
+    assert none is None and busy2
+    now = time.monotonic() + 60
+    assert store.sweep(now) == 1  # only the idle one expires
+    assert store.get(("b", "")) is None
+    assert store.get(("a", "")) is s1  # in_use: protected
+    store.checkin(s1)
+    assert store.sweep(now) == 1
+    assert store.stats()["expired_idle"] == 2
+
+
+def test_store_release_by_tenant():
+    store = SessionStore(cap=10, idle_s=0)
+    store.put(("a", "sig1"), ClusterSession("a", "sig1"))
+    store.put(("a", "sig2"), ClusterSession("a", "sig2"))
+    store.put(("b", ""), ClusterSession("b", ""))
+    assert store.release("a") == 2
+    assert store.stats()["count"] == 1 and store.stats()["released"] == 2
+
+
+# --- trusted-delta row cache ----------------------------------------------
+
+
+def test_trusted_delta_patch_matches_full_encode():
+    import numpy as np
+
+    from kafkabalancer_tpu.models import default_rebalance_config
+    # NOTE: ops/__init__ shadows the tensorize SUBMODULE with the
+    # tensorize function; import the seam directly from the module
+    from kafkabalancer_tpu.ops.tensorize import (
+        set_thread_row_cache,
+        tensorize,
+    )
+    from kafkabalancer_tpu.serve.cache import TensorizeRowCache
+
+    cfg = default_rebalance_config()
+    pl = get_partition_list_from_reader(open(FIXTURE).read(), True, [])
+    from kafkabalancer_tpu.balancer.steps import fill_defaults
+
+    fill_defaults(pl, cfg)
+    cache = TensorizeRowCache()
+    cache.enable_trusted_deltas()
+    set_thread_row_cache(cache)
+    try:
+        tensorize(pl, cfg)  # prime
+        pl.partitions[2].replicas[0] = 3
+        cache.mark_changed(2)
+        dp = tensorize(pl, cfg)  # trusted patch: no key scan
+        assert cache.stats()["hits"] == 1
+    finally:
+        set_thread_row_cache(None)
+    fresh = tensorize(pl, cfg)
+    for field in ("weights", "replicas", "nrep_cur", "nrep_tgt", "ncons",
+                  "allowed", "member", "pvalid", "bvalid", "topic_id"):
+        assert np.array_equal(getattr(dp, field), getattr(fresh, field)), field
+
+
+# --- end to end through the daemon ----------------------------------------
+
+
+@pytest.mark.parametrize("mode_args", [
+    ["-solver=greedy"],
+    ["-solver=tpu"],
+    ["-solver=beam"],
+    ["-fused", "-fused-batch=2"],
+], ids=["greedy", "tpu", "beam", "fused"])
+def test_outer_loop_delta_parity_per_solver(daemon, sock_dir, mode_args):
+    """Three outer-loop steps per solver mode: every served step —
+    register, then digest-matched delta requests — is byte-identical
+    (stdout + rc, stderr modulo timestamps) to a fresh ``-no-daemon``
+    run on the same state, and the emitted moves round-trip through
+    the simulated cluster."""
+    sock, d = daemon
+    state = _fixture_state()
+    input_path = os.path.join(sock_dir, "cluster.json")
+    args = ["-input-json", f"-input={input_path}", "-max-reassign=2"]
+    args += mode_args
+    for step in range(3):
+        with open(input_path, "w") as f:
+            json.dump(state, f)
+        want_rv, want_out, want_err = run_cli(args + ["-no-daemon"])
+        got_rv, got_out, got_err = run_cli(args + [f"-serve-socket={sock}"])
+        assert (got_rv, got_out) == (want_rv, want_out), f"step {step}"
+        assert strip_ts(got_err) == strip_ts(want_err), f"step {step}"
+        _apply_plan(state, want_out)
+    st = d.sessions.stats()
+    assert st["delta_hits"] >= 1, st
+    assert st["bytes"] > 0
+
+
+def test_outer_loop_steady_state_hits_delta_path(daemon, sock_dir):
+    """The steady state is delta hits: after register, every subsequent
+    predicted request plans with ZERO state shipped (delta_hits grows
+    per step), and the served attribution gauges carry the session
+    block."""
+    sock, d = daemon
+    state = _fixture_state()
+    input_path = os.path.join(sock_dir, "cluster.json")
+    metrics = os.path.join(sock_dir, "m.json")
+    args = ["-input-json", f"-input={input_path}", "-solver=tpu",
+            "-max-reassign=1", f"-serve-socket={sock}",
+            f"-metrics-json={metrics}"]
+    hits = []
+    for _step in range(4):
+        with open(input_path, "w") as f:
+            json.dump(state, f)
+        rv, out, _ = run_cli(args)
+        assert rv == 0
+        hits.append(d.sessions.stats()["delta_hits"])
+        _apply_plan(state, out)
+    assert hits[-1] >= 2, hits
+    payload = json.load(open(metrics))
+    assert payload["gauges"]["served"] is True
+    assert payload["gauges"]["serve.delta_hit"] is True
+    assert payload["gauges"]["serve.sessions"] >= 1.0
+    assert payload["gauges"]["serve.session_bytes"] > 0
+
+
+def test_external_drift_resyncs_rows_byte_identical(daemon, sock_dir):
+    """Cluster drift the daemon could not predict (an out-of-band
+    replica change) mismatches the digest; the row-level resync ships
+    only the drifted rows and the plan stays byte-identical."""
+    sock, d = daemon
+    state = _fixture_state()
+    input_path = os.path.join(sock_dir, "cluster.json")
+    args = ["-input-json", f"-input={input_path}", "-solver=tpu",
+            "-max-reassign=1"]
+    with open(input_path, "w") as f:
+        json.dump(state, f)
+    rv, out, _ = run_cli(args + [f"-serve-socket={sock}"])
+    assert rv == 0
+    _apply_plan(state, out)
+    # out-of-band drift: mutate a row the plan did not touch
+    state["partitions"][0]["replicas"] = [2, 3]
+    with open(input_path, "w") as f:
+        json.dump(state, f)
+    want_rv, want_out, want_err = run_cli(args + ["-no-daemon"])
+    got_rv, got_out, got_err = run_cli(args + [f"-serve-socket={sock}"])
+    assert (got_rv, got_out) == (want_rv, want_out)
+    assert strip_ts(got_err) == strip_ts(want_err)
+    assert d.sessions.stats()["resyncs_rows"] >= 1
+
+
+def test_structural_drift_full_resync_byte_identical(daemon, sock_dir):
+    """A row-count change (new partition appears) cannot row-patch;
+    the client re-registers the full state and parity holds."""
+    sock, d = daemon
+    state = _fixture_state()
+    input_path = os.path.join(sock_dir, "cluster.json")
+    args = ["-input-json", f"-input={input_path}", "-solver=greedy",
+            "-max-reassign=1"]
+    with open(input_path, "w") as f:
+        json.dump(state, f)
+    rv, _out, _ = run_cli(args + [f"-serve-socket={sock}"])
+    assert rv == 0
+    registered_before = d.sessions.stats()["registered"]
+    state["partitions"].append(
+        {"topic": "fresh", "partition": 0, "replicas": [1, 2]}
+    )
+    with open(input_path, "w") as f:
+        json.dump(state, f)
+    want_rv, want_out, want_err = run_cli(args + ["-no-daemon"])
+    got_rv, got_out, got_err = run_cli(args + [f"-serve-socket={sock}"])
+    assert (got_rv, got_out) == (want_rv, want_out)
+    assert strip_ts(got_err) == strip_ts(want_err)
+    assert d.sessions.stats()["registered"] == registered_before + 1
+
+
+def test_complete_partition_probe_move_never_wrong(daemon, sock_dir):
+    """The complete-partition probe move is applied to the live list
+    but not emitted — the cluster never sees it. The session reverts
+    it post-run (serve/sessions.py apply_unemitted_reverts), so the
+    DEFAULT flag set still hits the delta fast path, byte-identically
+    (the aliasing subtlety: the revert must not change the emitted
+    bytes, which can alias the probe partition)."""
+    sock, d = daemon
+    state = _fixture_state()
+    input_path = os.path.join(sock_dir, "cluster.json")
+    args = ["-input-json", f"-input={input_path}", "-solver=greedy",
+            "-max-reassign=2", "-complete-partition"]
+    for step in range(4):
+        with open(input_path, "w") as f:
+            json.dump(state, f)
+        want_rv, want_out, want_err = run_cli(args + ["-no-daemon"])
+        got_rv, got_out, got_err = run_cli(args + [f"-serve-socket={sock}"])
+        assert (got_rv, got_out) == (want_rv, want_out), f"step {step}"
+        assert strip_ts(got_err) == strip_ts(want_err), f"step {step}"
+        _apply_plan(state, want_out)
+    # the probe-move revert keeps the prediction live: steps after the
+    # register hit the delta path despite the unemitted applies
+    assert d.sessions.stats()["delta_hits"] >= 1
+
+
+def test_serve_no_session_disables(daemon, sock_dir):
+    sock, d = daemon
+    args = ["-input-json", f"-input={FIXTURE}", "-serve-no-session",
+            f"-serve-socket={sock}"]
+    want_rv, want_out, _ = run_cli(
+        ["-input-json", f"-input={FIXTURE}", "-no-daemon"]
+    )
+    rv, out, _ = run_cli(args)
+    assert (rv, out) == (want_rv, want_out)
+    assert d.sessions.stats()["count"] == 0
+
+
+def test_explicit_session_name_and_release(daemon):
+    sock, d = daemon
+    args = ["-input-json", f"-input={FIXTURE}", "-serve-session=my-fleet",
+            f"-serve-socket={sock}"]
+    rv, _out, _ = run_cli(args)
+    assert rv == 0
+    assert d.sessions.get(
+        ("my-fleet", flags_signature(["-input-json=true"]))
+    ) is not None
+    released = sclient.release_session(sock, "my-fleet")
+    assert released == 1
+    assert d.sessions.stats()["count"] == 0
+
+
+def test_v1_client_against_v2_daemon_byte_identical(daemon):
+    """Handshake compatibility pin: a raw v1-protocol conversation
+    (no max_v in hello, JSON plan frame) gets the exact plan a
+    ``-no-daemon`` run produces — the daemon only switches framing for
+    clients that advertised v2."""
+    sock, _d = daemon
+    want_rv, want_out, want_err = run_cli(
+        ["-input-json", "-no-daemon"], stdin=open(FIXTURE).read()
+    )
+    s = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+    s.settimeout(60)
+    try:
+        s.connect(sock)
+        protocol.write_frame(s, {"v": 1, "op": "hello"})
+        hello = protocol.read_frame(s)
+        assert hello["ok"] and hello["v"] == 1
+        assert hello["max_v"] >= 2  # advertised, not imposed
+        protocol.write_frame(s, {
+            "v": 1, "op": "plan",
+            "argv": ["-input-json=true", "-no-daemon=true"],
+            "stdin": open(FIXTURE).read(),
+        })
+        resp = protocol.read_frame(s)
+    finally:
+        s.close()
+    assert resp["ok"] and resp["v"] == 1
+    assert resp["rc"] == want_rv
+    assert resp["stdout"] == want_out
+    assert strip_ts(resp["stderr"]) == strip_ts(want_err)
+
+
+def test_v1_library_client_still_forwards(daemon, monkeypatch):
+    """An OLD client build (one that never negotiates v2) keeps
+    forwarding through the new daemon byte-identically."""
+    sock, d = daemon
+
+    def old_forward(path, argv, stdin_text, **_kw):
+        s = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+        s.settimeout(60)
+        try:
+            s.connect(path)
+            protocol.write_frame(s, {"v": 1, "op": "hello"})
+            hello = protocol.read_frame(s)
+            if not hello or not hello.get("ok"):
+                return None
+            req = {"v": 1, "op": "plan", "argv": argv}
+            if stdin_text is not None:
+                req["stdin"] = stdin_text
+            protocol.write_frame(s, req)
+            resp = protocol.read_frame(s)
+            return sclient.ServedResult(
+                resp["rc"], resp["stdout"], resp["stderr"]
+            )
+        finally:
+            s.close()
+
+    monkeypatch.setattr(sclient, "forward_plan", old_forward)
+    want_rv, want_out, _ = run_cli(
+        ["-input-json", f"-input={FIXTURE}", "-no-daemon"]
+    )
+    rv, out, _ = run_cli(
+        ["-input-json", f"-input={FIXTURE}", f"-serve-socket={sock}"]
+    )
+    assert (rv, out) == (want_rv, want_out)
+    assert d.sessions.stats()["count"] == 0  # v1 path: no session
+
+
+# --- fallback attribution --------------------------------------------------
+
+
+def test_client_fallback_counter_daemon_down(sock_dir):
+    """A dead socket file: the invocation plans in-process (stderr
+    silent, parity preserved elsewhere) and the fallback REASON lands
+    as a counter in its own metrics export."""
+    stale = os.path.join(sock_dir, "stale.sock")
+    with open(stale, "w") as f:
+        f.write("not a socket")
+    metrics = os.path.join(sock_dir, "m.json")
+    rv, _out, _err = run_cli(
+        ["-input-json", f"-input={FIXTURE}", f"-serve-socket={stale}",
+         f"-metrics-json={metrics}"]
+    )
+    assert rv == 0
+    payload = json.load(open(metrics))
+    assert payload["counters"].get("serve.fallbacks.daemon_down") == 1
+
+
+def test_daemon_fallback_counters_in_scrape(daemon):
+    """Daemon-observed fallback reasons ride the stats scrape and the
+    Prometheus rendering."""
+    sock, d = daemon
+    # provoke a version-mismatch refusal
+    s = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+    s.settimeout(10)
+    try:
+        s.connect(sock)
+        protocol.write_frame(s, {"v": 99, "op": "hello"})
+        resp = protocol.read_frame(s)
+        assert resp["ok"] is False
+    finally:
+        s.close()
+    doc = sclient.fetch_stats(sock)
+    assert doc["fallbacks"].get("version_mismatch", 0) >= 1
+    from kafkabalancer_tpu.obs.export import (
+        render_prometheus,
+        render_serve_stats,
+    )
+
+    prom = render_prometheus(doc)
+    assert 'kafkabalancer_tpu_serve_fallbacks{reason="version_mismatch"}' \
+        in prom
+    assert "kafkabalancer_tpu_sessions_count" in prom
+    human = render_serve_stats(doc)
+    assert "sessions:" in human and "fallbacks:" in human
+
+
+def test_session_stats_in_hello_and_scrape(daemon):
+    sock, d = daemon
+    rv, _out, _ = run_cli(
+        ["-input-json", f"-input={FIXTURE}", f"-serve-socket={sock}"]
+    )
+    assert rv == 0
+    hello = sclient.daemon_alive(sock)
+    doc = sclient.fetch_stats(sock)
+    for scrape in (hello, doc):
+        assert scrape["sessions"]["count"] == 1
+        assert scrape["sessions"]["bytes"] > 0
+        assert scrape["sessions"]["registered"] == 1
