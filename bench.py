@@ -746,6 +746,51 @@ def _run_delta_probe(n_parts: int, n_brokers: int) -> dict:
     return out
 
 
+def _run_replay_probe() -> dict:
+    """``replay_fleet_churn``: the multi-tenant churn replay harness
+    (kafkabalancer_tpu/replay/, docs/observability.md § Per-tenant
+    attribution) at smoke scale — a seeded 3-tenant fleet with diurnal
+    arrival skew, weight-shift churn, a topic storm and a broker
+    failure, driven closed-loop through the real client against a
+    private daemon. Lands the replay/1 artifact (per-tenant
+    p50/p95/p99, delta-hit/resync/fallback attribution, session-thrash
+    rate, padded-slot waste) so the artifact SCHEMA is pinned in bench
+    rounds before the bench-host BENCH_r06 run records it at fleet
+    scale. Scale knobs: BENCH_REPLAY_TENANTS / BENCH_REPLAY_REQUESTS.
+    """
+    out: dict = {}
+    if os.environ.get("BENCH_NO_SERVED") == "1":
+        return out
+    from kafkabalancer_tpu.replay import ReplayConfig, run_replay
+
+    fast = os.environ.get("BENCH_FAST") == "1"
+    cfg = ReplayConfig(
+        seed=int(os.environ.get("BENCH_REPLAY_SEED", "7")),
+        tenants=int(os.environ.get("BENCH_REPLAY_TENANTS", "3")),
+        requests=int(
+            os.environ.get("BENCH_REPLAY_REQUESTS", "40" if fast else "120")
+        ),
+        topic_storm_every=17,
+        broker_failure_every=29,
+    )
+    artifact = run_replay(cfg, log=log)
+    # the request-error tails are debugging payload, not a bench number
+    artifact.pop("request_errors", None)
+    out["replay_fleet_churn"] = artifact
+    per_tenant = artifact.get("per_tenant", {})
+    log(
+        f"replay fleet churn (seed {cfg.seed}, {cfg.tenants} tenants, "
+        f"{artifact.get('requests_issued')} requests in "
+        f"{artifact.get('wall_s')}s): reconciled="
+        f"{artifact.get('reconciled')}, delta-hit rates "
+        + ", ".join(
+            f"{name}={e.get('delta_hit_rate', 0):.0%}"
+            for name, e in sorted(per_tenant.items())
+        )
+    )
+    return out
+
+
 THROUGHPUT_LEVELS = (1, 2, 4)
 THROUGHPUT_REQS_PER_CLIENT = 3
 
@@ -1103,6 +1148,14 @@ def main() -> None:
         cold.update(_run_throughput_probe(n_parts, n_brokers))
     except Exception as exc:
         log(f"throughput probe unavailable: {exc!r}")
+
+    # replay probe: the seeded multi-tenant churn harness at smoke
+    # scale — pins the replay/1 artifact schema and the per-tenant
+    # scrape reconciliation in every bench round
+    try:
+        cold.update(_run_replay_probe())
+    except Exception as exc:
+        log(f"replay probe unavailable: {exc!r}")
 
     import jax
     import jax.numpy as jnp

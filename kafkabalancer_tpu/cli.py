@@ -221,7 +221,7 @@ def _track_warm_thread(t: Any) -> None:
 _NO_FORWARD_FLAGS = frozenset((
     "serve", "serve-socket", "serve-idle-timeout", "serve-prewarm",
     "serve-lanes", "serve-microbatch", "serve-batch-mode",
-    "serve-admission-hold", "serve-slow-ms",
+    "serve-admission-hold", "serve-slow-ms", "serve-tenant-cap",
     "serve-stats", "serve-stats-json", "serve-dump-trace", "metrics-prom",
     "serve-session", "serve-no-session",
     "no-daemon", "help", "pprof", "pprof-path", "jax-profile", "input",
@@ -614,6 +614,14 @@ def _run_impl(
             "request log) when a served request exceeds this many "
             "milliseconds (0 disables)",
         )
+        f_serve_tenant_cap = f.int(
+            "serve-tenant-cap",
+            32,
+            "Daemon: per-tenant telemetry label bound — the top-K "
+            "most-recently-active tenants keep individual latency "
+            "histograms and counters; the rest roll up into 'other' "
+            "(docs/observability.md)",
+        )
         f_serve_session = f.string(
             "serve-session",
             "",
@@ -640,7 +648,7 @@ def _run_impl(
             "serve-stats-json",
             False,
             "Scrape a live daemon's telemetry as one line of "
-            "schema-versioned JSON (kafkabalancer-tpu.serve-stats/3)",
+            "schema-versioned JSON (kafkabalancer-tpu.serve-stats/4)",
         )
         f_serve_dump_trace = f.string(
             "serve-dump-trace",
@@ -827,6 +835,7 @@ def _run_impl(
                 batch_mode=f_serve_batch_mode.value,
                 admission_hold=f_serve_admission_hold.value,
                 slow_ms=f_serve_slow_ms.value,
+                tenant_cap=f_serve_tenant_cap.value,
             ).serve_forever()
 
         if not f_no_daemon.value and not (f_pprof.value or f_jaxprof.value):
@@ -865,21 +874,25 @@ def _run_impl(
                     stdin_text = i.read()
             if forwardable:
                 declined: List[str] = []
+                # the tenant identity: an explicit -serve-session name,
+                # else the input path ("-" for true stdin). A v2 daemon
+                # keys its resident state per (tenant, planning-flags
+                # signature) AND attributes the request's telemetry to
+                # the tenant (serve-stats/4 "tenants" block) — so the
+                # label is derived even when sessions are disabled; a
+                # request with no derivable identity rolls up as
+                # "other" daemon-side.
+                tenant = f_serve_session.value or (
+                    os.path.abspath(f_input.value)
+                    if f_input.value != ""
+                    else ("-" if stdin_text is not None else "")
+                )
                 session_spec = None
                 if (
                     stdin_text is not None
                     and not f_serve_no_session.value
                     and f_zk.value == ""
                 ):
-                    # the resident-session identity: an explicit
-                    # -serve-session name, else the input path ("-"
-                    # for true stdin). A v2 daemon keys its resident
-                    # state per (tenant, planning-flags signature);
-                    # v1 daemons ignore all of this.
-                    tenant = f_serve_session.value or (
-                        os.path.abspath(f_input.value)
-                        if f_input.value != "" else "-"
-                    )
                     session_spec = serve_client.SessionSpec(
                         tenant=tenant,
                         text=stdin_text,
@@ -912,6 +925,7 @@ def _run_impl(
                         on_fallback=declined.append,
                         session=session_spec,
                         note=_note_fallback,
+                        tenant=tenant,
                     )
                 if served is None and declined:
                     # the daemon POSITIVELY declined (structured error

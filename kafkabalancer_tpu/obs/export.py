@@ -29,7 +29,7 @@ from __future__ import annotations
 import json
 import os
 import re
-from typing import Any, Dict, List, Optional, Set, TextIO
+from typing import Any, Dict, List, Optional, Set, TextIO, Tuple
 
 from kafkabalancer_tpu.obs.metrics import SCHEMA, MetricsRegistry
 from kafkabalancer_tpu.obs.trace import Tracer
@@ -210,6 +210,45 @@ def _prom_name(name: str) -> str:
     return _PROM_PREFIX + _PROM_BAD.sub("_", name)
 
 
+def _prom_label(value: str) -> str:
+    """A label VALUE escaped per the exposition format (backslash,
+    quote, newline) — tenant labels are operator strings (input paths,
+    session names) and must not be able to break the exposition."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _prom_summary_samples(
+    lines: List[str], metric: str, labels: str, h: Dict[str, Any]
+) -> None:
+    """One summary's sample lines (p50/p95/p99 quantiles + ``_sum`` /
+    ``_count``) under an optional label set (e.g. ``lane="0"``) — the
+    ONE emission shared by the plain, lane-labeled and tenant-labeled
+    histogram expositions, so quantile handling cannot drift between
+    them. The caller emits the ``# TYPE`` line."""
+    sep = "," if labels else ""
+    for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+        lines.append(
+            f'{metric}{{{labels}{sep}quantile="{q}"}} '
+            f"{_prom_value(h.get(key, 0))}"
+        )
+    suffix = f"{{{labels}}}" if labels else ""
+    lines.append(f"{metric}_sum{suffix} {_prom_value(h.get('sum', 0))}")
+    lines.append(f"{metric}_count{suffix} {int(h.get('count', 0))}")
+
+
+# name-embedded per-lane histogram series ("serve.lane<N>.<metric>"):
+# ALSO exposed as one label-dimensioned series per metric
+# (kafkabalancer_tpu_serve_lane_<metric>{lane="N"}). The name-embedded
+# spelling stays emitted alongside for one release — deprecated, see
+# docs/observability.md § Per-lane series
+_LANE_HIST_RE = re.compile(r"^serve\.lane(\d+)\.(.+)$")
+
+
 def _prom_value(v: float) -> str:
     """Exact exposition: integers stay integers (a %g-rounded counter
     reads as frozen between scrapes once it passes 6 digits and breaks
@@ -299,13 +338,138 @@ def render_prometheus(doc: Dict[str, Any]) -> str:
             continue
         m = _prom_name(name)
         lines.append(f"# TYPE {m} summary")
-        for q, key in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
-            lines.append(
-                f'{m}{{quantile="{q}"}} {_prom_value(h.get(key, 0))}'
-            )
-        lines.append(f"{m}_sum {_prom_value(h.get('sum', 0))}")
-        lines.append(f"{m}_count {int(h.get('count', 0))}")
+        _prom_summary_samples(lines, m, "", h)
+    # per-lane histograms as LABEL-dimensioned series: every
+    # serve.lane<N>.<metric> hist re-emitted under one
+    # serve_lane_<metric>{lane="N"} summary per metric (the
+    # name-embedded spelling above stays for one release — deprecated)
+    lane_hists: Dict[str, List[Tuple[str, Dict[str, Any]]]] = {}
+    for name, h in sorted(doc.get("hists", {}).items()):
+        mt = _LANE_HIST_RE.match(name)
+        if mt is None or not isinstance(h, dict):
+            continue
+        lane_hists.setdefault(mt.group(2), []).append((mt.group(1), h))
+    for metric in sorted(lane_hists):
+        m = _prom_name(f"serve.lane.{metric}")
+        lines.append(f"# TYPE {m} summary")
+        for lane, h in lane_hists[metric]:
+            _prom_summary_samples(lines, m, f'lane="{lane}"', h)
+    _render_prometheus_tenants(lines, doc.get("tenants"))
     return "\n".join(lines) + "\n"
+
+
+# per-tenant scalar samples: (entry key, exposed metric suffix, type)
+_TENANT_SCALARS = (
+    ("requests", "tenant_requests", "counter"),
+    ("crashed", "tenant_crashed_requests", "counter"),
+    ("delta_hits", "tenant_delta_hits", "counter"),
+    ("resyncs_rows", "tenant_resyncs_rows", "counter"),
+    ("resyncs_full", "tenant_resyncs_full", "counter"),
+    ("fallbacks", "tenant_fallbacks", "counter"),
+    ("sessions", "tenant_sessions", "gauge"),
+    ("session_bytes", "tenant_session_bytes", "gauge"),
+)
+
+
+def _render_prometheus_tenants(
+    lines: List[str], tenants: Any
+) -> None:
+    """The serve-stats/4 ``tenants`` block as tenant-labeled series:
+    one sample per live top-K tenant plus the ``other`` rollup, and the
+    per-tenant latency hist as a tenant-labeled summary. Label memory
+    is bounded by the daemon's tenant cap, so the exposition cannot
+    explode its series cardinality either."""
+    if not isinstance(tenants, dict):
+        return
+    entries: List[Tuple[str, Dict[str, Any]]] = []
+    top = tenants.get("top")
+    if isinstance(top, dict):
+        entries.extend(sorted(top.items()))
+    other = tenants.get("other")
+    if isinstance(other, dict):
+        entries.append(("other", other))
+    if isinstance(tenants.get("demoted"), (int, float)):
+        m = _prom_name("tenants_demoted")
+        lines.append(f"# TYPE {m} counter")
+        lines.append(f"{m} {_prom_value(tenants['demoted'])}")
+    if not entries:
+        return
+    for key, suffix, typ in _TENANT_SCALARS:
+        samples = [
+            (label, e[key]) for label, e in entries
+            if isinstance(e.get(key), (int, float))
+            and not isinstance(e.get(key), bool)
+        ]
+        if not samples:
+            continue
+        m = _prom_name(suffix)
+        lines.append(f"# TYPE {m} {typ}")
+        for label, v in samples:
+            lines.append(
+                f'{m}{{tenant="{_prom_label(label)}"}} {_prom_value(v)}'
+            )
+    m = _prom_name("tenant_request_s")
+    emitted_type = False
+    for label, e in entries:
+        h = e.get("request_s")
+        if not isinstance(h, dict):
+            continue
+        if not emitted_type:
+            lines.append(f"# TYPE {m} summary")
+            emitted_type = True
+        _prom_summary_samples(
+            lines, m, f'tenant="{_prom_label(label)}"', h
+        )
+
+
+def _fmt_latency(v: Any) -> str:
+    if not isinstance(v, (int, float)) or isinstance(v, bool):
+        return "n/a"
+    return f"{v * 1e3:.3g}ms" if v < 1.0 else f"{v:.3g}s"
+
+
+def _render_tenant_table(tenants: Any) -> List[str]:
+    """The ``-serve-stats`` top-tenants table: requests, latency
+    p50/p95, delta-hit rate and resident session bytes per live top-K
+    tenant (busiest first), plus the ``other`` rollup — so the scrape
+    answers "which tenant is slow / thrashing / eating the fallback
+    budget" without a Prometheus stack."""
+    if not isinstance(tenants, dict):
+        return []
+    rows: List[Tuple[str, Dict[str, Any]]] = []
+    top = tenants.get("top")
+    if isinstance(top, dict):
+        rows.extend(
+            sorted(
+                top.items(),
+                key=lambda kv: -int(kv[1].get("requests", 0)),
+            )
+        )
+    other = tenants.get("other")
+    if isinstance(other, dict):
+        rows.append(("(other)", other))
+    if not rows:
+        return []
+    lines = [
+        f"  tenants: {len(rows)} tracked (cap "
+        f"{tenants.get('cap', 0)}, {tenants.get('demoted', 0)} demoted "
+        "into other)",
+        "    tenant                          requests  p50       "
+        "p95       delta%  resident",
+    ]
+    for label, e in rows:
+        h = e.get("request_s") or {}
+        n = int(e.get("requests", 0))
+        hits = int(e.get("delta_hits", 0))
+        rate = f"{100.0 * hits / n:.0f}%" if n else "-"
+        name = label if len(label) <= 30 else "…" + label[-29:]
+        lines.append(
+            f"    {name:<30}  {n:<8}  "
+            f"{_fmt_latency(h.get('p50')):<8}  "
+            f"{_fmt_latency(h.get('p95')):<8}  {rate:<6}  "
+            f"{int(e.get('session_bytes', 0)) / 1e3:.1f}KB"
+        )
+    return lines
 
 
 def render_serve_stats(doc: Dict[str, Any]) -> str:
@@ -353,6 +517,7 @@ def render_serve_stats(doc: Dict[str, Any]) -> str:
             f"{k}={fallbacks[k]}" for k in sorted(fallbacks)
         )
         lines.append(f"  fallbacks: {rendered}")
+    lines.extend(_render_tenant_table(doc.get("tenants")))
     mem = doc.get("memory")
     if isinstance(mem, list):
         for entry in mem:

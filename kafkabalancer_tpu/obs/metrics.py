@@ -23,8 +23,16 @@ gate verdicts, and the solver/session counters. Four metric families:
   reads mid-traffic; tests reset them explicitly via
   :meth:`reset_hists`. Excluded from :meth:`snapshot` on purpose — the
   ``kafkabalancer-tpu.metrics/1`` schema is golden-pinned, and the
-  scrape document (``kafkabalancer-tpu.serve-stats/3``) is the
-  histograms' export seam.
+  scrape document (``kafkabalancer-tpu.serve-stats/4``) is the
+  histograms' export seam;
+- **label families** — bounded label-dimensioned histogram/counter
+  families (``tenant_hist_observe`` / ``tenant_count``): per-tenant
+  attribution with a hard memory bound (top-K labels by recent
+  activity, LRU-demoted into an ``other`` rollup — obs/hist.py
+  :class:`HistFamily`, :class:`CounterFamily`). Daemon-lifetime like
+  the histograms (:meth:`reset` leaves them alone; the daemon clears
+  them at startup via :meth:`reset_tenants`), exported through the
+  scrape's ``tenants`` block, never :meth:`snapshot`.
 
 The registry is ALWAYS on (its cost is the dict writes the old bare
 ``stats`` dict already paid, now lock-protected); only the tracer
@@ -35,9 +43,15 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Dict, Iterator, List, Mapping
+from collections import OrderedDict
+from typing import Any, Dict, Iterator, List, Mapping, Optional
 
-from kafkabalancer_tpu.obs.hist import StreamingHist
+from kafkabalancer_tpu.obs.hist import (
+    FAMILY_CAP,
+    OTHER_LABEL,
+    HistFamily,
+    StreamingHist,
+)
 
 SCHEMA_VERSION = 1
 SCHEMA = f"kafkabalancer-tpu.metrics/{SCHEMA_VERSION}"
@@ -47,6 +61,56 @@ SCHEMA = f"kafkabalancer-tpu.metrics/{SCHEMA_VERSION}"
 # (a long prewarm sweep or a pathological eviction storm must not turn
 # the metrics payload into the artifact being debugged)
 _MAX_EVENTS = 1024
+
+
+class CounterFamily:
+    """A bounded label-dimensioned counter family — the counter twin of
+    :class:`~kafkabalancer_tpu.obs.hist.HistFamily`: top-``cap`` labels
+    by recent activity keep individual values, the LRU label past the
+    cap is demoted into the ``other`` rollup (its value folded in, so
+    the family-wide sum is exact and monotone across any label churn).
+    One lock; every operation is a dict write."""
+
+    def __init__(self, cap: int = FAMILY_CAP) -> None:
+        self._lock = threading.Lock()
+        self._cap = max(1, int(cap))
+        self._labels: "OrderedDict[str, float]" = OrderedDict()
+        self._other = 0.0
+        self._demoted = 0
+
+    def add(self, label: str, delta: float = 1.0) -> None:
+        with self._lock:
+            if label == OTHER_LABEL:
+                self._other += delta
+                return
+            if label in self._labels:
+                self._labels[label] += delta
+                self._labels.move_to_end(label)
+                return
+            if len(self._labels) >= self._cap:
+                _victim, v = self._labels.popitem(last=False)
+                self._other += v
+                self._demoted += 1
+            self._labels[label] = delta
+
+    def get(self, label: str) -> float:
+        with self._lock:
+            if label == OTHER_LABEL:
+                return self._other
+            return self._labels.get(label, 0.0)
+
+    def total(self) -> float:
+        with self._lock:
+            return sum(self._labels.values()) + self._other
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "cap": self._cap,
+                "demoted": self._demoted,
+                "other": self._other,
+                "labels": dict(self._labels),
+            }
 
 
 class MetricsRegistry:
@@ -60,6 +124,11 @@ class MetricsRegistry:
         self._events: List[Dict[str, Any]] = []
         self._dropped_events = 0
         self._hists: Dict[str, StreamingHist] = {}
+        # label-dimensioned (tenant) families: bounded top-K + "other"
+        # rollup per name (obs/hist.py). Daemon-lifetime like the plain
+        # histograms — reset() leaves them alone; reset_tenants clears.
+        self._tenant_hists: Dict[str, HistFamily] = {}
+        self._tenant_counters: Dict[str, CounterFamily] = {}
 
     # -- writers ---------------------------------------------------------
     def count(self, name: str, delta: float = 1.0) -> None:
@@ -92,6 +161,46 @@ class MetricsRegistry:
 
     def hist_observe(self, name: str, value: float) -> None:
         self.hist(name).observe(value)
+
+    def tenant_hist(
+        self, name: str, cap: Optional[int] = None
+    ) -> HistFamily:
+        """Get-or-create the named label-dimensioned histogram family;
+        ``cap`` applies only on first creation (the family's label
+        bound is fixed for its lifetime)."""
+        with self._lock:
+            fam = self._tenant_hists.get(name)
+            if fam is None:
+                fam = self._tenant_hists[name] = HistFamily(
+                    cap=cap if cap is not None else FAMILY_CAP
+                )
+            return fam
+
+    def tenant_hist_observe(
+        self, name: str, label: str, value: float
+    ) -> None:
+        self.tenant_hist(name).observe(label, value)
+
+    def tenant_counter(
+        self, name: str, cap: Optional[int] = None
+    ) -> CounterFamily:
+        with self._lock:
+            fam = self._tenant_counters.get(name)
+            if fam is None:
+                fam = self._tenant_counters[name] = CounterFamily(
+                    cap=cap if cap is not None else FAMILY_CAP
+                )
+            return fam
+
+    def tenant_count(
+        self, name: str, label: str, delta: float = 1.0
+    ) -> None:
+        self.tenant_counter(name).add(label, delta)
+
+    def tenant_counter_get(self, name: str, label: str) -> float:
+        with self._lock:
+            fam = self._tenant_counters.get(name)
+        return 0.0 if fam is None else fam.get(label)
 
     def event(self, kind: str, **fields: Any) -> None:
         with self._lock:
@@ -130,6 +239,22 @@ class MetricsRegistry:
             hists = dict(self._hists)
         return {name: h.snapshot() for name, h in sorted(hists.items())}
 
+    def tenant_snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Every label family's export view — the scrape's per-tenant
+        attribution payload (serve-stats/4 ``tenants`` block). Like the
+        plain histograms, deliberately NOT part of :meth:`snapshot`."""
+        with self._lock:
+            hfams = dict(self._tenant_hists)
+            cfams = dict(self._tenant_counters)
+        return {
+            "hists": {
+                name: fam.snapshot() for name, fam in sorted(hfams.items())
+            },
+            "counters": {
+                name: fam.snapshot() for name, fam in sorted(cfams.items())
+            },
+        }
+
     # -- lifecycle -------------------------------------------------------
     def reset(self) -> None:
         """Per-invocation epoch boundary. Histograms survive on purpose:
@@ -149,6 +274,14 @@ class MetricsRegistry:
     def reset_hists(self) -> None:
         with self._lock:
             self._hists.clear()
+
+    def reset_tenants(self) -> None:
+        """Clear every label family (hist + counter) — the daemon's
+        startup boundary, so per-tenant counts reconcile exactly from
+        request 1 (mirrors ``reset_hists``)."""
+        with self._lock:
+            self._tenant_hists.clear()
+            self._tenant_counters.clear()
 
 
 class PhasesView(Mapping[str, Dict[str, float]]):
@@ -197,9 +330,16 @@ event = REGISTRY.event
 hist = REGISTRY.hist
 hist_observe = REGISTRY.hist_observe
 hist_snapshot = REGISTRY.hist_snapshot
+tenant_hist = REGISTRY.tenant_hist
+tenant_hist_observe = REGISTRY.tenant_hist_observe
+tenant_counter = REGISTRY.tenant_counter
+tenant_count = REGISTRY.tenant_count
+tenant_counter_get = REGISTRY.tenant_counter_get
+tenant_snapshot = REGISTRY.tenant_snapshot
 phase_get = REGISTRY.phase_get
 counter_get = REGISTRY.counter_get
 snapshot = REGISTRY.snapshot
 reset = REGISTRY.reset
 reset_phases = REGISTRY.reset_phases
 reset_hists = REGISTRY.reset_hists
+reset_tenants = REGISTRY.reset_tenants

@@ -1,0 +1,141 @@
+"""``python -m kafkabalancer_tpu.replay`` — run one seeded fleet-churn
+replay against a live (or private, self-spawned) planning daemon and
+write the ``kafkabalancer-tpu.replay/1`` artifact.
+
+Examples::
+
+    # smoke: 3 tenants, 30 requests, private daemon, artifact to stdout
+    python -m kafkabalancer_tpu.replay
+
+    # a real round: more tenants + churn, against an existing daemon
+    python -m kafkabalancer_tpu.replay --tenants 16 --requests 400 \\
+        --topic-storm-every 40 --broker-failure-every 80 \\
+        --socket /tmp/kafkabalancer-tpu-0.sock --out replay.json
+
+Exit codes: 0 = ran (artifact written; check ``reconciled`` yourself),
+2 = ``--check`` was given and reconciliation failed, 3 = no daemon
+could be reached/spawned.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from kafkabalancer_tpu.replay.harness import (
+    ReplayConfig,
+    ReplayError,
+    render_summary,
+    run_replay,
+)
+
+
+def main(argv: list) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m kafkabalancer_tpu.replay",
+        description="seeded multi-tenant churn replay harness",
+    )
+    d = ReplayConfig()
+    p.add_argument("--seed", type=int, default=d.seed)
+    p.add_argument("--tenants", type=int, default=d.tenants)
+    p.add_argument("--requests", type=int, default=d.requests)
+    p.add_argument(
+        "--base-partitions", type=int, default=d.base_partitions,
+        help="whale-tenant partition count (tail tenants scale down "
+        "by the zipf skew)",
+    )
+    p.add_argument("--brokers", type=int, default=d.brokers)
+    p.add_argument("--replicas", type=int, default=d.replicas)
+    p.add_argument("--skew", type=float, default=d.skew)
+    p.add_argument(
+        "--arrival", choices=("weighted", "uniform"), default=d.arrival,
+    )
+    p.add_argument(
+        "--diurnal-period", type=int, default=d.diurnal_period,
+    )
+    p.add_argument(
+        "--diurnal-amplitude", type=float, default=d.diurnal_amplitude,
+    )
+    p.add_argument(
+        "--weight-shift-every", type=int, default=d.weight_shift_every,
+    )
+    p.add_argument(
+        "--weight-shift-frac", type=float, default=d.weight_shift_frac,
+    )
+    p.add_argument(
+        "--broker-failure-every", type=int,
+        default=d.broker_failure_every,
+    )
+    p.add_argument(
+        "--topic-storm-every", type=int, default=d.topic_storm_every,
+    )
+    p.add_argument("--storm-size", type=int, default=d.storm_size)
+    p.add_argument("--max-reassign", type=int, default=d.max_reassign)
+    p.add_argument("--solver", default=d.solver)
+    p.add_argument(
+        "--socket", default="",
+        help="existing daemon socket (default: spawn a private daemon)",
+    )
+    p.add_argument(
+        "--no-spawn", action="store_true",
+        help="never spawn a daemon (requires --socket)",
+    )
+    p.add_argument(
+        "--latency-tolerance-buckets", type=int,
+        default=d.latency_tolerance_buckets,
+    )
+    p.add_argument(
+        "--no-parity", action="store_true",
+        help="skip the -no-daemon plan byte-parity sample",
+    )
+    p.add_argument(
+        "--out", default="-",
+        help="artifact path ('-' = stdout, the default)",
+    )
+    p.add_argument(
+        "--check", action="store_true",
+        help="exit 2 unless the run reconciled (counts exact, "
+        "latencies within tolerance, parity sample ok)",
+    )
+    a = p.parse_args(argv)
+    cfg = ReplayConfig(
+        seed=a.seed, tenants=a.tenants, requests=a.requests,
+        base_partitions=a.base_partitions, brokers=a.brokers,
+        replicas=a.replicas, skew=a.skew, arrival=a.arrival,
+        diurnal_period=a.diurnal_period,
+        diurnal_amplitude=a.diurnal_amplitude,
+        weight_shift_every=a.weight_shift_every,
+        weight_shift_frac=a.weight_shift_frac,
+        broker_failure_every=a.broker_failure_every,
+        topic_storm_every=a.topic_storm_every,
+        storm_size=a.storm_size, max_reassign=a.max_reassign,
+        solver=a.solver, socket=a.socket, spawn=not a.no_spawn,
+        latency_tolerance_buckets=a.latency_tolerance_buckets,
+        parity_sample=not a.no_parity,
+    )
+    try:
+        artifact = run_replay(cfg)
+    except ReplayError as exc:
+        print(f"replay: {exc}", file=sys.stderr)
+        return 3
+    line = json.dumps(
+        artifact, sort_keys=True, separators=(",", ":"), default=str,
+    ) + "\n"
+    if a.out == "-":
+        sys.stdout.write(line)
+    else:
+        with open(a.out, "w") as f:
+            f.write(line)
+    sys.stderr.write(render_summary(artifact))
+    if a.check:
+        parity = artifact.get("parity")
+        parity_ok = parity is None or bool(parity.get("ok"))
+        if not (artifact.get("reconciled") and parity_ok):
+            print("replay: reconciliation FAILED", file=sys.stderr)
+            return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

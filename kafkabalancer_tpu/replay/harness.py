@@ -1,0 +1,519 @@
+"""The fleet-churn replay harness: drive a live daemon closed-loop and
+reconcile client-side truth against the daemon's per-tenant scrape.
+
+This is ROADMAP item 5 (and Clipper's continuously-measured-tail-latency
+methodology, PAPERS.md) turned into an executable acceptance gate: a
+seeded :class:`~kafkabalancer_tpu.replay.synth.FleetSynth` generates
+multi-tenant churn, every request runs through the REAL forwarding
+client (``cli.run`` with a ``-serve-socket`` — the same code path the
+production outer loop uses, resident-session ladder included), the
+emitted plan is applied back to the tenant's state (the closed loop),
+and at the end the harness fetches the daemon's ``serve-stats/4``
+scrape and reconciles:
+
+- per-tenant REQUEST COUNTS: the driver's issued counts must equal the
+  daemon's ``tenants.top[t].requests`` EXACTLY (minus any pre-existing
+  baseline when pointed at a shared daemon);
+- per-tenant LATENCY: the scrape's per-tenant p50/p95/p99 must land
+  within ``latency_tolerance_buckets`` histogram buckets of the same
+  percentiles recomputed from the flight recorder's tenant-labeled
+  request log — two INDEPENDENT daemon-side stores (bounded top-K
+  family vs request ring) that agree only when every request landed in
+  the right tenant's histogram with the right value. Client-side walls
+  are recorded per tenant too (with their bucket distance from the
+  daemon view) and sanity-bounded — the daemon percentile may not
+  exceed the client's, since the client wall CONTAINS the daemon wall
+  — but they are deliberately not held to one bucket: a converged
+  delta-path tenant's daemon wall is near zero (that is the feature)
+  while the client still pays its own O(P) parse + digest;
+- optionally, PLAN BYTES: one sampled request re-planned ``-no-daemon``
+  from identical input must produce byte-identical stdout (the serving
+  layer's oldest pin, exercised under churn).
+
+The result is one schema-versioned artifact
+(``kafkabalancer-tpu.replay/1``) with per-tenant tails, session-thrash
+and fallback rates, and padded-slot waste — the shape bench.py's
+``replay_fleet_churn`` probe lands in BENCH rounds and gate.sh asserts
+pre-merge. No jax is imported here or anywhere below it: the harness is
+a pure client of the daemon (plus the greedy in-process path for the
+parity sample).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import tempfile
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from kafkabalancer_tpu.obs.hist import bucket_index, percentile_from_buckets
+from kafkabalancer_tpu.replay.synth import FleetSynth
+
+REPLAY_SCHEMA_VERSION = 1
+REPLAY_SCHEMA = f"kafkabalancer-tpu.replay/{REPLAY_SCHEMA_VERSION}"
+
+LogFn = Callable[[str], None]
+
+
+class ReplayError(RuntimeError):
+    """The harness could not run at all (no daemon, spawn failure) —
+    distinct from a run that completed but failed reconciliation."""
+
+
+@dataclass
+class ReplayConfig:
+    """One replay run's knobs; defaults are smoke scale (seconds on a
+    laptop CPU), sized so the gate stage stays cheap. Every field is a
+    plain value — the artifact embeds the config verbatim."""
+
+    seed: int = 0
+    tenants: int = 3
+    requests: int = 30
+    base_partitions: int = 48
+    brokers: int = 8
+    replicas: int = 3
+    skew: float = 1.5
+    arrival: str = "weighted"  # or "uniform"
+    diurnal_period: int = 64
+    diurnal_amplitude: float = 0.6
+    weight_shift_every: int = 7
+    weight_shift_frac: float = 0.1
+    broker_failure_every: int = 0
+    topic_storm_every: int = 0
+    storm_size: int = 4
+    max_reassign: int = 2
+    solver: str = "greedy"
+    # empty socket = spawn a private daemon (spawn=True) in a private
+    # tempdir; a named socket targets an existing daemon and the
+    # harness subtracts its pre-run per-tenant baseline from the counts
+    socket: str = ""
+    spawn: bool = True
+    daemon_args: Tuple[str, ...] = field(default_factory=tuple)
+    latency_tolerance_buckets: int = 1
+    parity_sample: bool = True
+
+
+def _percentile_via_buckets(walls: List[float], q: float) -> float:
+    """Client-side percentile folded through the SAME log buckets the
+    daemon's streaming hists use, reported as the bucket upper bound —
+    so daemon-vs-client comparison is bucket-index arithmetic, not
+    float-noise comparison."""
+    buckets: Dict[int, int] = {}
+    for w in walls:
+        i = bucket_index(w)
+        buckets[i] = buckets.get(i, 0) + 1
+    return percentile_from_buckets(buckets, q)
+
+
+def _bucket_delta(client_le: float, daemon_le: float) -> Optional[int]:
+    """Signed distance in log-bucket indexes between two bucket upper
+    bounds (positive = client slower); None when either side is
+    empty/zero."""
+    if client_le <= 0.0 or daemon_le <= 0.0:
+        return None
+    return bucket_index(client_le) - bucket_index(daemon_le)
+
+
+def _spawn_daemon(
+    sock: str, tenants: int, extra: Tuple[str, ...], log: LogFn
+) -> Any:
+    """Start a private daemon subprocess on ``sock`` and wait for its
+    hello. ``-serve-lanes=1`` keeps the jax-free single-lane dispatcher
+    so a greedy smoke run never waits on a backend attach, and the
+    tenant-label cap is sized to the fleet — a 40-tenant replay against
+    the default cap of 32 would demote early tenants into ``other``
+    and the count reconciliation could never succeed. (When targeting
+    an EXISTING daemon via ``socket=``, its ``-serve-tenant-cap`` must
+    be >= the replay's tenant count for the same reason.)"""
+    import subprocess
+    import sys
+
+    from kafkabalancer_tpu.serve import client as sclient
+
+    args = [
+        sys.executable, "-m", "kafkabalancer_tpu", "-serve",
+        f"-serve-socket={sock}", "-serve-idle-timeout=300",
+        "-serve-lanes=1",
+        f"-serve-tenant-cap={max(32, tenants)}", *extra,
+    ]
+    proc = subprocess.Popen(
+        args,
+        stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL,
+    )
+    deadline = time.monotonic() + 60.0
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise ReplayError(
+                f"replay daemon exited rc={proc.returncode} during startup"
+            )
+        if sclient.daemon_alive(sock) is not None:
+            log(f"replay: private daemon up on {sock} (pid {proc.pid})")
+            return proc
+        time.sleep(0.05)
+    proc.terminate()
+    raise ReplayError("replay daemon never became ready")
+
+
+def _tenant_scrape_counts(doc: Optional[Dict[str, Any]]) -> Dict[str, int]:
+    """Per-tenant daemon request counts from a scrape doc ({} when the
+    daemon has no tenants block — e.g. a pre-v4 daemon)."""
+    out: Dict[str, int] = {}
+    if not isinstance(doc, dict):
+        return out
+    tenants = doc.get("tenants")
+    if not isinstance(tenants, dict):
+        return out
+    top = tenants.get("top")
+    if isinstance(top, dict):
+        for name, e in top.items():
+            if isinstance(e, dict):
+                out[name] = int(e.get("requests", 0))
+    return out
+
+
+def run_replay(
+    cfg: ReplayConfig, log: Optional[LogFn] = None
+) -> Dict[str, Any]:
+    """Run one seeded replay; returns the ``kafkabalancer-tpu.replay/1``
+    artifact (see the module docstring). Raises :class:`ReplayError`
+    only when no daemon could be reached/spawned — a reconciliation
+    failure is DATA (``reconciled: false``), not an exception, so bench
+    rounds land the evidence instead of dying."""
+    import sys
+
+    from kafkabalancer_tpu import cli
+    from kafkabalancer_tpu.serve import client as sclient
+
+    _log: LogFn = log or (
+        lambda msg: print(msg, file=sys.stderr, flush=True)
+    )
+    tmpdir = None
+    sock = cfg.socket
+    spawned = None
+    if not sock:
+        # unix socket paths cap at ~104 bytes: a short private tempdir
+        tmpdir = tempfile.mkdtemp(prefix="kb-replay-")
+        sock = os.path.join(tmpdir, "kb.sock")
+        if cfg.spawn:
+            spawned = _spawn_daemon(
+                sock, cfg.tenants, cfg.daemon_args, _log
+            )
+    try:
+        hello = sclient.daemon_alive(sock)
+        if hello is None:
+            raise ReplayError(f"no live daemon on {sock}")
+        baseline = _tenant_scrape_counts(sclient.fetch_stats(sock))
+
+        synth = FleetSynth(
+            seed=cfg.seed,
+            tenants=cfg.tenants,
+            base_partitions=cfg.base_partitions,
+            brokers=cfg.brokers,
+            replicas=cfg.replicas,
+            skew=cfg.skew,
+            arrival=cfg.arrival,
+            diurnal_period=cfg.diurnal_period,
+            diurnal_amplitude=cfg.diurnal_amplitude,
+            weight_shift_every=cfg.weight_shift_every,
+            weight_shift_frac=cfg.weight_shift_frac,
+            broker_failure_every=cfg.broker_failure_every,
+            topic_storm_every=cfg.topic_storm_every,
+            storm_size=cfg.storm_size,
+        )
+        base_argv = [
+            "kafkabalancer", "-input-json",
+            f"-serve-socket={sock}",
+            f"-max-reassign={cfg.max_reassign}",
+        ]
+        if cfg.solver != "greedy":
+            base_argv.append(f"-solver={cfg.solver}")
+
+        walls: Dict[str, List[float]] = {
+            t.name: [] for t in synth.tenants
+        }
+        issued: Dict[str, int] = {t.name: 0 for t in synth.tenants}
+        errors: List[Dict[str, Any]] = []
+        parity: Optional[Dict[str, Any]] = None
+        parity_step = cfg.requests // 2 if cfg.parity_sample else -1
+        t_run0 = time.perf_counter()
+        for step in range(cfg.requests):
+            tenant, fired = synth.step(step)
+            text = tenant.text()
+            argv = base_argv + [f"-serve-session={tenant.name}"]
+            if step == parity_step:
+                # the parity sample: the SAME input planned in-process
+                # (-no-daemon) must emit byte-identical plan stdout —
+                # run it FIRST (it mutates nothing), then the served one
+                out_l, err_l = io.StringIO(), io.StringIO()
+                rc_l = cli.run(
+                    io.StringIO(text), out_l, err_l,
+                    argv + ["-no-daemon"],
+                )
+                parity = {
+                    "step": step, "tenant": tenant.name,
+                    "rc_local": rc_l, "stdout_local": out_l.getvalue(),
+                }
+            out, err = io.StringIO(), io.StringIO()
+            t0 = time.perf_counter()
+            rc = cli.run(io.StringIO(text), out, err, argv)
+            wall = time.perf_counter() - t0
+            if parity is not None and parity.get("step") == step:
+                # resolve the sample NOW, before any early continue,
+                # and pop BOTH blobs unconditionally — the raw plan
+                # text must never ride into the artifact/summary
+                stdout_l = parity.pop("stdout_local", None)
+                rc_l = parity.pop("rc_local", None)
+                parity["ok"] = (
+                    rc == 0
+                    and rc_l == rc
+                    and stdout_l == out.getvalue()
+                )
+            if rc != 0:
+                errors.append({
+                    "step": step, "tenant": tenant.name, "rc": rc,
+                    "stderr_tail": err.getvalue()[-400:],
+                })
+                continue
+            walls[tenant.name].append(wall)
+            issued[tenant.name] += 1
+            tenant.apply_plan(out.getvalue())
+        wall_s = time.perf_counter() - t_run0
+
+        doc = sclient.fetch_stats(sock)
+        # the daemon's own per-request evidence: the flight recorder's
+        # tenant-labeled request log (wall_s per request) — the
+        # independent store the scrape's per-tenant hists reconcile
+        # against
+        trace = sclient.fetch_trace(sock)
+        flight_requests: List[Dict[str, Any]] = []
+        if isinstance(trace, dict):
+            td = trace.get("trace")
+            if isinstance(td, dict):
+                od = td.get("otherData")
+                if isinstance(od, dict) and isinstance(
+                    od.get("requests"), list
+                ):
+                    flight_requests = [
+                        r for r in od["requests"] if isinstance(r, dict)
+                    ]
+        return _build_artifact(
+            cfg, synth, walls, issued, errors, parity, baseline, doc,
+            flight_requests, wall_s,
+        )
+    finally:
+        if spawned is not None:
+            try:
+                sclient.request_shutdown(sock)
+                spawned.wait(15)
+            except Exception:
+                spawned.terminate()
+        if tmpdir is not None:
+            import shutil
+
+            shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def _build_artifact(
+    cfg: ReplayConfig,
+    synth: FleetSynth,
+    walls: Dict[str, List[float]],
+    issued: Dict[str, int],
+    errors: List[Dict[str, Any]],
+    parity: Optional[Dict[str, Any]],
+    baseline: Dict[str, int],
+    doc: Optional[Dict[str, Any]],
+    flight_requests: List[Dict[str, Any]],
+    wall_s: float,
+) -> Dict[str, Any]:
+    tenants_block = (
+        doc.get("tenants") if isinstance(doc, dict) else None
+    ) or {}
+    top = tenants_block.get("top") or {}
+    flight_walls: Dict[str, List[float]] = {}
+    for r in flight_requests:
+        t_name = r.get("tenant")
+        w_s = r.get("wall_s")
+        if isinstance(t_name, str) and isinstance(w_s, (int, float)):
+            flight_walls.setdefault(t_name, []).append(float(w_s))
+    per_tenant: Dict[str, Any] = {}
+    counts_ok = True
+    latency_ok = True
+    for t in synth.tenants:
+        name = t.name
+        entry = top.get(name) if isinstance(top, dict) else None
+        w = sorted(walls[name])
+        fw = sorted(flight_walls.get(name, []))
+        # a tenant the scrape has never seen reports 0 — correct when
+        # the arrival process never picked it, a miss when it did (a
+        # demotion past the cap, or lost attribution)
+        daemon_requests = (
+            int(entry.get("requests", 0)) - baseline.get(name, 0)
+            if isinstance(entry, dict) else 0
+        )
+        t_counts_ok = daemon_requests == issued[name]
+        counts_ok = counts_ok and t_counts_ok
+        rec: Dict[str, Any] = {
+            "issued": issued[name],
+            "daemon_requests": daemon_requests,
+            "counts_ok": t_counts_ok,
+            "moves_applied": t.moves_applied,
+            "partitions": len(t.rows),
+        }
+        dh = entry.get("request_s") if isinstance(entry, dict) else None
+        # latency is VERIFIABLE for this tenant only when the daemon's
+        # request ring still holds exactly this tenant's requests (the
+        # 512-entry ring truncates long runs, and a shared daemon's
+        # foreign traffic evicts replay entries) and no pre-run
+        # baseline pollutes the hist. Unverifiable latency is reported
+        # as unchecked — never conflated with a reconciliation failure.
+        fresh = (
+            baseline.get(name, 0) == 0
+            and isinstance(dh, dict)
+            and len(fw) == issued[name]
+        )
+        lat_deltas: Dict[str, Optional[int]] = {}
+        client_deltas: Dict[str, Optional[int]] = {}
+        covers = True
+        for qname, q in (("p50", 0.50), ("p95", 0.95), ("p99", 0.99)):
+            client_le = _percentile_via_buckets(w, q) if w else 0.0
+            flight_le = _percentile_via_buckets(fw, q) if fw else 0.0
+            daemon_le = (
+                float(dh.get(qname, 0.0)) if isinstance(dh, dict) else 0.0
+            )
+            rec[f"client_{qname}"] = round(client_le, 9)
+            rec[f"daemon_{qname}"] = round(daemon_le, 9)
+            rec[f"flight_{qname}"] = round(flight_le, 9)
+            # the gate: scrape hist vs flight log, two independent
+            # daemon-side stores of the same per-request walls
+            lat_deltas[qname] = (
+                _bucket_delta(daemon_le, flight_le) if fresh else None
+            )
+            # reported, not gated: how far the end-to-end client view
+            # sits above the daemon view (the delta/steady-state gap)
+            client_deltas[qname] = _bucket_delta(client_le, daemon_le)
+            if daemon_le > client_le > 0.0:
+                covers = False
+        rec["latency_bucket_delta"] = lat_deltas
+        rec["client_bucket_delta"] = client_deltas
+        # sanity bound: the client wall CONTAINS the daemon wall, so a
+        # daemon percentile above the client's means mis-attribution
+        rec["client_covers_daemon"] = covers
+        checked = fresh and bool(w)
+        rec["latency_checked"] = checked
+        if checked:
+            t_lat_ok = covers and all(
+                d is not None and abs(d) <= cfg.latency_tolerance_buckets
+                for d in lat_deltas.values()
+            )
+        else:
+            # unverifiable (ring overflow / shared daemon / no
+            # requests): vacuously ok, flagged unchecked above
+            t_lat_ok = True
+        rec["latency_ok"] = t_lat_ok
+        latency_ok = latency_ok and t_lat_ok
+        if isinstance(entry, dict):
+            rec.update({
+                "delta_hits": int(entry.get("delta_hits", 0)),
+                "resyncs_rows": int(entry.get("resyncs_rows", 0)),
+                "resyncs_full": int(entry.get("resyncs_full", 0)),
+                "fallbacks": int(entry.get("fallbacks", 0)),
+                "session_bytes": int(entry.get("session_bytes", 0)),
+            })
+            n = issued[name]
+            rec["delta_hit_rate"] = (
+                round(rec["delta_hits"] / n, 4) if n else 0.0
+            )
+        per_tenant[name] = rec
+
+    sessions = (doc or {}).get("sessions") or {}
+    total = sum(issued.values())
+    fallbacks_total = sum(
+        e.get("fallbacks", 0) for e in per_tenant.values()
+        if isinstance(e, dict)
+    )
+    reconciled = counts_ok and latency_ok and not errors
+    if parity is not None and "ok" not in parity:
+        # safety net: never let the raw plan text reach the artifact
+        parity.pop("stdout_local", None)
+        parity.pop("rc_local", None)
+        parity["ok"] = False
+    return {
+        "schema": REPLAY_SCHEMA,
+        "scrape_schema": (doc or {}).get("schema"),
+        "seed": cfg.seed,
+        "config": asdict(cfg),
+        "requests_issued": total,
+        "request_errors": errors,
+        "wall_s": round(wall_s, 3),
+        "throughput_rps": round(total / wall_s, 3) if wall_s > 0 else None,
+        "events": dict(synth.events),
+        "per_tenant": per_tenant,
+        "session_thrash": {
+            "evicted_lru": int(sessions.get("evicted_lru", 0)),
+            "expired_idle": int(sessions.get("expired_idle", 0)),
+            "resyncs_rows": int(sessions.get("resyncs_rows", 0)),
+            "resyncs_full": int(sessions.get("resyncs_full", 0)),
+            "rate": (
+                round(
+                    (
+                        int(sessions.get("resyncs_rows", 0))
+                        + int(sessions.get("resyncs_full", 0))
+                        + int(sessions.get("evicted_lru", 0))
+                    ) / total,
+                    4,
+                ) if total else None
+            ),
+        },
+        "fallback_rate": (
+            round(fallbacks_total / total, 4) if total else None
+        ),
+        # padded-slot waste under mixed buckets: only a lane-scheduler
+        # daemon (microbatch > 1) reports nonzero here — the smoke
+        # single-lane daemon pins the schema with zeros
+        "padded_slots": int((doc or {}).get("mb_padded_slots", 0)),
+        "microbatched": int((doc or {}).get("microbatched", 0)),
+        "tenant_cap": int(tenants_block.get("cap", 0)),
+        "tenants_demoted": int(tenants_block.get("demoted", 0)),
+        "parity": parity,
+        "reconciled_counts": counts_ok,
+        # latency_checked: every tenant with traffic was actually
+        # verifiable (fresh hist + complete flight log); when False,
+        # reconciled_latency is (partly) vacuous — consumers that need
+        # the strong claim (the gate) assert both
+        "latency_checked": all(
+            e["latency_checked"]
+            for e in per_tenant.values() if e["issued"]
+        ),
+        "reconciled_latency": latency_ok,
+        "reconciled": reconciled,
+    }
+
+
+def render_summary(artifact: Dict[str, Any]) -> str:
+    """A short human summary of one replay artifact (stderr of the
+    ``python -m kafkabalancer_tpu.replay`` entry point)."""
+    lines = [
+        f"-- replay {artifact['schema']} (seed {artifact['seed']}): "
+        f"{artifact['requests_issued']} requests, "
+        f"{artifact['wall_s']}s wall, "
+        f"reconciled={artifact['reconciled']}",
+        f"  events: {artifact['events']}",
+    ]
+    for name, e in sorted(artifact.get("per_tenant", {}).items()):
+        lines.append(
+            f"  {name}: {e['issued']} req "
+            f"(daemon {e['daemon_requests']}, counts_ok {e['counts_ok']}) "
+            f"client p50/p95/p99 {e['client_p50']:.4g}/"
+            f"{e['client_p95']:.4g}/{e['client_p99']:.4g}s "
+            f"delta-hit {e.get('delta_hit_rate', 0):.0%} "
+            f"resyncs {e.get('resyncs_rows', 0)}r/"
+            f"{e.get('resyncs_full', 0)}f "
+            f"latency_ok {e['latency_ok']}"
+        )
+    if artifact.get("parity") is not None:
+        lines.append(f"  parity sample: {artifact['parity']}")
+    return "\n".join(lines) + "\n"

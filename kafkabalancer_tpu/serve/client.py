@@ -121,6 +121,7 @@ def forward_plan(
     on_fallback: Optional[Callable[[str], None]] = None,
     session: Optional[SessionSpec] = None,
     note: Optional[Callable[[str], None]] = None,
+    tenant: str = "",
 ) -> Optional[ServedResult]:
     """Forward one invocation to the daemon at ``path``.
 
@@ -148,6 +149,12 @@ def forward_plan(
     rows (``plan-rows``); structural drift re-registers the full state.
     A v1 daemon — or ``session=None`` — gets the exact v1 byte sequence
     this function always sent.
+
+    ``tenant`` is a pure telemetry label: a v2 daemon attributes the
+    request's latency/counters to it in its per-tenant scrape block
+    (docs/observability.md § Per-tenant attribution). It defaults to
+    the session's tenant when a session spec is given; it never
+    affects planning, and v1 framing never carries it.
     """
 
     def _declined(reason: str) -> None:
@@ -182,7 +189,9 @@ def forward_plan(
         sock.settimeout(plan_timeout)
         if v2:
             return _forward_v2(
-                sock, argv, stdin_text, session, _declined, _note
+                sock, argv, stdin_text, session,
+                tenant or (session.tenant if session is not None else ""),
+                _declined, _note,
             )
         req: Dict[str, Any] = {"v": PROTO_VERSION, "op": "plan", "argv": argv}
         if stdin_text is not None:
@@ -250,6 +259,7 @@ def _forward_v2(
     argv: List[str],
     stdin_text: Optional[str],
     session: Optional[SessionSpec],
+    tenant: str,
     _declined: Callable[[str], None],
     _note: Callable[[str], None],
 ) -> Optional[ServedResult]:
@@ -272,6 +282,10 @@ def _forward_v2(
             "v": PROTO_V2, "op": "plan", "argv": argv,
             "has_stdin": stdin_text is not None,
         }
+        if tenant:
+            # telemetry-only: the daemon's per-tenant attribution for
+            # requests that skip the session ladder
+            hdr["tenant"] = tenant
         blob = stdin_text.encode("utf-8") if stdin_text is not None else b""
         try:
             write_frame2(sock, hdr, blob)

@@ -60,6 +60,7 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
 
 from kafkabalancer_tpu import __version__, obs
 from kafkabalancer_tpu.obs.flight import PHASE_OF_SPAN, FlightRecorder
+from kafkabalancer_tpu.obs.hist import OTHER_LABEL
 from kafkabalancer_tpu.obs.trace import Span
 from kafkabalancer_tpu.serve.devmem import device_memory_stats
 from kafkabalancer_tpu.serve.protocol import (
@@ -84,6 +85,15 @@ PLAN_CONNECTION_TIMEOUT_S = 7200.0
 # warm thread — lane resolution performs the backend attach); far past
 # this the warm thread is presumed wedged and the request is refused
 DISPATCHER_WAIT_S = 600.0
+
+# the per-tenant label families the daemon feeds (obs.metrics registry,
+# bounded top-K + "other"); created at startup so the configured
+# tenant cap applies before the first observation
+_TENANT_HIST_FAMILIES = ("serve.request_s", "serve.phase.queue")
+_TENANT_COUNTER_FAMILIES = (
+    "serve.requests", "serve.crashed_requests", "serve.delta_hits",
+    "serve.resyncs_rows", "serve.resyncs_full", "serve.fallbacks",
+)
 
 
 def _argv_value(argv: List[str], name: str) -> Optional[str]:
@@ -114,10 +124,12 @@ class PlanRequest:
 
     __slots__ = (
         "argv", "stdin", "done", "response", "bucket", "bucketed", "staged",
-        "mb_entered", "t_submit", "session_ctx",
+        "mb_entered", "t_submit", "session_ctx", "tenant",
     )
 
-    def __init__(self, argv: List[str], stdin: Optional[str]) -> None:
+    def __init__(
+        self, argv: List[str], stdin: Optional[str], tenant: str = ""
+    ) -> None:
         self.argv = argv
         self.stdin = stdin
         self.done = threading.Event()
@@ -130,6 +142,10 @@ class PlanRequest:
         # resident-session context (serve/sessions.py
         # PlanSessionContext) for the protocol-v2 session ops
         self.session_ctx: Optional[Any] = None
+        # telemetry attribution label (the v2 session identity, or the
+        # plan header's "tenant"); "" lands in the scrape's "other"
+        # rollup — never a correctness input, only an attribution key
+        self.tenant = tenant
 
 
 class Coalescer:
@@ -263,6 +279,7 @@ class Daemon:
         flight_dir: str = "",
         session_cap: int = 64,
         session_idle_s: float = 3600.0,
+        tenant_cap: int = 32,
     ) -> None:
         self.socket_path = socket_path
         self.idle_timeout = idle_timeout
@@ -313,6 +330,11 @@ class Daemon:
         # resident cluster sessions (protocol v2; serve/sessions.py):
         # LRU-capped per-tenant parsed/settled state + primed row cache
         self.sessions = SessionStore(cap=session_cap, idle_s=session_idle_s)
+        # per-tenant telemetry label bound: top-K tenants by recent
+        # activity keep individual hists/counters, the rest roll into
+        # "other" (obs/hist.py HistFamily) — a million-tenant fleet
+        # cannot grow the scrape payload or daemon memory unboundedly
+        self.tenant_cap = max(1, tenant_cap)
         # daemon-observed client fallback/resync reasons, scraped as
         # the stats doc's "fallbacks" block (satellite: a degraded
         # fleet is diagnosable without log archaeology)
@@ -440,9 +462,24 @@ class Daemon:
         pl = get_partition_list_from_reader(io.StringIO(text), as_json, topics)
         return pl, _argv_brokers(req.argv)
 
-    def _count_fallback(self, reason: str) -> None:
+    def _count_resync_full(self, tenant: str) -> None:
+        """One full re-sync landed for ``tenant``: the store's global
+        monotone counter plus the tenant family (session thrash is a
+        per-tenant signal — the replay artifact's thrash rate)."""
+        self.sessions.count_resync_full()
+        obs.metrics.tenant_count(
+            "serve.resyncs_full", tenant or OTHER_LABEL
+        )
+
+    def _count_fallback(self, reason: str, tenant: str = "") -> None:
         with self._lock:
             self._fallbacks[reason] = self._fallbacks.get(reason, 0) + 1
+        # tenant attribution rides the bounded label family: which
+        # tenant is eating the fallback budget (untenanted reasons —
+        # bad frames, version skew — roll up under "other")
+        obs.metrics.tenant_count(
+            "serve.fallbacks", tenant or OTHER_LABEL
+        )
 
     def _bucket_of(self, req: PlanRequest) -> Optional[BucketKey]:
         """Jax-free shape-bucket probe of one queued request — the same
@@ -480,10 +517,14 @@ class Daemon:
         from kafkabalancer_tpu import cli
 
         t_start = time.perf_counter()
+        tenant_label = req.tenant or OTHER_LABEL
         if req.t_submit is not None:
-            # queue wait: accept-thread submit to dispatcher pickup
-            obs.metrics.hist_observe(
-                "serve.phase.queue", t_start - req.t_submit
+            # queue wait: accept-thread submit to dispatcher pickup —
+            # global hist AND the tenant family (who waits behind whom)
+            queue_s = t_start - req.t_submit
+            obs.metrics.hist_observe("serve.phase.queue", queue_s)
+            obs.metrics.tenant_hist_observe(
+                "serve.phase.queue", tenant_label, queue_s
             )
         with self._lock:
             self._requests += 1
@@ -499,6 +540,10 @@ class Daemon:
             "serve.coalesced": float(n_coal),
         }
         ctx = req.session_ctx
+        if req.tenant:
+            # the tenant rides the request's own -metrics-json line too:
+            # a served invocation's export names whose traffic it was
+            attrs["serve.tenant"] = req.tenant
         if ctx is not None:
             ss = self.sessions.stats()
             attrs["serve.sessions"] = float(ss["count"])
@@ -639,6 +684,15 @@ class Daemon:
             # post-traffic scrape's hist count equals serve.requests
             wall = time.perf_counter() - t_start
             obs.metrics.hist_observe("serve.request_s", wall)
+            # the tenant dimension: same invariant per label — every
+            # _handle_plan call lands exactly one serve.request_s
+            # family observation and one serve.requests count, so a
+            # replay driver's per-tenant issued counts reconcile
+            # EXACTLY against the scrape (kafkabalancer_tpu/replay/)
+            obs.metrics.tenant_hist_observe(
+                "serve.request_s", tenant_label, wall
+            )
+            obs.metrics.tenant_count("serve.requests", tenant_label)
             phases = self.flight.pop_request_phases(thread_name)
             rc_val = rc_box[0] if rc_box else None
             if ctx is not None:
@@ -682,6 +736,7 @@ class Daemon:
                 "req": seq,
                 "t": round(time.time(), 3),
                 "lane": lane.index if lane is not None else 0,
+                "tenant": req.tenant or None,
                 "bucket": list(req.bucket) if req.bucket else None,
                 "rc": rc_val,
                 "coalesced": coalesced,
@@ -694,6 +749,9 @@ class Daemon:
                 with self._lock:
                     self._crashed += 1
                 obs.metrics.count("serve.crashed_requests")
+                obs.metrics.tenant_count(
+                    "serve.crashed_requests", tenant_label
+                )
                 self.flight.autodump(
                     f"crash-req-{seq}",
                     directory=self.flight_dir or None,
@@ -969,12 +1027,101 @@ class Daemon:
             **self._core_snapshot(),
         }
 
+    def _tenants_block(self) -> Dict[str, Any]:
+        """The serve-stats/4 per-tenant attribution block: one entry
+        per live top-K tenant (keyed off the ``serve.request_s`` family
+        — request activity is the authority on who is "top") carrying
+        request counts, latency hists, queue time, the session
+        delta/resync ladder, fallback counts and resident session
+        bytes; demoted tenants aggregate under ``other``. Reads only
+        the registry's label families and the session store — locks
+        the plan dispatcher never holds across a dispatch."""
+        snap = obs.metrics.tenant_snapshot()
+        hfams, cfams = snap["hists"], snap["counters"]
+        req_fam = hfams.get("serve.request_s") or {
+            "cap": self.tenant_cap, "demoted": 0, "other": None,
+            "labels": {},
+        }
+        queue_fam = hfams.get("serve.phase.queue") or {
+            "other": None, "labels": {},
+        }
+
+        def cval(name: str, label: str) -> int:
+            fam = cfams.get(name)
+            if fam is None:
+                return 0
+            if label == OTHER_LABEL:
+                # the families LRU independently: a label demoted from
+                # the request_s family may still hold live counters in
+                # a sparser family (delta_hits is only touched on
+                # hits). The rollup absorbs every count NOT attributed
+                # to a live top-K label, so the table's totals always
+                # reconcile with the global blocks.
+                return int(
+                    fam.get("other", 0)
+                    + sum(
+                        v for lbl, v in fam["labels"].items()
+                        if lbl not in top_labels
+                    )
+                )
+            return int(fam["labels"].get(label, 0))
+
+        by_tenant = self.sessions.stats_by_tenant()
+        # the rollup's session footprint: everything resident that is
+        # NOT attributed to a live top-K label (demoted tenants keep
+        # their sessions; the table must still reconcile with the
+        # global "sessions" block)
+        top_labels = set(req_fam["labels"])
+        rolled = {"sessions": 0, "bytes": 0}
+        for t_label, s in by_tenant.items():
+            if t_label not in top_labels:
+                rolled["sessions"] += s["sessions"]
+                rolled["bytes"] += s["bytes"]
+
+        def entry(label: str, hist: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+            sess = rolled if label == OTHER_LABEL else by_tenant.get(
+                label, {}
+            )
+            queue = (
+                queue_fam.get("other") if label == OTHER_LABEL
+                else queue_fam["labels"].get(label)
+            )
+            return {
+                "requests": cval("serve.requests", label),
+                "crashed": cval("serve.crashed_requests", label),
+                "request_s": hist,
+                "queue_s": queue,
+                "delta_hits": cval("serve.delta_hits", label),
+                "resyncs_rows": cval("serve.resyncs_rows", label),
+                "resyncs_full": cval("serve.resyncs_full", label),
+                "fallbacks": cval("serve.fallbacks", label),
+                "sessions": int(sess.get("sessions", 0)),
+                "session_bytes": int(sess.get("bytes", 0)),
+            }
+
+        other = entry(OTHER_LABEL, req_fam.get("other"))
+        has_other = req_fam.get("other") is not None or any(
+            other[k] for k in (
+                "requests", "crashed", "delta_hits", "resyncs_rows",
+                "resyncs_full", "fallbacks",
+            )
+        )
+        return {
+            "cap": int(req_fam.get("cap", self.tenant_cap)),
+            "demoted": int(req_fam.get("demoted", 0)),
+            "top": {
+                label: entry(label, hist)
+                for label, hist in req_fam["labels"].items()
+            },
+            "other": other if has_other else None,
+        }
+
     def _stats_doc(self) -> Dict[str, Any]:
         """The ``stats`` scrape document (``STATS_SCHEMA``): the shared
-        core snapshot plus every streaming histogram and the flight
-        recorder's occupancy. Built entirely from locks the plan
-        dispatcher never holds across a dispatch, so a scrape cannot
-        pause planning."""
+        core snapshot plus every streaming histogram, the per-tenant
+        attribution block and the flight recorder's occupancy. Built
+        entirely from locks the plan dispatcher never holds across a
+        dispatch, so a scrape cannot pause planning."""
         doc: Dict[str, Any] = {
             "v": PROTO_VERSION, "ok": True, "op": "stats",
             "schema": STATS_SCHEMA,
@@ -983,6 +1130,7 @@ class Daemon:
         }
         doc["batch_mode"] = self.batch_mode
         doc["hists"] = obs.metrics.hist_snapshot()
+        doc["tenants"] = self._tenants_block()
         doc["flight"] = self.flight.stats()
         return doc
 
@@ -1045,16 +1193,16 @@ class Daemon:
                 "v": PROTO_V2, "ok": True, "op": op, "resync": "full",
             }, b""
 
+        tenant = str(hdr.get("tenant", ""))
         if op == "plan":
             stdin = (
                 blob.decode("utf-8", errors="replace")
                 if hdr.get("has_stdin") else None
             )
             return self._v2_plan_resp(
-                self._dispatch_plan(PlanRequest(argv, stdin))
+                self._dispatch_plan(PlanRequest(argv, stdin, tenant))
             )
 
-        tenant = str(hdr.get("tenant", ""))
         key = (tenant, flags_signature(argv))
         if op == "register":
             text = blob.decode("utf-8", errors="replace")
@@ -1065,7 +1213,7 @@ class Daemon:
             with sess.lock:
                 sess.in_use = True
                 try:
-                    req = PlanRequest(argv, text)
+                    req = PlanRequest(argv, text, tenant)
                     req.session_ctx = ctx
                     resp = self._dispatch_plan(req)
                 finally:
@@ -1084,7 +1232,7 @@ class Daemon:
             sess, busy = self.sessions.checkout(key)
             if sess is None:
                 self._count_fallback(
-                    "session_busy" if busy else "session_absent"
+                    "session_busy" if busy else "session_absent", tenant
                 )
                 return _resync_full()
             try:
@@ -1095,12 +1243,15 @@ class Daemon:
                         resident_pl=sess.pl if kind == "delta" else None,
                     )
                     self.sessions.count_delta_hit()
-                    req = PlanRequest(argv, None)
+                    obs.metrics.tenant_count(
+                        "serve.delta_hits", tenant or OTHER_LABEL
+                    )
+                    req = PlanRequest(argv, None, tenant)
                     req.session_ctx = ctx
                     return self._v2_plan_resp(self._dispatch_plan(req))
                 # mismatch: offer the row-level diff — the client ships
                 # only the rows whose hashes differ
-                self._count_fallback("session_digest_mismatch")
+                self._count_fallback("session_digest_mismatch", tenant)
                 table = sess.hash_table()
                 return {
                     "v": PROTO_V2, "ok": True, "op": op,
@@ -1114,30 +1265,33 @@ class Daemon:
             sess, busy = self.sessions.checkout(key)
             if sess is None:
                 self._count_fallback(
-                    "session_busy" if busy else "session_absent"
+                    "session_busy" if busy else "session_absent", tenant
                 )
                 return _resync_full()
             try:
                 try:
                     patches = sstate.unpack_rows(blob)
                 except ValueError:
-                    self._count_fallback("session_rows_invalid")
-                    self.sessions.count_resync_full()
+                    self._count_fallback("session_rows_invalid", tenant)
+                    self._count_resync_full(tenant)
                     return _resync_full()
                 if not sess.apply_row_patches(patches):
-                    self._count_fallback("session_rows_mismatch")
-                    self.sessions.count_resync_full()
+                    self._count_fallback("session_rows_mismatch", tenant)
+                    self._count_resync_full(tenant)
                     return _resync_full()
                 if sess.digest != digest:
                     # the diff was computed against a table an
                     # interleaved request has since invalidated;
                     # re-register from ground truth
-                    self._count_fallback("session_rows_mismatch")
-                    self.sessions.count_resync_full()
+                    self._count_fallback("session_rows_mismatch", tenant)
+                    self._count_resync_full(tenant)
                     return _resync_full()
                 self.sessions.count_resync_rows()
+                obs.metrics.tenant_count(
+                    "serve.resyncs_rows", tenant or OTHER_LABEL
+                )
                 ctx = PlanSessionContext("rows", sess)
-                req = PlanRequest(argv, None)
+                req = PlanRequest(argv, None, tenant)
                 req.session_ctx = ctx
                 return self._v2_plan_resp(self._dispatch_plan(req))
             finally:
@@ -1380,6 +1534,14 @@ class Daemon:
         # scrape's reconciliation invariant (serve.request_s count ==
         # serve.requests) holds exactly from request 1
         obs.metrics.reset_hists()
+        # the tenant dimension resets on the same boundary, and the
+        # families are created NOW so the configured cap binds before
+        # the first observation (cap applies at first creation)
+        obs.metrics.reset_tenants()
+        for fam in _TENANT_HIST_FAMILIES:
+            obs.metrics.tenant_hist(fam, cap=self.tenant_cap)
+        for fam in _TENANT_COUNTER_FAMILIES:
+            obs.metrics.tenant_counter(fam, cap=self.tenant_cap)
         obs.tracer.set_observer(self._observe_span)
 
         if self.warm:

@@ -902,6 +902,13 @@ class LaneScheduler:
             obs.metrics.hist_observe(
                 "serve.cb_occupancy", float(len(running))
             )
+            # the per-lane occupancy twin of the queue-depth series:
+            # -metrics-prom renders both as lane-labeled series
+            # (lane="N") beside the deprecated name-embedded spelling
+            # (docs/observability.md)
+            obs.metrics.hist_observe(
+                f"serve.lane{lane.index}.occupancy", float(len(running))
+            )
             while waiting and len(running) < self._microbatch:
                 req = waiting.popleft()
                 coalesced = n_started > 0 or not first

@@ -29,8 +29,10 @@ Requests carry ``"op"``:
 - ``plan``     — one CLI invocation: ``argv`` (the canonical flag list
   the client built, ``-no-daemon`` included so the daemon never
   re-forwards) plus ``stdin`` (the input text when no ``-input``/
-  ``-from-zk`` names a source). The response carries ``rc``/``stdout``/
-  ``stderr`` verbatim;
+  ``-from-zk`` names a source). v2 plan headers may carry ``tenant``
+  (the client's session identity) purely for telemetry attribution —
+  an untenanted request lands in the scrape's ``other`` rollup. The
+  response carries ``rc``/``stdout``/``stderr`` verbatim;
 - ``stats``    — live telemetry scrape: the daemon's shared snapshot
   (requests/inflight/lane attribution) plus every streaming histogram's
   lifetime + windowed percentiles, as a schema-versioned document
@@ -84,7 +86,10 @@ PROTO_V2 = 2
 # v2: + "memory" (per-lane HBM/residency-pool attribution)
 # v3: + "sessions" (resident cluster sessions: count/bytes/delta hits)
 #     + "fallbacks" (daemon-observed client fallback/resync reasons)
-STATS_SCHEMA_VERSION = 3
+# v4: + "tenants" (per-tenant attribution: bounded top-K label families
+#     — request counts, latency hists, session/fallback attribution,
+#     with demoted tenants rolled into "other")
+STATS_SCHEMA_VERSION = 4
 STATS_SCHEMA = f"kafkabalancer-tpu.serve-stats/{STATS_SCHEMA_VERSION}"
 
 # a frame larger than this is a protocol error, not a payload: the

@@ -458,6 +458,21 @@ class SessionStore:
             self.expired_idle += len(expired)
             return len(expired)
 
+    def stats_by_tenant(self) -> Dict[str, Dict[str, int]]:
+        """Per-tenant resident footprint (session count + approx
+        bytes), summed across flag signatures — the scrape's
+        ``tenants`` block reads session attribution through this (one
+        key per tenant with ANY resident session; tenants whose
+        sessions were all evicted/expired report nothing here — their
+        counters live on in the label families)."""
+        with self._lock:
+            out: Dict[str, Dict[str, int]] = {}
+            for (tenant, _sig), s in self._sessions.items():
+                e = out.setdefault(tenant, {"sessions": 0, "bytes": 0})
+                e["sessions"] += 1
+                e["bytes"] += s.approx_bytes
+            return out
+
     def stats(self) -> Dict[str, int]:
         with self._lock:
             return {

@@ -44,7 +44,13 @@
 #                         parity vs -no-daemon at every step),
 #                         serve.delta_hits >= 1 and session bytes
 #                         present via -serve-stats-json
-#  11. tier-1 tests     — the ROADMAP.md verify suite (skip: --no-tests)
+#  11. replay smoke     — seeded 3-tenant churn replay against a
+#                         private daemon: serve-stats/4 schema,
+#                         per-tenant counts reconciling exactly with
+#                         the driver, scrape-vs-flight latency within
+#                         one histogram bucket, plan byte parity vs
+#                         -no-daemon on a sampled request
+#  12. tier-1 tests     — the ROADMAP.md verify suite (skip: --no-tests)
 #
 # Exit 0 only when every stage that ran passed. Optional tools that are
 # not installed SKIP with a notice instead of failing: the gate must be
@@ -437,7 +443,7 @@ if [ "$cb_ready" = 1 ]; then
       -serve-stats-json 2>/dev/null | "$PYTHON" -c '
 import json, sys
 p = json.loads(sys.stdin.read())
-assert p["schema"] == "kafkabalancer-tpu.serve-stats/3", p.get("schema")
+assert p["schema"] == "kafkabalancer-tpu.serve-stats/4", p.get("schema")
 assert "serve.request_s" in p["hists"], sorted(p["hists"])
 assert "serve.phase.parse" in p["hists"], sorted(p["hists"])
 assert isinstance(p["memory"], list) and p["memory"], p.get("memory")
@@ -602,6 +608,45 @@ else
   fail=1
 fi
 rm -rf "$ss_tmp"
+
+step "replay smoke (seeded 3-tenant churn, per-tenant reconciliation)"
+# The fleet-churn replay harness end to end (ROADMAP item 5,
+# docs/observability.md § Per-tenant attribution): a seeded 3-tenant
+# churn run — weight shifts, a topic storm, a broker failure — driven
+# closed-loop through the real client against a private self-spawned
+# daemon. Asserts the serve-stats/4 scrape schema, per-tenant request
+# counts reconciling EXACTLY with the driver's issued counts, the
+# scrape's per-tenant percentiles agreeing with the flight recorder's
+# tenant-labeled request log within one histogram bucket, and plan
+# byte parity vs -no-daemon on a sampled request (--check exits 2 when
+# any of those fail).
+rp_tmp=$(mktemp -d)
+if JAX_PLATFORMS=cpu "$PYTHON" -m kafkabalancer_tpu.replay \
+    --tenants 3 --requests 24 --seed 7 --topic-storm-every 9 \
+    --broker-failure-every 11 --check --out "$rp_tmp/replay.json" \
+    >/dev/null 2>"$rp_tmp/replay.log" \
+  && "$PYTHON" -c '
+import json
+a = json.load(open("'"$rp_tmp"'/replay.json"))
+assert a["schema"] == "kafkabalancer-tpu.replay/1", a["schema"]
+assert a["scrape_schema"] == "kafkabalancer-tpu.serve-stats/4", (
+    a["scrape_schema"])
+assert a["reconciled_counts"] is True
+assert a["latency_checked"] is True
+assert a["reconciled_latency"] is True
+assert a["parity"] and a["parity"]["ok"] is True, a["parity"]
+per = a["per_tenant"]
+assert len(per) == 3, sorted(per)
+assert all(e["counts_ok"] for e in per.values()), per
+assert sum(e["issued"] for e in per.values()) == a["requests_issued"]
+'; then
+  echo "seeded 3-tenant churn: counts exact + latency + parity: OK"
+else
+  echo "replay smoke FAILED (see $rp_tmp)"
+  tail -10 "$rp_tmp/replay.log" 2>/dev/null
+  fail=1
+fi
+rm -rf "$rp_tmp"
 
 if [ "$run_tests" = 1 ]; then
   step "tier-1 tests"

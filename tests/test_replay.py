@@ -1,0 +1,222 @@
+"""The fleet-churn replay harness (kafkabalancer_tpu/replay/).
+
+Pins:
+
+- the synthesizer is DETERMINISTIC: one seed, one event stream, one
+  byte sequence of tenant states — a replay run is a reproducible
+  regression gate, not a flaky load test;
+- churn events do what they claim (weight drift, broker failure with
+  allowlist rewrite, topic storms growing the row set);
+- a seeded run against a live daemon produces a replay/1 artifact whose
+  per-tenant request counts reconcile EXACTLY with the daemon's
+  serve-stats/4 scrape, whose scrape percentiles agree with the flight
+  recorder's tenant-labeled request log within one histogram bucket,
+  and whose sampled request has plan byte parity vs -no-daemon.
+"""
+
+import json
+import os
+import shutil
+import tempfile
+import threading
+import time
+
+import pytest
+
+from kafkabalancer_tpu.replay import (
+    REPLAY_SCHEMA,
+    FleetSynth,
+    ReplayConfig,
+    run_replay,
+)
+from kafkabalancer_tpu.serve import client as sclient
+from kafkabalancer_tpu.serve.daemon import Daemon
+
+
+# --- synthesizer ----------------------------------------------------------
+
+
+def _drive(seed: int, steps: int):
+    synth = FleetSynth(
+        seed,
+        tenants=3,
+        base_partitions=24,
+        brokers=6,
+        weight_shift_every=5,
+        topic_storm_every=7,
+        broker_failure_every=9,
+    )
+    trail = []
+    for step in range(steps):
+        tenant, fired = synth.step(step)
+        trail.append((tenant.name, tuple(fired), tenant.text()))
+    return synth, trail
+
+
+def test_synth_is_deterministic_per_seed():
+    _s1, t1 = _drive(42, 40)
+    _s2, t2 = _drive(42, 40)
+    assert t1 == t2
+    _s3, t3 = _drive(43, 40)
+    assert t1 != t3
+
+
+def test_synth_skewed_sizes_and_valid_states():
+    synth = FleetSynth(11, tenants=4, base_partitions=64, brokers=8)
+    sizes = [len(t.rows) for t in synth.tenants]
+    assert sizes[0] > sizes[-1]  # zipf skew: tenant 0 is the whale
+    for t in synth.tenants:
+        doc = json.loads(t.text())
+        assert doc["version"] == 1
+        keys = {(r["topic"], r["partition"]) for r in doc["partitions"]}
+        assert len(keys) == len(doc["partitions"])  # unambiguous
+        for r in doc["partitions"]:
+            assert len(set(r["replicas"])) == len(r["replicas"])
+            assert all(0 <= b < 8 for b in r["replicas"])
+
+
+def test_synth_churn_events_mutate_state():
+    synth = FleetSynth(5, tenants=1, base_partitions=24, brokers=8)
+    t = synth.tenants[0]
+    before = t.text()
+    assert t.shift_weights(synth.rng, 0.2) >= 1
+    assert t.text() != before
+    n_rows = len(t.rows)
+    t.topic_storm(synth.rng, 4)
+    assert len(t.rows) == n_rows + 4
+    failed = t.fail_broker(synth.rng)
+    assert failed is not None
+    assert failed not in t.brokers
+    for row in t.rows:
+        assert failed not in row["brokers"]
+        assert failed not in row["replicas"]
+
+
+def test_tenant_apply_plan_closes_the_loop():
+    synth = FleetSynth(3, tenants=1, base_partitions=16, brokers=6)
+    t = synth.tenants[0]
+    row = t.rows[0]
+    new = [b for b in range(6) if b not in row["replicas"]][: len(
+        row["replicas"]
+    )]
+    plan = json.dumps({
+        "version": 1,
+        "partitions": [{
+            "topic": row["topic"], "partition": row["partition"],
+            "replicas": new,
+        }, {"topic": "unknown", "partition": 999, "replicas": [1]}],
+    })
+    assert t.apply_plan(plan) == 1  # unknown entries ignored
+    assert t.rows[0]["replicas"] == new
+    assert t.moves_applied == 1
+
+
+# --- the harness against a live daemon ------------------------------------
+
+
+@pytest.fixture
+def daemon_sock():
+    # NOT tmp_path: unix socket paths cap at ~104 bytes
+    d0 = tempfile.mkdtemp(prefix="kbr-")
+    sock = os.path.join(d0, "kb.sock")
+    d = Daemon(sock, idle_timeout=120.0, warm=False, log=lambda _m: None)
+    rc_box = []
+    t = threading.Thread(
+        target=lambda: rc_box.append(d.serve_forever()), daemon=True
+    )
+    t.start()
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if sclient.daemon_alive(sock) is not None:
+            break
+        time.sleep(0.02)
+    else:
+        pytest.fail("daemon never became ready")
+    yield sock
+    sclient.request_shutdown(sock)
+    t.join(15)
+    assert rc_box == [0], rc_box
+    shutil.rmtree(d0, ignore_errors=True)
+
+
+def test_replay_reconciles_against_live_daemon(daemon_sock):
+    """The acceptance pin: seeded multi-tenant churn, closed loop
+    through the real client — counts exact, latency within one bucket,
+    parity on the sampled request, session ladder exercised."""
+    cfg = ReplayConfig(
+        seed=7, tenants=3, requests=36,
+        socket=daemon_sock, spawn=False,
+        topic_storm_every=11, broker_failure_every=13,
+    )
+    art = run_replay(cfg, log=lambda _m: None)
+    assert art["schema"] == REPLAY_SCHEMA
+    assert art["scrape_schema"] == "kafkabalancer-tpu.serve-stats/4"
+    assert art["requests_issued"] == 36
+    assert art["request_errors"] == []
+    assert art["reconciled_counts"] is True
+    assert art["latency_checked"] is True  # fresh daemon, ring not full
+    assert art["reconciled_latency"] is True
+    assert art["reconciled"] is True
+    assert art["parity"] is not None and art["parity"]["ok"] is True
+    per = art["per_tenant"]
+    assert sorted(per) == ["tenant-00", "tenant-01", "tenant-02"]
+    assert sum(e["issued"] for e in per.values()) == 36
+    for e in per.values():
+        assert e["counts_ok"] and e["latency_ok"]
+        assert e["daemon_requests"] == e["issued"]
+        assert e["client_covers_daemon"]
+    # the churn must actually exercise the session ladder: steady-state
+    # delta hits AND at least one resync across the fleet
+    assert sum(e.get("delta_hits", 0) for e in per.values()) >= 3
+    assert (
+        sum(e.get("resyncs_rows", 0) for e in per.values())
+        + sum(e.get("resyncs_full", 0) for e in per.values())
+    ) >= 1
+    assert art["events"]["plan"] == 36
+    assert art["events"]["topic_storm"] >= 1
+
+
+def test_replay_artifact_schema_keys(daemon_sock):
+    """The replay/1 artifact's top-level keys are the schema bench.py
+    lands in BENCH rounds — changing them requires a version bump."""
+    cfg = ReplayConfig(
+        seed=1, tenants=2, requests=8, socket=daemon_sock, spawn=False,
+        parity_sample=False,
+    )
+    art = run_replay(cfg, log=lambda _m: None)
+    assert set(art) == {
+        "schema", "scrape_schema", "seed", "config", "requests_issued",
+        "request_errors", "wall_s", "throughput_rps", "events",
+        "per_tenant", "session_thrash", "fallback_rate", "padded_slots",
+        "microbatched", "tenant_cap", "tenants_demoted", "parity",
+        "reconciled_counts", "latency_checked", "reconciled_latency",
+        "reconciled",
+    }
+    assert art["parity"] is None  # parity_sample=False
+    entry = art["per_tenant"]["tenant-00"]
+    for key in (
+        "issued", "daemon_requests", "counts_ok", "moves_applied",
+        "partitions", "client_p50", "client_p95", "client_p99",
+        "daemon_p50", "daemon_p95", "daemon_p99", "flight_p50",
+        "flight_p95", "flight_p99", "latency_bucket_delta",
+        "client_bucket_delta", "client_covers_daemon",
+        "latency_checked", "latency_ok",
+        "delta_hits", "resyncs_rows", "resyncs_full", "fallbacks",
+        "session_bytes", "delta_hit_rate",
+    ):
+        assert key in entry, key
+
+
+def test_replay_requires_a_daemon():
+    from kafkabalancer_tpu.replay import ReplayError
+
+    d0 = tempfile.mkdtemp(prefix="kbr-")
+    try:
+        cfg = ReplayConfig(
+            socket=os.path.join(d0, "absent.sock"), spawn=False,
+            requests=2,
+        )
+        with pytest.raises(ReplayError):
+            run_replay(cfg, log=lambda _m: None)
+    finally:
+        shutil.rmtree(d0, ignore_errors=True)

@@ -1699,7 +1699,7 @@ def test_dev_cached_asarray_reuses_equal_content():
 # --- live daemon telemetry: the stats / dump-trace scrape ops --------------
 
 GOLDEN_STATS = os.path.join(
-    os.path.dirname(__file__), "data", "serve_stats_schema_v3.json"
+    os.path.dirname(__file__), "data", "serve_stats_schema_v4.json"
 )
 
 
@@ -1829,8 +1829,8 @@ def test_stats_scrape_never_blocks_on_inflight_plan(sock_dir, monkeypatch):
 
 def test_serve_stats_json_schema_golden(daemon):
     """Golden-file pin: the stats document's top-level keys, histogram
-    entry keys and flight keys are VERSIONED
-    (kafkabalancer-tpu.serve-stats/3) — changing any requires a schema
+    entry keys, per-tenant entry keys and flight keys are VERSIONED
+    (kafkabalancer-tpu.serve-stats/4) — changing any requires a schema
     bump and a new golden."""
     sock, _d = daemon
     rv, _out, _err = run_cli(
@@ -1861,6 +1861,17 @@ def test_serve_stats_json_schema_golden(daemon):
     assert doc["sessions"]["count"] >= 1  # the -input request registered
     assert doc["sessions"]["bytes"] > 0
     assert isinstance(doc["fallbacks"], dict)
+    # v4: per-tenant attribution (bounded top-K label families)
+    tenants = doc["tenants"]
+    assert set(tenants) == set(golden["tenants_keys"])
+    assert tenants["top"], "the -input request must be tenant-attributed"
+    for name, entry in tenants["top"].items():
+        assert set(entry) == set(golden["tenant_entry_keys"]), name
+        assert entry["requests"] >= 1
+        assert set(entry["request_s"]) == set(golden["hist_keys"]), name
+        assert entry["request_s"]["count"] == entry["requests"]
+    if tenants["other"] is not None:
+        assert set(tenants["other"]) == set(golden["tenant_entry_keys"])
 
 
 def test_served_explain_forwards_and_matches(daemon, sock_dir, tmp_path):
@@ -1913,7 +1924,7 @@ def test_scrape_cli_verbs_roundtrip(daemon, sock_dir):
     rv, out, _err = run_cli([f"-serve-socket={sock}", "-serve-stats-json"])
     assert rv == 0
     doc = json.loads(out)
-    assert doc["schema"] == "kafkabalancer-tpu.serve-stats/3"
+    assert doc["schema"] == "kafkabalancer-tpu.serve-stats/4"
     assert doc["hists"]["serve.request_s"]["count"] == doc["requests"]
     rv, out, _err = run_cli([f"-serve-socket={sock}", "-serve-stats"])
     assert rv == 0

@@ -1,0 +1,336 @@
+"""Bounded label-dimensioned telemetry families (obs/hist.py
+HistFamily, obs/metrics.py CounterFamily) and their export surfaces.
+
+The load-bearing pins:
+
+- the label bound is HARD: past ``cap`` live labels the LRU label is
+  demoted into the ``other`` rollup — a million-tenant label churn can
+  never grow family memory past cap+1 histograms;
+- demotion is LOSSLESS in aggregate: the family-wide observation total
+  is exact and monotone across any amount of churn (the rollup absorbs
+  every demoted observation, lifetime AND windowed);
+- the families survive per-invocation registry resets (daemon-lifetime,
+  like the plain histograms) and clear on ``reset_tenants``;
+- the Prometheus exposition renders tenants as LABELED series (bounded
+  cardinality, escaped label values) and re-emits the name-embedded
+  per-lane hists as lane-labeled series beside the deprecated names.
+"""
+
+import threading
+
+from kafkabalancer_tpu.obs.hist import (
+    OTHER_LABEL,
+    HistFamily,
+    StreamingHist,
+    bucket_le,
+)
+from kafkabalancer_tpu.obs.metrics import CounterFamily, MetricsRegistry
+
+
+# --- HistFamily -----------------------------------------------------------
+
+
+def test_hist_family_demotes_lru_into_other():
+    f = HistFamily(cap=2)
+    f.observe("a", 1.0)
+    f.observe("b", 2.0)
+    f.observe("a", 1.5)  # bumps a's recency: b is now the LRU
+    f.observe("c", 4.0)  # cap exceeded: b demotes into other
+    snap = f.snapshot()
+    assert sorted(snap["labels"]) == ["a", "c"]
+    assert snap["demoted"] == 1
+    assert snap["other"]["count"] == 1  # b's one observation
+    assert snap["other"]["max"] == 2.0
+    # a demoted label coming back starts fresh; its history stays in
+    # the rollup (a is now the LRU and demotes with BOTH its samples)
+    f.observe("b", 8.0)
+    snap = f.snapshot()
+    assert sorted(snap["labels"]) == ["b", "c"]
+    assert snap["demoted"] == 2
+    assert snap["other"]["count"] == 3  # b's old 1 + a's 2
+    assert snap["labels"]["b"]["count"] == 1  # fresh, not resurrected
+
+
+def test_hist_family_rollup_total_monotone_across_churn():
+    """The family-wide total equals the observation count exactly, no
+    matter how labels churn through the cap."""
+    f = HistFamily(cap=3)
+    n = 0
+    for i in range(200):
+        f.observe(f"tenant-{i % 17}", float(i % 7 + 1))
+        n += 1
+        assert f.total_count() == n
+    snap = f.snapshot()
+    in_labels = sum(h["count"] for h in snap["labels"].values())
+    assert in_labels + snap["other"]["count"] == 200
+    assert len(snap["labels"]) == 3
+    # the 17-label cycle never revisits a label while it is still live
+    # (cap 3 < 17), so every observation past the first 3 demotes one
+    assert snap["demoted"] == 200 - 3
+
+
+def test_hist_family_reserved_other_label_feeds_rollup():
+    f = HistFamily(cap=2)
+    f.observe(OTHER_LABEL, 3.0)
+    snap = f.snapshot()
+    assert snap["labels"] == {}
+    assert snap["other"]["count"] == 1
+
+
+def test_hist_family_windowed_view_rotation_under_churn():
+    """Windowed state follows a demoted label into the rollup when
+    still fresh, and ages out of it on the normal ring schedule."""
+    clock = [0.0]
+    f = HistFamily(cap=1, window_s=60.0, ring=6, now=lambda: clock[0])
+    f.observe("a", 1.0)
+    clock[0] = 5.0
+    f.observe("b", 2.0)  # demotes a at t=5: its t=0 slot is still live
+    other = f.snapshot()["other"]
+    assert other["count"] == 1
+    assert other["window"]["count"] == 1  # a's fresh slot merged in
+    # age the window out: the rollup's LIFETIME keeps a's observation,
+    # the windowed view drops it
+    clock[0] = 120.0
+    other = f.snapshot()["other"]
+    assert other["count"] == 1
+    assert other["window"]["count"] == 0
+
+
+def test_hist_family_demotion_never_recycles_newer_window_slots():
+    """A demoted label whose ring slots are OLDER than what the rollup
+    already holds in those positions must not wipe the rollup's newer
+    sub-epochs (merge_from's epoch guard)."""
+    clock = [0.0]
+    f = HistFamily(cap=1, window_s=60.0, ring=6, now=lambda: clock[0])
+    f.observe("a", 1.0)  # a's slot: epoch 0
+    clock[0] = 61.0  # one full window later
+    f.observe(OTHER_LABEL, 9.0)  # rollup slot: same ring position, newer
+    f.observe("b", 2.0)  # demotes a; a's epoch-0 slot is stale
+    other = f.snapshot()["other"]
+    assert other["count"] == 2  # lifetime keeps both
+    assert other["window"]["count"] == 1  # only the fresh observation
+
+
+def test_streaming_hist_merge_from_matches_combined_stream():
+    a, b = StreamingHist(), StreamingHist()
+    combined = StreamingHist()
+    vals_a = [0.001, 0.01, 0.5, 3.0]
+    vals_b = [0.002, 0.2, 7.0]
+    for v in vals_a:
+        a.observe(v)
+        combined.observe(v)
+    for v in vals_b:
+        b.observe(v)
+        combined.observe(v)
+    a.merge_from(b)
+    sa, sc = a.snapshot(), combined.snapshot()
+    for key in ("count", "min", "max", "p50", "p95", "p99", "buckets"):
+        assert sa[key] == sc[key], key
+    assert abs(sa["sum"] - sc["sum"]) < 1e-9
+
+
+# --- CounterFamily --------------------------------------------------------
+
+
+def test_counter_family_demotion_preserves_total():
+    f = CounterFamily(cap=2)
+    total = 0.0
+    for i, label in enumerate("abcabcddee"):
+        f.add(label, float(i + 1))
+        total += i + 1
+        assert f.total() == total
+    snap = f.snapshot()
+    assert len(snap["labels"]) == 2
+    assert snap["other"] + sum(snap["labels"].values()) == total
+    assert snap["demoted"] >= 3
+
+
+def test_counter_family_other_is_reserved():
+    f = CounterFamily(cap=1)
+    f.add(OTHER_LABEL, 5.0)
+    f.add("a", 1.0)
+    assert f.get(OTHER_LABEL) == 5.0
+    assert f.get("a") == 1.0
+    assert f.snapshot()["demoted"] == 0
+
+
+# --- concurrency ----------------------------------------------------------
+
+
+def test_family_concurrency_hammer():
+    """The registry-hammer mirror for the label families: concurrent
+    observers churning labels through the cap, with readers racing
+    snapshots — the final totals must be exact (no lost or
+    double-counted observation at the demotion boundary)."""
+    hf = HistFamily(cap=4)
+    cf = CounterFamily(cap=4)
+    n_threads, n_obs = 8, 500
+    stop = threading.Event()
+
+    def writer(k: int) -> None:
+        for i in range(n_obs):
+            label = f"tenant-{(i * (k + 3)) % 23}"
+            hf.observe(label, float(i % 9 + 1))
+            cf.add(label)
+
+    ceiling = n_threads * n_obs
+
+    def reader() -> None:
+        while not stop.is_set():
+            snap = hf.snapshot()
+            live = sum(h["count"] for h in snap["labels"].values())
+            other = snap["other"]["count"] if snap["other"] else 0
+            # every snapshot is internally consistent: nothing counted
+            # both live and rolled-up (<= the eventual total), and the
+            # monotone total never overshoots
+            assert live + other <= ceiling
+            assert cf.total() <= ceiling
+
+    threads = [
+        threading.Thread(target=writer, args=(k,))
+        for k in range(n_threads)
+    ]
+    readers = [threading.Thread(target=reader) for _ in range(2)]
+    for t in readers:
+        t.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    stop.set()
+    for t in readers:
+        t.join()
+    assert hf.total_count() == n_threads * n_obs
+    assert cf.total() == float(n_threads * n_obs)
+    assert len(hf.snapshot()["labels"]) <= 4
+
+
+# --- registry integration -------------------------------------------------
+
+
+def test_registry_tenant_families_survive_reset():
+    r = MetricsRegistry()
+    r.tenant_hist_observe("serve.request_s", "t0", 0.5)
+    r.tenant_count("serve.requests", "t0")
+    r.reset()  # the per-invocation epoch boundary
+    snap = r.tenant_snapshot()
+    assert snap["hists"]["serve.request_s"]["labels"]["t0"]["count"] == 1
+    assert snap["counters"]["serve.requests"]["labels"]["t0"] == 1.0
+    assert r.tenant_counter_get("serve.requests", "t0") == 1.0
+    r.reset_tenants()
+    assert r.tenant_snapshot() == {"hists": {}, "counters": {}}
+
+
+def test_registry_tenant_family_cap_binds_at_creation():
+    r = MetricsRegistry()
+    fam = r.tenant_hist("serve.request_s", cap=2)
+    assert r.tenant_hist("serve.request_s", cap=99) is fam
+    for i in range(5):
+        fam.observe(f"t{i}", 1.0)
+    assert len(fam.snapshot()["labels"]) == 2
+
+
+# --- export surfaces ------------------------------------------------------
+
+
+def _tenants_doc():
+    hist = {
+        "count": 3, "sum": 0.3, "min": 0.05, "max": 0.15,
+        "p50": 0.1, "p95": 0.15, "p99": 0.15,
+        "window": {
+            "count": 3, "span_s": 60.0, "p50": 0.1, "p95": 0.15,
+            "p99": 0.15,
+        },
+        "buckets": [[0.1, 2], [0.15, 1]],
+    }
+    return {
+        "requests": 7,
+        "hists": {
+            "serve.lane0.queue_depth": dict(hist),
+            "serve.lane1.queue_depth": dict(hist),
+            "serve.lane0.occupancy": dict(hist),
+            "serve.request_s": dict(hist),
+        },
+        "tenants": {
+            "cap": 32, "demoted": 4,
+            "top": {
+                'ten"ant\\1': {
+                    "requests": 3, "crashed": 0, "request_s": dict(hist),
+                    "queue_s": None, "delta_hits": 2, "resyncs_rows": 1,
+                    "resyncs_full": 0, "fallbacks": 1, "sessions": 1,
+                    "session_bytes": 2048,
+                },
+            },
+            "other": {
+                "requests": 4, "crashed": 1, "request_s": dict(hist),
+                "queue_s": None, "delta_hits": 0, "resyncs_rows": 0,
+                "resyncs_full": 2, "fallbacks": 3, "sessions": 0,
+                "session_bytes": 0,
+            },
+        },
+    }
+
+
+def test_prometheus_tenant_series_and_escaping():
+    from kafkabalancer_tpu.obs import export as obs_export
+
+    text = obs_export.render_prometheus(_tenants_doc())
+    # escaped label value: backslash and quote both survive safely
+    assert (
+        'kafkabalancer_tpu_tenant_requests{tenant="ten\\"ant\\\\1"} 3'
+        in text
+    )
+    assert 'kafkabalancer_tpu_tenant_requests{tenant="other"} 4' in text
+    assert 'kafkabalancer_tpu_tenant_delta_hits{tenant="ten\\"ant\\\\1"} 2' in text
+    assert 'kafkabalancer_tpu_tenant_session_bytes{tenant="ten\\"ant\\\\1"} 2048' in text
+    assert "# TYPE kafkabalancer_tpu_tenants_demoted counter" in text
+    assert "kafkabalancer_tpu_tenants_demoted 4" in text
+    assert (
+        'kafkabalancer_tpu_tenant_request_s{tenant="other",quantile="0.99"}'
+        in text
+    )
+    assert 'kafkabalancer_tpu_tenant_request_s_count{tenant="other"} 3' in text
+
+
+def test_prometheus_lane_labeled_series_beside_deprecated_names():
+    from kafkabalancer_tpu.obs import export as obs_export
+
+    text = obs_export.render_prometheus(_tenants_doc())
+    # the deprecated name-embedded spelling still emits...
+    assert "# TYPE kafkabalancer_tpu_serve_lane0_queue_depth summary" in text
+    # ...and the labeled series rides beside it, one metric per kind
+    assert "# TYPE kafkabalancer_tpu_serve_lane_queue_depth summary" in text
+    assert (
+        'kafkabalancer_tpu_serve_lane_queue_depth{lane="0",quantile="0.5"}'
+        in text
+    )
+    assert (
+        'kafkabalancer_tpu_serve_lane_queue_depth{lane="1",quantile="0.5"}'
+        in text
+    )
+    assert 'kafkabalancer_tpu_serve_lane_queue_depth_count{lane="1"} 3' in text
+    assert (
+        'kafkabalancer_tpu_serve_lane_occupancy{lane="0",quantile="0.99"}'
+        in text
+    )
+    # the plain request hist is untouched by the lane re-labeling
+    assert "# TYPE kafkabalancer_tpu_serve_request_s summary" in text
+
+
+def test_serve_stats_human_rendering_top_tenants_table():
+    from kafkabalancer_tpu.obs import export as obs_export
+
+    text = obs_export.render_serve_stats(_tenants_doc())
+    assert "tenants: 2 tracked (cap 32, 4 demoted into other)" in text
+    assert "requests  p50" in text  # the table header
+    assert "(other)" in text
+    # delta-hit rate: 2 hits of 3 requests
+    assert "67%" in text
+    # resident bytes
+    assert "2.0KB" in text
+
+
+def test_bucket_le_sanity():
+    # the replay harness leans on bucket arithmetic; pin the contract
+    assert bucket_le(0) == 1.0
+    assert bucket_le(4) == 2.0
