@@ -752,7 +752,7 @@ def _run_replay_probe() -> dict:
     attribution) at smoke scale — a seeded 3-tenant fleet with diurnal
     arrival skew, weight-shift churn, a topic storm and a broker
     failure, driven closed-loop through the real client against a
-    private daemon. Lands the replay/1 artifact (per-tenant
+    private daemon. Lands the replay/2 artifact (per-tenant
     p50/p95/p99, delta-hit/resync/fallback attribution, session-thrash
     rate, padded-slot waste) so the artifact SCHEMA is pinned in bench
     rounds before the bench-host BENCH_r06 run records it at fleet
@@ -1150,7 +1150,7 @@ def main() -> None:
         log(f"throughput probe unavailable: {exc!r}")
 
     # replay probe: the seeded multi-tenant churn harness at smoke
-    # scale — pins the replay/1 artifact schema and the per-tenant
+    # scale — pins the replay/2 artifact schema and the per-tenant
     # scrape reconciliation in every bench round
     try:
         cold.update(_run_replay_probe())

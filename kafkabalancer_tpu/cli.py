@@ -222,6 +222,8 @@ _NO_FORWARD_FLAGS = frozenset((
     "serve", "serve-socket", "serve-idle-timeout", "serve-prewarm",
     "serve-lanes", "serve-microbatch", "serve-batch-mode",
     "serve-admission-hold", "serve-slow-ms", "serve-tenant-cap",
+    "serve-max-queue", "serve-tenant-inflight", "serve-watchdog",
+    "serve-faults", "serve-client-timeout",
     "serve-stats", "serve-stats-json", "serve-dump-trace", "metrics-prom",
     "serve-session", "serve-no-session",
     "no-daemon", "help", "pprof", "pprof-path", "jax-profile", "input",
@@ -622,6 +624,44 @@ def _run_impl(
             "histograms and counters; the rest roll up into 'other' "
             "(docs/observability.md)",
         )
+        f_serve_max_queue = f.int(
+            "serve-max-queue",
+            256,
+            "Daemon: total admission-queue bound — arrivals past it "
+            "are shed with a structured retry-after frame instead of "
+            "queueing forever (0 disables; docs/serving.md § Overload)",
+        )
+        f_serve_tenant_inflight = f.int(
+            "serve-tenant-inflight",
+            64,
+            "Daemon: per-tenant queued+inflight cap — one churn-heavy "
+            "tenant past it is shed (retry-after frame) while other "
+            "tenants keep planning (0 disables)",
+        )
+        f_serve_watchdog = f.float(
+            "serve-watchdog",
+            120.0,
+            "Daemon: lane health watchdog interval in seconds — a lane "
+            "with active work and no progress past it is quarantined, "
+            "its queued work requeued onto healthy lanes, its in-flight "
+            "work answered with a structured error (0 disables)",
+        )
+        f_serve_faults = f.string(
+            "serve-faults",
+            "",
+            "Daemon: ARM the fault-injection seam with this schedule "
+            "(site@n[,n...][:arg][;...]; sites: lane_crash, "
+            "dispatch_delay, socket_drop, transfer_fail) — chaos "
+            "testing only, inert by default (docs/serving.md)",
+        )
+        f_serve_client_timeout = f.float(
+            "serve-client-timeout",
+            0.0,
+            "Client: bound the whole daemon plan wait to this many "
+            "seconds (also sent as the request's deadline_ms budget); "
+            "0 = progress-aware default — a wedged daemon is detected "
+            "by liveness probes and falls back in seconds",
+        )
         f_serve_session = f.string(
             "serve-session",
             "",
@@ -648,7 +688,7 @@ def _run_impl(
             "serve-stats-json",
             False,
             "Scrape a live daemon's telemetry as one line of "
-            "schema-versioned JSON (kafkabalancer-tpu.serve-stats/4)",
+            "schema-versioned JSON (kafkabalancer-tpu.serve-stats/5)",
         )
         f_serve_dump_trace = f.string(
             "serve-dump-trace",
@@ -836,6 +876,10 @@ def _run_impl(
                 admission_hold=f_serve_admission_hold.value,
                 slow_ms=f_serve_slow_ms.value,
                 tenant_cap=f_serve_tenant_cap.value,
+                max_queue=f_serve_max_queue.value,
+                tenant_inflight=f_serve_tenant_inflight.value,
+                watchdog_s=f_serve_watchdog.value,
+                faults_spec=f_serve_faults.value,
             ).serve_forever()
 
         if not f_no_daemon.value and not (f_pprof.value or f_jaxprof.value):
@@ -878,7 +922,7 @@ def _run_impl(
                 # else the input path ("-" for true stdin). A v2 daemon
                 # keys its resident state per (tenant, planning-flags
                 # signature) AND attributes the request's telemetry to
-                # the tenant (serve-stats/4 "tenants" block) — so the
+                # the tenant (serve-stats/5 "tenants" block) — so the
                 # label is derived even when sessions are disabled; a
                 # request with no derivable identity rolls up as
                 # "other" daemon-side.
@@ -926,6 +970,9 @@ def _run_impl(
                         session=session_spec,
                         note=_note_fallback,
                         tenant=tenant,
+                        client_timeout=max(
+                            0.0, f_serve_client_timeout.value
+                        ),
                     )
                 if served is None and declined:
                     # the daemon POSITIVELY declined (structured error

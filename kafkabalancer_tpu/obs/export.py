@@ -297,6 +297,54 @@ def render_prometheus(doc: Dict[str, Any]) -> str:
             m = _prom_name(f"sessions_{key}")
             lines.append(f"# TYPE {m} {typ}")
             lines.append(f"{m} {_prom_value(v)}")
+    # overload protection (serve-stats/5 "admission" block): queue
+    # occupancy gauges, shed counters by reason, the live retry-after
+    # estimate — the scrape half of docs/serving.md § Overload
+    adm = doc.get("admission")
+    if isinstance(adm, dict):
+        for key, typ in (
+            ("window", "gauge"), ("max_queue", "gauge"),
+            ("tenant_inflight", "gauge"), ("queued", "gauge"),
+            ("granted", "gauge"), ("arrivals", "counter"),
+            ("admitted", "counter"), ("shed_total", "counter"),
+            ("retry_after_ms", "gauge"),
+        ):
+            v = adm.get(key)
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            m = _prom_name(f"admission_{key}")
+            lines.append(f"# TYPE {m} {typ}")
+            lines.append(f"{m} {_prom_value(v)}")
+        sheds = adm.get("sheds")
+        if isinstance(sheds, dict) and sheds:
+            m = _prom_name("serve_sheds")
+            lines.append(f"# TYPE {m} counter")
+            for reason in sorted(sheds):
+                v = sheds[reason]
+                if isinstance(v, bool) or not isinstance(v, (int, float)):
+                    continue
+                lines.append(
+                    f'{m}{{reason="{reason}"}} {_prom_value(v)}'
+                )
+    # lane health (serve-stats/5): quarantine/requeue/recovery counters
+    # plus a per-lane quarantined gauge
+    lh = doc.get("lane_health")
+    if isinstance(lh, dict):
+        for key, typ in (
+            ("quarantines", "counter"), ("requeues", "counter"),
+            ("recoveries", "counter"), ("watchdog_s", "gauge"),
+        ):
+            v = lh.get(key)
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            m = _prom_name(f"lane_health_{key}")
+            lines.append(f"# TYPE {m} {typ}")
+            lines.append(f"{m} {_prom_value(v)}")
+        if isinstance(lh.get("quarantined"), list):
+            m = _prom_name("lane_quarantined")
+            lines.append(f"# TYPE {m} gauge")
+            for lane in lh["quarantined"]:
+                lines.append(f'{m}{{lane="{lane}"}} 1')
     # daemon-observed fallback/resync reasons, one labeled counter —
     # a degraded fleet (clients silently planning in-process) shows up
     # as a rate() here instead of requiring log archaeology
@@ -366,6 +414,7 @@ _TENANT_SCALARS = (
     ("resyncs_rows", "tenant_resyncs_rows", "counter"),
     ("resyncs_full", "tenant_resyncs_full", "counter"),
     ("fallbacks", "tenant_fallbacks", "counter"),
+    ("sheds", "tenant_sheds", "counter"),
     ("sessions", "tenant_sessions", "gauge"),
     ("session_bytes", "tenant_session_bytes", "gauge"),
 )
@@ -374,7 +423,7 @@ _TENANT_SCALARS = (
 def _render_prometheus_tenants(
     lines: List[str], tenants: Any
 ) -> None:
-    """The serve-stats/4 ``tenants`` block as tenant-labeled series:
+    """The serve-stats/5 ``tenants`` block as tenant-labeled series:
     one sample per live top-K tenant plus the ``other`` rollup, and the
     per-tenant latency hist as a tenant-labeled summary. Label memory
     is bounded by the daemon's tenant cap, so the exposition cannot
@@ -517,6 +566,42 @@ def render_serve_stats(doc: Dict[str, Any]) -> str:
             f"{k}={fallbacks[k]}" for k in sorted(fallbacks)
         )
         lines.append(f"  fallbacks: {rendered}")
+    adm = doc.get("admission")
+    if isinstance(adm, dict):
+        sheds = adm.get("sheds") or {}
+        shed_s = (
+            " (" + ", ".join(
+                f"{k}={sheds[k]}" for k in sorted(sheds)
+            ) + ")" if sheds else ""
+        )
+        lines.append(
+            f"  admission: {adm.get('queued', 0)} queued / "
+            f"{adm.get('granted', 0)} granted (window "
+            f"{adm.get('window', 0)}, max queue "
+            f"{adm.get('max_queue', 0)}, tenant cap "
+            f"{adm.get('tenant_inflight', 0)}); "
+            f"{adm.get('shed_total', 0)} shed{shed_s}, retry-after "
+            f"{adm.get('retry_after_ms', 0)}ms"
+        )
+    lh = doc.get("lane_health")
+    if isinstance(lh, dict) and (
+        lh.get("quarantines") or lh.get("quarantined")
+    ):
+        lines.append(
+            f"  lane health: {lh.get('quarantines', 0)} quarantines, "
+            f"{lh.get('requeues', 0)} requeues, "
+            f"{lh.get('recoveries', 0)} recoveries"
+            + (
+                f"; QUARANTINED NOW: {lh['quarantined']}"
+                if lh.get("quarantined") else ""
+            )
+        )
+    flt = doc.get("faults")
+    if isinstance(flt, dict) and flt.get("armed"):
+        lines.append(
+            f"  FAULTS ARMED: {flt['armed']} (fired: "
+            f"{flt.get('fired') or {}})"
+        )
     lines.extend(_render_tenant_table(doc.get("tenants")))
     mem = doc.get("memory")
     if isinstance(mem, list):

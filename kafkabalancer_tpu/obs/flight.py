@@ -41,6 +41,12 @@ from typing import Any, Callable, Deque, Dict, List, Optional, Set
 SPAN_RING = 4096
 REQUEST_RING = 512
 MAX_AUTODUMPS = 8
+# autodumps are additionally RATE-LIMITED: under a shed/crash storm
+# (sustained overload, a quarantined lane answering dozens of errors a
+# second) every incident would otherwise race to burn the dump cap in
+# the first second, leaving nothing for the incident after the storm.
+# Suppressed dumps are counted (stats "autodumps_suppressed").
+AUTODUMP_MIN_INTERVAL_S = 5.0
 # phase-accumulation threads tracked at once; serve-req threads pop
 # their entry at retirement, so this only bounds leakage from threads
 # that die without popping
@@ -76,6 +82,8 @@ class FlightRecorder:
         )
         self._acc: Dict[str, Dict[str, float]] = {}
         self._dumps = 0
+        self._dumps_suppressed = 0
+        self._last_dump_t = 0.0
         self.base_ns = time.perf_counter_ns()
         self.epoch = time.time()
 
@@ -129,6 +137,7 @@ class FlightRecorder:
                 "span_cap": self._spans.maxlen or 0,
                 "request_cap": self._requests.maxlen or 0,
                 "autodumps": self._dumps,
+                "autodumps_suppressed": self._dumps_suppressed,
             }
 
     def request_log(self) -> List[Dict[str, Any]]:
@@ -185,14 +194,22 @@ class FlightRecorder:
         reason: str,
         directory: Optional[str] = None,
         log: Optional[Callable[[str], None]] = None,
+        min_interval_s: float = AUTODUMP_MIN_INTERVAL_S,
     ) -> Optional[str]:
         """Write the ring to ``<directory>/kafkabalancer-flight-<pid>-
         <n>-<reason>.trace.json``; the written path, or None when the
-        per-process dump cap is spent or the write fails. Never
-        raises — the recorder must not turn an incident into a crash."""
+        per-process dump cap is spent, a dump landed within
+        ``min_interval_s`` (storm rate limit — suppressions are
+        counted), or the write fails. Never raises — the recorder must
+        not turn an incident into a crash."""
         with self._lock:
             if self._dumps >= MAX_AUTODUMPS:
                 return None
+            now = time.monotonic()
+            if self._dumps and now - self._last_dump_t < min_interval_s:
+                self._dumps_suppressed += 1
+                return None
+            self._last_dump_t = now
             self._dumps += 1
             seq = self._dumps
         path = os.path.join(

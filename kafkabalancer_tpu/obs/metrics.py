@@ -23,7 +23,7 @@ gate verdicts, and the solver/session counters. Four metric families:
   reads mid-traffic; tests reset them explicitly via
   :meth:`reset_hists`. Excluded from :meth:`snapshot` on purpose — the
   ``kafkabalancer-tpu.metrics/1`` schema is golden-pinned, and the
-  scrape document (``kafkabalancer-tpu.serve-stats/4``) is the
+  scrape document (``kafkabalancer-tpu.serve-stats/5``) is the
   histograms' export seam;
 - **label families** — bounded label-dimensioned histogram/counter
   families (``tenant_hist_observe`` / ``tenant_count``): per-tenant
@@ -241,7 +241,7 @@ class MetricsRegistry:
 
     def tenant_snapshot(self) -> Dict[str, Dict[str, Any]]:
         """Every label family's export view — the scrape's per-tenant
-        attribution payload (serve-stats/4 ``tenants`` block). Like the
+        attribution payload (serve-stats/5 ``tenants`` block). Like the
         plain histograms, deliberately NOT part of :meth:`snapshot`."""
         with self._lock:
             hfams = dict(self._tenant_hists)

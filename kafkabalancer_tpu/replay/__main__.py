@@ -1,6 +1,6 @@
 """``python -m kafkabalancer_tpu.replay`` — run one seeded fleet-churn
 replay against a live (or private, self-spawned) planning daemon and
-write the ``kafkabalancer-tpu.replay/1`` artifact.
+write the ``kafkabalancer-tpu.replay/2`` artifact.
 
 Examples::
 
@@ -90,6 +90,24 @@ def main(argv: list) -> int:
         help="skip the -no-daemon plan byte-parity sample",
     )
     p.add_argument(
+        "--chaos", action="store_true",
+        help="fault-injection mode: arm a seeded fault schedule "
+        "(lane crash, dispatch delays, socket drops, transfer "
+        "failure) on a private daemon with tight admission caps, "
+        "drive it from concurrent clients, and check plan-byte "
+        "parity on EVERY answered request",
+    )
+    p.add_argument(
+        "--chaos-faults", default="",
+        help="override the seeded fault schedule "
+        "(site@n[,n...][:arg];... — see -serve-faults)",
+    )
+    p.add_argument(
+        "--concurrency", type=int, default=d.concurrency,
+        help="chaos mode: concurrent client threads (the overload "
+        "pressure)",
+    )
+    p.add_argument(
         "--out", default="-",
         help="artifact path ('-' = stdout, the default)",
     )
@@ -113,6 +131,8 @@ def main(argv: list) -> int:
         solver=a.solver, socket=a.socket, spawn=not a.no_spawn,
         latency_tolerance_buckets=a.latency_tolerance_buckets,
         parity_sample=not a.no_parity,
+        chaos=a.chaos, chaos_faults=a.chaos_faults,
+        concurrency=a.concurrency,
     )
     try:
         artifact = run_replay(cfg)
@@ -127,7 +147,10 @@ def main(argv: list) -> int:
     else:
         with open(a.out, "w") as f:
             f.write(line)
-    sys.stderr.write(render_summary(artifact))
+    if artifact.get("mode") == "chaos":
+        sys.stderr.write(render_chaos_summary(artifact))
+    else:
+        sys.stderr.write(render_summary(artifact))
     if a.check:
         parity = artifact.get("parity")
         parity_ok = parity is None or bool(parity.get("ok"))
@@ -135,6 +158,23 @@ def main(argv: list) -> int:
             print("replay: reconciliation FAILED", file=sys.stderr)
             return 2
     return 0
+
+
+def render_chaos_summary(artifact: dict) -> str:
+    ch = artifact.get("chaos") or {}
+    return (
+        f"-- chaos replay (seed {artifact.get('seed')}): "
+        f"{artifact.get('requests_issued')} requests, "
+        f"{ch.get('answered')} answered (parity checked on every one), "
+        f"{len(ch.get('wrong_plans') or [])} wrong plans, "
+        f"{ch.get('shed_total')} sheds {ch.get('sheds')}, "
+        f"{ch.get('quarantines')} quarantines / "
+        f"{ch.get('requeues')} requeues / "
+        f"{ch.get('recoveries')} recoveries, "
+        f"faults fired {ch.get('faults_fired')}, "
+        f"daemon alive {ch.get('daemon_alive_at_end')}, "
+        f"ok={ch.get('ok')}\n"
+    )
 
 
 if __name__ == "__main__":

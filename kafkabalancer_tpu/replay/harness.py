@@ -8,7 +8,7 @@ multi-tenant churn, every request runs through the REAL forwarding
 client (``cli.run`` with a ``-serve-socket`` — the same code path the
 production outer loop uses, resident-session ladder included), the
 emitted plan is applied back to the tenant's state (the closed loop),
-and at the end the harness fetches the daemon's ``serve-stats/4``
+and at the end the harness fetches the daemon's ``serve-stats/5``
 scrape and reconciles:
 
 - per-tenant REQUEST COUNTS: the driver's issued counts must equal the
@@ -31,7 +31,7 @@ scrape and reconciles:
   layer's oldest pin, exercised under churn).
 
 The result is one schema-versioned artifact
-(``kafkabalancer-tpu.replay/1``) with per-tenant tails, session-thrash
+(``kafkabalancer-tpu.replay/2``) with per-tenant tails, session-thrash
 and fallback rates, and padded-slot waste — the shape bench.py's
 ``replay_fleet_churn`` probe lands in BENCH rounds and gate.sh asserts
 pre-merge. No jax is imported here or anywhere below it: the harness is
@@ -52,7 +52,12 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from kafkabalancer_tpu.obs.hist import bucket_index, percentile_from_buckets
 from kafkabalancer_tpu.replay.synth import FleetSynth
 
-REPLAY_SCHEMA_VERSION = 1
+# v2: + "mode" ("churn" | "chaos") and the "chaos" block (null on churn
+# runs) — the --chaos closed-loop fault-injection run: seeded fault
+# schedule, concurrent clients driving sustained overload, plan-byte
+# parity checked on EVERY answered request, and the daemon's
+# shed/requeue/quarantine accounting reconciled exactly from the scrape
+REPLAY_SCHEMA_VERSION = 2
 REPLAY_SCHEMA = f"kafkabalancer-tpu.replay/{REPLAY_SCHEMA_VERSION}"
 
 LogFn = Callable[[str], None]
@@ -94,6 +99,15 @@ class ReplayConfig:
     daemon_args: Tuple[str, ...] = field(default_factory=tuple)
     latency_tolerance_buckets: int = 1
     parity_sample: bool = True
+    # chaos mode (--chaos): arm the daemon's fault seam with a seeded
+    # schedule (chaos_faults, auto-derived from the seed when empty),
+    # drive the fleet from `concurrency` concurrent clients against
+    # tight admission caps (sustained overload -> sheds -> client
+    # backoff -> in-process fallback), and check plan-byte parity vs
+    # -no-daemon on EVERY answered request
+    chaos: bool = False
+    chaos_faults: str = ""
+    concurrency: int = 8
 
 
 def _percentile_via_buckets(walls: List[float], q: float) -> float:
@@ -117,8 +131,39 @@ def _bucket_delta(client_le: float, daemon_le: float) -> Optional[int]:
     return bucket_index(client_le) - bucket_index(daemon_le)
 
 
+def chaos_fault_spec(seed: int, requests: int) -> str:
+    """A seeded fault schedule sized to one chaos run: a lane crash
+    mid-run, dispatch delays sprinkled through the first half (they
+    build the overload queue), socket drops, and one device-transfer
+    failure. Deterministic in the seed; the exact request each firing
+    lands on still depends on scheduling, which is the point — parity
+    must hold regardless."""
+    import random as random_mod
+
+    rng = random_mod.Random(seed ^ 0xC4A05)
+    n = max(12, requests)
+    crash_at = rng.randint(3, max(4, n // 3))
+    # the overload phase: a run of slow dispatches jams the (single)
+    # lane so the concurrent clients overflow the admission queue —
+    # sustained overload by construction, not by luck
+    pool = list(range(2, max(12, 2 * n // 3)))
+    delays = sorted(rng.sample(pool, min(6, len(pool))))
+    drops = sorted(rng.sample(range(2, max(6, n - 2)), 2))
+    xfer_at = rng.randint(2, max(3, n - 2))
+    return (
+        f"lane_crash@{crash_at}"
+        f";dispatch_delay@{','.join(str(d) for d in delays)}:0.5"
+        f";socket_drop@{','.join(str(d) for d in drops)}"
+        f";transfer_fail@{xfer_at}"
+    )
+
+
 def _spawn_daemon(
-    sock: str, tenants: int, extra: Tuple[str, ...], log: LogFn
+    sock: str,
+    tenants: int,
+    extra: Tuple[str, ...],
+    log: LogFn,
+    lane_args: Tuple[str, ...] = ("-serve-lanes=1",),
 ) -> Any:
     """Start a private daemon subprocess on ``sock`` and wait for its
     hello. ``-serve-lanes=1`` keeps the jax-free single-lane dispatcher
@@ -136,7 +181,7 @@ def _spawn_daemon(
     args = [
         sys.executable, "-m", "kafkabalancer_tpu", "-serve",
         f"-serve-socket={sock}", "-serve-idle-timeout=300",
-        "-serve-lanes=1",
+        *lane_args,
         f"-serve-tenant-cap={max(32, tenants)}", *extra,
     ]
     proc = subprocess.Popen(
@@ -178,7 +223,7 @@ def _tenant_scrape_counts(doc: Optional[Dict[str, Any]]) -> Dict[str, int]:
 def run_replay(
     cfg: ReplayConfig, log: Optional[LogFn] = None
 ) -> Dict[str, Any]:
-    """Run one seeded replay; returns the ``kafkabalancer-tpu.replay/1``
+    """Run one seeded replay; returns the ``kafkabalancer-tpu.replay/2``
     artifact (see the module docstring). Raises :class:`ReplayError`
     only when no daemon could be reached/spawned — a reconciliation
     failure is DATA (``reconciled: false``), not an exception, so bench
@@ -191,6 +236,8 @@ def run_replay(
     _log: LogFn = log or (
         lambda msg: print(msg, file=sys.stderr, flush=True)
     )
+    if cfg.chaos:
+        return _run_chaos(cfg, _log)
     tmpdir = None
     sock = cfg.socket
     spawned = None
@@ -315,6 +362,316 @@ def run_replay(
             import shutil
 
             shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def _run_chaos(cfg: ReplayConfig, _log: LogFn) -> Dict[str, Any]:
+    """The ``--chaos`` closed loop: a private daemon armed with a
+    seeded fault schedule and TIGHT admission caps, driven by
+    ``cfg.concurrency`` concurrent clients (sustained overload by
+    construction). Every answered request's plan is compared
+    byte-for-byte against a fresh ``-no-daemon`` run of the identical
+    input — lane crashes, dispatch delays, socket drops, transfer
+    failures and sheds may slow requests or push them to the
+    in-process fallback, but NEVER change a plan's bytes. At the end
+    the daemon must still be alive, and its shed/requeue/quarantine
+    accounting must reconcile exactly inside the scrape:
+
+    - ``admission.shed_total == sum(admission.sheds.values())``
+      ``== sum(per-tenant sheds incl. other)``;
+    - ``admission.arrivals == admitted + shed_total``;
+    - ``admitted == requests + lane_health.abandoned`` (every admitted
+      request either ran to an answer or was structurally abandoned by
+      the health monitor — nothing vanished);
+    - no lane still quarantined (every crash/wedge recovered).
+    """
+    import threading
+
+    from kafkabalancer_tpu import cli
+    from kafkabalancer_tpu.serve import client as sclient
+
+    spec = cfg.chaos_faults or chaos_fault_spec(cfg.seed, cfg.requests)
+    tmpdir = tempfile.mkdtemp(prefix="kb-chaos-")
+    sock = os.path.join(tmpdir, "kb.sock")
+    # -serve-lanes=0 -serve-microbatch=2 forces the LaneScheduler even
+    # on one device (the lane_crash site lives in its workers); tight
+    # caps make the concurrent clients overflow the queue; the high
+    # watchdog never false-triggers on a slow CI box but still arms
+    # crashed-worker detection (interval-independent)
+    daemon_args: Tuple[str, ...] = (
+        "-serve-microbatch=2",
+        f"-serve-faults={spec}",
+        "-serve-max-queue=2",
+        "-serve-tenant-inflight=8",
+        "-serve-watchdog=30",
+        *cfg.daemon_args,
+    )
+    spawned = _spawn_daemon(
+        sock, cfg.tenants, daemon_args, _log,
+        lane_args=("-serve-lanes=0",),
+    )
+    try:
+        synth = FleetSynth(
+            seed=cfg.seed,
+            tenants=cfg.tenants,
+            base_partitions=cfg.base_partitions,
+            brokers=cfg.brokers,
+            replicas=cfg.replicas,
+            skew=cfg.skew,
+            arrival=cfg.arrival,
+            diurnal_period=cfg.diurnal_period,
+            diurnal_amplitude=cfg.diurnal_amplitude,
+            weight_shift_every=cfg.weight_shift_every,
+            weight_shift_frac=cfg.weight_shift_frac,
+            broker_failure_every=cfg.broker_failure_every,
+            topic_storm_every=cfg.topic_storm_every,
+            storm_size=cfg.storm_size,
+        )
+        base_argv = [
+            "kafkabalancer", "-input-json",
+            f"-serve-socket={sock}",
+            f"-max-reassign={cfg.max_reassign}",
+            # a bounded, deadline-carrying wait: sheds travel as
+            # retry_after frames, the backoff ladder runs, and a
+            # wedged daemon can cost at most this per request
+            "-serve-client-timeout=30",
+        ]
+        if cfg.solver != "greedy":
+            base_argv.append(f"-solver={cfg.solver}")
+
+        synth_lock = threading.Lock()
+        tenant_locks = {t.name: threading.Lock() for t in synth.tenants}
+        issued: Dict[str, int] = {t.name: 0 for t in synth.tenants}
+        answered = 0
+        wrong: List[Dict[str, Any]] = []
+        errors: List[Dict[str, Any]] = []
+        step_box = [0]
+        stats_lock = threading.Lock()
+
+        def worker() -> None:
+            nonlocal answered
+            while True:
+                with synth_lock:
+                    step = step_box[0]
+                    if step >= cfg.requests:
+                        return
+                    step_box[0] = step + 1
+                    tenant, _fired = synth.step(step)
+                with tenant_locks[tenant.name]:
+                    text = tenant.text()
+                    argv = base_argv + [
+                        f"-serve-session={tenant.name}"
+                    ]
+                    # the oracle FIRST (mutates nothing): the same
+                    # input planned in-process is the byte truth every
+                    # answered plan must match
+                    out_l, err_l = io.StringIO(), io.StringIO()
+                    rc_l = cli.run(
+                        io.StringIO(text), out_l, err_l,
+                        argv + ["-no-daemon"],
+                    )
+                    out_s, err_s = io.StringIO(), io.StringIO()
+                    rc_s = cli.run(io.StringIO(text), out_s, err_s, argv)
+                    with stats_lock:
+                        issued[tenant.name] += 1
+                        if rc_s != rc_l:
+                            errors.append({
+                                "step": step, "tenant": tenant.name,
+                                "rc": rc_s, "rc_local": rc_l,
+                                "stderr_tail": err_s.getvalue()[-300:],
+                            })
+                        elif rc_s == 0:
+                            answered += 1
+                            if out_s.getvalue() != out_l.getvalue():
+                                wrong.append({
+                                    "step": step,
+                                    "tenant": tenant.name,
+                                })
+                    if rc_s == 0:
+                        tenant.apply_plan(out_s.getvalue())
+
+        t_run0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=worker, name=f"chaos-{i}", daemon=True)
+            for i in range(max(1, cfg.concurrency))
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        # -- the SUSTAINED-OVERLOAD phase (deterministic, not timing
+        # luck): one deliberately slow request occupies the single lane
+        # while a burst of concurrent clients arrives — arrivals past
+        # the admission window + -serve-max-queue MUST shed, the shed
+        # clients back off honoring retry_after_ms, and every one of
+        # them still ends with a byte-correct answer (a later admit or
+        # the in-process fallback). Locals run AFTER the burst (the
+        # inputs are static) so the burst's arrival concurrency is real.
+        import random as random_mod
+
+        ovl_rng = random_mod.Random(cfg.seed ^ 0x0F10AD)
+        from kafkabalancer_tpu.replay.synth import TenantState
+
+        blocker = TenantState(
+            "chaos-blocker", ovl_rng, partitions=4000,
+            brokers=cfg.brokers, replicas=cfg.replicas,
+            arrival_weight=1.0, diurnal_phase=0.0,
+        )
+        burst_tenants = [
+            TenantState(
+                f"chaos-burst-{i:02d}", ovl_rng, partitions=16,
+                brokers=cfg.brokers, replicas=cfg.replicas,
+                arrival_weight=1.0, diurnal_phase=0.0,
+            )
+            for i in range(12)
+        ]
+        ovl_results: Dict[str, Tuple[int, str]] = {}
+        ovl_lock = threading.Lock()
+
+        def fire(t: "TenantState") -> None:
+            out, err = io.StringIO(), io.StringIO()
+            rc = cli.run(
+                io.StringIO(t.text()), out, err,
+                base_argv + [f"-serve-session={t.name}"],
+            )
+            with ovl_lock:
+                ovl_results[t.name] = (rc, out.getvalue())
+
+        blocker_t = threading.Thread(target=fire, args=(blocker,))
+        blocker_t.start()
+        time.sleep(0.5)  # the blocker holds the lane before the burst
+        burst_threads = [
+            threading.Thread(target=fire, args=(t,))
+            for t in burst_tenants
+        ]
+        for t in burst_threads:
+            t.start()
+        for t in burst_threads:
+            t.join()
+        blocker_t.join()
+        for t in [blocker] + burst_tenants:
+            out_l, err_l = io.StringIO(), io.StringIO()
+            rc_l = cli.run(
+                io.StringIO(t.text()), out_l, err_l,
+                base_argv + [
+                    f"-serve-session={t.name}", "-no-daemon",
+                ],
+            )
+            rc_s, stdout_s = ovl_results.get(t.name, (None, ""))
+            with stats_lock:
+                issued.setdefault(t.name, 0)
+                issued[t.name] += 1
+                if rc_s != rc_l:
+                    errors.append({
+                        "phase": "overload", "tenant": t.name,
+                        "rc": rc_s, "rc_local": rc_l,
+                    })
+                elif rc_s == 0:
+                    answered += 1
+                    if stdout_s != out_l.getvalue():
+                        wrong.append({
+                            "phase": "overload", "tenant": t.name,
+                        })
+        wall_s = time.perf_counter() - t_run0
+
+        alive = sclient.daemon_alive(sock) is not None
+        doc = sclient.fetch_stats(sock) or {}
+        adm = doc.get("admission") or {}
+        lh = doc.get("lane_health") or {}
+        flt = doc.get("faults") or {}
+        tenants_block = doc.get("tenants") or {}
+        sheds_by_reason = adm.get("sheds") or {}
+        shed_total = int(adm.get("shed_total", 0))
+        tenant_sheds = sum(
+            int(e.get("sheds", 0))
+            for e in (tenants_block.get("top") or {}).values()
+            if isinstance(e, dict)
+        ) + int((tenants_block.get("other") or {}).get("sheds", 0) or 0)
+        identities = {
+            "sheds_sum_matches": shed_total == sum(
+                int(v) for v in sheds_by_reason.values()
+            ),
+            "tenant_sheds_match": tenant_sheds == shed_total,
+            "arrivals_conserved": int(adm.get("arrivals", -1)) == (
+                int(adm.get("admitted", 0)) + shed_total
+            ),
+            "admitted_conserved": int(adm.get("admitted", -1)) == (
+                int(doc.get("requests", 0))
+                + int(lh.get("abandoned", 0))
+            ),
+            "no_lane_still_quarantined": not lh.get("quarantined"),
+        }
+        chaos_ok = (
+            alive
+            and not wrong
+            and all(identities.values())
+            and shed_total >= 1  # the overload phase actually happened
+        )
+        chaos_block = {
+            "faults_spec": spec,
+            "faults_fired": flt.get("fired") or {},
+            "concurrency": max(1, cfg.concurrency),
+            "answered": answered,
+            "parity_checked": answered,
+            "wrong_plans": wrong,
+            "sheds": sheds_by_reason,
+            "shed_total": shed_total,
+            # the live estimate the shed frames carried (scrape view);
+            # the frame-level pin (retry_after_ms >= 1 on every shed)
+            # is tests/test_overload.py's job
+            "retry_after_ms_estimate": int(adm.get("retry_after_ms", 0)),
+            "quarantines": int(lh.get("quarantines", 0)),
+            "requeues": int(lh.get("requeues", 0)),
+            "recoveries": int(lh.get("recoveries", 0)),
+            "abandoned": int(lh.get("abandoned", 0)),
+            "daemon_alive_at_end": alive,
+            "identities": identities,
+            "ok": chaos_ok,
+        }
+        total = sum(issued.values())
+        return {
+            "schema": REPLAY_SCHEMA,
+            "scrape_schema": doc.get("schema"),
+            "mode": "chaos",
+            "chaos": chaos_block,
+            "seed": cfg.seed,
+            "config": asdict(cfg),
+            "requests_issued": total,
+            "request_errors": errors,
+            "wall_s": round(wall_s, 3),
+            "throughput_rps": (
+                round(total / wall_s, 3) if wall_s > 0 else None
+            ),
+            "events": dict(synth.events),
+            "per_tenant": {
+                t.name: {
+                    "issued": issued[t.name],
+                    # daemon-SERVED count from the scrape: the fairness
+                    # signal (a tenant the daemon shed into oblivion
+                    # shows issued > 0 but daemon_requests == 0)
+                    "daemon_requests": int(
+                        (
+                            (tenants_block.get("top") or {})
+                            .get(t.name) or {}
+                        ).get("requests", 0)
+                    ),
+                    "moves_applied": t.moves_applied,
+                    "partitions": len(t.rows),
+                }
+                for t in synth.tenants
+            },
+            "reconciled": chaos_ok and not errors,
+        }
+    finally:
+        if spawned is not None:
+            try:
+                sclient.request_shutdown(sock)
+                spawned.wait(15)
+            except Exception:
+                spawned.terminate()
+        import shutil
+
+        shutil.rmtree(tmpdir, ignore_errors=True)
 
 
 def _build_artifact(
@@ -444,6 +801,8 @@ def _build_artifact(
     return {
         "schema": REPLAY_SCHEMA,
         "scrape_schema": (doc or {}).get("schema"),
+        "mode": "churn",
+        "chaos": None,
         "seed": cfg.seed,
         "config": asdict(cfg),
         "requests_issued": total,

@@ -34,7 +34,17 @@ package removes the fresh process from the hot path entirely:
   the socket-path convention shared by both sides;
 - ``cache`` — the digest-keyed incremental tensorize cache the daemon
   installs so the outer loop's mostly-unchanged input re-encodes only
-  its changed rows.
+  its changed rows;
+- ``sessions``/``state`` — resident per-tenant cluster sessions and the
+  jax-free digest/row-record machinery behind the protocol-v2 delta
+  ladder (steady state ships a content digest, not the cluster);
+- ``admission`` — overload protection in front of the dispatcher:
+  per-tenant weighted deficit-round-robin fair queueing, queue/tenant
+  caps, deadline shedding, and the structured
+  ``{op: "overload", retry_after_ms}`` frame;
+- ``faults`` — the chaos fault-injection seam (inert by default;
+  ``-serve-faults`` arms a deterministic schedule for the ``--chaos``
+  replay and the failure-path tests).
 
 HARD CONSTRAINT: ``protocol`` and ``client`` import no jax (directly or
 transitively) — a forwarded invocation must stay as light as an

@@ -16,7 +16,9 @@ daemon is that the client process never pays the jax import.
 from __future__ import annotations
 
 import os
+import random
 import socket
+import time
 from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
 
 from kafkabalancer_tpu import __version__
@@ -32,9 +34,48 @@ from kafkabalancer_tpu.serve.protocol import (
 
 # connect + handshake must be near-free when a daemon exists and exactly
 # one failed connect() when it does not; the plan response itself gets a
-# generous ceiling (a convergence-scale session runs minutes)
+# generous HARD ceiling (a convergence-scale session runs minutes) —
+# but the wait is no longer one blind 3600 s read: see _await_reply
 CONNECT_TIMEOUT_S = 2.0
 PLAN_TIMEOUT_S = 3600.0
+
+# the progress-aware plan wait (the -serve-client-timeout=0 default):
+# while no reply byte has arrived, the client wakes every tick and
+# probes the daemon's hello on a fresh connection. A daemon that stops
+# answering hello — or answers but holds NO in-flight work and makes no
+# progress (it accepted our frame and lost it) — is presumed wedged
+# after PROGRESS_GRACE_PROBES consecutive bad probes, and the client
+# takes its byte-identical in-process fallback in seconds instead of an
+# hour, attributed serve.fallbacks.daemon_wedged. (A daemon-side wedged
+# LANE is the daemon watchdog's job — it answers a structured error;
+# this ladder only has to catch process-level wedges.)
+PROGRESS_TICK_S = 5.0
+PROGRESS_GRACE_PROBES = 2
+# once the first reply byte is visible the frame is in flight; draining
+# it gets a plain bounded timeout (generous: a -full-output plan for a
+# very large cluster is tens of MB)
+REPLY_DRAIN_TIMEOUT_S = 600.0
+
+# the overload backoff ladder: a daemon shedding under load answers a
+# structured {op:"overload", retry_after_ms} frame; the client sleeps
+# max(retry_after, base*2^attempt) — capped, jittered — and retries on
+# the same connection before giving up to the in-process fallback
+RETRY_MAX_ATTEMPTS = 4
+RETRY_BACKOFF_BASE_S = 0.05
+RETRY_BACKOFF_CAP_S = 2.0
+
+
+class _Wedged(Exception):
+    """The daemon accepted the request but is presumed wedged (stopped
+    answering hello / lost the request) or the wait budget ran out."""
+
+
+class _Overload(Exception):
+    """The daemon shed the request with a structured overload frame."""
+
+    def __init__(self, retry_after_ms: int) -> None:
+        super().__init__(f"shed; retry after {retry_after_ms}ms")
+        self.retry_after_ms = max(0, int(retry_after_ms))
 
 
 class ServedResult(NamedTuple):
@@ -94,6 +135,86 @@ def _hello_ok(resp: Optional[Dict[str, Any]]) -> bool:
     )
 
 
+def _await_reply(
+    sock: socket.socket,
+    path: str,
+    deadline: float,
+    progress: bool,
+) -> None:
+    """Block until the daemon's reply starts arriving (first byte
+    visible via ``MSG_PEEK`` — probing can never desynchronize a frame
+    already in flight), then set the drain timeout. Raises
+    :class:`_Wedged` when the budget runs out or — in progress-aware
+    mode — the daemon is presumed wedged; ``ConnectionError`` on EOF
+    before any reply byte (dead peer)."""
+    probes_dead = 0
+    stalls = 0
+    last_done: Any = None
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            raise _Wedged("plan wait budget exhausted")
+        sock.settimeout(min(PROGRESS_TICK_S, remaining))
+        try:
+            head = sock.recv(1, socket.MSG_PEEK)
+        except socket.timeout:
+            if not progress:
+                continue  # explicit -serve-client-timeout: budget only
+            hello = daemon_alive(path, timeout=2.0)
+            if hello is None:
+                probes_dead += 1
+                if probes_dead >= PROGRESS_GRACE_PROBES:
+                    raise _Wedged("daemon stopped answering hello")
+                continue
+            probes_dead = 0
+            inflight = hello.get("requests_inflight")
+            done = hello.get("requests")
+            if hello.get("warming") or (
+                isinstance(inflight, int) and inflight > 0
+            ):
+                # our request is plausibly queued/running (or the
+                # daemon is still building its dispatcher): keep
+                # waiting — slow is not wedged
+                stalls = 0
+                last_done = done
+                continue
+            # alive, warm, and holding NO in-flight work while we wait:
+            # the daemon lost our request. Two consecutive such probes
+            # (with no completions in between) confirm it.
+            if done == last_done:
+                stalls += 1
+            else:
+                stalls = 0
+            last_done = done
+            if stalls >= PROGRESS_GRACE_PROBES:
+                raise _Wedged("request lost daemon-side")
+            continue
+        if head == b"":
+            raise ConnectionError("EOF before reply")
+        sock.settimeout(
+            max(CONNECT_TIMEOUT_S, min(remaining, REPLY_DRAIN_TIMEOUT_S))
+        )
+        return
+
+
+def _overload_sleep(
+    attempt: int, retry_after_ms: int, deadline: float
+) -> Optional[float]:
+    """The backoff ladder's next sleep: the daemon's ``retry_after_ms``
+    is a FLOOR (retrying earlier would arrive at a still-full queue and
+    burn an attempt), the exponential term is capped, and jitter goes
+    UP (0–50%) so a thundering herd of shed clients decorrelates
+    without ever undercutting the advertised earliest-admit time.
+    None when the remaining budget cannot cover the sleep (give up and
+    fall back in-process)."""
+    base = min(RETRY_BACKOFF_BASE_S * (2 ** attempt), RETRY_BACKOFF_CAP_S)
+    sleep = max(retry_after_ms / 1000.0, base)
+    sleep *= 1.0 + 0.5 * random.random()
+    if deadline - time.monotonic() <= sleep:
+        return None
+    return sleep
+
+
 def daemon_alive(
     path: str, timeout: float = CONNECT_TIMEOUT_S
 ) -> Optional[Dict[str, Any]]:
@@ -112,6 +233,10 @@ def daemon_alive(
         sock.close()
 
 
+def _remaining_ms(deadline: float) -> int:
+    return max(1, int((deadline - time.monotonic()) * 1000.0))
+
+
 def forward_plan(
     path: str,
     argv: List[str],
@@ -122,6 +247,7 @@ def forward_plan(
     session: Optional[SessionSpec] = None,
     note: Optional[Callable[[str], None]] = None,
     tenant: str = "",
+    client_timeout: float = 0.0,
 ) -> Optional[ServedResult]:
     """Forward one invocation to the daemon at ``path``.
 
@@ -155,6 +281,17 @@ def forward_plan(
     (docs/observability.md § Per-tenant attribution). It defaults to
     the session's tenant when a session spec is given; it never
     affects planning, and v1 framing never carries it.
+
+    ``client_timeout`` bounds the whole plan wait (``-serve-client-
+    timeout``). The default 0 keeps the generous ``plan_timeout``
+    ceiling but waits PROGRESS-AWARE (see ``_await_reply``): a daemon
+    that accepts the request and then wedges is detected in seconds
+    and falls back, attributed ``daemon_wedged``. An explicit timeout
+    is also SENT as the request's ``deadline_ms`` budget so the daemon
+    can shed it from the queue once it can no longer be useful. Shed
+    (``op: "overload"``) responses are retried with capped, jittered
+    exponential backoff honoring ``retry_after_ms`` before the
+    in-process fallback (attributed ``overload``).
     """
 
     def _declined(reason: str) -> None:
@@ -175,6 +312,12 @@ def forward_plan(
     if sock is None:
         _note("daemon_down")
         return None
+    # the plan-wait budget: an explicit -serve-client-timeout bounds
+    # everything (and travels as the request's deadline_ms); the
+    # default keeps the generous ceiling but waits progress-aware
+    progress = client_timeout <= 0
+    budget = client_timeout if client_timeout > 0 else plan_timeout
+    deadline = time.monotonic() + budget
     try:
         write_frame(
             sock, {"v": PROTO_VERSION, "op": "hello", "max_v": PROTO_V2}
@@ -186,41 +329,92 @@ def forward_plan(
         assert isinstance(hello, dict)
         max_v = hello.get("max_v")
         v2 = isinstance(max_v, int) and max_v >= PROTO_V2
-        sock.settimeout(plan_timeout)
-        if v2:
-            return _forward_v2(
-                sock, argv, stdin_text, session,
-                tenant or (session.tenant if session is not None else ""),
-                _declined, _note,
-            )
-        req: Dict[str, Any] = {"v": PROTO_VERSION, "op": "plan", "argv": argv}
-        if stdin_text is not None:
-            req["stdin"] = stdin_text
-        try:
-            write_frame(sock, req)
-        except ValueError as exc:
-            # the input is too large for one protocol frame — a positive
-            # local refusal, not a daemon failure
-            _declined(f"request exceeds the protocol frame cap: {exc}")
-            _note("frame_cap")
-            return None
-        resp = read_frame(sock)
-        if (
-            not isinstance(resp, dict)
-            or not resp.get("ok")
-            or resp.get("v") != PROTO_VERSION
-        ):
-            if isinstance(resp, dict) and resp.get("error"):
-                _declined(str(resp["error"]))
-                _note("declined")
-            else:
-                _note("transport_error")
-            return None
-        return ServedResult(
-            rc=int(resp["rc"]),
-            stdout=str(resp.get("stdout", "")),
-            stderr=str(resp.get("stderr", "")),
+        # writes need a generous timeout too: a multi-MB register blob
+        # to a GIL-saturated daemon can take longer than the 2 s
+        # connect timeout to drain into the socket buffer (reads set
+        # their own timeouts per _await_reply call)
+        sock.settimeout(
+            max(CONNECT_TIMEOUT_S, min(budget, REPLY_DRAIN_TIMEOUT_S))
         )
+        # the session digest is attempt-invariant: compute it once and
+        # share across overload retries (a multi-MB parse must not be
+        # re-paid 4 times in the middle of an overload storm)
+        state_cache: Dict[str, Any] = {}
+        attempt = 0
+        while True:
+            try:
+                if v2:
+                    return _forward_v2(
+                        sock, argv, stdin_text, session,
+                        tenant or (
+                            session.tenant if session is not None else ""
+                        ),
+                        _declined, _note,
+                        path=path, deadline=deadline, progress=progress,
+                        send_deadline=not progress,
+                        state_cache=state_cache,
+                    )
+                req: Dict[str, Any] = {
+                    "v": PROTO_VERSION, "op": "plan", "argv": argv,
+                }
+                if not progress:
+                    req["deadline_ms"] = _remaining_ms(deadline)
+                if stdin_text is not None:
+                    req["stdin"] = stdin_text
+                try:
+                    write_frame(sock, req)
+                except ValueError as exc:
+                    # the input is too large for one protocol frame — a
+                    # positive local refusal, not a daemon failure
+                    _declined(
+                        f"request exceeds the protocol frame cap: {exc}"
+                    )
+                    _note("frame_cap")
+                    return None
+                _await_reply(sock, path, deadline, progress)
+                resp = read_frame(sock)
+                if (
+                    isinstance(resp, dict)
+                    and resp.get("op") == "overload"
+                    and resp.get("reason") != "shutdown"
+                ):
+                    # a "shutdown" shed falls through to the declined
+                    # path below — retrying against a dying daemon
+                    # only delays the in-process fallback
+                    raise _Overload(
+                        int(resp.get("retry_after_ms", 0) or 0)
+                    )
+                if (
+                    not isinstance(resp, dict)
+                    or not resp.get("ok")
+                    or resp.get("v") != PROTO_VERSION
+                ):
+                    if isinstance(resp, dict) and resp.get("error"):
+                        _declined(str(resp["error"]))
+                        _note("declined")
+                    else:
+                        _note("transport_error")
+                    return None
+                return ServedResult(
+                    rc=int(resp["rc"]),
+                    stdout=str(resp.get("stdout", "")),
+                    stderr=str(resp.get("stderr", "")),
+                )
+            except _Overload as ov:
+                # the backoff ladder: honor retry_after_ms (capped,
+                # jittered), retry on the same connection, give up to
+                # the in-process fallback when attempts/budget run out
+                sleep = _overload_sleep(
+                    attempt, ov.retry_after_ms, deadline
+                )
+                attempt += 1
+                if sleep is None or attempt > RETRY_MAX_ATTEMPTS:
+                    _note("overload")
+                    return None
+                time.sleep(sleep)
+    except _Wedged:
+        _note("daemon_wedged")
+        return None
     except Exception:
         _note("transport_error")
         return None
@@ -235,11 +429,14 @@ def _v2_result(
 ) -> Optional[ServedResult]:
     """Decode a v2 plan response (stdout rides in the blob, everything
     else in the header); None on any shape the caller must fall back
-    from."""
+    from; raises :class:`_Overload` on a structured shed frame (the
+    caller's backoff ladder owns the retry)."""
     if resp is None:
         _note("transport_error")
         return None
     hdr, blob = resp
+    if hdr.get("op") == "overload" and hdr.get("reason") != "shutdown":
+        raise _Overload(int(hdr.get("retry_after_ms", 0) or 0))
     if not hdr.get("ok") or hdr.get("v") != PROTO_V2:
         if hdr.get("error"):
             _declined(str(hdr["error"]))
@@ -262,21 +459,45 @@ def _forward_v2(
     tenant: str,
     _declined: Callable[[str], None],
     _note: Callable[[str], None],
+    *,
+    path: str,
+    deadline: float,
+    progress: bool,
+    send_deadline: bool,
+    state_cache: Dict[str, Any],
 ) -> Optional[ServedResult]:
     """The v2 exchange after a successful hello negotiation: the
     session ladder (plan-delta -> plan-rows -> register) when a session
     spec is usable, else a plain v2 ``plan`` with the input as a raw
-    blob (no JSON string escaping either way)."""
+    blob (no JSON string escaping either way). Every plan-family read
+    waits through ``_await_reply`` (progress-aware wedge detection);
+    ``send_deadline`` adds the remaining budget as ``deadline_ms``.
+    The wait-contract parameters are keyword-REQUIRED: a caller that
+    forgot them would silently disable wedge detection and deadlines."""
     from kafkabalancer_tpu.serve import state as sstate
+
+    def _read2() -> "Optional[Tuple[Dict[str, Any], bytes]]":
+        _await_reply(sock, path, deadline, progress)
+        return read_frame2(sock)
+
+    def _stamp(hdr: Dict[str, Any]) -> Dict[str, Any]:
+        if send_deadline:
+            hdr["deadline_ms"] = _remaining_ms(deadline)
+        return hdr
 
     state = None
     if session is not None:
         # parse + digest through the very codecs reader the planner
         # uses; None (unusual input) falls through to the full-state
-        # path and the daemon surfaces any real error normally
-        state = sstate.client_state(
-            session.text, session.is_json, session.topics
-        )
+        # path and the daemon surfaces any real error normally. The
+        # caller's cache shares the result across overload retries —
+        # the input is attempt-invariant.
+        if "state" in state_cache:
+            state = state_cache["state"]
+        else:
+            state = state_cache["state"] = sstate.client_state(
+                session.text, session.is_json, session.topics
+            )
     if state is None or session is None:
         hdr: Dict[str, Any] = {
             "v": PROTO_V2, "op": "plan", "argv": argv,
@@ -288,18 +509,18 @@ def _forward_v2(
             hdr["tenant"] = tenant
         blob = stdin_text.encode("utf-8") if stdin_text is not None else b""
         try:
-            write_frame2(sock, hdr, blob)
+            write_frame2(sock, _stamp(hdr), blob)
         except ValueError as exc:
             _declined(f"request exceeds the protocol frame cap: {exc}")
             _note("frame_cap")
             return None
-        return _v2_result(read_frame2(sock), _declined, _note)
+        return _v2_result(_read2(), _declined, _note)
 
-    write_frame2(sock, {
+    write_frame2(sock, _stamp({
         "v": PROTO_V2, "op": "plan-delta", "tenant": session.tenant,
         "digest": state.digest, "nrows": len(state.canon), "argv": argv,
-    })
-    resp = read_frame2(sock)
+    }))
+    resp = _read2()
     if resp is None:
         _note("transport_error")
         return None
@@ -325,18 +546,18 @@ def _forward_v2(
                 [(i, state.rows[i]) for i in changed]
             )
             try:
-                write_frame2(sock, {
+                write_frame2(sock, _stamp({
                     "v": PROTO_V2, "op": "plan-rows",
                     "tenant": session.tenant, "digest": state.digest,
                     "argv": argv,
-                }, rows_blob)
+                }), rows_blob)
             except ValueError as exc:
                 _declined(
                     f"request exceeds the protocol frame cap: {exc}"
                 )
                 _note("frame_cap")
                 return None
-            resp = read_frame2(sock)
+            resp = _read2()
             if resp is None:
                 _note("transport_error")
                 return None
@@ -350,15 +571,15 @@ def _forward_v2(
         # even this worst case skips the JSON escape pass
         _note("session_resync_full")
         try:
-            write_frame2(sock, {
+            write_frame2(sock, _stamp({
                 "v": PROTO_V2, "op": "register", "tenant": session.tenant,
                 "argv": argv, "has_stdin": True,
-            }, session.text.encode("utf-8"))
+            }), session.text.encode("utf-8"))
         except ValueError as exc:
             _declined(f"request exceeds the protocol frame cap: {exc}")
             _note("frame_cap")
             return None
-        return _v2_result(read_frame2(sock), _declined, _note)
+        return _v2_result(_read2(), _declined, _note)
     return _v2_result((hdr2, blob2), _declined, _note)
 
 

@@ -48,6 +48,7 @@ daemon-side crashes or requests over ``-serve-slow-ms``
 
 from __future__ import annotations
 
+import contextlib
 import io
 import os
 import signal
@@ -56,12 +57,23 @@ import sys
 import threading
 import time
 from collections import deque
-from typing import Any, Callable, Deque, Dict, List, Optional, Tuple
+from typing import (
+    Any,
+    Callable,
+    Deque,
+    Dict,
+    Iterator,
+    List,
+    Optional,
+    Tuple,
+)
 
 from kafkabalancer_tpu import __version__, obs
 from kafkabalancer_tpu.obs.flight import PHASE_OF_SPAN, FlightRecorder
 from kafkabalancer_tpu.obs.hist import OTHER_LABEL
 from kafkabalancer_tpu.obs.trace import Span
+from kafkabalancer_tpu.serve import faults
+from kafkabalancer_tpu.serve.admission import AdmissionController
 from kafkabalancer_tpu.serve.devmem import device_memory_stats
 from kafkabalancer_tpu.serve.protocol import (
     PROTO_V2,
@@ -93,7 +105,17 @@ _TENANT_HIST_FAMILIES = ("serve.request_s", "serve.phase.queue")
 _TENANT_COUNTER_FAMILIES = (
     "serve.requests", "serve.crashed_requests", "serve.delta_hits",
     "serve.resyncs_rows", "serve.resyncs_full", "serve.fallbacks",
+    "serve.sheds",
 )
+
+
+def _deadline_of(hdr: Dict[str, Any]) -> Optional[float]:
+    """A request header's ``deadline_ms`` budget as an absolute
+    monotonic deadline (None when absent/invalid — no deadline)."""
+    ms = hdr.get("deadline_ms")
+    if isinstance(ms, bool) or not isinstance(ms, (int, float)) or ms <= 0:
+        return None
+    return time.monotonic() + float(ms) / 1000.0
 
 
 def _argv_value(argv: List[str], name: str) -> Optional[str]:
@@ -124,11 +146,16 @@ class PlanRequest:
 
     __slots__ = (
         "argv", "stdin", "done", "response", "bucket", "bucketed", "staged",
-        "mb_entered", "t_submit", "session_ctx", "tenant",
+        "mb_entered", "t_submit", "session_ctx", "tenant", "deadline",
+        "started",
     )
 
     def __init__(
-        self, argv: List[str], stdin: Optional[str], tenant: str = ""
+        self,
+        argv: List[str],
+        stdin: Optional[str],
+        tenant: str = "",
+        deadline: Optional[float] = None,
     ) -> None:
         self.argv = argv
         self.stdin = stdin
@@ -146,6 +173,16 @@ class PlanRequest:
         # plan header's "tenant"); "" lands in the scrape's "other"
         # rollup — never a correctness input, only an attribution key
         self.tenant = tenant
+        # absolute monotonic deadline from the client's ``deadline_ms``
+        # budget; QUEUED requests past it are shed (serve/admission.py),
+        # in-flight ones always run to completion
+        self.deadline = deadline
+        # _handle_plan entered (the ``requests`` counter includes it):
+        # the health monitor's ``abandoned`` accounting counts only
+        # requests that never began handling, so the conservation
+        # identity admitted == requests + abandoned cannot double-count
+        # a wedged-mid-handling request
+        self.started = False
 
 
 class Coalescer:
@@ -168,6 +205,12 @@ class Coalescer:
         self._cv = threading.Condition()
         self._stop = False
         self._active = 0  # requests popped but not yet completed
+        # the popped-but-unfinished group, for health_tick: a dispatch
+        # thread dying mid-group must not leave its waiters blocked
+        self._current: List[PlanRequest] = []
+        self.quarantines = 0
+        self.recoveries = 0
+        self.abandoned = 0
         self._thread = threading.Thread(
             target=self._loop, name="serve-dispatch", daemon=True
         )
@@ -178,6 +221,64 @@ class Coalescer:
         must not count a long-running plan as idleness."""
         with self._cv:
             return bool(self._dq) or self._active > 0
+
+    def health_stats(self) -> Dict[str, Any]:
+        """The single-lane half of the scrape's ``lane_health`` block
+        (the Coalescer has no per-lane watchdog; its one failure mode
+        is dispatch-thread death, recovered by :meth:`health_tick`)."""
+        with self._cv:
+            return {
+                "watchdog_s": 0.0,
+                "quarantined": [],
+                "quarantines": self.quarantines,
+                "requeues": 0,
+                "recoveries": self.recoveries,
+                "abandoned": self.abandoned,
+            }
+
+    def health_tick(
+        self, log: Optional[LogFn] = None
+    ) -> None:
+        """Detect and recover a dead dispatch thread: queued requests
+        are answered with a structured error (their submitters would
+        otherwise block forever) and a fresh loop thread takes over."""
+        if self._thread.is_alive() or self._stop:
+            return
+        with self._cv:
+            if self._stop:
+                return
+            pending = list(self._current) + list(self._dq)
+            self._current = []
+            self._dq.clear()
+            self._active = 0
+            self.quarantines += 1
+        flushed = 0
+        for r in pending:
+            if not r.done.is_set():
+                r.response = {
+                    "v": PROTO_VERSION, "ok": False,
+                    "error": "dispatcher died; request abandoned",
+                }
+                r.done.set()
+                flushed += 1
+        with self._cv:
+            self.abandoned += flushed
+        t = threading.Thread(
+            target=self._loop, name="serve-dispatch", daemon=True
+        )
+        try:
+            t.start()
+        except Exception:
+            return  # no thread to spare; retried next tick
+        with self._cv:
+            self._thread = t
+            self.recoveries += 1
+        if log is not None:
+            log(
+                "serve: dispatch thread died — restarted "
+                f"({len(pending)} queued requests answered with errors)"
+            )
+        obs.metrics.event("serve_dispatcher_restarted", flushed=len(pending))
 
     def _bucket(self, req: PlanRequest) -> Optional[BucketKey]:
         from kafkabalancer_tpu.serve.lanes import probe_bucket
@@ -214,8 +315,11 @@ class Coalescer:
                 first = self._dq.popleft()
                 self._active += 1
                 contended = bool(self._dq)
-            try:
                 group = [first]
+                # alias, not copy: group extensions below stay visible
+                # to health_tick's flush
+                self._current = group
+            try:
                 if contended:
                     # the bucket probes (input read + parse) run OUTSIDE
                     # the lock: submitters must stay able to enqueue
@@ -245,6 +349,8 @@ class Coalescer:
                         with self._cv:
                             self._active -= 1
                         req.done.set()
+                with self._cv:
+                    self._current = []
             except Exception:
                 # group-assembly failure: the popped requests must not
                 # wedge their waiters nor leak the active count
@@ -252,6 +358,7 @@ class Coalescer:
                     self._active -= sum(
                         1 for r in group if not r.done.is_set()
                     )
+                    self._current = []
                 for r in group:
                     if not r.done.is_set():
                         r.response = {
@@ -280,6 +387,10 @@ class Daemon:
         session_cap: int = 64,
         session_idle_s: float = 3600.0,
         tenant_cap: int = 32,
+        max_queue: int = 256,
+        tenant_inflight: int = 64,
+        watchdog_s: float = 120.0,
+        faults_spec: str = "",
     ) -> None:
         self.socket_path = socket_path
         self.idle_timeout = idle_timeout
@@ -342,6 +453,26 @@ class Daemon:
         self._coalescer: Optional[Any] = None
         self._dispatcher_ready = threading.Event()
         self._lanes: "List[Any]" = []
+        # request-thread -> lane map for the span-driven heartbeat:
+        # every span completing on a serve-req thread beats its lane,
+        # so a legitimately slow plan (chunk rounds, phase spans keep
+        # completing) never reads as a wedged lane — only a call that
+        # produces NO observable progress past -serve-watchdog does
+        self._thread_lanes: Dict[str, Any] = {}
+        # overload protection (serve/admission.py): per-tenant fair
+        # queueing + caps in FRONT of whichever dispatcher gets built.
+        # The window starts sized for the single-lane case and is
+        # re-sized once lane resolution knows the device count; the
+        # admission-hold depth must fit inside it (a held batch needs
+        # that many requests queued on the lane simultaneously)
+        self.watchdog_s = max(0.0, watchdog_s)
+        self.faults_spec = faults_spec
+        self._admission = AdmissionController(
+            window=max(4, 2 * self.microbatch, self.admission_hold),
+            max_queue=max_queue,
+            tenant_inflight=tenant_inflight,
+            parallel=1,
+        )
 
     # -- warmup ----------------------------------------------------------
     def _warm_body(self) -> None:
@@ -428,6 +559,11 @@ class Daemon:
         self.flight.note_span(
             sp.name, sp.t0_ns, t1, sp.thread_name, sp.tid, sp.attrs
         )
+        lane = self._thread_lanes.get(sp.thread_name)
+        if lane is not None:
+            # watchdog heartbeat: observable request progress on this
+            # lane (one dict get + a float store per span)
+            lane.last_beat = time.monotonic()
         phase = PHASE_OF_SPAN.get(sp.name)
         if phase is not None:
             obs.metrics.hist_observe(
@@ -516,6 +652,14 @@ class Daemon:
     ) -> None:
         from kafkabalancer_tpu import cli
 
+        # handling BEGINS here (before any injected wedge): a request
+        # the watchdog later abandons mid-handling still lands in the
+        # requests counter when it resumes, never in `abandoned`
+        req.started = True
+        # chaos seam (serve/faults.py; inert unless -serve-faults armed):
+        # a scheduled dispatch_delay sleeps HERE — observable by the
+        # lane watchdog exactly like a wedged host call
+        faults.fire("dispatch_delay")
         t_start = time.perf_counter()
         tenant_label = req.tenant or OTHER_LABEL
         if req.t_submit is not None:
@@ -620,6 +764,10 @@ class Daemon:
         def body() -> None:
             import contextlib
 
+            # chaos seam: a scheduled transfer_fail raises before the
+            # device work — the request crashes server-side and is
+            # answered with a structured error, never a wrong plan
+            faults.fire("transfer_fail")
             with contextlib.ExitStack() as st:
                 if lane is not None:
                     st.enter_context(lane.context())
@@ -645,6 +793,8 @@ class Daemon:
         # and the flight recorder attributes phase spans to it by name
         thread_name = f"serve-req-{seq}"
         t = threading.Thread(target=body, name=thread_name)
+        if lane is not None:
+            self._thread_lanes[thread_name] = lane
         try:
             t.start()
             t.join()
@@ -684,6 +834,8 @@ class Daemon:
             # post-traffic scrape's hist count equals serve.requests
             wall = time.perf_counter() - t_start
             obs.metrics.hist_observe("serve.request_s", wall)
+            # feed the admission layer's retry-after estimate
+            self._admission.note_service(wall)
             # the tenant dimension: same invariant per label — every
             # _handle_plan call lands exactly one serve.request_s
             # family observation and one serve.requests count, so a
@@ -694,6 +846,7 @@ class Daemon:
             )
             obs.metrics.tenant_count("serve.requests", tenant_label)
             phases = self.flight.pop_request_phases(thread_name)
+            self._thread_lanes.pop(thread_name, None)
             rc_val = rc_box[0] if rc_box else None
             if ctx is not None:
                 # revert the unemitted complete-partition probe
@@ -823,7 +976,16 @@ class Daemon:
             admissible=self._admissible_request,
             batch_mode=self.batch_mode,
             admission_hold=self.admission_hold,
+            watchdog_s=self.watchdog_s,
         )
+        # the admission window scales with the real lane count: each
+        # lane can batch up to `microbatch` members and should have a
+        # queued same-bucket feed for mid-flight admission
+        self._admission.set_window(max(
+            4, self.admission_hold,
+            2 * self.microbatch * len(self._lanes),
+        ))
+        self._admission.set_parallel(len(self._lanes))
         # concurrent request bodies share the daemon-lifetime registry:
         # a per-request reset would wipe an in-flight peer's attribution.
         # Set only AFTER the scheduler constructed — a construction
@@ -962,6 +1124,7 @@ class Daemon:
             )
             slow, crashed = self._slow, self._crashed
             fallbacks = dict(self._fallbacks)
+        fault_plan = faults.active()
         # tensorize-cache attribution: the process-wide cache plus every
         # resident session's trusted-delta cache (retired sessions
         # folded in, so the counters stay monotone)
@@ -975,6 +1138,10 @@ class Daemon:
             "pid": os.getpid(),
             "version": __version__,
             "uptime_s": round(time.monotonic() - self._started, 3),
+            # still inside the startup warm window: a client progress
+            # probe must not read "no in-flight work" as a wedge while
+            # the dispatcher is still being built
+            "warming": not self._warm_done.is_set(),
             "requests": n,
             "coalesced": n_coal,
             "requests_inflight": inflight,
@@ -987,8 +1154,28 @@ class Daemon:
             "sessions": self.sessions.stats(),
             # daemon-observed fallback/resync reasons, by name
             "fallbacks": fallbacks,
+            # overload protection (serve-stats/5): fair-queue occupancy,
+            # caps, shed counts by reason, the live retry_after estimate
+            "admission": self._admission.stats(),
+            # the chaos seam: armed spec (null when inert) + per-site
+            # fired counts — a chaos run's scrape names what it injected
+            "faults": {
+                "armed": fault_plan.spec if fault_plan is not None else None,
+                "fired": (
+                    fault_plan.fired_counts()
+                    if fault_plan is not None else {}
+                ),
+            },
         }
         sched = self._coalescer
+        if sched is not None and hasattr(sched, "health_stats"):
+            out["lane_health"] = sched.health_stats()
+        else:
+            out["lane_health"] = {
+                "watchdog_s": self.watchdog_s, "quarantined": [],
+                "quarantines": 0, "requeues": 0, "recoveries": 0,
+                "abandoned": 0,
+            }
         if self._lanes and hasattr(sched, "stats"):
             s = sched.stats()
             out["lanes"] = int(s["lanes"])
@@ -1028,7 +1215,7 @@ class Daemon:
         }
 
     def _tenants_block(self) -> Dict[str, Any]:
-        """The serve-stats/4 per-tenant attribution block: one entry
+        """The serve-stats/5 per-tenant attribution block: one entry
         per live top-K tenant (keyed off the ``serve.request_s`` family
         — request activity is the authority on who is "top") carrying
         request counts, latency hists, queue time, the session
@@ -1095,6 +1282,7 @@ class Daemon:
                 "resyncs_rows": cval("serve.resyncs_rows", label),
                 "resyncs_full": cval("serve.resyncs_full", label),
                 "fallbacks": cval("serve.fallbacks", label),
+                "sheds": cval("serve.sheds", label),
                 "sessions": int(sess.get("sessions", 0)),
                 "session_bytes": int(sess.get("bytes", 0)),
             }
@@ -1103,7 +1291,7 @@ class Daemon:
         has_other = req_fam.get("other") is not None or any(
             other[k] for k in (
                 "requests", "crashed", "delta_hits", "resyncs_rows",
-                "resyncs_full", "fallbacks",
+                "resyncs_full", "fallbacks", "sheds",
             )
         )
         return {
@@ -1138,18 +1326,40 @@ class Daemon:
         self._last_activity = time.monotonic()
 
     def _dispatch_plan(self, req: PlanRequest) -> Optional[Dict[str, Any]]:
-        """Route one plan request through the dispatcher (waiting out
-        the startup race), with the in-flight gauge held; None when the
-        dispatcher never became ready."""
+        """Route one plan request through admission control and the
+        dispatcher (waiting out the startup race), with the in-flight
+        gauge held; None when the dispatcher never became ready. A shed
+        returns the structured overload frame WITHOUT touching the
+        dispatcher — shed latency lands in ``serve.shed_s``, never in
+        the served-request histograms."""
         self._dispatcher_ready.wait(DISPATCHER_WAIT_S)
         dispatcher = self._coalescer
         if dispatcher is None:
             return None
+        # t_submit anchors the queue-wait histogram at ARRIVAL: the
+        # fair-queue wait is part of what a tenant waits behind
         req.t_submit = time.perf_counter()
+        shed = self._admission.acquire(req)
+        if shed is not None:
+            return shed
+        try:
+            return dispatcher.submit(req)
+        finally:
+            self._admission.release(req)
+
+    @contextlib.contextmanager
+    def _inflight_op(self) -> "Iterator[None]":
+        """Hold the ``requests_inflight`` gauge across one plan-family
+        connection op — from frame decode through response build, the
+        session-op pre-dispatch work (register parse/digest, row
+        patching) INCLUDED: a client's progress probe reads
+        ``requests_inflight > 0`` as "my request is being worked on",
+        and that must be true for every phase the daemon can spend
+        real time in, or a slow register reads as a lost request."""
         with self._lock:
             self._inflight += 1
         try:
-            return dispatcher.submit(req)
+            yield
         finally:
             with self._lock:
                 self._inflight -= 1
@@ -1166,6 +1376,15 @@ class Daemon:
                 "error": "daemon dispatcher not ready",
             }, b""
         if not resp.get("ok"):
+            if resp.get("op") == "overload":
+                # the structured shed frame survives v2 framing intact:
+                # the client's backoff ladder reads retry_after_ms
+                return {
+                    "v": PROTO_V2, "ok": False, "op": "overload",
+                    "reason": str(resp.get("reason", "overload")),
+                    "retry_after_ms": int(resp.get("retry_after_ms", 0)),
+                    "error": str(resp.get("error", "request shed")),
+                }, b""
             return {
                 "v": PROTO_V2, "ok": False, "op": "error",
                 "error": str(resp.get("error", "request failed")),
@@ -1194,13 +1413,16 @@ class Daemon:
             }, b""
 
         tenant = str(hdr.get("tenant", ""))
+        deadline = _deadline_of(hdr)
         if op == "plan":
             stdin = (
                 blob.decode("utf-8", errors="replace")
                 if hdr.get("has_stdin") else None
             )
             return self._v2_plan_resp(
-                self._dispatch_plan(PlanRequest(argv, stdin, tenant))
+                self._dispatch_plan(
+                    PlanRequest(argv, stdin, tenant, deadline=deadline)
+                )
             )
 
         key = (tenant, flags_signature(argv))
@@ -1213,7 +1435,7 @@ class Daemon:
             with sess.lock:
                 sess.in_use = True
                 try:
-                    req = PlanRequest(argv, text, tenant)
+                    req = PlanRequest(argv, text, tenant, deadline=deadline)
                     req.session_ctx = ctx
                     resp = self._dispatch_plan(req)
                 finally:
@@ -1246,7 +1468,9 @@ class Daemon:
                     obs.metrics.tenant_count(
                         "serve.delta_hits", tenant or OTHER_LABEL
                     )
-                    req = PlanRequest(argv, None, tenant)
+                    req = PlanRequest(
+                        argv, None, tenant, deadline=deadline
+                    )
                     req.session_ctx = ctx
                     return self._v2_plan_resp(self._dispatch_plan(req))
                 # mismatch: offer the row-level diff — the client ships
@@ -1291,7 +1515,7 @@ class Daemon:
                     "serve.resyncs_rows", tenant or OTHER_LABEL
                 )
                 ctx = PlanSessionContext("rows", sess)
-                req = PlanRequest(argv, None, tenant)
+                req = PlanRequest(argv, None, tenant, deadline=deadline)
                 req.session_ctx = ctx
                 return self._v2_plan_resp(self._dispatch_plan(req))
             finally:
@@ -1360,7 +1584,15 @@ class Daemon:
                     return
                 obs.metrics.hist_observe("serve.phase.read", read_s)
                 argv = [str(a) for a in raw_argv]
-                resp_hdr, resp_blob = self._session_op(op, hdr, blob, argv)
+                with self._inflight_op():
+                    resp_hdr, resp_blob = self._session_op(
+                        op, hdr, blob, argv
+                    )
+                if faults.should("socket_drop"):
+                    # chaos seam: vanish mid-exchange instead of
+                    # replying — the client sees a dead peer and takes
+                    # its transport-error path (retry, then fallback)
+                    return
                 t_reply0 = time.perf_counter()
                 write_frame2(conn, resp_hdr, resp_blob)
                 obs.metrics.hist_observe(
@@ -1447,17 +1679,22 @@ class Daemon:
                     argv = [str(a) for a in raw_argv]
                     stdin = msg.get("stdin")
                     req = PlanRequest(
-                        argv, str(stdin) if stdin is not None else None
+                        argv,
+                        str(stdin) if stdin is not None else None,
+                        deadline=_deadline_of(msg),
                     )
                     # startup race: the dispatcher is built on the warm
                     # thread; a plan arriving first waits for it
-                    resp = self._dispatch_plan(req)
+                    with self._inflight_op():
+                        resp = self._dispatch_plan(req)
                     if resp is None:
                         write_frame(conn, {
                             "v": PROTO_VERSION, "ok": False, "op": "error",
                             "error": "daemon dispatcher not ready",
                         })
                         return
+                    if faults.should("socket_drop"):
+                        return  # chaos seam: dead peer instead of a reply
                     t_reply0 = time.perf_counter()
                     write_frame(conn, resp)
                     obs.metrics.hist_observe(
@@ -1482,10 +1719,76 @@ class Daemon:
                 pass
 
     # -- lifecycle -------------------------------------------------------
+    @staticmethod
+    def _pid_alive(pid: int) -> bool:
+        """Is ``pid`` a live process? (signal 0 probe; a process we may
+        not signal still counts as alive). A ZOMBIE is dead for our
+        purposes — a SIGKILL'd daemon whose parent never reaped it
+        (containers without an init reaper) still answers the signal
+        probe but cannot own a socket, and must not block a restart."""
+        if pid <= 0:
+            return False
+        try:
+            os.kill(pid, 0)
+        except ProcessLookupError:
+            return False
+        except PermissionError:
+            return True
+        except OSError:
+            return False
+        try:
+            with open(f"/proc/{pid}/stat") as f:
+                # field 3, after the parenthesized comm (which may
+                # itself contain spaces/parens): parse from the LAST ')'
+                state = f.read().rsplit(")", 1)[1].split()[0]
+            return state != "Z"
+        except (OSError, IndexError):
+            return True  # no procfs: the signal probe's verdict stands
+
+    @staticmethod
+    def _pid_looks_like_daemon(pid: int) -> bool:
+        """Does ``pid``'s command line look like one of OUR daemons?
+        Guards the takeover refusal against PID RECYCLING: a SIGKILL'd
+        daemon's recorded pid can be reborn as an unrelated process,
+        and refusing forever over a stranger would re-create the
+        manual-cleanup failure mode this preflight exists to remove.
+        Unreadable cmdline (no procfs, permissions) says True —
+        refusing when unsure beats hijacking a live daemon."""
+        try:
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read()
+        except OSError:
+            return True
+        return b"kafkabalancer" in cmd or b"-serve" in cmd
+
+    def _pidfile_owner(self) -> Optional[int]:
+        """The pid recorded next to the socket, or None."""
+        try:
+            with open(pidfile_path(self.socket_path)) as f:
+                return int(f.read().strip())
+        except (OSError, ValueError):
+            return None
+
     def _preflight_socket(self) -> Optional[str]:
-        """None when the socket path is free (stale files unlinked), an
-        error string when a live daemon already owns it."""
+        """None when the socket path is free (stale files swept), an
+        error string when a live daemon already owns it.
+
+        The refusal is PIDFILE-VERIFIED: a socket that answers hello is
+        a live daemon (refuse); a socket that does NOT answer is only
+        refused when the pidfile's process is still alive (it may be
+        mid-startup, wedged, or a different package version — hijacking
+        its socket would orphan it). Leftovers from a SIGKILL'd daemon
+        — socket + pidfile with a dead pid — are swept and replaced
+        instead of blocking the restart."""
         if not os.path.exists(self.socket_path):
+            # the socket is gone but a SIGKILL can still leave the
+            # pidfile; sweep it so the liveness story stays coherent
+            pid = self._pidfile_owner()
+            if pid is not None and not self._pid_alive(pid):
+                try:
+                    os.unlink(pidfile_path(self.socket_path))
+                except OSError:
+                    pass
             return None
         from kafkabalancer_tpu.serve import client
 
@@ -1495,11 +1798,34 @@ class Daemon:
                 f"daemon already running on {self.socket_path} "
                 f"(pid {hello.get('pid')})"
             )
-        try:
-            os.unlink(self.socket_path)
-            self._log(f"serve: removed stale socket {self.socket_path}")
-        except OSError as exc:
-            return f"cannot remove stale socket {self.socket_path}: {exc}"
+        pid = self._pidfile_owner()
+        if (
+            pid is not None
+            and pid != os.getpid()
+            and self._pid_alive(pid)
+            and self._pid_looks_like_daemon(pid)
+        ):
+            return (
+                f"socket {self.socket_path} is unresponsive but its "
+                f"pidfile process {pid} is still alive; refusing to "
+                "take it over (kill the process or remove "
+                f"{pidfile_path(self.socket_path)} first)"
+            )
+        for path, what in (
+            (self.socket_path, "socket"),
+            (pidfile_path(self.socket_path), "pidfile"),
+        ):
+            try:
+                os.unlink(path)
+                self._log(
+                    f"serve: swept stale {what} {path}"
+                    + (f" (pid {pid} dead)" if pid is not None else "")
+                )
+            except FileNotFoundError:
+                pass
+            except OSError as exc:
+                if what == "socket":
+                    return f"cannot remove stale socket {path}: {exc}"
         return None
 
     def serve_forever(self) -> int:
@@ -1544,6 +1870,28 @@ class Daemon:
             obs.metrics.tenant_counter(fam, cap=self.tenant_cap)
         obs.tracer.set_observer(self._observe_span)
 
+        # the chaos seam: armed ONLY here, by explicit operator intent
+        # (-serve-faults, or the env var when the flag is empty); a
+        # malformed spec refuses startup — a chaos run with a typo'd
+        # schedule must not silently run un-chaos'd
+        spec = self.faults_spec or os.environ.get(
+            "KAFKABALANCER_TPU_FAULTS", ""
+        )
+        if spec:
+            try:
+                plan = faults.arm(spec)
+            except ValueError as exc:
+                self._log(f"serve: bad -serve-faults spec: {exc}")
+                listener.close()
+                for path in (self.socket_path, pid_path):
+                    if path:
+                        try:
+                            os.unlink(path)
+                        except OSError:
+                            pass
+                return 3
+            self._log(f"serve: FAULT INJECTION ARMED: {plan.spec}")
+
         if self.warm:
             # the dispatcher is built on the warm thread (its lane
             # resolution pays the backend attach) so the accept loop
@@ -1572,11 +1920,22 @@ class Daemon:
         try:
             while not self._stop.is_set():
                 self.sessions.sweep()
+                # overload/health maintenance, every accept tick
+                # (~0.5 s): shed queued requests past their deadline,
+                # and run the lane watchdog (quarantine / requeue /
+                # recover — docs/serving.md § Lane health)
+                self._admission.sweep()
+                tick_disp = self._coalescer
+                if tick_disp is not None and hasattr(
+                    tick_disp, "health_tick"
+                ):
+                    tick_disp.health_tick(log=self._log)
                 if (
                     self.idle_timeout > 0
                     and self._warm_done.is_set()
                     and self._coalescer is not None
                     and not self._coalescer.busy()
+                    and not self._admission.busy()
                     and time.monotonic() - self._last_activity
                     > self.idle_timeout
                 ):
@@ -1599,8 +1958,12 @@ class Daemon:
                 ).start()
         finally:
             listener.close()
+            # flush the fair queue FIRST (its waiters would otherwise
+            # block their connection threads through dispatcher stop)
+            self._admission.stop()
             if self._coalescer is not None:
                 self._coalescer.stop()
+            faults.disarm()
             obs.tracer.set_observer(None)
             obs.set_shared_registry(False)
             set_row_cache(None)

@@ -74,6 +74,8 @@ from typing import (
 )
 
 from kafkabalancer_tpu import obs
+from kafkabalancer_tpu.serve import faults
+from kafkabalancer_tpu.serve.admission import overload_response
 from kafkabalancer_tpu.serve.protocol import PROTO_VERSION
 from kafkabalancer_tpu.serve.residency import ResidencyPool
 
@@ -135,11 +137,19 @@ class Lane:
 
     __slots__ = (
         "index", "device", "row_cache", "stage_cache", "busy_s", "requests",
+        "quarantined", "quarantined_at", "last_beat",
     )
 
     def __init__(self, index: int, device: Any = None) -> None:
         self.index = index
         self.device = device
+        # lane health (the daemon's watchdog — LaneScheduler.health_tick):
+        # last_beat is touched at every pop/retire/round boundary; a lane
+        # with active work and a stale beat is presumed wedged and
+        # quarantined (excluded from routing) until it beats again
+        self.quarantined = False
+        self.quarantined_at = 0.0
+        self.last_beat = time.monotonic()
         self.row_cache: Any = None  # TensorizeRowCache, daemon-installed
         # the lane's staging structure is the SHARED residency pool:
         # digest-keyed device buffers uploaded once per lane, shared by
@@ -482,6 +492,7 @@ class LaneScheduler:
         admissible: Optional[FusibleFn] = None,
         batch_mode: str = "continuous",
         admission_hold: int = 0,
+        watchdog_s: float = 0.0,
     ) -> None:
         self._handle = handle
         self._bucket_of = bucket_of
@@ -493,8 +504,22 @@ class LaneScheduler:
         self._cv = threading.Condition()
         self._queues: List[Deque[Any]] = [deque() for _ in self.lanes]
         self._active = [0] * len(self.lanes)
+        # per-lane claimed-but-unfinished requests — what the health
+        # monitor answers with a structured error when the lane dies
+        self._current: List[List[Any]] = [[] for _ in self.lanes]
         self._affinity: Dict[BucketKey, int] = {}
         self._stop = False
+        # lane health (docs/serving.md § Lane health): 0 disables the
+        # watchdog; quarantine/requeue/recovery counters feed the
+        # scrape's "lane_health" block
+        self._watchdog_s = max(0.0, watchdog_s)
+        self.quarantines = 0
+        self.requeues = 0
+        self.recoveries = 0
+        # requests answered with a structured error because their lane
+        # died/wedged under them (never requeued: an in-flight request
+        # may have side effects — only queued-but-unstarted work moves)
+        self.abandoned = 0
         self._hold_n = max(0, admission_hold)
         self._hold_window_s = ADMISSION_HOLD_WINDOW_S
         self._hold_since: List[Optional[float]] = [None] * len(self.lanes)
@@ -534,6 +559,17 @@ class LaneScheduler:
                     "v": PROTO_VERSION, "ok": False,
                     "error": "daemon shutting down",
                 }
+            if all(ln.quarantined for ln in self.lanes):
+                # nothing can serve this request right now — a wedged
+                # fleet must answer a structured retry-after shed, not
+                # park the submitter on a queue nothing drains (the
+                # client backs off, retries, and falls back; the
+                # in-flight gauge would otherwise keep its progress
+                # probe waiting the full budget)
+                return overload_response(
+                    "quarantine", 1000,
+                    detail="every lane is quarantined",
+                )
             i = self._route_locked(b)
             self._queues[i].append(req)
             self._cv.notify_all()
@@ -588,17 +624,260 @@ class LaneScheduler:
                 self._occupancy.get(occupancy, 0) + 1
             )
 
+    # -- lane health -------------------------------------------------------
+    def health_stats(self) -> Dict[str, Any]:
+        """The scrape's ``lane_health`` block (serve-stats/5)."""
+        with self._cv:
+            return {
+                "watchdog_s": self._watchdog_s,
+                "quarantined": [
+                    ln.index for ln in self.lanes if ln.quarantined
+                ],
+                "quarantines": self.quarantines,
+                "requeues": self.requeues,
+                "recoveries": self.recoveries,
+                "abandoned": self.abandoned,
+            }
+
+    def health_tick(
+        self, log: Optional[Callable[[str], None]] = None
+    ) -> None:
+        """The lane watchdog (called from the daemon's accept-loop
+        tick). Three verdicts per lane:
+
+        - **crashed** — the worker thread is dead: quarantine, answer
+          its claimed in-flight requests with a structured error (never
+          a wrong plan), requeue its queued-but-unstarted work onto
+          healthy lanes, then RESTART a fresh worker and re-admit the
+          lane (the recovery re-probe for a dead worker is a restart);
+        - **wedged** — the worker is alive but its lane has active work
+          and no heartbeat for ``watchdog_s``: quarantine (routing and
+          stealing exclude it), answer the stuck in-flight requests,
+          requeue its queue — the wedged call may still be executing,
+          so the thread is left alone;
+        - **recovered** — a wedged-quarantined lane beat again (the
+          stuck call finally finished) and has drained: re-admit it.
+        """
+        if self._watchdog_s <= 0 or self._stop:
+            return
+        now = time.monotonic()
+        for i, lane in enumerate(self.lanes):
+            worker = self._workers[i]
+            if not worker.is_alive():
+                self._quarantine(i, "crashed", log, restarting=True)
+                # restart: the dead worker's active count can never be
+                # decremented by it, so reset the lane's slate first
+                with self._cv:
+                    self._active[i] = 0
+                    self._current[i] = []
+                nt = threading.Thread(
+                    target=self._worker, args=(i,),
+                    name=f"serve-lane-{i}", daemon=True,
+                )
+                try:
+                    nt.start()
+                except Exception:
+                    continue  # no thread to spare; retried next tick
+                with self._cv:
+                    self._workers[i] = nt
+                    lane.quarantined = False
+                    lane.last_beat = time.monotonic()
+                    self.recoveries += 1
+                    self._cv.notify_all()
+                if log is not None:
+                    log(f"serve: lane {i} worker restarted (recovered)")
+                obs.metrics.event("serve_lane_recovered", lane=i)
+            elif lane.quarantined:
+                # drain anything that slipped onto the quarantined
+                # lane's queue in a race window — nothing else will
+                self._drain_quarantined(i, log)
+                with self._cv:
+                    drained = (
+                        self._active[i] == 0 and not self._current[i]
+                    )
+                    beat_since = lane.last_beat > lane.quarantined_at
+                if drained or beat_since:
+                    with self._cv:
+                        lane.quarantined = False
+                        lane.last_beat = time.monotonic()
+                        self.recoveries += 1
+                        self._cv.notify_all()
+                    if log is not None:
+                        log(f"serve: lane {i} recovered from quarantine")
+                    obs.metrics.event("serve_lane_recovered", lane=i)
+            else:
+                with self._cv:
+                    active = self._active[i] > 0 or bool(self._current[i])
+                if active and now - lane.last_beat > self._watchdog_s:
+                    self._quarantine(i, "wedged", log)
+
+    def _drain_quarantined(
+        self, i: int, log: Optional[Callable[[str], None]]
+    ) -> None:
+        """Move (or answer) work that landed on a STILL-quarantined
+        lane's queue after its quarantine flush — the routing guard in
+        :meth:`submit` makes this a race-window case, but a queued
+        request must never sit where nothing drains it."""
+        with self._cv:
+            if not self._queues[i]:
+                return
+            queued = list(self._queues[i])
+            self._queues[i].clear()
+            healthy = [
+                j for j, ln in enumerate(self.lanes)
+                if j != i
+                and not ln.quarantined
+                and self._workers[j].is_alive()
+            ]
+            moved = 0
+            orphaned: List[Any] = []
+            for r in queued:
+                if healthy:
+                    j = min(
+                        healthy,
+                        key=lambda k: len(self._queues[k])
+                        + self._active[k],
+                    )
+                    self._queues[j].append(r)
+                    moved += 1
+                else:
+                    orphaned.append(r)
+            self.requeues += moved
+            self.abandoned += len(orphaned)
+            if moved:
+                self._cv.notify_all()
+        for r in orphaned:
+            r.response = overload_response(
+                "quarantine", 1000,
+                detail=f"lane {i} quarantined and no healthy peer",
+            )
+            r.done.set()
+        if (moved or orphaned) and log is not None:
+            log(
+                f"serve: drained {moved + len(orphaned)} request(s) "
+                f"off quarantined lane {i} "
+                f"({moved} requeued, {len(orphaned)} answered)"
+            )
+
+    def _quarantine(
+        self,
+        i: int,
+        why: str,
+        log: Optional[Callable[[str], None]],
+        restarting: bool = False,
+    ) -> None:
+        """Quarantine lane ``i``: answer its claimed in-flight requests
+        with a structured error, move its queued-but-unstarted work to
+        healthy lanes — or, with no healthy lane, answer it too (an
+        answered error beats an un-served queue) UNLESS ``restarting``
+        (the crashed-worker path): a fresh worker is about to take over
+        this very lane, so its queue stays in place and is served
+        moments later instead of stampeding every client into the
+        in-process fallback. Excluded from routing until health_tick
+        re-admits it."""
+        lane = self.lanes[i]
+        with self._cv:
+            if lane.quarantined:
+                return
+            lane.quarantined = True
+            lane.quarantined_at = time.monotonic()
+            self.quarantines += 1
+            stuck = [
+                r for r in self._current[i] if not r.done.is_set()
+            ]
+            healthy = [
+                j for j, ln in enumerate(self.lanes)
+                if j != i
+                and not ln.quarantined
+                and self._workers[j].is_alive()
+            ]
+            if healthy or not restarting:
+                queued = list(self._queues[i])
+                self._queues[i].clear()
+            else:
+                queued = []  # kept for the restarted worker
+            requeued: List[Any] = []
+            orphaned: List[Any] = []
+            for r in queued:
+                if healthy:
+                    j = min(
+                        healthy,
+                        key=lambda k: len(self._queues[k])
+                        + self._active[k],
+                    )
+                    self._queues[j].append(r)
+                    requeued.append(r)
+                else:
+                    orphaned.append(r)
+            self.requeues += len(requeued)
+            # abandoned = admitted work that never BEGAN handling and
+            # got an error instead; a request wedged mid-handling still
+            # reaches the requests counter, so counting it here too
+            # would double-book the conservation identity
+            self.abandoned += len(
+                [r for r in stuck if not getattr(r, "started", False)]
+            ) + len(orphaned)
+            # affinity for buckets owned by the sick lane re-resolves
+            # on the next route (a healthy lane takes ownership)
+            self._affinity = {
+                b: j for b, j in self._affinity.items() if j != i
+            }
+            self._cv.notify_all()
+        # responses OUTSIDE the lock: a late-finishing wedged thread
+        # setting req.response afterwards is harmless — done is already
+        # set and the client has the structured error, never a plan
+        for r in stuck:
+            r.response = {
+                "v": PROTO_VERSION, "ok": False,
+                "error": (
+                    f"lane {i} {why}: in-flight request abandoned "
+                    "(lane quarantined)"
+                ),
+            }
+            r.done.set()
+        for r in orphaned:
+            r.response = {
+                "v": PROTO_VERSION, "ok": False,
+                "error": (
+                    f"lane {i} {why} and no healthy lane to requeue to"
+                ),
+            }
+            r.done.set()
+        obs.metrics.count("serve.quarantines")
+        if requeued:
+            obs.metrics.count("serve.requeues", len(requeued))
+        obs.metrics.event(
+            "serve_lane_quarantined", lane=i, why=why,
+            stuck=len(stuck), requeued=len(requeued),
+            orphaned=len(orphaned),
+        )
+        if log is not None:
+            log(
+                f"serve: lane {i} {why} — quarantined "
+                f"({len(stuck)} in-flight answered, "
+                f"{len(requeued)} requeued, {len(orphaned)} orphaned)"
+            )
+
     # -- routing ----------------------------------------------------------
     def _bucket(self, req: Any) -> Optional[BucketKey]:
         return probe_bucket(req, self._bucket_of)
 
     def _route_locked(self, b: Optional[BucketKey]) -> int:
+        healthy = [
+            i for i, ln in enumerate(self.lanes) if not ln.quarantined
+        ]
+        if not healthy:
+            # every lane quarantined: least-loaded of all is still the
+            # best bet (recovery/restart re-drains the queue)
+            healthy = list(range(len(self.lanes)))
         if b is not None:
             owner = self._affinity.get(b)
-            if owner is not None:
+            if owner is not None and owner in healthy:
                 return owner
-        load = [len(q) + a for q, a in zip(self._queues, self._active)]
-        i = load.index(min(load))
+        i = min(
+            healthy,
+            key=lambda j: len(self._queues[j]) + self._active[j],
+        )
         if b is not None:
             self._affinity[b] = i
         return i
@@ -612,7 +891,13 @@ class LaneScheduler:
         and stealing out of it would trade a free ride on the resident
         executable for a cold load elsewhere — UNLESS the run is deeper
         than one fused dispatch can absorb (past the microbatch width
-        the surplus gains nothing by waiting)."""
+        the surplus gains nothing by waiting).
+
+        A quarantined lane never steals (its worker is dead or wedged);
+        stealing FROM a quarantined lane is allowed and desirable — it
+        drains work the victim can no longer serve."""
+        if self.lanes[i].quarantined:
+            return None
         best, best_len = -1, 0
         for j, q in enumerate(self._queues):
             if j != i and len(q) > best_len:
@@ -722,6 +1007,14 @@ class LaneScheduler:
             # the batch runs, and each pull must ride the same answer-
             # everything / active-count guarantees as the initial group
             claimed = list(group)
+            with self._cv:
+                self._current[i] = claimed
+                lane.last_beat = time.monotonic()
+            # the chaos seam's worker-death injection (serve/faults.py):
+            # LaneCrash is a BaseException — it skips the except/finally
+            # nets below exactly like a real thread death, leaving the
+            # claimed work for health_tick to answer and requeue
+            faults.fire("lane_crash")
             t0 = time.monotonic()
             try:
                 self._run_group(lane, group, claimed)
@@ -750,8 +1043,10 @@ class LaneScheduler:
             finally:
                 with self._cv:
                     self._active[i] -= len(claimed)
+                    self._current[i] = []
                     lane.busy_s += time.monotonic() - t0
                     lane.requests += len(claimed)
+                    lane.last_beat = time.monotonic()
                     self._cv.notify_all()
 
     def _stage_ahead(self, lane: Lane) -> None:
@@ -893,7 +1188,10 @@ class LaneScheduler:
         while True:
             # per-round live-telemetry samples (obs/hist.py): this
             # lane's queue depth and the batcher's live occupancy —
-            # the Orca-style time series the stats scrape exposes
+            # the Orca-style time series the stats scrape exposes.
+            # Each round is also a watchdog heartbeat: a healthy
+            # continuous batch must never read as a wedged lane
+            lane.last_beat = time.monotonic()
             with self._cv:
                 depth = len(self._queues[lane.index])
             obs.metrics.hist_observe(

@@ -43,6 +43,21 @@ Requests carry ``"op"``:
 - ``shutdown`` — orderly daemon exit (acknowledged before the listener
   closes).
 
+Overload protection (serve/admission.py, docs/serving.md § Overload):
+a ``plan``-family request may carry ``deadline_ms`` — the client's
+remaining wait budget. The daemon sheds a QUEUED request whose deadline
+has passed (never one already dispatched), and sheds arrivals past its
+queue/tenant caps, answering a structured
+
+    ``{"ok": false, "op": "overload", "reason": <overload|tenant|
+    deadline|quarantine|shutdown>, "retry_after_ms": N, "error": ...}``
+
+frame instead of queueing forever. ``retry_after_ms`` is the daemon's
+live estimate of when a retry could be admitted; the client honors it
+with capped, jittered exponential backoff before taking its
+byte-identical in-process fallback. Both framings carry the same keys
+(v1: the JSON frame verbatim; v2: in the response header).
+
 v2-only session ops (serve/sessions.py, docs/serving.md):
 
 - ``register``   — create/replace a resident cluster session for
@@ -89,7 +104,12 @@ PROTO_V2 = 2
 # v4: + "tenants" (per-tenant attribution: bounded top-K label families
 #     — request counts, latency hists, session/fallback attribution,
 #     with demoted tenants rolled into "other")
-STATS_SCHEMA_VERSION = 4
+# v5: + "admission" (fair-queue occupancy, caps, shed counts by reason,
+#     the live retry_after estimate), "lane_health" (quarantines /
+#     requeues / recoveries, quarantined lane list), "faults" (the
+#     chaos seam's armed spec + fired counts), per-tenant "sheds", and
+#     the flight recorder's "autodumps_suppressed"
+STATS_SCHEMA_VERSION = 5
 STATS_SCHEMA = f"kafkabalancer-tpu.serve-stats/{STATS_SCHEMA_VERSION}"
 
 # a frame larger than this is a protocol error, not a payload: the

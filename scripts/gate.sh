@@ -45,12 +45,20 @@
 #                         serve.delta_hits >= 1 and session bytes
 #                         present via -serve-stats-json
 #  11. replay smoke     — seeded 3-tenant churn replay against a
-#                         private daemon: serve-stats/4 schema,
+#                         private daemon: serve-stats/5 schema,
 #                         per-tenant counts reconciling exactly with
 #                         the driver, scrape-vs-flight latency within
 #                         one histogram bucket, plan byte parity vs
 #                         -no-daemon on a sampled request
-#  12. tier-1 tests     — the ROADMAP.md verify suite (skip: --no-tests)
+#  12. overload + chaos — seeded --chaos replay: fault injection (lane
+#      smoke               crash, dispatch delays, socket drops,
+#                         transfer failure) + sustained overload past
+#                         the queue cap; sheds observed with a
+#                         retry-after estimate, plan-byte parity on
+#                         EVERY answered request, shed/requeue/
+#                         quarantine accounting reconciled exactly,
+#                         daemon alive at the end
+#  13. tier-1 tests     — the ROADMAP.md verify suite (skip: --no-tests)
 #
 # Exit 0 only when every stage that ran passed. Optional tools that are
 # not installed SKIP with a notice instead of failing: the gate must be
@@ -443,7 +451,7 @@ if [ "$cb_ready" = 1 ]; then
       -serve-stats-json 2>/dev/null | "$PYTHON" -c '
 import json, sys
 p = json.loads(sys.stdin.read())
-assert p["schema"] == "kafkabalancer-tpu.serve-stats/4", p.get("schema")
+assert p["schema"] == "kafkabalancer-tpu.serve-stats/5", p.get("schema")
 assert "serve.request_s" in p["hists"], sorted(p["hists"])
 assert "serve.phase.parse" in p["hists"], sorted(p["hists"])
 assert isinstance(p["memory"], list) and p["memory"], p.get("memory")
@@ -614,7 +622,7 @@ step "replay smoke (seeded 3-tenant churn, per-tenant reconciliation)"
 # docs/observability.md § Per-tenant attribution): a seeded 3-tenant
 # churn run — weight shifts, a topic storm, a broker failure — driven
 # closed-loop through the real client against a private self-spawned
-# daemon. Asserts the serve-stats/4 scrape schema, per-tenant request
+# daemon. Asserts the serve-stats/5 scrape schema, per-tenant request
 # counts reconciling EXACTLY with the driver's issued counts, the
 # scrape's per-tenant percentiles agreeing with the flight recorder's
 # tenant-labeled request log within one histogram bucket, and plan
@@ -628,8 +636,8 @@ if JAX_PLATFORMS=cpu "$PYTHON" -m kafkabalancer_tpu.replay \
   && "$PYTHON" -c '
 import json
 a = json.load(open("'"$rp_tmp"'/replay.json"))
-assert a["schema"] == "kafkabalancer-tpu.replay/1", a["schema"]
-assert a["scrape_schema"] == "kafkabalancer-tpu.serve-stats/4", (
+assert a["schema"] == "kafkabalancer-tpu.replay/2", a["schema"]
+assert a["scrape_schema"] == "kafkabalancer-tpu.serve-stats/5", (
     a["scrape_schema"])
 assert a["reconciled_counts"] is True
 assert a["latency_checked"] is True
@@ -647,6 +655,54 @@ else
   fail=1
 fi
 rm -rf "$rp_tmp"
+
+step "overload + chaos smoke (seeded fault injection, sheds, parity)"
+# The overload-hardened serving layer end to end (docs/serving.md §
+# Overload and fault tolerance): a seeded --chaos replay arms the
+# daemon's fault seam (lane crash + dispatch delays + socket drops +
+# device-transfer failure), floods the 1-lane daemon past its queue
+# cap with mixed tenants (the deterministic blocker+burst overload
+# phase), and asserts: sheds observed (structured overload frames with
+# a live retry-after estimate), EVERY answered plan byte-identical to
+# -no-daemon, no tenant starved to zero, the daemon's
+# shed/requeue/quarantine accounting reconciled exactly in the
+# serve-stats/5 scrape, and the daemon alive at the end.
+ch_tmp=$(mktemp -d)
+if JAX_PLATFORMS=cpu "$PYTHON" -m kafkabalancer_tpu.replay --chaos \
+    --tenants 3 --requests 24 --seed 7 --arrival uniform --check \
+    --out "$ch_tmp/chaos.json" >/dev/null 2>"$ch_tmp/chaos.log" \
+  && "$PYTHON" -c '
+import json
+a = json.load(open("'"$ch_tmp"'/chaos.json"))
+assert a["mode"] == "chaos", a["mode"]
+assert a["scrape_schema"] == "kafkabalancer-tpu.serve-stats/5"
+c = a["chaos"]
+assert c["ok"] is True, c
+assert c["wrong_plans"] == [], c["wrong_plans"]
+assert c["answered"] == c["parity_checked"] >= 24
+assert c["shed_total"] >= 1 and c["sheds"].get("overload", 0) >= 1
+assert c["retry_after_ms_estimate"] >= 1
+assert c["quarantines"] >= 1 and c["recoveries"] >= 1
+assert c["daemon_alive_at_end"] is True
+assert all(c["identities"].values()), c["identities"]
+fired = c["faults_fired"]
+assert fired.get("lane_crash", 0) >= 1, fired
+assert fired.get("dispatch_delay", 0) >= 1, fired
+# fairness: every churn tenant was actually SERVED by the daemon
+# (daemon-side counts from the scrape — a tenant shed into oblivion
+# would show issued > 0 with daemon_requests == 0)
+per = a["per_tenant"]
+assert all(e["issued"] >= 1 for e in per.values()), per
+assert all(e["daemon_requests"] >= 1 for e in per.values()), per
+assert not a["request_errors"], a["request_errors"]
+'; then
+  echo "chaos run: sheds + parity on every answer + reconciled + alive: OK"
+else
+  echo "overload/chaos smoke FAILED (see $ch_tmp)"
+  tail -10 "$ch_tmp/chaos.log" 2>/dev/null
+  fail=1
+fi
+rm -rf "$ch_tmp"
 
 if [ "$run_tests" = 1 ]; then
   step "tier-1 tests"

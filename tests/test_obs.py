@@ -707,10 +707,19 @@ def test_flight_autodump_writes_perfetto_and_caps(tmp_path):
     assert [e for e in doc["traceEvents"] if e["ph"] == "X"]
     assert doc["otherData"]["requests"][0]["req"] == 1
     assert any("dumped" in m for m in logs)
+    # storm rate limit: a second dump inside the min interval is
+    # SUPPRESSED (counted, not written) — a shed/crash storm must not
+    # burn the whole dump budget in its first second
+    assert fr.autodump("storm", directory=str(tmp_path)) is None
+    assert fr.stats()["autodumps_suppressed"] == 1
     # the per-process cap: past MAX_AUTODUMPS, dumps are refused
+    # (min_interval_s=0 disables the rate limit to exercise the cap)
     for i in range(obs_flight.MAX_AUTODUMPS):
-        fr.autodump(f"r{i}", directory=str(tmp_path))
-    assert fr.autodump("over", directory=str(tmp_path)) is None
+        fr.autodump(f"r{i}", directory=str(tmp_path), min_interval_s=0)
+    assert (
+        fr.autodump("over", directory=str(tmp_path), min_interval_s=0)
+        is None
+    )
     assert fr.stats()["autodumps"] == obs_flight.MAX_AUTODUMPS
 
 

@@ -1,0 +1,961 @@
+"""Overload protection + fault tolerance (serve/admission.py,
+serve/faults.py, the lane health monitor, the client backoff/wedge
+ladder, pidfile-verified stale-socket takeover).
+
+The load-bearing pins:
+
+- the fault seam is INERT by default — an unarmed process carries no
+  schedule and ``fire`` is one None check;
+- shedding answers a structured ``{op: "overload", retry_after_ms}``
+  frame instead of queueing forever, lands in ``serve.shed_s`` (never
+  ``serve.request_s``), and the DRR grant order starves no tenant;
+- deadlines shed QUEUED requests only — never in-flight ones;
+- a crashed or wedged lane is quarantined: its in-flight work answers a
+  structured error (never a wrong plan), its queued work requeues onto
+  healthy lanes and still plans byte-identically, and the lane
+  recovers;
+- the client honors ``retry_after_ms`` with capped jittered backoff
+  before its byte-identical in-process fallback, and detects a wedged
+  daemon in seconds (``serve.fallbacks.daemon_wedged``) instead of
+  hanging for an hour;
+- a SIGKILL'd daemon's leftovers are swept on restart, but a live
+  process's socket is never hijacked.
+"""
+
+import io
+import json
+import os
+import shutil
+import socket as socket_mod
+import struct
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+import pytest
+
+from kafkabalancer_tpu import __version__, cli, obs
+from kafkabalancer_tpu.serve import client as sclient
+from kafkabalancer_tpu.serve import faults, protocol
+from kafkabalancer_tpu.serve.admission import AdmissionController
+from kafkabalancer_tpu.serve.daemon import Coalescer, Daemon, PlanRequest
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "data", "test.json")
+
+
+def run_cli(args, stdin=""):
+    out, err = io.StringIO(), io.StringIO()
+    rv = cli.run(io.StringIO(stdin), out, err, ["kafkabalancer"] + args)
+    return rv, out.getvalue(), err.getvalue()
+
+
+@pytest.fixture
+def sock_dir():
+    d = tempfile.mkdtemp(prefix="kbo-")
+    yield d
+    shutil.rmtree(d, ignore_errors=True)
+
+
+def _start_daemon(sock, **kw):
+    kw.setdefault("idle_timeout", 60.0)
+    kw.setdefault("warm", False)
+    kw.setdefault("log", lambda _m: None)
+    d = Daemon(sock, **kw)
+    rc_box = []
+    t = threading.Thread(
+        target=lambda: rc_box.append(d.serve_forever()), daemon=True
+    )
+    t.start()
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if sclient.daemon_alive(sock) is not None:
+            return d, t, rc_box
+        time.sleep(0.02)
+    pytest.fail("daemon never became ready")
+
+
+class _Req:
+    """Minimal admission-facing request."""
+
+    def __init__(self, tenant="", deadline=None):
+        self.tenant = tenant
+        self.deadline = deadline
+
+
+# --- the fault seam -------------------------------------------------------
+
+
+def test_fault_seam_inert_by_default():
+    """The hot-path pin: no schedule unless armed, fire/should are
+    no-ops, and disarm restores inertness."""
+    assert faults.active() is None
+    faults.fire("lane_crash")  # must not raise
+    assert faults.should("socket_drop") is False
+    plan = faults.arm("dispatch_delay@1:0.0;socket_drop@2")
+    try:
+        assert faults.active() is plan
+        faults.fire("dispatch_delay")  # occurrence 1: scheduled, 0s sleep
+        assert not faults.should("socket_drop")  # occurrence 1: not in plan
+        assert faults.should("socket_drop")  # occurrence 2: fires
+        assert plan.fired_counts() == {
+            "dispatch_delay": 1, "socket_drop": 1,
+        }
+    finally:
+        faults.disarm()
+    assert faults.active() is None
+    faults.fire("dispatch_delay")  # inert again
+
+
+def test_fault_spec_parse_errors():
+    for bad in ("nonsense", "unknown_site@1", "lane_crash@0",
+                "lane_crash@x", "lane_crash"):
+        with pytest.raises(ValueError):
+            faults.parse_spec(bad)
+    plan = faults.parse_spec("lane_crash@3;transfer_fail@1,5:0.2")
+    assert plan.spec.startswith("lane_crash@3")
+
+
+def test_fault_fire_raises_scheduled():
+    faults.arm("lane_crash@1;transfer_fail@1")
+    try:
+        with pytest.raises(BaseException) as ei:
+            faults.fire("lane_crash")
+        assert isinstance(ei.value, faults.LaneCrash)
+        assert not isinstance(ei.value, Exception)  # escapes except nets
+        with pytest.raises(faults.FaultError):
+            faults.fire("transfer_fail")
+    finally:
+        faults.disarm()
+
+
+# --- admission control ----------------------------------------------------
+
+
+def test_admission_caps_shed_with_structured_frame():
+    a = AdmissionController(window=1, max_queue=1, tenant_inflight=2)
+    r1 = _Req("a")
+    assert a.acquire(r1) is None  # granted
+    # r2 queues; r3 overflows the total queue cap
+    done = []
+    t = threading.Thread(target=lambda: done.append(a.acquire(_Req("b"))))
+    t.start()
+    time.sleep(0.05)
+    shed = a.acquire(_Req("c"))
+    assert shed["ok"] is False and shed["op"] == "overload"
+    assert shed["reason"] == "overload"
+    assert shed["retry_after_ms"] >= 1
+    # the per-tenant cap: tenant "a" holds 1 granted; with cap 2 a
+    # second queues, a third sheds with reason "tenant". Lift the
+    # total-queue cap FIRST so only the tenant cap binds.
+    a.max_queue = 10
+    t2 = threading.Thread(target=lambda: a.acquire(_Req("a")))
+    t2.start()
+    time.sleep(0.05)
+    shed2 = a.acquire(_Req("a"))
+    assert shed2["op"] == "overload" and shed2["reason"] == "tenant"
+    a.stop()
+    t.join(5)
+    t2.join(5)
+
+
+def test_admission_drr_fairness_no_starvation():
+    """A whale tenant floods the queue; grants still alternate so the
+    minnow is never starved behind the whale's backlog."""
+    a = AdmissionController(window=1, max_queue=0, tenant_inflight=0)
+    blocker = _Req("whale")
+    assert a.acquire(blocker) is None
+    order = []
+    lock = threading.Lock()
+
+    def waiter(tenant):
+        r = _Req(tenant)
+        if a.acquire(r) is None:
+            with lock:
+                order.append(tenant)
+            a.release(r)
+
+    threads = []
+    # the whale enqueues a deep backlog first, then the minnow arrives
+    for i in range(6):
+        t = threading.Thread(target=waiter, args=("whale",))
+        t.start()
+        threads.append(t)
+        time.sleep(0.02)
+    for i in range(2):
+        t = threading.Thread(target=waiter, args=("minnow",))
+        t.start()
+        threads.append(t)
+        time.sleep(0.02)
+    a.release(blocker)  # grants begin; each release grants the next
+    for t in threads:
+        t.join(10)
+    assert sorted(order.count(x) for x in ("whale", "minnow")) == [2, 6]
+    # DRR: the minnow's first grant must come long before the whale's
+    # backlog drains (round-robin across tenants, not FIFO)
+    assert "minnow" in order[:3], order
+
+
+def test_admission_deadline_sheds_queued_never_inflight():
+    now = [0.0]
+    a = AdmissionController(
+        window=1, max_queue=0, tenant_inflight=0, clock=lambda: now[0]
+    )
+    inflight = _Req("t", deadline=1.0)
+    assert a.acquire(inflight) is None  # granted at t=0
+    got = []
+    queued = _Req("t", deadline=5.0)
+    t = threading.Thread(target=lambda: got.append(a.acquire(queued)))
+    t.start()
+    time.sleep(0.05)
+    # past BOTH deadlines: the queued request sheds on sweep, the
+    # granted one is untouched (never shed in flight)
+    now[0] = 10.0
+    assert a.sweep() == 1
+    t.join(5)
+    assert got[0]["op"] == "overload" and got[0]["reason"] == "deadline"
+    assert got[0]["retry_after_ms"] == 0
+    st = a.stats()
+    assert st["granted"] == 1 and st["sheds"] == {"deadline": 1}
+    # arrival past its own deadline sheds immediately
+    dead = a.acquire(_Req("t", deadline=3.0))
+    assert dead["reason"] == "deadline"
+    a.release(inflight)
+    a.stop()
+
+
+def test_sheds_land_in_shed_hist_not_request_hist():
+    obs.metrics.reset_hists()
+    a = AdmissionController(window=1, max_queue=1, tenant_inflight=0)
+    r = _Req("t")
+    assert a.acquire(r) is None
+    t = threading.Thread(target=lambda: a.acquire(_Req("t")))
+    t.start()
+    time.sleep(0.05)
+    assert a.acquire(_Req("t"))["op"] == "overload"
+    hists = obs.metrics.hist_snapshot()
+    assert hists["serve.shed_s"]["count"] == 1
+    assert "serve.request_s" not in hists
+    a.stop()
+    t.join(5)
+
+
+# --- lane health ----------------------------------------------------------
+
+
+def _lane_daemon(sock_dir, **kw):
+    """An in-process LaneScheduler daemon (device-less is fine on CPU:
+    lanes resolve against the one visible device)."""
+    sock = os.path.join(sock_dir, "kb.sock")
+    kw.setdefault("lanes", 0)
+    kw.setdefault("microbatch", 2)
+    return sock, _start_daemon(sock, **kw)
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_lane_crash_answers_structured_error_and_recovers(sock_dir):
+    """The injected worker death (a BaseException, like the real
+    thing): the claimed request answers a structured error — never a
+    wrong plan — the lane restarts, and the next request plans
+    byte-identically; the scrape reconciles the incident."""
+    sock, (d, t, rc_box) = _lane_daemon(
+        sock_dir, faults_spec="lane_crash@1", watchdog_s=5.0
+    )
+    from kafkabalancer_tpu.serve.lanes import LaneScheduler
+
+    assert isinstance(d._coalescer, LaneScheduler)
+    text = open(FIXTURE).read()
+    declined = []
+    res = sclient.forward_plan(
+        sock, ["-no-daemon=true", "-input-json=true"], text,
+        on_fallback=declined.append,
+    )
+    # answered with a structured error (the client would fall back)
+    assert res is None
+    assert declined and "quarantin" in declined[0]
+    # recovery: the next request is served normally, byte-identical
+    want_rv, want_out, _ = run_cli(["-input-json", "-no-daemon"], text)
+    deadline = time.monotonic() + 10
+    res2 = None
+    while time.monotonic() < deadline and res2 is None:
+        res2 = sclient.forward_plan(
+            sock, ["-no-daemon=true", "-input-json=true"], text
+        )
+        if res2 is None:
+            time.sleep(0.2)
+    assert res2 is not None
+    assert res2.rc == want_rv and res2.stdout == want_out
+    doc = sclient.fetch_stats(sock)
+    lh = doc["lane_health"]
+    assert lh["quarantines"] == 1
+    assert lh["recoveries"] == 1
+    assert lh["abandoned"] == 1
+    assert lh["quarantined"] == []
+    adm = doc["admission"]
+    assert adm["admitted"] == doc["requests"] + lh["abandoned"]
+    sclient.request_shutdown(sock)
+    t.join(15)
+    assert rc_box == [0]
+
+
+def test_wedged_lane_quarantine_requeue_and_recovery():
+    """Scheduler-level: a lane wedged mid-request is quarantined by the
+    watchdog; its queued-but-unstarted work moves to the healthy lane
+    and completes normally (requeued-request parity), its in-flight
+    request answers a structured error, and the lane re-admits once the
+    stuck call finally returns."""
+    from kafkabalancer_tpu.serve.lanes import Lane, LaneScheduler
+
+    release = threading.Event()
+    handled = []
+
+    def handle(req, coalesced, lane, mb):
+        if req.stdin == "WEDGE":
+            release.wait(30)
+        handled.append((req.stdin, lane.index))
+        req.response = {"v": 1, "ok": True, "rc": 0,
+                        "stdout": f"plan:{req.stdin}", "stderr": ""}
+
+    lanes = [Lane(0), Lane(1)]
+    sched = LaneScheduler(
+        handle, lambda _r: None, lanes, watchdog_s=0.3
+    )
+    try:
+        wedge = PlanRequest([], "WEDGE")
+        tw = threading.Thread(target=lambda: sched.submit(wedge))
+        tw.start()
+        time.sleep(0.1)
+        wedged_lane = next(
+            i for i in range(2) if sched._active[i] > 0
+        )
+        # pile queued work onto the WEDGED lane directly (routing
+        # would avoid it once quarantined; this models work that was
+        # already queued when the wedge began)
+        q1, q2 = PlanRequest([], "q1"), PlanRequest([], "q2")
+        results = {}
+
+        def submit(r):
+            results[r.stdin] = sched.submit(r)
+
+        with sched._cv:
+            sched._queues[wedged_lane].append(q1)
+            sched._queues[wedged_lane].append(q2)
+        t1 = threading.Thread(target=submit, args=(q1,))
+        t2 = threading.Thread(target=submit, args=(q2,))
+        # the waiters' submit() would re-route; emulate the blocked
+        # connection threads by waiting on done directly instead
+        assert not q1.done.wait(0.0)
+        # watchdog: no heartbeat past 0.3 s with active work -> wedge
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not lanes[
+            wedged_lane
+        ].quarantined:
+            sched.health_tick()
+            time.sleep(0.05)
+        assert lanes[wedged_lane].quarantined
+        assert sched.quarantines == 1
+        # in-flight answered with a structured error, never a plan
+        assert wedge.done.wait(2)
+        assert wedge.response["ok"] is False
+        assert "quarantined" in wedge.response["error"]
+        # queued work requeued onto the healthy lane and completed there
+        assert q1.done.wait(5) and q2.done.wait(5)
+        assert q1.response["ok"] and q1.response["stdout"] == "plan:q1"
+        assert q2.response["ok"] and q2.response["stdout"] == "plan:q2"
+        healthy = 1 - wedged_lane
+        assert ("q1", healthy) in handled and ("q2", healthy) in handled
+        assert sched.requeues == 2 and sched.abandoned == 1
+        # recovery: the stuck call returns -> heartbeat -> re-admitted
+        release.set()
+        tw.join(5)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and lanes[
+            wedged_lane
+        ].quarantined:
+            sched.health_tick()
+            time.sleep(0.05)
+        assert not lanes[wedged_lane].quarantined
+        assert sched.recoveries == 1
+        del t1, t2
+    finally:
+        release.set()
+        sched.stop()
+
+
+def test_all_lanes_quarantined_sheds_instead_of_parking():
+    """With EVERY lane quarantined, a new submit must answer a
+    structured quarantine shed immediately — parking it on a queue
+    nothing drains would hang the client for its whole budget."""
+    from kafkabalancer_tpu.serve.lanes import Lane, LaneScheduler
+
+    release = threading.Event()
+
+    def handle(req, coalesced, lane, mb):
+        release.wait(30)
+        req.response = {"v": 1, "ok": True, "rc": 0,
+                        "stdout": "x", "stderr": ""}
+
+    lanes = [Lane(0)]
+    sched = LaneScheduler(handle, lambda _r: None, lanes, watchdog_s=0.2)
+    try:
+        wedge = PlanRequest([], "WEDGE")
+        tw = threading.Thread(target=lambda: sched.submit(wedge))
+        tw.start()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and not lanes[0].quarantined:
+            sched.health_tick()
+            time.sleep(0.05)
+        assert lanes[0].quarantined
+        resp = sched.submit(PlanRequest([], "next"))
+        assert resp["ok"] is False and resp["op"] == "overload"
+        assert resp["reason"] == "quarantine"
+        assert resp["retry_after_ms"] >= 1
+        release.set()
+        tw.join(5)
+    finally:
+        release.set()
+        sched.stop()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning"
+)
+def test_coalescer_dispatcher_death_flushes_and_restarts():
+    """Dispatcher-thread death (only a BaseException can do it): the
+    popped request and the queue both answer structured errors instead
+    of blocking their clients forever, and a fresh loop thread takes
+    over."""
+    boom = threading.Event()
+
+    def handle(req, coalesced):
+        if req.stdin == "BOOM":
+            boom.set()
+            raise SystemExit("injected dispatcher death")
+        req.response = {"v": 1, "ok": True, "rc": 0,
+                        "stdout": req.stdin, "stderr": ""}
+
+    c = Coalescer(handle, lambda _r: None)
+    try:
+        r1, rq = PlanRequest([], "BOOM"), PlanRequest([], "queued")
+        res = {}
+        t1 = threading.Thread(
+            target=lambda: res.__setitem__("r1", c.submit(r1))
+        )
+        t1.start()
+        boom.wait(5)
+        # a second request queues behind the dying dispatch
+        tq = threading.Thread(
+            target=lambda: res.__setitem__("rq", c.submit(rq))
+        )
+        tq.start()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and c._thread.is_alive():
+            time.sleep(0.02)
+        assert not c._thread.is_alive()
+        # the popped request already answered through the loop's
+        # finally (a structured "request dropped" — never a hang)
+        t1.join(5)
+        assert res["r1"]["ok"] is False
+        logs = []
+        c.health_tick(log=logs.append)
+        # the queued request is flushed with a structured error
+        tq.join(5)
+        assert res["rq"]["ok"] is False
+        assert "abandoned" in res["rq"]["error"]
+        assert c.quarantines == 1 and c.recoveries == 1
+        assert c.abandoned >= 1
+        assert any("restarted" in m for m in logs)
+        # the restarted thread serves normally
+        r2 = PlanRequest([], "ok")
+        resp = c.submit(r2)
+        assert resp["ok"] and resp["stdout"] == "ok"
+    finally:
+        c.stop()
+
+
+def test_client_disconnect_mid_plan_daemon_survives(sock_dir):
+    """A client that sends a plan and vanishes must not hurt the
+    daemon: the request runs, the reply write fails quietly, and the
+    next client is served normally."""
+    sock = os.path.join(sock_dir, "kb.sock")
+    d, t, rc_box = _start_daemon(sock)
+    text = open(FIXTURE).read()
+    s = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+    s.connect(sock)
+    protocol.write_frame(s, {"v": 1, "op": "hello"})
+    protocol.read_frame(s)
+    protocol.write_frame(s, {
+        "v": 1, "op": "plan",
+        "argv": ["-no-daemon=true", "-input-json=true"], "stdin": text,
+    })
+    s.close()  # gone before the answer
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        doc = sclient.fetch_stats(sock)
+        if doc is not None and doc["requests"] >= 1:
+            break
+        time.sleep(0.05)
+    res = sclient.forward_plan(
+        sock, ["-no-daemon=true", "-input-json=true"], text
+    )
+    assert res is not None and res.rc == 0
+    sclient.request_shutdown(sock)
+    t.join(15)
+    assert rc_box == [0]
+
+
+# --- daemon-level shedding + session interaction --------------------------
+
+
+def test_daemon_sheds_with_retry_after_and_session_survives(
+    sock_dir, monkeypatch
+):
+    """Flood a window-saturated daemon past -serve-max-queue: the
+    overflow answers the structured overload frame (v2 framing
+    included), sheds land in serve.shed_s with per-tenant attribution,
+    and a resident session that was shed is NOT poisoned — its next
+    delta request still hits."""
+    sock = os.path.join(sock_dir, "kb.sock")
+    d, t, rc_box = _start_daemon(sock, max_queue=1, tenant_inflight=0)
+    text = open(FIXTURE).read()
+    # register a resident session the normal way (-max-reassign=0: a
+    # zero-move plan keeps the resident digest equal to the input, so
+    # the repeat below can only delta-hit if the session SURVIVED)
+    rv, out0, _ = run_cli(
+        ["-input-json", f"-input={FIXTURE}", "-max-reassign=0",
+         f"-serve-socket={sock}", "-serve-session=tenant-x"]
+    )
+    assert rv == 0
+    doc0 = sclient.fetch_stats(sock)
+    assert doc0["sessions"]["count"] == 1
+
+    # wedge the dispatcher open: every in-daemon run blocks on a latch
+    release = threading.Event()
+    real_run = cli.run
+
+    def slow_run(i, o, e, args, **kw):
+        release.wait(30)
+        return real_run(i, o, e, args, **kw)
+
+    monkeypatch.setattr(cli, "run", slow_run)
+    window = d._admission.stats()["window"]
+    # fill the window (granted) + the 1-slot queue, all slow
+    fillers = []
+    for i in range(window + 1):
+        th = threading.Thread(
+            target=sclient.forward_plan,
+            args=(sock, ["-no-daemon=true", "-input-json=true"], text),
+        )
+        th.start()
+        fillers.append(th)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        st = d._admission.stats()
+        if st["granted"] >= window and st["queued"] >= 1:
+            break
+        time.sleep(0.05)
+    # the next arrival must shed: raw v1 exchange shows the frame
+    s = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+    s.settimeout(10)
+    s.connect(sock)
+    protocol.write_frame(s, {"v": 1, "op": "hello"})
+    protocol.read_frame(s)
+    protocol.write_frame(s, {
+        "v": 1, "op": "plan",
+        "argv": ["-no-daemon=true", "-input-json=true"], "stdin": text,
+    })
+    frame = protocol.read_frame(s)
+    s.close()
+    assert frame["ok"] is False and frame["op"] == "overload"
+    assert frame["reason"] == "overload"
+    assert frame["retry_after_ms"] >= 1
+    release.set()
+    for th in fillers:
+        th.join(15)
+    monkeypatch.setattr(cli, "run", real_run)
+    # shed telemetry: its own histogram + counters, request_s untouched
+    doc = sclient.fetch_stats(sock)
+    assert doc["admission"]["sheds"]["overload"] >= 1
+    assert doc["hists"]["serve.shed_s"]["count"] >= 1
+    assert doc["hists"]["serve.request_s"]["count"] == doc["requests"]
+    # the shed/poison interaction: the resident session still delta-hits
+    rv2, out2, _ = run_cli(
+        ["-input-json", f"-input={FIXTURE}", "-max-reassign=0",
+         f"-serve-socket={sock}", "-serve-session=tenant-x"]
+    )
+    assert rv2 == 0
+    doc2 = sclient.fetch_stats(sock)
+    assert doc2["sessions"]["delta_hits"] >= 1
+    sclient.request_shutdown(sock)
+    t.join(15)
+    assert rc_box == [0]
+
+
+# --- the client ladder ----------------------------------------------------
+
+
+class _FakeDaemon:
+    """A scripted protocol peer: answers hello like a live daemon,
+    then plays a per-plan script ('overload', 'ok', 'hang').
+    ``answer_hello=False`` answers only the FIRST connection's hello
+    (the handshake) and goes silent for every later one — exactly a
+    daemon that wedges after accepting the request, as the client's
+    liveness probes see it."""
+
+    def __init__(self, sock_path, script, hello_extra=None,
+                 answer_hello=True):
+        self.path = sock_path
+        self.script = list(script)
+        self.plans = 0
+        self.conns = 0
+        self.answer_hello = answer_hello
+        self.hello_extra = hello_extra or {}
+        self._listener = socket_mod.socket(
+            socket_mod.AF_UNIX, socket_mod.SOCK_STREAM
+        )
+        self._listener.bind(sock_path)
+        self._listener.listen(8)
+        self._listener.settimeout(0.2)
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def _hello(self):
+        return {
+            "v": 1, "ok": True, "op": "hello", "pid": os.getpid(),
+            "version": __version__, "requests": 0,
+            "requests_inflight": 0, "warming": False,
+            **self.hello_extra,
+        }
+
+    def _serve(self, conn):
+        try:
+            conn.settimeout(5)
+            self.conns += 1
+            first_conn = self.conns == 1
+            while not self._stop.is_set():
+                msg = protocol.read_frame(conn)
+                if msg is None:
+                    return
+                if msg.get("op") == "hello":
+                    if not self.answer_hello and not first_conn:
+                        return  # silent: the wedge the probe detects
+                    protocol.write_frame(conn, self._hello())
+                    continue
+                if msg.get("op") == "plan":
+                    self.plans += 1
+                    step = (
+                        self.script.pop(0) if self.script else "ok"
+                    )
+                    if step == "hang":
+                        self._stop.wait(30)
+                        return
+                    if step == "overload":
+                        protocol.write_frame(conn, {
+                            "v": 1, "ok": False, "op": "overload",
+                            "reason": "overload", "retry_after_ms": 20,
+                            "error": "request shed (overload)",
+                        })
+                        continue
+                    protocol.write_frame(conn, {
+                        "v": 1, "ok": True, "rc": 0,
+                        "stdout": "SERVED", "stderr": "",
+                    })
+        except Exception:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except socket_mod.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve, args=(conn,), daemon=True
+            ).start()
+
+    def close(self):
+        self._stop.set()
+        self._listener.close()
+        self._t.join(5)
+
+
+def test_client_backoff_honors_retry_after_then_succeeds(sock_dir):
+    sock = os.path.join(sock_dir, "fd.sock")
+    fd = _FakeDaemon(sock, ["overload", "overload", "ok"])
+    try:
+        notes = []
+        t0 = time.monotonic()
+        res = sclient.forward_plan(
+            sock, ["-no-daemon=true"], "x", note=notes.append
+        )
+        wall = time.monotonic() - t0
+        assert res is not None and res.stdout == "SERVED"
+        assert fd.plans == 3  # two sheds, one success, same connection
+        assert wall >= 0.02  # at least the retry_after sleeps happened
+        assert "overload" not in notes  # it recovered, no fallback
+    finally:
+        fd.close()
+
+
+def test_client_overload_gives_up_to_fallback(sock_dir, monkeypatch):
+    monkeypatch.setattr(sclient, "RETRY_MAX_ATTEMPTS", 2)
+    monkeypatch.setattr(sclient, "RETRY_BACKOFF_CAP_S", 0.05)
+    sock = os.path.join(sock_dir, "fd.sock")
+    fd = _FakeDaemon(sock, ["overload"] * 10)
+    try:
+        notes = []
+        res = sclient.forward_plan(
+            sock, ["-no-daemon=true"], "x", note=notes.append
+        )
+        assert res is None
+        assert notes == ["overload"]
+        assert fd.plans == 3  # initial + 2 retries
+    finally:
+        fd.close()
+
+
+def test_client_detects_wedged_daemon_in_seconds(sock_dir, monkeypatch):
+    """The 3600 s blind wait is gone: a daemon that accepts the plan,
+    never answers, and stops answering hello is detected within a few
+    progress ticks and attributed daemon_wedged."""
+    monkeypatch.setattr(sclient, "PROGRESS_TICK_S", 0.15)
+    sock = os.path.join(sock_dir, "fd.sock")
+    fd = _FakeDaemon(sock, ["hang"], answer_hello=False)
+    try:
+        notes = []
+        t0 = time.monotonic()
+        res = sclient.forward_plan(
+            sock, ["-no-daemon=true"], "x", note=notes.append
+        )
+        wall = time.monotonic() - t0
+        assert res is None
+        assert notes == ["daemon_wedged"]
+        assert wall < 10.0  # seconds, not 3600
+    finally:
+        fd.close()
+
+
+def test_client_detects_lost_request(sock_dir, monkeypatch):
+    """The daemon stays alive and chatty but holds NO in-flight work
+    while we wait: our request was lost — fall back instead of waiting
+    out the hour."""
+    monkeypatch.setattr(sclient, "PROGRESS_TICK_S", 0.15)
+    sock = os.path.join(sock_dir, "fd.sock")
+    fd = _FakeDaemon(sock, ["hang"])  # hello fine, plan never answered
+    try:
+        notes = []
+        res = sclient.forward_plan(
+            sock, ["-no-daemon=true"], "x", note=notes.append
+        )
+        assert res is None
+        assert notes == ["daemon_wedged"]
+    finally:
+        fd.close()
+
+
+def test_client_explicit_timeout_sends_deadline(sock_dir):
+    """-serve-client-timeout both bounds the wait and ships the budget
+    as deadline_ms in the plan header."""
+    sock = os.path.join(sock_dir, "fd.sock")
+    seen = {}
+
+    class _Peek(_FakeDaemon):
+        def _serve(self, conn):
+            try:
+                conn.settimeout(5)
+                while True:
+                    msg = protocol.read_frame(conn)
+                    if msg is None:
+                        return
+                    if msg.get("op") == "hello":
+                        protocol.write_frame(conn, self._hello())
+                        continue
+                    seen.update(msg)
+                    self._stop.wait(30)  # never answer the plan
+                    return
+            except Exception:
+                pass
+
+    fd = _Peek(sock, [])
+    try:
+        notes = []
+        t0 = time.monotonic()
+        res = sclient.forward_plan(
+            sock, ["-no-daemon=true"], "x",
+            note=notes.append, client_timeout=0.6,
+        )
+        wall = time.monotonic() - t0
+        assert res is None
+        assert notes == ["daemon_wedged"]
+        assert 0.4 <= wall < 8.0
+        assert 1 <= seen.get("deadline_ms", 0) <= 600
+    finally:
+        fd.close()
+
+
+def test_cli_attributes_daemon_wedged_fallback(sock_dir, monkeypatch):
+    """End to end through the CLI: the wedge falls back byte-identical
+    and lands the serve.fallbacks.daemon_wedged counter in the
+    invocation's own metrics export."""
+    monkeypatch.setattr(sclient, "PROGRESS_TICK_S", 0.15)
+    sock = os.path.join(sock_dir, "fd.sock")
+    monkeypatch.setenv("KAFKABALANCER_TPU_SOCKET", sock)
+    fd = _FakeDaemon(sock, ["hang"], answer_hello=False)
+    try:
+        want_rv, want_out, _ = run_cli(
+            ["-input-json", f"-input={FIXTURE}", "-no-daemon"]
+        )
+        mpath = os.path.join(os.path.dirname(sock), "m.json")
+        rv, out, _err = run_cli(
+            ["-input-json", f"-input={FIXTURE}",
+             f"-metrics-json={mpath}"]
+        )
+        assert rv == want_rv and out == want_out
+        with open(mpath) as f:
+            payload = json.load(f)
+        assert payload["counters"]["serve.fallbacks.daemon_wedged"] == 1
+    finally:
+        fd.close()
+
+
+# --- stale-socket takeover ------------------------------------------------
+
+
+def _make_stale_socket(sock):
+    s = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+    s.bind(sock)
+    s.close()  # the file stays; connect() now refuses
+
+
+def test_sigkilled_daemon_leftovers_are_swept(sock_dir):
+    """Socket + pidfile left by a SIGKILL'd daemon (pid dead): startup
+    sweeps them and serves instead of refusing."""
+    sock = os.path.join(sock_dir, "kb.sock")
+    _make_stale_socket(sock)
+    p = subprocess.Popen(["sleep", "0"])
+    p.wait()
+    with open(protocol.pidfile_path(sock), "w") as f:
+        f.write(f"{p.pid}\n")
+    logs = []
+    d, t, rc_box = _start_daemon(sock, log=logs.append)
+    assert any("swept stale" in m for m in logs)
+    assert sclient.daemon_alive(sock) is not None
+    sclient.request_shutdown(sock)
+    t.join(15)
+    assert rc_box == [0]
+
+
+def test_zombie_pidfile_process_counts_as_dead():
+    """A SIGKILL'd daemon whose parent never reaped it (container
+    without an init reaper) is a ZOMBIE: it answers the signal-0 probe
+    but cannot own a socket — takeover must treat it as dead."""
+    pid = os.fork()
+    if pid == 0:
+        os._exit(0)
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            with open(f"/proc/{pid}/stat") as f:
+                if f.read().rsplit(")", 1)[1].split()[0] == "Z":
+                    break
+            time.sleep(0.01)
+        assert Daemon._pid_alive(pid) is False
+    finally:
+        os.waitpid(pid, 0)
+    assert Daemon._pid_alive(os.getpid()) is True
+
+
+def test_live_pidfile_process_blocks_takeover(sock_dir):
+    """An unresponsive socket whose pidfile process is ALIVE and looks
+    like one of our daemons is refused (exit 3), not hijacked."""
+    sock = os.path.join(sock_dir, "kb.sock")
+    _make_stale_socket(sock)
+    # a live process whose cmdline matches a daemon's (the real case:
+    # a wedged/mid-start kafkabalancer -serve)
+    p = subprocess.Popen([
+        sys.executable, "-c", "import time; time.sleep(30)",
+        "kafkabalancer -serve (takeover test)",
+    ])
+    try:
+        with open(protocol.pidfile_path(sock), "w") as f:
+            f.write(f"{p.pid}\n")
+        logs = []
+        d = Daemon(sock, warm=False, log=logs.append)
+        assert d.serve_forever() == 3
+        assert any("refusing to take it over" in m for m in logs)
+        assert os.path.exists(sock)  # nothing was swept
+    finally:
+        p.kill()
+        p.wait()
+
+
+def test_recycled_pid_does_not_block_takeover(sock_dir):
+    """PID RECYCLING: the pidfile's pid now belongs to an unrelated
+    live process — takeover sweeps and serves instead of demanding
+    manual cleanup forever."""
+    sock = os.path.join(sock_dir, "kb.sock")
+    _make_stale_socket(sock)
+    p = subprocess.Popen(["sleep", "30"])  # alive, but not a daemon
+    try:
+        with open(protocol.pidfile_path(sock), "w") as f:
+            f.write(f"{p.pid}\n")
+        logs = []
+        d, t, rc_box = _start_daemon(sock, log=logs.append)
+        assert any("swept stale" in m for m in logs)
+        sclient.request_shutdown(sock)
+        t.join(15)
+        assert rc_box == [0]
+    finally:
+        p.kill()
+        p.wait()
+
+
+def test_live_daemon_still_refuses_second_daemon(sock_dir):
+    sock = os.path.join(sock_dir, "kb.sock")
+    d, t, rc_box = _start_daemon(sock)
+    d2 = Daemon(sock, warm=False, log=lambda _m: None)
+    assert d2.serve_forever() == 3
+    sclient.request_shutdown(sock)
+    t.join(15)
+    assert rc_box == [0]
+
+
+# --- scrape schema --------------------------------------------------------
+
+
+def test_scrape_carries_overload_blocks(sock_dir):
+    """serve-stats/5: admission, lane_health and faults blocks are
+    present with their golden key sets, and tenant entries carry
+    sheds."""
+    sock = os.path.join(sock_dir, "kb.sock")
+    d, t, rc_box = _start_daemon(sock)
+    rv, _out, _err = run_cli(
+        ["-input-json", f"-input={FIXTURE}", f"-serve-socket={sock}"]
+    )
+    assert rv == 0
+    doc = sclient.fetch_stats(sock)
+    golden = json.load(open(os.path.join(
+        os.path.dirname(__file__), "data", "serve_stats_schema_v5.json"
+    )))
+    assert set(doc["admission"]) == set(golden["admission_keys"])
+    assert set(doc["lane_health"]) == set(golden["lane_health_keys"])
+    assert set(doc["faults"]) == set(golden["faults_keys"])
+    assert doc["faults"]["armed"] is None  # inert by default
+    assert doc["admission"]["admitted"] == doc["requests"]
+    assert doc["admission"]["shed_total"] == 0
+    for entry in doc["tenants"]["top"].values():
+        assert entry["sheds"] == 0
+    sclient.request_shutdown(sock)
+    t.join(15)
+    assert rc_box == [0]

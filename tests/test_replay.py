@@ -7,9 +7,9 @@ Pins:
   regression gate, not a flaky load test;
 - churn events do what they claim (weight drift, broker failure with
   allowlist rewrite, topic storms growing the row set);
-- a seeded run against a live daemon produces a replay/1 artifact whose
+- a seeded run against a live daemon produces a replay/2 artifact whose
   per-tenant request counts reconcile EXACTLY with the daemon's
-  serve-stats/4 scrape, whose scrape percentiles agree with the flight
+  serve-stats/5 scrape, whose scrape percentiles agree with the flight
   recorder's tenant-labeled request log within one histogram bucket,
   and whose sampled request has plan byte parity vs -no-daemon.
 """
@@ -150,7 +150,7 @@ def test_replay_reconciles_against_live_daemon(daemon_sock):
     )
     art = run_replay(cfg, log=lambda _m: None)
     assert art["schema"] == REPLAY_SCHEMA
-    assert art["scrape_schema"] == "kafkabalancer-tpu.serve-stats/4"
+    assert art["scrape_schema"] == "kafkabalancer-tpu.serve-stats/5"
     assert art["requests_issued"] == 36
     assert art["request_errors"] == []
     assert art["reconciled_counts"] is True
@@ -177,7 +177,7 @@ def test_replay_reconciles_against_live_daemon(daemon_sock):
 
 
 def test_replay_artifact_schema_keys(daemon_sock):
-    """The replay/1 artifact's top-level keys are the schema bench.py
+    """The replay/2 artifact's top-level keys are the schema bench.py
     lands in BENCH rounds — changing them requires a version bump."""
     cfg = ReplayConfig(
         seed=1, tenants=2, requests=8, socket=daemon_sock, spawn=False,
@@ -185,13 +185,16 @@ def test_replay_artifact_schema_keys(daemon_sock):
     )
     art = run_replay(cfg, log=lambda _m: None)
     assert set(art) == {
-        "schema", "scrape_schema", "seed", "config", "requests_issued",
-        "request_errors", "wall_s", "throughput_rps", "events",
-        "per_tenant", "session_thrash", "fallback_rate", "padded_slots",
-        "microbatched", "tenant_cap", "tenants_demoted", "parity",
-        "reconciled_counts", "latency_checked", "reconciled_latency",
-        "reconciled",
+        "schema", "scrape_schema", "mode", "chaos", "seed", "config",
+        "requests_issued", "request_errors", "wall_s", "throughput_rps",
+        "events", "per_tenant", "session_thrash", "fallback_rate",
+        "padded_slots", "microbatched", "tenant_cap", "tenants_demoted",
+        "parity", "reconciled_counts", "latency_checked",
+        "reconciled_latency", "reconciled",
     }
+    # a churn (non-chaos) run marks its mode and carries no chaos block
+    assert art["mode"] == "churn"
+    assert art["chaos"] is None
     assert art["parity"] is None  # parity_sample=False
     entry = art["per_tenant"]["tenant-00"]
     for key in (
