@@ -791,6 +791,242 @@ def _run_replay_probe() -> dict:
     return out
 
 
+def _shard_scale_tier(n_parts: int, n_brokers: int, budget: int,
+                      batch: int, mesh, ndev: int) -> dict:
+    """One scale-tier measurement: plan a synthetic ``n_parts x
+    n_brokers`` cluster through ``plan_sharded(scale=True)`` on
+    ``mesh`` and attribute WHERE the time and memory go — per-shard
+    utilization (fine-ladder real/padded rows), cross-shard collective
+    time at the session's exact payload shapes, and the chunked
+    per-device peak-memory bound."""
+    from functools import partial as _partial
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.sharding import PartitionSpec as PS
+
+    from kafkabalancer_tpu.balancer.costmodel import (
+        get_bl,
+        get_broker_load,
+        get_unbalance_bl,
+    )
+    from kafkabalancer_tpu.models import default_rebalance_config
+    from kafkabalancer_tpu.ops.runtime import next_bucket, scale_bucket
+    from kafkabalancer_tpu.parallel.mesh import PART_AXIS, shard_map
+    from kafkabalancer_tpu.parallel.shard_session import (
+        SCALE_ROW_CHUNK,
+        _resolve_row_chunk,
+        plan_sharded,
+    )
+    from kafkabalancer_tpu.serve.devmem import device_memory_stats
+    from kafkabalancer_tpu.utils.synth import synth_cluster
+
+    t0 = time.perf_counter()
+    pl = synth_cluster(n_parts, n_brokers, rf=3, seed=19, weighted=True)
+    t_synth = time.perf_counter() - t0
+    cfg = default_rebalance_config()
+    cfg.min_unbalance = 1e-7
+    cfg.allow_leader_rebalancing = True
+
+    t0 = time.perf_counter()
+    opl = plan_sharded(
+        pl, cfg, budget, mesh, batch=batch,
+        dtype=jnp.float32,  # jaxlint: disable=R4 — flagship throughput dtype
+        engine="xla" if jax.devices()[0].platform == "cpu" else "auto",
+        scale=True,
+    )
+    wall = time.perf_counter() - t0
+    n_moves = len(opl)
+    final_u = get_unbalance_bl(get_bl(get_broker_load(pl)))
+
+    # per-shard utilization: the fine ladder's real/padded row split
+    step = 8 * ndev
+    P_bucket = scale_bucket(n_parts, step)
+    P_l = P_bucket // ndev
+    util = [
+        min(max(n_parts - s * P_l, 0), P_l) / P_l for s in range(ndev)
+    ]
+    B_bucket = max(next_bucket(n_brokers, 8), 128)
+    rc = _resolve_row_chunk(None, P_l)
+
+    # cross-shard collective time at the session's payload shapes: the
+    # [K] float winner values + the stacked [3, K] int32 attribute
+    # gather, per move iteration
+    K = B_bucket + B_bucket // 2
+    rep = PS()
+
+    @_partial(jax.jit, static_argnames=())
+    def _coll(v, a):
+        @_partial(
+            shard_map, mesh=mesh, in_specs=(rep, rep),
+            out_specs=(rep, rep), check_vma=False,
+        )
+        def go(v, a):
+            return (
+                lax.all_gather(v, PART_AXIS),
+                lax.all_gather(a, PART_AXIS),
+            )
+
+        return go(v, a)
+
+    from kafkabalancer_tpu.models.config import kernel_dtype
+
+    v = jnp.zeros(K, kernel_dtype())  # the session's throughput dtype
+    a = jnp.zeros((3, K), jnp.int32)
+    jax.block_until_ready(_coll(v, a))  # compile
+    reps = 50
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = _coll(v, a)
+    jax.block_until_ready(out)
+    coll_s = (time.perf_counter() - t0) / reps
+
+    # per-device peak bound of the chunked scoring path (the number the
+    # acceptance criterion caps): sharded state + what-if chunks
+    dt = 4  # f32
+    state_bytes = P_l * (B_bucket * 2 + 4 * 4)  # member+allowed bool, [P_l,R=4] i32
+    whatif_bytes = 6 * (rc or P_l) * B_bucket * dt
+    peak_bound = state_bytes + whatif_bytes
+    hbm = [
+        (device_memory_stats(d) or {}).get("peak_bytes_in_use")
+        for d in mesh.devices.flat
+    ]
+    return {
+        "metric": f"converge_wall_s_{n_parts}parts_{n_brokers}brokers",
+        "value": round(wall, 4),
+        "unit": "s",
+        "n_moves": n_moves,
+        "budget": budget,
+        "budget_bound": n_moves >= budget,
+        "final_unbalance": float(f"{final_u:.3e}"),
+        "synth_s": round(t_synth, 3),
+        "devices": ndev,
+        "p_bucket": P_bucket,
+        "p_bucket_pow2": next_bucket(n_parts, step),
+        "padded_rows": P_bucket - n_parts,
+        "row_chunk": rc or SCALE_ROW_CHUNK,
+        "per_shard_utilization": [round(u, 4) for u in util],
+        "collective_us_per_iter": round(coll_s * 1e6, 1),
+        "per_device_peak_bytes_bound": peak_bound,
+        "per_device_peak_bytes_in_use": hbm,
+    }
+
+
+def _run_shard_scale_probe(fast: bool) -> dict:
+    """The SCALE-tier probe (ISSUE 13 / ROADMAP item 3): the
+    mesh-sharded cost model at cluster sizes one device cannot hold.
+    Always records the CPU-portable smoke tier
+    (``converge_wall_s_100000parts_200brokers``, budget-bound) plus a
+    weak-scaling curve (P grows with S at fixed per-shard rows); the
+    1M × 1000 flagship (``converge_wall_s_1000000parts_1000brokers``)
+    runs where hardware warrants — multi-device non-CPU hosts, or
+    anywhere with ``BENCH_SHARD_SCALE=flagship`` — and is the bench
+    host's BENCH_r06 headline for this tier."""
+    import jax
+
+    from kafkabalancer_tpu.parallel.mesh import make_mesh
+
+    ndev = len(jax.devices())
+    if ndev < 2:
+        if (
+            jax.devices()[0].platform == "cpu"
+            and not os.environ.get("_KBTPU_SHARD_SCALE_CHILD")
+        ):
+            # a 1-device CPU container still records the smoke tier:
+            # fake an 8-device CPU mesh in a CHILD process (the XLA
+            # device-count flag must precede jax import, and this
+            # process's backend is already live) — the same rehearsal
+            # shape the test suite and gate.sh use
+            import re as _re
+            import subprocess as _sp
+
+            env = dict(os.environ)
+            token = "--xla_force_host_platform_device_count"
+            flags = _re.sub(
+                rf"{token}=\d+", "", env.get("XLA_FLAGS", "")
+            ).strip()
+            env["XLA_FLAGS"] = f"{flags} {token}=8".strip()
+            env["JAX_PLATFORMS"] = "cpu"
+            env["_KBTPU_SHARD_SCALE_CHILD"] = "1"
+            proc = _sp.run(
+                [
+                    sys.executable, os.path.abspath(__file__),
+                    "--shard-scale-child",
+                ],
+                env=env, capture_output=True, text=True, timeout=1800,
+            )
+            for raw in proc.stderr.splitlines():
+                log(f"[shard-scale child] {raw}")
+            for line in reversed(proc.stdout.splitlines()):
+                line = line.strip()
+                if line.startswith("{"):
+                    return json.loads(line)
+            log(f"shard-scale child failed (rc={proc.returncode})")
+            return {}
+        log("shard-scale probe: single device — skipped")
+        return {}
+    mesh = make_mesh(ndev, shape=(1, ndev))
+    out: dict = {"shard_scale": {}}
+
+    smoke = _shard_scale_tier(
+        100_000, 200, budget=500 if fast else 2000, batch=256,
+        mesh=mesh, ndev=ndev,
+    )
+    out["shard_scale"]["smoke"] = smoke
+    log(
+        f"shard-scale smoke ({smoke['metric']}): {smoke['value']}s, "
+        f"{smoke['n_moves']} moves, util "
+        f"{min(smoke['per_shard_utilization']):.2%}+, collective "
+        f"{smoke['collective_us_per_iter']}us/iter, peak bound "
+        f"{smoke['per_device_peak_bytes_bound'] / 1e6:.0f}MB/device"
+    )
+
+    # weak scaling: per-shard rows pinned, the cluster grows with S —
+    # flat wall == the sharding actually divides the work
+    curve = []
+    base_rows = 6_250 if fast else 12_500
+    s_vals = [s for s in (1, 2, 4, 8) if s <= ndev and ndev % s == 0]
+    for s in s_vals:
+        sub = make_mesh(s, shape=(1, s))
+        tier = _shard_scale_tier(
+            base_rows * s, 64, budget=200, batch=64, mesh=sub, ndev=s,
+        )
+        curve.append({
+            "devices": s,
+            "n_parts": base_rows * s,
+            "wall_s": tier["value"],
+            "collective_us_per_iter": tier["collective_us_per_iter"],
+        })
+    out["shard_scale"]["weak_scaling"] = curve
+    log(
+        "shard-scale weak scaling: "
+        + ", ".join(f"S={c['devices']}: {c['wall_s']}s" for c in curve)
+    )
+
+    flagship = os.environ.get("BENCH_SHARD_SCALE") == "flagship" or (
+        not fast and jax.devices()[0].platform.lower() in ("tpu", "axon")
+    )
+    if flagship:
+        tier = _shard_scale_tier(
+            1_000_000, 1000, budget=100_000, batch=1024,
+            mesh=mesh, ndev=ndev,
+        )
+        out["shard_scale"]["flagship"] = tier
+        log(
+            f"shard-scale flagship ({tier['metric']}): {tier['value']}s, "
+            f"{tier['n_moves']} moves, peak bound "
+            f"{tier['per_device_peak_bytes_bound'] / 1e6:.0f}MB/device"
+        )
+    else:
+        log(
+            "shard-scale flagship (1M x 1000): deferred to the bench "
+            "host (BENCH_SHARD_SCALE=flagship forces it)"
+        )
+    return out
+
+
 THROUGHPUT_LEVELS = (1, 2, 4)
 THROUGHPUT_REQS_PER_CLIENT = 3
 
@@ -1175,6 +1411,13 @@ def main() -> None:
     log(f"devices: {jax.devices()}")
     log(f"instance: {n_parts} partitions x {n_brokers} brokers, rf=3")
 
+    # scale-tier probe: the mesh-sharded cost model at cluster sizes one
+    # device cannot hold (smoke tier everywhere, 1M flagship gated)
+    try:
+        cold.update(_run_shard_scale_probe(fast))
+    except Exception as exc:
+        log(f"shard-scale probe unavailable: {exc!r}")
+
     def fresh(allow_leader=False):
         return _flagship_case(n_parts, n_brokers, allow_leader)
 
@@ -1434,6 +1677,7 @@ def main() -> None:
                     "throughput_served_phase_breakdown",
                     "throughput_served_stats_requests",
                     "throughput_served_queue_series",
+                    "shard_scale",
                 ) if k in cold},
                 # before/after vs the pinned round-5 cold breakdown —
                 # only at the default scale, where the r05 pin was taken
@@ -1447,10 +1691,20 @@ def main() -> None:
     )
 
 
+def shard_scale_child() -> None:
+    """Child-process entry for the faked-mesh shard-scale probe: one
+    JSON line on stdout, logs on stderr (see _run_shard_scale_probe)."""
+    print(json.dumps(_run_shard_scale_probe(
+        os.environ.get("BENCH_FAST") == "1"
+    )))
+
+
 if __name__ == "__main__":
     if "--cold-child" in sys.argv[1:]:
         cold_child()
     elif "--cold-single-child" in sys.argv[1:]:
         cold_single_child()
+    elif "--shard-scale-child" in sys.argv[1:]:
+        shard_scale_child()
     else:
         main()

@@ -21,6 +21,11 @@ pooled selection via S=1) is the baseline row.
 Run:  python benchmarks/shard_scaling.py          # re-exec under a
                                                   # virtual 8-device CPU
                                                   # mesh automatically
+      python benchmarks/shard_scaling.py --scale-tier
+                                                  # same curve through the
+                                                  # SCALE tier (lean state,
+                                                  # row-chunked scoring,
+                                                  # docs/ENGINES.md)
 Output: one JSON line per S on stderr, a table on stdout.
 tests/test_examples.py smoke-runs the S∈{1,2} rows.
 """
@@ -54,7 +59,8 @@ def _reexec() -> int:
     ).returncode
 
 
-def measure(n_parts: int, n_brokers: int, budget: int, s_values):
+def measure(n_parts: int, n_brokers: int, budget: int, s_values,
+            scale_tier: bool = False):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -78,24 +84,76 @@ def measure(n_parts: int, n_brokers: int, budget: int, s_values):
         validate_weights(pl, cfg)
         fill_defaults(pl, cfg)
         mesh = make_mesh(S, shape=(1, S))
-        dp = tensorize(pl, cfg, min_bucket=8 * S)
         dtype = jnp.float64
-        all_allowed, (loads, w_dev, nc_dev, allowed_dev, _ew) = (
-            _prep_from_dp(dp, dtype)
-        )
-        args = (
-            loads, jnp.asarray(dp.replicas), jnp.asarray(dp.member),
-            allowed_dev, w_dev, jnp.asarray(dp.nrep_cur),
-            jnp.asarray(dp.nrep_tgt), nc_dev, jnp.asarray(dp.pvalid),
-            jnp.asarray(_cfg_broker_mask(dp, cfg)), jnp.asarray(dp.bvalid),
-            jnp.int32(cfg.min_replicas_for_rebalancing),
-            jnp.asarray(0.0, dtype), jnp.int32(budget),
-            jnp.asarray(1.5, dtype),
-        )
-        kw = dict(
-            max_moves=next_bucket(budget, 128), allow_leader=True,
-            batch=1, mesh=mesh, engine="xla",
-        )
+        if scale_tier:
+            # the SCALE tier's session shape: fine-ladder bucket, lean
+            # on-device membership, mesh-sharded upload, row-chunked
+            # scoring (row_chunk small enough to chunk at this size)
+            from kafkabalancer_tpu.ops.runtime import scale_bucket
+            from kafkabalancer_tpu.parallel.mesh import (
+                replicate_put,
+                shard_put,
+            )
+            from kafkabalancer_tpu.parallel.shard_session import (
+                _resolve_row_chunk,
+                _scale_prep,
+            )
+
+            dp = tensorize(
+                pl, cfg, min_bucket=8 * S,
+                p_bucket=scale_bucket(len(pl.partitions or []), 8 * S),
+                build_member=False,
+            )
+            loads, w_dev, nc_dev = _scale_prep(
+                dp.replicas, dp.weights, dp.nrep_cur, dp.ncons,
+                dp.bvalid, dtype=dtype,
+            )
+            import numpy as _np
+
+            args = (
+                replicate_put(_np.asarray(loads), mesh),
+                shard_put(dp.replicas, mesh),
+                None,  # member: lean rebuild
+                None,  # allowed: all-allowed broadcast
+                replicate_put(_np.asarray(w_dev), mesh),
+                replicate_put(dp.nrep_cur, mesh),
+                replicate_put(dp.nrep_tgt, mesh),
+                replicate_put(_np.asarray(nc_dev), mesh),
+                replicate_put(dp.pvalid, mesh),
+                replicate_put(_cfg_broker_mask(dp, cfg), mesh),
+                replicate_put(dp.bvalid, mesh),
+                jnp.int32(cfg.min_replicas_for_rebalancing),
+                jnp.asarray(0.0, dtype), jnp.int32(budget),
+                jnp.asarray(1.5, dtype),
+            )
+            kw = dict(
+                max_moves=next_bucket(budget, 128), allow_leader=True,
+                batch=1, mesh=mesh, engine="xla", lean=True,
+                all_allowed=True,
+                row_chunk=_resolve_row_chunk(
+                    max(8, dp.replicas.shape[0] // (S * 4)),
+                    dp.replicas.shape[0] // S,
+                ),
+            )
+        else:
+            dp = tensorize(pl, cfg, min_bucket=8 * S)
+            all_allowed, (loads, w_dev, nc_dev, allowed_dev, _ew) = (
+                _prep_from_dp(dp, dtype)
+            )
+            args = (
+                loads, jnp.asarray(dp.replicas), jnp.asarray(dp.member),
+                allowed_dev, w_dev, jnp.asarray(dp.nrep_cur),
+                jnp.asarray(dp.nrep_tgt), nc_dev, jnp.asarray(dp.pvalid),
+                jnp.asarray(_cfg_broker_mask(dp, cfg)),
+                jnp.asarray(dp.bvalid),
+                jnp.int32(cfg.min_replicas_for_rebalancing),
+                jnp.asarray(0.0, dtype), jnp.int32(budget),
+                jnp.asarray(1.5, dtype),
+            )
+            kw = dict(
+                max_moves=next_bucket(budget, 128), allow_leader=True,
+                batch=1, mesh=mesh, engine="xla",
+            )
         out = sharded_session(*args, **kw)  # compile + warm
         jax.block_until_ready(out)
         n_moves = int(out[2])
@@ -126,10 +184,11 @@ def main() -> int:
     if not os.environ.get("_KBTPU_SHARD_SCALING_CHILD"):
         return _reexec()
     fast = os.environ.get("BENCH_FAST") == "1"
+    scale_tier = "--scale-tier" in sys.argv[1:]
     n_parts = 1024 if fast else 8192
     budget = 16 if fast else 64
     s_values = (1, 2) if fast else (1, 2, 4, 8)
-    rows = measure(n_parts, 64, budget, s_values)
+    rows = measure(n_parts, 64, budget, s_values, scale_tier=scale_tier)
     print(f"{'S':>3} {'iter_ms':>9} {'rows/shard':>11} {'combine elems':>14}")
     for r in rows:
         print(

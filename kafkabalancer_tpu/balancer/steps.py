@@ -443,6 +443,30 @@ def scan_partition_move(
 _SCAN_CHUNK = 8192
 
 
+def replay_broker_loads(bl, moves) -> list:
+    """Oracle-side replay of a move log onto a broker-load table with
+    the session's exact IEEE-754 op order: per move, ONE subtract on the
+    source cell then ONE add on the target cell (the two ops both the
+    scalar scan's what-if and the device session's
+    ``loads.at[s].add(-w).at[t].add(w)`` commit perform), applied in
+    move order. ``moves`` is a sequence of ``(src_broker_id,
+    tgt_broker_id, applied_delta)``. Returns a fresh ``[[bid, load]]``
+    table; ``bl`` is not mutated.
+
+    This is the differential-pin harness for the sharded scale tier
+    (tests/test_parallel.py): the mesh session's replicated/psum-exact
+    broker-load table after k accepted moves must equal this replay of
+    its own move log bit for bit — any drift in the cross-shard
+    accumulation order would show up here before it could corrupt a
+    plan."""
+    out = [[bid, load] for bid, load in bl]
+    idx = {int(bid): i for i, (bid, _load) in enumerate(out)}
+    for s, t, w in moves:
+        out[idx[int(s)]][1] -= w
+        out[idx[int(t)]][1] += w
+    return out
+
+
 def scan_moves(
     parts: Sequence[Partition],
     bl,
@@ -450,6 +474,7 @@ def scan_moves(
     best: Optional[tuple],
     cfg: RebalanceConfig,
     leaders: bool,
+    chunk: int = _SCAN_CHUNK,
 ) -> "Tuple[float, Optional[tuple], int]":
     """Vectorized replay of :func:`scan_partition_move` over ``parts`` in
     order — same ``(cu, best)`` to the last bit, plus the index into
@@ -468,6 +493,13 @@ def scan_moves(
     minimum — which is the first index of that minimum in the scored
     vector. The scalar scan remains the oracle; the randomized differential
     pin is tests/test_steps.py.
+
+    ``chunk`` bounds the what-if matrix at ``chunk × B`` doubles — the
+    oracle-side CHUNKED replay: the running strict-< minimum replays
+    across chunks exactly like the sharded scale tier's per-chunk winner
+    combine replays across row blocks, so results are invariant to the
+    chunk size (pinned by tests) and the oracle scales to candidate
+    counts that would not fit one what-if matrix.
     """
     import numpy as np  # deferred: keep the jax-free client import-light
 
@@ -537,8 +569,9 @@ def scan_moves(
 
     # -- score chunks; replay the running strict-< minimum across them ----
     winner = -1
-    for lo in range(0, len(src), _SCAN_CHUNK):
-        hi = min(lo + _SCAN_CHUNK, len(src))
+    chunk = max(1, int(chunk))
+    for lo in range(0, len(src), chunk):
+        hi = min(lo + chunk, len(src))
         n = hi - lo
         mat = np.tile(base, (n, 1))
         rows = np.arange(n)

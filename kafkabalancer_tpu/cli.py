@@ -510,6 +510,18 @@ def _run_impl(
             "as such); on one device it degenerates to the plain "
             "session",
         )
+        f_shard_scale = f.bool(
+            "shard-scale",
+            False,
+            "Fused-shard SCALE tier: plan clusters bigger than one "
+            "device can hold — fine-ladder partition buckets (multiples "
+            "of 8 x device count above ~64k rows), mesh-sharded device "
+            "upload (no single-device staging of the [P, B] state), "
+            "on-device per-shard membership rebuild, and row-chunked "
+            "per-shard scoring with a bounded what-if footprint. Plans "
+            "stay byte-identical to the single-device session "
+            "(docs/ENGINES.md). Requires -fused-shard",
+        )
         f_jaxprof = f.string(
             "jax-profile",
             "",
@@ -819,6 +831,11 @@ def _run_impl(
 
             if f_shard.value and not f_fused.value:
                 log("-fused-shard requires -fused")
+                usage()
+                return 3
+
+            if f_shard_scale.value and not f_shard.value:
+                log("-shard-scale requires -fused-shard")
                 usage()
                 return 3
 
@@ -1173,7 +1190,8 @@ def _run_impl(
             explain_rec.attach(
                 pl, cfg,
                 mode=(
-                    "fused-shard" if f_shard.value
+                    "fused-shard-scale" if f_shard_scale.value
+                    else "fused-shard" if f_shard.value
                     else "fused" if f_fused.value
                     else "per-move"
                 ),
@@ -1238,7 +1256,7 @@ def _run_impl(
                     mesh = make_mesh(ndev, shape=(1, ndev))
                     with obs.span(
                         "plan", mode="fused-shard", engine=f_engine.value,
-                        polish=f_polish.value,
+                        polish=f_polish.value, scale=f_shard_scale.value,
                     ):
                         opl = plan_sharded(
                             pl, cfg, r, mesh,
@@ -1246,6 +1264,7 @@ def _run_impl(
                             engine=f_engine.value,
                             polish=f_polish.value,
                             anti_colocation=max(0.0, f_anti_coloc.value),
+                            scale=f_shard_scale.value,
                         )
                 else:
                     from kafkabalancer_tpu.solvers.scan import plan

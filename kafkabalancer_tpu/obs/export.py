@@ -201,6 +201,7 @@ _PROM_SCALARS = (
     ("crashed_requests", "counter"),
     ("lanes", "gauge"),
     ("steals", "counter"),
+    ("mesh_exclusive", "counter"),
     ("microbatched", "counter"),
     ("mb_padded_slots", "counter"),
 )
@@ -539,6 +540,7 @@ def render_serve_stats(doc: Dict[str, Any]) -> str:
     if "lanes" in doc:
         lines.append(
             f"  lanes: {doc['lanes']} (steals {doc.get('steals', 0)}, "
+            f"mesh-exclusive {doc.get('mesh_exclusive', 0)}, "
             f"microbatched {doc.get('microbatched', 0)}, occupancy "
             f"{doc.get('mb_occupancy', {})}, padded slots "
             f"{doc.get('mb_padded_slots', 0)})"

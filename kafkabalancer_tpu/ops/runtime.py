@@ -141,3 +141,32 @@ def next_bucket(n: int, minimum: int = 8) -> int:
     while b < n:
         b *= 2
     return b
+
+
+# where the fine partition-bucket ladder takes over from the doubling
+# ladder: below this the power-of-two buckets waste at most ~64k rows of
+# padding AND buy broad jit-cache reuse; above it one doubling step
+# wastes up to the whole instance again (131072 -> 262144 pads 131071
+# rows) while scale-tier plans are one-off compiles anyway
+SCALE_LADDER_THRESHOLD = 65536
+
+
+def scale_bucket(n: int, step: int = 8) -> int:
+    """Partition bucket on the SCALE-tier fine ladder.
+
+    Below :data:`SCALE_LADDER_THRESHOLD` this is exactly
+    :func:`next_bucket` on a ``step`` minimum (``step`` = 8 × part-axis
+    size keeps every bucket divisible by the mesh axis, the
+    ``shard_session`` contract). Above it, the doubling ladder would
+    double the tensorized footprint between buckets — at 1M rows that is
+    up to ~1M padded rows of dead [P, B] state per device — so the
+    ladder switches to multiples of ``step``: padding is bounded by
+    ``step - 1`` rows total, divisibility by the axis size is preserved,
+    and the jit-cache-reuse argument for coarse buckets no longer
+    applies (a cluster-scale plan compiles once for its own shape).
+    """
+    n = max(1, n)
+    b = next_bucket(n, step)
+    if b <= SCALE_LADDER_THRESHOLD:
+        return b
+    return -(-n // step) * step

@@ -48,7 +48,12 @@ class DensePlan:
     nrep_tgt: np.ndarray  # [P] int32 — partition.num_replicas
     ncons: np.ndarray  # [P] f64 — partition.num_consumers
     allowed: np.ndarray  # [P, B] bool — per-partition allowed brokers
-    member: np.ndarray  # [P, B] bool — broker currently holds a replica
+    # [P, B] bool — broker currently holds a replica. None under the
+    # lean scale-tier encode (tensorize(build_member=False)): the
+    # sharded session rebuilds its shard's membership on device from
+    # the replica matrix, so the host never materializes (or ships)
+    # the full [P, B] table
+    member: Optional[np.ndarray]
     pvalid: np.ndarray  # [P] bool
     bvalid: np.ndarray  # [B] bool
     topic_id: np.ndarray  # [P] int32 — dense topic index (pad rows: 0)
@@ -198,6 +203,8 @@ def tensorize(
     min_bucket: int = 8,
     min_broker_bucket: int = 8,
     min_replica_bucket: int = 2,
+    p_bucket: Optional[int] = None,
+    build_member: bool = True,
 ) -> DensePlan:
     """Encode ``pl`` (post-``fill_defaults``: weights, brokers, num_replicas
     populated) into a :class:`DensePlan`.
@@ -207,6 +214,16 @@ def tensorize(
     ``min_replica_bucket`` floors the replica-slot bucket — used by sweeps
     that tensorize per-scenario repaired assignments and need every
     scenario's arrays shape-aligned for stacking.
+
+    ``p_bucket`` overrides the power-of-two partition bucket with an
+    explicit row count — the scale tier's fine-ladder seam
+    (``ops.runtime.scale_bucket``: multiples of 8 × part-axis size
+    instead of doubling, so a 1M-row cluster pads tens of rows, not
+    hundreds of thousands). Must cover the real partition count.
+    ``build_member=False`` is the lean sharded-encode mode: the [P, B]
+    membership table — the largest encode output — is skipped
+    (``member=None``) because the sharded session rebuilds each shard's
+    slice on device from the replica matrix.
     """
     parts = list(pl.iter_partitions())
     ids = broker_universe(pl, cfg, extra_brokers)
@@ -219,11 +236,17 @@ def tensorize(
     rmax = max(rmax, max((p.num_replicas for p in parts), default=0))
 
     P = next_bucket(np_real, min_bucket)
+    if p_bucket is not None:
+        if p_bucket < np_real:
+            raise ValueError(
+                f"p_bucket {p_bucket} < {np_real} real partitions"
+            )
+        P = p_bucket
     R = next_bucket(rmax, max(2, min_replica_bucket))
     B = next_bucket(nb, min_broker_bucket)
 
     cache = row_cache()
-    if cache is not None:
+    if cache is not None and build_member:
         cached = cache.lookup(parts, ids, P, R, B)
         if cached is not None:
             a = cached["arrays"]
@@ -249,7 +272,7 @@ def tensorize(
     nrep_tgt = np.zeros(P, dtype=np.int32)
     ncons = np.zeros(P, dtype=HOST_FLOAT_DTYPE)
     allowed = np.zeros((P, B), dtype=bool)
-    member = np.zeros((P, B), dtype=bool)
+    member = np.zeros((P, B), dtype=bool) if build_member else None
     pvalid = np.zeros(P, dtype=bool)
     bvalid = np.zeros(B, dtype=bool)
     bvalid[:nb] = True
@@ -316,10 +339,11 @@ def tensorize(
             row = encode_allowed_row(brokers, ids, nb, B)
             allowed[np.asarray(rows_i, dtype=np.int64)] = row
 
-    rows, cols = np.nonzero(replicas >= 0)
-    member[rows, replicas[rows, cols]] = True
+    if member is not None:
+        rows, cols = np.nonzero(replicas >= 0)
+        member[rows, replicas[rows, cols]] = True
 
-    if cache is not None:
+    if cache is not None and member is not None:
         cache.prime(
             parts, ids, P, R, B,
             {

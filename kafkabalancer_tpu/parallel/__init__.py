@@ -15,7 +15,12 @@ two orthogonal mesh axes instead:
   (:mod:`kafkabalancer_tpu.parallel.shard_session` ``plan_sharded`` — CLI
   ``-fused-shard``), with the streaming Mosaic scoring kernel
   (:mod:`kafkabalancer_tpu.parallel.shard_kernel`) carrying both the load
-  and the combined anti-colocation objectives.
+  and the combined anti-colocation objectives; its SCALE tier
+  (``plan_sharded(scale=True)`` — CLI ``-shard-scale``) plans clusters
+  bigger than one device can hold (fine-ladder buckets, mesh-sharded
+  upload via :func:`kafkabalancer_tpu.parallel.mesh.shard_put`, lean
+  on-device membership, row-chunked scoring) with plans byte-identical
+  to the single-device session.
 
 Collectives ride the ICI mesh; host code only dispatches and decodes.
 """

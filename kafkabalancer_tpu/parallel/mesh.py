@@ -53,6 +53,45 @@ def balanced_factors(n: int) -> Tuple[int, int]:
     return a, n // a
 
 
+def shard_put(arr, mesh: Mesh, axis: str = PART_AXIS):
+    """Materialize a host array as a GLOBAL mesh array sharded over
+    ``axis`` on its leading dimension, transferring each device's slice
+    directly from the host buffer (``jax.make_array_from_callback``).
+
+    This is the scale tier's chunked ``device_put``: the plain upload
+    path stages the whole array on one device first and lets the
+    shard_map reshard it — which caps the plannable cluster at what ONE
+    device can hold. Here no device ever sees more than its own
+    ``1/axis_size`` slice, so the per-device footprint of the [P, B] /
+    [P, R] session state is the shard, not the cluster. Works for
+    single- and multi-process meshes alike (each process feeds exactly
+    its addressable shards).
+    """
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as _PS
+
+    a = np.asarray(arr)
+    sharding = NamedSharding(mesh, _PS(axis))
+    return jax.make_array_from_callback(
+        a.shape, sharding, lambda idx: a[idx]
+    )
+
+
+def replicate_put(arr, mesh: Mesh):
+    """Materialize a host array fully replicated across ``mesh`` —
+    the upload twin of :func:`shard_put` for the O(P)/O(B) session
+    vectors (weights, validity, loads) whose bytes are trivial next to
+    the sharded [P, B] state but which every shard reads."""
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as _PS
+
+    a = np.asarray(arr)
+    sharding = NamedSharding(mesh, _PS())
+    return jax.make_array_from_callback(
+        a.shape, sharding, lambda idx: a[idx]
+    )
+
+
 def make_mesh(
     n_devices: Optional[int] = None,
     axis_names: Sequence[str] = (SWEEP_AXIS, PART_AXIS),

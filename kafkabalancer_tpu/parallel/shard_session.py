@@ -67,6 +67,7 @@ from kafkabalancer_tpu.solvers.scan import prefix_accept  # noqa: E402
     jax.jit,
     static_argnames=(
         "max_moves", "allow_leader", "batch", "mesh", "engine", "n_topics",
+        "lean", "all_allowed", "row_chunk",
     ),
 )
 def sharded_session(
@@ -94,6 +95,9 @@ def sharded_session(
     mesh: Mesh,
     engine: str = "xla",
     n_topics: int = 0,
+    lean: bool = False,
+    all_allowed: bool = False,
+    row_chunk: int = 0,
 ):
     """``scan.session``'s batch path with the partition axis sharded over
     ``mesh``'s ``part`` axis; same return contract ``(replicas, loads, n,
@@ -124,6 +128,28 @@ def sharded_session(
     takes the per-row counts as one more gridded input (r5,
     parallel/shard_kernel.py ``with_colo``) with move logs
     bit-identical to the XLA shard engine at float32.
+
+    SCALE-tier statics (``plan_sharded(scale=True)`` sets all three):
+
+    - ``lean=True`` — ``member`` is passed as None and each shard
+      rebuilds its [P_l, B] membership slice on device from its replica
+      rows (the exact scatter the host encode performs), so the host
+      never materializes or ships the cluster-wide [P, B] table;
+    - ``all_allowed=True`` — ``allowed`` is passed as None and each
+      shard broadcasts its slice from the [B] broker-validity row (what
+      the unsharded all-allowed mode does on one device, here per
+      shard), eliminating the other [P, B] transfer;
+    - ``row_chunk > 0`` (XLA engine only; the streaming Mosaic kernel
+      already bounds VMEM by tiling) — each shard scores its partition
+      rows in ``row_chunk``-row blocks via a sequential ``lax.map``, so
+      the per-device what-if intermediates are [row_chunk, B] instead
+      of [P_l, B]. Per-chunk winners combine under the same total-order
+      key as the cross-shard combine — ``(val, is_leader, row)`` —
+      under which the unsharded per-target/per-pair argmins are
+      associative mins, so the selection (and therefore the move log)
+      is bit-identical to the unchunked scoring: every candidate's
+      value is computed by the same row-independent IEEE-754 op
+      sequence, and min is exact in any grouping.
     """
     P, R = replicas.shape
     B = loads.shape[0]
@@ -145,6 +171,23 @@ def sharded_session(
             "the sharded anti-colocation session requires batch > 1 "
             "(the pooled batched selection)"
         )
+    if lean != (member is None):
+        raise ValueError(
+            "lean=True rebuilds membership on device (pass member=None); "
+            "lean=False requires the member matrix"
+        )
+    if all_allowed != (allowed is None):
+        raise ValueError(
+            "all_allowed=True broadcasts allowed on device (pass "
+            "allowed=None); all_allowed=False requires the allowed matrix"
+        )
+    if row_chunk and use_pallas:
+        raise ValueError(
+            "row_chunk applies to the XLA shard engine (the streaming "
+            "kernel bounds its footprint by tiling)"
+        )
+    if row_chunk >= P_l or row_chunk < 0:
+        row_chunk = 0  # one chunk covers the shard: unchunked scoring
     if not n_topics:
         # dummy replicated inputs keep ONE shard_map arity (a [P] int32
         # and a scalar are noise next to the session state)
@@ -154,31 +197,43 @@ def sharded_session(
     rep = PS()
     pshard = PS(PART_AXIS)
 
+    # the shard_map arity matches the optional inputs: lean drops the
+    # member slot, all_allowed drops the allowed slot (both rebuilt
+    # per shard inside the body)
+    in_specs = [rep, pshard]  # loads, replicas
+    if not lean:
+        in_specs.append(pshard)  # member
+    if not all_allowed:
+        in_specs.append(pshard)  # allowed
+    in_specs += [
+        rep,      # weights (full: _applied_delta indexes global p)
+        rep,      # nrep_cur
+        rep,      # nrep_tgt
+        rep,      # ncons
+        rep,      # pvalid
+        rep, rep, rep, rep, rep, rep,
+        rep,      # tid (full: candidate topics index global p)
+        rep,      # lam
+    ]
+
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(
-            rep,      # loads
-            pshard,   # replicas
-            pshard,   # member
-            pshard,   # allowed
-            rep,      # weights (full: _applied_delta indexes global p)
-            rep,      # nrep_cur
-            rep,      # nrep_tgt
-            rep,      # ncons
-            rep,      # pvalid
-            rep, rep, rep, rep, rep, rep,
-            rep,      # tid (full: candidate topics index global p)
-            rep,      # lam
-        ),
+        in_specs=tuple(in_specs),
         out_specs=(pshard, rep, rep, rep, rep, rep, rep, rep),
         # winner indices derive from axis_index; the varying-mode analysis
         # cannot see they are replicated after the gather+min combine
         check_vma=False,
     )
-    def run(loads, replicas, member, allowed, weights, nrep_cur, nrep_tgt,
-            ncons, pvalid, always_valid, universe_valid, min_replicas,
-            min_unbalance, budget, churn_gate, tid, lam):
+    def run(*xs):
+        it = iter(xs)
+        loads = next(it)
+        replicas = next(it)
+        member = None if lean else next(it)
+        allowed = None if all_allowed else next(it)
+        (weights, nrep_cur, nrep_tgt, ncons, pvalid, always_valid,
+         universe_valid, min_replicas, min_unbalance, budget, churn_gate,
+         tid, lam) = it
         shard_i = lax.axis_index(PART_AXIS)
         off = (shard_i * P_l).astype(jnp.int32)
 
@@ -190,6 +245,25 @@ def sharded_session(
         ntgt_l = lslice(nrep_tgt)
         ncons_l = lslice(ncons)
         pvalid_l = lslice(pvalid)
+
+        if member is None:
+            # lean rebuild: the exact scatter the host encode performs
+            # (member[p, replicas[p, s]] = True wherever the slot holds
+            # a real broker) on this shard's rows only — booleans, so
+            # bit-identity with the host table is structural
+            rows_i = jnp.broadcast_to(
+                jnp.arange(P_l, dtype=jnp.int32)[:, None], (P_l, R)
+            )
+            member = (
+                jnp.zeros((P_l, B), jnp.int32)
+                .at[rows_i, jnp.clip(replicas, 0)]
+                .add((replicas >= 0).astype(jnp.int32))
+                > 0
+            )
+        if allowed is None:
+            # all-allowed: the broker-validity row broadcast, per shard
+            # (what _device_prep builds whole-cluster on one device)
+            allowed = jnp.broadcast_to(universe_valid[None, :], (P_l, B))
 
         mp0 = jnp.full(max_moves + 1, -1, jnp.int32)
         bcount0 = jax.lax.psum(
@@ -210,6 +284,96 @@ def sharded_session(
             )
         else:
             counts0 = jnp.zeros((1, 1), dtype)
+
+        if row_chunk:
+            # --- scale-tier row-chunked scoring --------------------------
+            # Bound the per-iteration what-if intermediates at
+            # [row_chunk, B] by scoring this shard's rows in sequential
+            # blocks (lax.map) and combining per-chunk winners under the
+            # (val, is_leader, row) total order — the same key (and the
+            # same exactness argument) as the cross-shard combine, so
+            # the selection is bit-identical to the unchunked calls.
+            n_chunks = -(-P_l // row_chunk)
+            P_pad = n_chunks * row_chunk
+            pad_n = P_pad - P_l
+
+            def _chunk_rows(a, fill):
+                # [P_l, ...] -> [n_chunks, row_chunk, ...]; pad rows are
+                # neutral (pvalid False / replicas -1 / member False) so
+                # their candidates score +inf and never win
+                if pad_n:
+                    padv = jnp.full((pad_n,) + a.shape[1:], fill, a.dtype)
+                    a = jnp.concatenate([a, padv], axis=0)
+                return a.reshape((n_chunks, row_chunk) + a.shape[1:])
+
+            # loop-invariant per-row inputs, chunked once per session
+            w_c = _chunk_rows(w_l, 0)
+            ncur_c = _chunk_rows(ncur_l, 0)
+            ntgt_c = _chunk_rows(ntgt_l, 0)
+            ncons_c = _chunk_rows(ncons_l, 0)
+            pvalid_c = _chunk_rows(pvalid_l, False)
+            # all-allowed rebuilds each chunk's rows from the [B] row
+            # inside the scorer instead of materializing [P_pad, B]
+            allowed_c = None if all_allowed else _chunk_rows(allowed, False)
+            tid_c = _chunk_rows(tid_l, 0) if n_topics else None
+            offs_c = jnp.arange(n_chunks, dtype=jnp.int32) * row_chunk
+
+            def _chunked_best(loads, replicas, member, counts, bvalid, nb):
+                reps_c = _chunk_rows(replicas, -1)
+                mem_c = _chunk_rows(member, False)
+
+                def one(xs):
+                    reps, mem, alw, w_, ncur_, ntgt_, ncons_, pv_, tid_ = xs
+                    if alw is None:
+                        alw = jnp.broadcast_to(
+                            universe_valid[None, :], (row_chunk, B)
+                        )
+                    crows = counts[tid_] if n_topics else None
+                    su_c, vt, pt, st = cost.factored_target_best(
+                        loads, reps, alw, mem, bvalid, w_, ncur_, ntgt_,
+                        ncons_, pv_, nb, min_replicas,
+                        allow_leader=allow_leader, c_rows=crows, lam=lam,
+                    )
+                    vp, pp, sp, s_i, t_i, _live = cost.paired_best(
+                        loads, reps, alw, mem, bvalid, w_, ncur_, ntgt_,
+                        ncons_, pv_, min_replicas,
+                        allow_leader=allow_leader, c_rows=crows, lam=lam,
+                    )
+                    return su_c, vt, pt, st, vp, pp, sp, s_i, t_i
+
+                (su_all, vt_all, pt_all, st_all, vp_all, pp_all, sp_all,
+                 si_all, ti_all) = lax.map(
+                    one,
+                    (reps_c, mem_c, allowed_c, w_c, ncur_c, ntgt_c,
+                     ncons_c, pvalid_c, tid_c),
+                )
+
+                def combine(vals_all, p_all, slot_all):
+                    # chunk-local winner rows -> shard-local; min under
+                    # (val, is_leader, row), exactly the cross-shard key
+                    pg = p_all + offs_c[:, None]
+                    vmin = jnp.min(vals_all, axis=0)
+                    is_lead = (slot_all == 0).astype(jnp.int32)
+                    tiekey = jnp.where(
+                        vals_all == vmin[None, :],
+                        is_lead * (P_pad + 1) + pg,
+                        jnp.iinfo(jnp.int32).max,
+                    )
+                    k = jnp.argmin(tiekey, axis=0)
+
+                    def take(a):
+                        return jnp.take_along_axis(a, k[None, :], axis=0)[0]
+
+                    return vmin, take(pg).astype(jnp.int32), take(slot_all)
+
+                vals_t, p_t, slot_t = combine(vt_all, pt_all, st_all)
+                vals_p, p_p, slot_p = combine(vp_all, pp_all, sp_all)
+                # su and the pair frame are row-independent: every chunk
+                # carries bit-identical copies
+                return (
+                    su_all[0], vals_t, p_t, slot_t,
+                    vals_p, p_p, slot_p, si_all[0], ti_all[0],
+                )
 
         if use_pallas:
             from kafkabalancer_tpu.parallel.shard_kernel import (
@@ -315,14 +479,22 @@ def sharded_session(
             avg = jnp.sum(jnp.where(bvalid, loads, 0.0)) / nb
             # local per-target + per-pair winners over this shard's
             # partition rows; loads/bvalid are replicated so su/avg/rank
-            # arithmetic is bit-identical on every shard
-            c_rows = counts[tid_l] if n_topics else None
+            # arithmetic is bit-identical on every shard. The chunked
+            # scale-tier scorer never materializes the [P_l, B] c_rows
+            # gather either — each chunk gathers its own rows
             if use_pallas:
+                c_rows = counts[tid_l] if n_topics else None
                 su, vals_t_l, p_t_l, slot_t_l, vals_p_l, p_p_l, slot_p_l, \
                     s_p, t_p = _score_pallas(
                         loads, replicas, member, bvalid, nb, c_rows=c_rows
                     )
+            elif row_chunk:
+                (su, vals_t_l, p_t_l, slot_t_l, vals_p_l, p_p_l,
+                 slot_p_l, s_p, t_p) = _chunked_best(
+                    loads, replicas, member, counts, bvalid, nb
+                )
             else:
+                c_rows = counts[tid_l] if n_topics else None
                 su, vals_t_l, p_t_l, slot_t_l = cost.factored_target_best(
                     loads, replicas, allowed, member, bvalid, w_l, ncur_l,
                     ntgt_l, ncons_l, pvalid_l, nb, min_replicas,
@@ -445,11 +617,17 @@ def sharded_session(
             mtgt[:max_moves], final_su,
         )
 
-    return run(
-        loads, replicas, member, allowed, weights, nrep_cur, nrep_tgt,
-        ncons, pvalid, always_valid, universe_valid, min_replicas,
-        min_unbalance, budget, churn_gate, tid, lam,
-    )
+    call_args = [loads, replicas]
+    if not lean:
+        call_args.append(member)
+    if not all_allowed:
+        call_args.append(allowed)
+    call_args += [
+        weights, nrep_cur, nrep_tgt, ncons, pvalid, always_valid,
+        universe_valid, min_replicas, min_unbalance, budget, churn_gate,
+        tid, lam,
+    ]
+    return run(*call_args)
 
 
 # positions of the partition-sharded session inputs (replicas, member,
@@ -463,6 +641,65 @@ _PSHARD_ARGS = (1, 2, 3)
 # them, so plan_sharded delegates there when this engine/scale combination
 # is requested on a TPU mesh)
 SHARD_XLA_CRASH_CELLS = 131072 * 256
+
+# scale-tier default row chunk: the per-device what-if tables are
+# bounded at ~6 x SCALE_ROW_CHUNK x B floats regardless of cluster size
+# (at B=1024/f32 that is ~200 MB — well under any device), while the
+# chunk stays wide enough that the sequential lax.map adds a handful of
+# iterations, not thousands
+SCALE_ROW_CHUNK = 8192
+
+
+def _resolve_row_chunk(requested: "int | None", P_l: int) -> int:
+    """The scale tier's static row chunk for a ``P_l``-row shard:
+    balance the requested bound across equal chunks (rounded up to a
+    multiple of 8) so padding is at most 7 rows per chunk instead of up
+    to a whole chunk. 0 = unchunked (the shard fits one block)."""
+    rc = SCALE_ROW_CHUNK if requested is None else int(requested)
+    if rc <= 0 or rc >= P_l:
+        return 0
+    n_chunks = -(-P_l // rc)
+    even = -(-P_l // n_chunks)  # ceil: equal-ish chunks
+    rc = -(-even // 8) * 8
+    return 0 if rc >= P_l else rc
+
+
+def _mesh_cached_put(cache: dict, name: str, arr, mesh: Mesh,
+                     sharded: bool):
+    """Digest-keyed mesh upload: ``parallel.mesh.shard_put`` /
+    ``replicate_put`` behind ``scan._dev_cached_asarray``'s ONE cache
+    discipline (its ``upload`` seam) — a multi-chunk scale session
+    re-tensorizes between chunks but weights/allowed/validity content
+    never changes under moves, so matching digests return the
+    already-mesh-resident global array instead of re-slicing and
+    re-shipping it. A changed array (replicas after commits) misses and
+    replaces its slot; staleness is impossible by construction."""
+    from kafkabalancer_tpu.parallel.mesh import replicate_put, shard_put
+    from kafkabalancer_tpu.solvers.scan import _dev_cached_asarray
+
+    return _dev_cached_asarray(
+        cache, name, arr,
+        upload=(
+            (lambda a: shard_put(a, mesh))
+            if sharded
+            else (lambda a: replicate_put(a, mesh))
+        ),
+    )
+
+
+@partial(jax.jit, static_argnames=("dtype",))
+def _scale_prep(replicas, weights, nrep_cur, ncons, bvalid, *, dtype):
+    """The scale tier's device input prep: exactly ``_device_prep``'s
+    dtype casts and broker-load scatter (the same IEEE op sequence, so
+    the [B] loads are bit-identical to what the single-device session
+    computes) WITHOUT the [P, B] all-allowed broadcast that function
+    materializes on one device — the whole point of the scale tier is
+    that no device ever holds a cluster-wide [P, B] table."""
+    w = weights.astype(dtype)
+    nc = ncons.astype(dtype)
+    B = bvalid.shape[0]
+    loads = cost.broker_loads(replicas, w, nrep_cur, nc, B)
+    return loads, w, nc
 
 
 def _globalize(args, mesh: Mesh):
@@ -494,6 +731,8 @@ def plan_sharded(
     engine: str = "auto",
     polish: bool = False,
     anti_colocation: "float | None" = None,
+    scale: bool = False,
+    row_chunk: "int | None" = None,
 ):
     """Mesh-sharded analog of ``solvers.scan.plan`` — repairs settle
     host-side first, sharded move-session chunks re-enter like ``plan``.
@@ -533,7 +772,35 @@ def plan_sharded(
     predicate). Unlike ``plan()`` (whose whole-session kernel has no
     colocation state), BOTH shard engines carry the objective since r5
     — the streaming kernel streams the per-row counts — so no engine is
-    overridden and ``auto`` keeps the kernel on TPU meshes."""
+    overridden and ``auto`` keeps the kernel on TPU meshes.
+
+    ``scale=True`` is the SCALE tier: plan a cluster N× bigger than one
+    device can hold. Three coupled changes, all parity-preserving
+    (plans stay byte-identical to ``plan()`` on the same input, pinned
+    by tests/test_parallel.py and the gate.sh sharded-scale stage):
+
+    - the partition bucket rides the fine ladder
+      (``ops.runtime.scale_bucket``: multiples of ``8 × S`` above ~64k
+      rows instead of doubling — a 1M-row cluster pads tens of rows
+      where the power-of-two ladder padded up to another million);
+    - session state ships via mesh-sharded upload
+      (``parallel.mesh.shard_put`` — each device receives only its
+      [P/S, ·] slice straight from the host buffer; the default path
+      stages the full array on one device first, which caps the
+      instance at single-device memory). The [P, B] membership table is
+      not built or shipped at all (lean tensorize + on-device rebuild),
+      and all-allowed instances ship no [P, B] allowed matrix either;
+    - each shard scores its rows in ``row_chunk`` blocks
+      (default ``SCALE_ROW_CHUNK``), bounding the per-device what-if
+      intermediates at ~6 × row_chunk × B floats regardless of P.
+
+    The ``polish`` tail and the ``rebalance_leaders`` delegation remain
+    single-device by design; at cluster sizes that genuinely exceed one
+    device, run the scale tier with ``polish=False`` (the move session
+    is the phase sharding exists to divide). The crash-bucket
+    delegation to ``plan()`` does not apply under ``scale`` — it was
+    measured on the unchunked shard body, and delegating a
+    bigger-than-one-device cluster to one device is never an answer."""
     from kafkabalancer_tpu.balancer.steps import BalanceError
     from kafkabalancer_tpu.models.partition import empty_partition_list
     from kafkabalancer_tpu.ops import tensorize
@@ -549,6 +816,7 @@ def plan_sharded(
         _pack_log,
         _prep_from_dp,
         _settle_head,
+        all_allowed_of,
         anti_colocation_requested,
         auto_chunk_moves,
         resolve_engine,
@@ -592,7 +860,8 @@ def plan_sharded(
             "anti_colocation is not supported with rebalance_leaders "
             "(the fused leader session has no colocation state)"
         )
-    if engine == "xla" and on_tpu and not cfg.rebalance_leaders:
+    if engine == "xla" and on_tpu and not cfg.rebalance_leaders \
+            and not scale:
         # crash-bucket guard: the XLA shard body is the only
         # colocation-capable (and only f64) shard engine, but at
         # >= 131072 x 256 buckets it kills the v5e worker with no
@@ -644,6 +913,23 @@ def plan_sharded(
     if cfg.rebalance_leaders:
         from kafkabalancer_tpu.solvers.scan import plan
 
+        if scale:
+            # the fused leader session is single-device BY DESIGN (its
+            # Balance loop replays the reference's sequential step
+            # precedence) — the scale tier cannot shard it, so the
+            # delegation stands, but silently staging a cluster that
+            # was requested at bigger-than-one-device scale onto one
+            # device must at least be visible
+            import warnings
+
+            warnings.warn(
+                "-shard-scale with rebalance_leaders delegates to the "
+                "single-device fused leader session (sequential by "
+                "contract): the cluster must fit one device on this "
+                "path",
+                UserWarning,
+                stacklevel=2,
+            )
         return plan(
             pl, cfg, max_reassign, dtype=dtype, batch=batch,
             chunk_moves=chunk_moves,
@@ -680,16 +966,40 @@ def plan_sharded(
     # instead of re-uploading them per chunk (scan._dev_cached_asarray)
     dev_cache: dict = {}
     remaining = budget
+    rc_static = 0
     while remaining > 0:
-        dp = tensorize(pl, cfg, min_bucket=min_bucket)
-        all_allowed, (loads, w_dev, nc_dev, allowed_dev, _ew) = (
-            _prep_from_dp(dp, dtype, dev_cache=dev_cache)
-        )
+        if scale:
+            from kafkabalancer_tpu.ops.runtime import scale_bucket
+
+            # fine-ladder bucket + lean encode: no [P, B] membership
+            # table is built host-side, none is shipped
+            dp = tensorize(
+                pl, cfg, min_bucket=min_bucket,
+                p_bucket=scale_bucket(
+                    max(1, len(pl.partitions or [])), min_bucket
+                ),
+                build_member=False,
+            )
+            all_allowed = all_allowed_of(dp)
+            # the streaming Mosaic kernel already bounds its footprint
+            # by tiling; row chunking is the XLA shard body's bound
+            rc_static = (
+                0
+                if engine in ("pallas", "pallas-interpret")
+                else _resolve_row_chunk(row_chunk, dp.replicas.shape[0] // S)
+            )
+        else:
+            dp = tensorize(pl, cfg, min_bucket=min_bucket)
+            all_allowed, (loads, w_dev, nc_dev, allowed_dev, _ew) = (
+                _prep_from_dp(dp, dtype, dev_cache=dev_cache)
+            )
         chunk = min(remaining, chunk_moves)
         _conv_rec = convergence.recorder()
-        if _conv_rec is not None:
+        if _conv_rec is not None and dp.member is not None:
             # -explain candidate-space stats (same dense encoding the
-            # sharded round scores; one numpy pass, no device sync)
+            # sharded round scores; one numpy pass, no device sync —
+            # the lean scale encode has no member table, so the scale
+            # tier skips this sample rather than materializing one)
             _conv_rec.note_round(
                 dp, cfg, chunk=chunk, engine=f"shard-{engine}"
             )
@@ -703,7 +1013,59 @@ def plan_sharded(
             tid_np = np.zeros(dp.replicas.shape[0], np.int32)
             n_topics = 0
             lam_np = np.asarray(0.0, dtype)
-        if multiproc:
+        if scale:
+            # mesh-sharded upload: every array lands as a GLOBAL array
+            # whose per-device slices transfer straight from the host
+            # buffer (parallel/mesh.py shard_put) — no single-device
+            # staging of any [P, ·] table. Loads come from the same
+            # casts + broker-load scatter as _device_prep (bit-identical
+            # [B] table), computed from the small [P, R]/[P] inputs.
+            loads_d, w_d, nc_d = _scale_prep(
+                dp.replicas, dp.weights, dp.nrep_cur, dp.ncons,
+                dp.bvalid, dtype=dtype,
+            )
+            args = (
+                _mesh_cached_put(
+                    dev_cache, "sc.loads", np.asarray(loads_d), mesh,
+                    False,
+                ),
+                _mesh_cached_put(
+                    dev_cache, "sc.replicas", dp.replicas, mesh, True
+                ),
+                None,  # member: lean on-device rebuild
+                None if all_allowed else _mesh_cached_put(
+                    dev_cache, "sc.allowed", dp.allowed, mesh, True
+                ),
+                _mesh_cached_put(
+                    dev_cache, "sc.weights", np.asarray(w_d), mesh, False
+                ),
+                _mesh_cached_put(
+                    dev_cache, "sc.nrep_cur", dp.nrep_cur, mesh, False
+                ),
+                _mesh_cached_put(
+                    dev_cache, "sc.nrep_tgt", dp.nrep_tgt, mesh, False
+                ),
+                _mesh_cached_put(
+                    dev_cache, "sc.ncons", np.asarray(nc_d), mesh, False
+                ),
+                _mesh_cached_put(
+                    dev_cache, "sc.pvalid", dp.pvalid, mesh, False
+                ),
+                _mesh_cached_put(
+                    dev_cache, "sc.cfg_mask", _cfg_broker_mask(dp, cfg),
+                    mesh, False,
+                ),
+                _mesh_cached_put(
+                    dev_cache, "sc.bvalid", dp.bvalid, mesh, False
+                ),
+                jnp.int32(cfg.min_replicas_for_rebalancing),
+                jnp.asarray(cfg.min_unbalance, dtype),
+                jnp.int32(chunk),
+                jnp.asarray(churn_gate, dtype),
+                _mesh_cached_put(dev_cache, "sc.tid", tid_np, mesh, False),
+                jnp.asarray(lam_np),
+            )
+        elif multiproc:
             # build from the HOST arrays (the [P, B]/[P, R] state must
             # not round-trip through the default device before the
             # global device_put; only the small device-prep outputs —
@@ -761,6 +1123,9 @@ def plan_sharded(
                     mesh=mesh,
                     engine=engine,
                     n_topics=n_topics,
+                    lean=scale,
+                    all_allowed=scale and all_allowed,
+                    row_chunk=rc_static,
                 )
             )
         except BalanceError:
@@ -774,9 +1139,10 @@ def plan_sharded(
                     f"engine='xla' or 'pallas-interpret'"
                 ) from exc
             raise
-        if multiproc:
+        if multiproc or scale:
             # the replicated log outputs are fully addressable on every
-            # process; pack host-side (_pack_log is a single-device jit)
+            # process; pack host-side (_pack_log is a single-device jit,
+            # and the scale tier's outputs are mesh-global arrays)
             packed = np.concatenate(
                 [
                     np.asarray(mp), np.asarray(mslot), np.asarray(mtgt),
@@ -796,7 +1162,6 @@ def plan_sharded(
     # single moves each swap phase exposes.
     while polish and remaining > 0:
         from kafkabalancer_tpu.solvers.polish import entry_table
-        from kafkabalancer_tpu.solvers.scan import all_allowed_of
 
         dp = tensorize(pl, cfg)
         all_allowed = all_allowed_of(dp)

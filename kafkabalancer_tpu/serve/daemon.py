@@ -977,6 +977,7 @@ class Daemon:
             batch_mode=self.batch_mode,
             admission_hold=self.admission_hold,
             watchdog_s=self.watchdog_s,
+            exclusive=self._mesh_exclusive_request,
         )
         # the admission window scales with the real lane count: each
         # lane can batch up to `microbatch` members and should have a
@@ -1019,6 +1020,17 @@ class Daemon:
             engine in ("auto", "xla")
             and _argv_value(req.argv, "fused-shard") != "true"
         )
+
+    @staticmethod
+    def _mesh_exclusive_request(req: PlanRequest) -> bool:
+        """MESH-EXCLUSIVE prediction: a ``-fused-shard`` plan shard_maps
+        over EVERY attached device, so it must never race lane-pinned
+        dispatches — the scheduler drains all lanes before running it
+        and holds new dispatches until it returns
+        (serve/lanes.py ``LaneScheduler._run_exclusive``). It is also
+        predicted NON-admissible for continuous batching above (a
+        member that owns the mesh could never fuse with lane peers)."""
+        return _argv_value(req.argv, "fused-shard") == "true"
 
     def _stage_request(self, req: PlanRequest, lane: Any) -> None:
         """Host-encode stage of the lane pipeline (runs on the lane's
@@ -1180,6 +1192,9 @@ class Daemon:
             s = sched.stats()
             out["lanes"] = int(s["lanes"])
             out["steals"] = int(s["steals"])
+            # mesh-exclusive runs (-fused-shard: drained the fleet and
+            # owned every device for the dispatch)
+            out["mesh_exclusive"] = int(s.get("mesh_exclusive", 0))
             out["microbatched"] = int(s["microbatched"])
             out["batch_mode"] = self.batch_mode
             out["mb_occupancy"] = sched.occupancy_hist()

@@ -493,6 +493,7 @@ class LaneScheduler:
         batch_mode: str = "continuous",
         admission_hold: int = 0,
         watchdog_s: float = 0.0,
+        exclusive: Optional[FusibleFn] = None,
     ) -> None:
         self._handle = handle
         self._bucket_of = bucket_of
@@ -501,9 +502,26 @@ class LaneScheduler:
         self._stage = stage
         self._admissible = admissible
         self._batch_mode = batch_mode
+        # MESH-EXCLUSIVE predicate (daemon: -fused-shard requests): a
+        # matching request owns EVERY attached device (the sharded
+        # session shard_maps over the whole mesh), so its lane first
+        # DRAINS the fleet — waits until no other lane has in-flight
+        # work — and holds every pop loop closed while it runs; nothing
+        # lane-pinned can race the mesh collectives. Sequential by
+        # construction: a second exclusive parks until the first
+        # releases ownership.
+        self._exclusive = exclusive
         self._cv = threading.Condition()
         self._queues: List[Deque[Any]] = [deque() for _ in self.lanes]
         self._active = [0] * len(self.lanes)
+        # lane index currently owning the mesh, and per-lane count of
+        # popped-but-parked exclusive requests (parked = waiting for the
+        # drain, deliberately NOT counted as busy by the drain check so
+        # two concurrent exclusives cannot deadlock waiting on each
+        # other's active slot)
+        self._mesh_owner: Optional[int] = None
+        self._excl_parked = [0] * len(self.lanes)
+        self.mesh_exclusive = 0
         # per-lane claimed-but-unfinished requests — what the health
         # monitor answers with a structured error when the lane dies
         self._current: List[List[Any]] = [[] for _ in self.lanes]
@@ -591,6 +609,7 @@ class LaneScheduler:
             return {
                 "lanes": float(len(self.lanes)),
                 "steals": float(self.steals),
+                "mesh_exclusive": float(self.mesh_exclusive),
                 "microbatched": float(self.microbatched),
                 "padded_slots": float(self.padded_slots),
                 "occupancy_max": float(
@@ -666,10 +685,16 @@ class LaneScheduler:
             if not worker.is_alive():
                 self._quarantine(i, "crashed", log, restarting=True)
                 # restart: the dead worker's active count can never be
-                # decremented by it, so reset the lane's slate first
+                # decremented by it, so reset the lane's slate first —
+                # including any mesh hold it died holding (a stuck
+                # owner/parked flag would freeze every other lane's pop
+                # loop forever)
                 with self._cv:
                     self._active[i] = 0
                     self._current[i] = []
+                    self._excl_parked[i] = 0
+                    if self._mesh_owner == i:
+                        self._mesh_owner = None
                 nt = threading.Thread(
                     target=self._worker, args=(i,),
                     name=f"serve-lane-{i}", daemon=True,
@@ -959,6 +984,17 @@ class LaneScheduler:
         return True
 
     # -- the lane worker ---------------------------------------------------
+    def _is_exclusive(self, req: Any) -> bool:
+        """Does ``req`` take the whole mesh? argv-only predicate,
+        lock-safe, fail-closed (an erroring predicate means a normal
+        lane-pinned run — the pre-exclusive behavior)."""
+        if self._exclusive is None:
+            return False
+        try:
+            return bool(self._exclusive(req))
+        except Exception:
+            return False
+
     def _worker(self, i: int) -> None:
         lane = self.lanes[i]
         while True:
@@ -966,6 +1002,15 @@ class LaneScheduler:
             contended = False
             with self._cv:
                 while True:
+                    # mesh hold: while an exclusive request owns (or is
+                    # draining toward) the mesh, no lane starts NEW
+                    # work — in-flight requests finish, pops wait
+                    if not self._stop and (
+                        self._mesh_owner is not None
+                        or any(self._excl_parked)
+                    ):
+                        self._cv.wait(0.1)
+                        continue
                     if self._queues[i]:
                         if self._hold_locked(i):
                             self._cv.wait(0.02)
@@ -981,6 +1026,9 @@ class LaneScheduler:
                         return
                     self._cv.wait()
                 self._active[i] += 1
+            excl = self._is_exclusive(first)
+            if excl:
+                contended = False  # never grouped: it runs the mesh alone
             group = [first]
             if contended:
                 # same-bucket group assembly, probes OUTSIDE the lock
@@ -1017,7 +1065,10 @@ class LaneScheduler:
             faults.fire("lane_crash")
             t0 = time.monotonic()
             try:
-                self._run_group(lane, group, claimed)
+                if excl:
+                    self._run_exclusive(lane, first)
+                else:
+                    self._run_group(lane, group, claimed)
             except Exception as exc:
                 # the worker must SURVIVE anything a group throws
                 # (thread exhaustion in a fused run, a stage-thread
@@ -1048,6 +1099,58 @@ class LaneScheduler:
                     lane.requests += len(claimed)
                     lane.last_beat = time.monotonic()
                     self._cv.notify_all()
+
+    def _run_exclusive(self, lane: Lane, req: Any) -> None:
+        """Run one mesh-exclusive request: park until every OTHER lane
+        has zero in-flight work (their pops are already held closed by
+        the parked flag, so the fleet drains monotonically), claim mesh
+        ownership, run the request solo on this lane's thread, release.
+        Parked peers on other lanes do not count as busy — they are
+        waiting on this same drain, and counting them would deadlock
+        two concurrent exclusives; ownership arbitration under the lock
+        serializes them instead. Shutdown mid-park NEVER runs the
+        request without ownership — dispatching a mesh-wide collective
+        beside still-in-flight lane work is exactly the race this
+        mechanism exists to prevent (and can wedge the device worker
+        uncatchably); the parked request is answered with a structured
+        shutdown error instead."""
+        i = lane.index
+        owned = False
+        with self._cv:
+            self._excl_parked[i] += 1
+            self._cv.notify_all()
+            try:
+                while not self._stop:
+                    if self._mesh_owner is None and all(
+                        self._active[j] - self._excl_parked[j] <= 0
+                        for j in range(len(self.lanes))
+                        if j != i
+                    ):
+                        self._mesh_owner = i
+                        owned = True
+                        break
+                    self._cv.wait(0.05)
+            finally:
+                self._excl_parked[i] -= 1
+            if owned:
+                self.mesh_exclusive += 1
+        if not owned:
+            req.response = {
+                "v": PROTO_VERSION, "ok": False,
+                "error": (
+                    "daemon shutting down (mesh-exclusive request "
+                    "not dispatched)"
+                ),
+            }
+            req.done.set()
+            return
+        obs.metrics.count("serve.mesh_exclusive")
+        try:
+            self._run_one(lane, req, coalesced=False, mb=None)
+        finally:
+            with self._cv:
+                self._mesh_owner = None
+                self._cv.notify_all()
 
     def _stage_ahead(self, lane: Lane) -> None:
         """Kick the host-encode stage for this lane's NEXT queued request
@@ -1140,7 +1243,14 @@ class LaneScheduler:
             return []
         i = lane.index
         with self._cv:
-            if self._stop or not self._queues[i]:
+            if (
+                self._stop
+                or self._mesh_owner is not None
+                or any(self._excl_parked)
+                or not self._queues[i]
+            ):
+                # a draining/held mesh also stops the continuous feed:
+                # mid-flight admission is new work too
                 return []
             pending = list(self._queues[i])
         want = []

@@ -1037,7 +1037,7 @@ def _dispatch_chunk(dp, cfg: RebalanceConfig, chunk: int, *a, **kw) -> "np.ndarr
 from kafkabalancer_tpu.ops.tensorize import all_allowed_of  # noqa: E402
 
 
-def _dev_cached_asarray(cache, name: str, arr):
+def _dev_cached_asarray(cache, name: str, arr, upload=None):
     """``jnp.asarray`` behind a session-scoped digest-keyed reuse cache.
 
     A multi-chunk session re-tensorizes between chunks, producing FRESH
@@ -1056,11 +1056,18 @@ def _dev_cached_asarray(cache, name: str, arr):
     method): the key then drops the slot name and becomes pure content
     (shape, dtype, digest), so identical arrays are shared ACROSS
     sessions, requests and slots instead of within one session's slot —
-    the serve lanes' cross-request generalization of this cache."""
+    the serve lanes' cross-request generalization of this cache.
+
+    ``upload`` (default ``jnp.asarray``) is the device-materialization
+    seam: the scale tier reuses this exact cache discipline for
+    mesh-global uploads (``parallel.shard_session._mesh_cached_put``
+    passes ``shard_put``/``replicate_put`` closures) instead of
+    maintaining a second digest cache."""
     if arr is None:
         return None
+    up = jnp.asarray if upload is None else upload
     if cache is None:
-        return jnp.asarray(arr)
+        return up(arr)
     a = np.asarray(arr)
     digest = hashlib.md5(np.ascontiguousarray(a).tobytes()).digest()
     if hasattr(cache, "lookup"):
@@ -1069,7 +1076,7 @@ def _dev_cached_asarray(cache, name: str, arr):
         if pooled is not None:
             obs.metrics.count("solver.dev_cache_hits")
             return pooled
-        dev = jnp.asarray(a)
+        dev = up(a)
         cache.put(pkey, dev)
         return dev
     key = (name, a.shape, a.dtype.str)
@@ -1077,7 +1084,7 @@ def _dev_cached_asarray(cache, name: str, arr):
     if hit is not None and hit[0] == digest:
         obs.metrics.count("solver.dev_cache_hits")
         return hit[1]
-    dev = jnp.asarray(a)
+    dev = up(a)
     cache[key] = (digest, dev)
     return dev
 

@@ -28,6 +28,12 @@
 #      parity smoke       single-device plan, on real multi-device
 #                         hosts or a faked 2-device CPU mesh (skips on
 #                         a single non-CPU device)
+#   8b. sharded-scale   — the SCALE tier pin: a 20000-row cluster split
+#      parity             across a faked 2-device CPU mesh
+#                         (plan_sharded(scale=True): fine-ladder
+#                         buckets, lean membership, sharded upload,
+#                         row-chunked scoring) byte-identical to the
+#                         single-device plan (docs/ENGINES.md)
 #   9. continuous       — K concurrent clients against a daemon with a
 #      batching +         deterministic admission hold: per-client
 #      live-scrape        served attribution + byte parity vs
@@ -384,6 +390,60 @@ if [ "$shard_run" = 1 ]; then
   fi
 fi
 rm -rf "$shard_tmp"
+
+step "sharded-scale parity (20000-row cluster split across a faked 2-device mesh)"
+# The SCALE tier pre-merge pin (ISSUE 13): a 20000-partition synthetic
+# cluster planned through plan_sharded(scale=True) — fine-ladder
+# buckets, lean on-device membership, mesh-sharded upload, row-chunked
+# scoring — must be BYTE-identical (move log and final assignment) to
+# the single-device plan of the same input. Runs on a faked 2-device
+# CPU mesh so every host exercises it; the tier-1 twin covers the
+# 8-device 100k case (tests/test_parallel.py).
+scale_tmp=$(mktemp -d)
+if env JAX_PLATFORMS=cpu \
+    XLA_FLAGS=--xla_force_host_platform_device_count=2 \
+    JAX_COMPILATION_CACHE_DIR="$scale_tmp" JAX_ENABLE_X64=1 \
+    timeout 600 "$PYTHON" - <<'PYEOF'
+from kafkabalancer_tpu.models import default_rebalance_config
+from kafkabalancer_tpu.parallel.mesh import make_mesh
+from kafkabalancer_tpu.parallel.shard_session import plan_sharded
+from kafkabalancer_tpu.solvers.scan import plan
+from kafkabalancer_tpu.utils.synth import synth_cluster
+
+
+def fresh():
+    pl = synth_cluster(20_000, 24, rf=3, seed=13, weighted=True)
+    cfg = default_rebalance_config()
+    cfg.min_unbalance = 1e-7
+    cfg.allow_leader_rebalancing = True
+    return pl, cfg
+
+
+def log_of(opl):
+    return [
+        (p.topic, p.partition, tuple(p.replicas))
+        for p in (opl.partitions or [])
+    ]
+
+
+mesh = make_mesh(2, shape=(1, 2))
+pl_s, cfg_s = fresh()
+opl_s = plan_sharded(
+    pl_s, cfg_s, 300, mesh, batch=32, scale=True, row_chunk=2048
+)
+pl_1, cfg_1 = fresh()
+opl_1 = plan(pl_1, cfg_1, 300, batch=32)
+assert log_of(opl_s), "scale-tier plan produced no moves"
+assert log_of(opl_s) == log_of(opl_1), "move logs diverged"
+assert pl_s == pl_1, "final assignments diverged"
+print(f"sharded-scale parity: {len(log_of(opl_s))} moves byte-identical")
+PYEOF
+then
+  echo "sharded-scale byte parity: OK"
+else
+  echo "sharded-scale parity FAILED"; fail=1
+fi
+rm -rf "$scale_tmp"
 
 step "continuous batching + live-scrape smoke (3 held clients)"
 # The continuous batcher end to end: a daemon with a deterministic

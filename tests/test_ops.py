@@ -40,6 +40,60 @@ def test_next_bucket():
     assert next_bucket(1000) == 1024
 
 
+def test_scale_bucket_fine_ladder():
+    """The SCALE tier's fine partition-bucket ladder (multiples of
+    8 × part-axis size above ~64k rows): padded-row counts are pinned —
+    the power-of-two ladder pads a 100k-row cluster with 31,072 dead
+    rows, the fine ladder with 32."""
+    from kafkabalancer_tpu.ops.runtime import scale_bucket
+
+    # below the threshold: exactly the power-of-two ladder on the step
+    assert scale_bucket(1000, 64) == 1024
+    assert scale_bucket(65536, 64) == 65536
+    assert scale_bucket(0, 64) == 64
+    # above: multiples of the step — padding bounded by step - 1
+    assert scale_bucket(100_000, 64) == 100_032   # pow2: 131072
+    assert scale_bucket(100_032, 64) == 100_032   # exact multiples stick
+    assert scale_bucket(1_000_000, 64) == 1_000_000
+    assert scale_bucket(1_000_001, 64) == 1_000_064
+    # padded-row pins: fine vs doubling
+    assert scale_bucket(100_000, 64) - 100_000 == 32
+    assert next_bucket(100_000, 64) - 100_000 == 31_072
+    # odd part-axis sizes keep divisibility (S=6 -> step 48)
+    assert scale_bucket(100_000, 48) % 48 == 0
+    assert scale_bucket(100_000, 48) - 100_000 < 48
+    # every bucket divides by the step (the P % S contract)
+    for n in (5, 70_000, 131_073):
+        assert scale_bucket(n, 64) % 64 == 0
+        assert scale_bucket(n, 64) >= n
+
+
+def test_tensorize_lean_scale_encode():
+    """The lean sharded-encode seam: p_bucket overrides the row bucket
+    (fine ladder) and build_member=False skips the [P, B] membership
+    table — everything else identical to the full encode."""
+    import numpy as np
+
+    from kafkabalancer_tpu.models import default_rebalance_config
+    from kafkabalancer_tpu.utils.synth import synth_cluster
+
+    pl = synth_cluster(100, 8, rf=2, seed=3, weighted=True)
+    cfg = default_rebalance_config()
+    full = tensorize(pl, cfg, min_bucket=16)
+    lean = tensorize(pl, cfg, min_bucket=16, p_bucket=112,
+                     build_member=False)
+    assert lean.member is None
+    assert lean.replicas.shape[0] == 112
+    n = lean.np_
+    assert n == full.np_
+    np.testing.assert_array_equal(lean.replicas[:n], full.replicas[:n])
+    np.testing.assert_array_equal(lean.allowed[:n], full.allowed[:n])
+    np.testing.assert_array_equal(lean.weights[:n], full.weights[:n])
+    assert not lean.pvalid[n:].any()
+    with pytest.raises(ValueError, match="p_bucket"):
+        tensorize(pl, cfg, p_bucket=50)
+
+
 def test_tensorize_round_trip():
     rng = random.Random(7)
     for trial in range(8):

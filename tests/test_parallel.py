@@ -639,6 +639,34 @@ def test_sharded_polish_reaches_single_chip_quality():
     assert u_single <= u_shard * 5 + 1e-12
 
 
+def test_shard_scale_rebalance_leaders_warns_on_delegation():
+    """scale=True with rebalance_leaders cannot shard (the fused leader
+    session is sequential by contract): it still delegates — identical
+    results — but must WARN that the cluster lands on one device."""
+    import warnings as _warnings
+
+    from kafkabalancer_tpu.parallel.shard_session import plan_sharded
+    from kafkabalancer_tpu.solvers.scan import plan
+    from kafkabalancer_tpu.utils.synth import synth_cluster
+
+    mesh = make_mesh(4, shape=(1, 4))
+
+    def fresh():
+        pl = synth_cluster(120, 10, rf=3, seed=77, weighted=True)
+        cfg = default_rebalance_config()
+        cfg.rebalance_leaders = True
+        cfg.min_unbalance = 1e-6
+        return pl, cfg
+
+    pl_s, cfg_s = fresh()
+    with pytest.warns(UserWarning, match="single-device"):
+        opl_s = plan_sharded(pl_s, cfg_s, 200, mesh, batch=4, scale=True)
+    pl_1, cfg_1 = fresh()
+    opl_1 = plan(pl_1, cfg_1, 200, batch=4)
+    assert _move_log(opl_s) == _move_log(opl_1)
+    assert pl_s == pl_1
+
+
 def test_sharded_rebalance_leaders_delegates():
     """plan_sharded with rebalance_leaders delegates to the fused leader
     session and matches plan() exactly (same move log, same final
@@ -845,6 +873,238 @@ def test_sharded_colocation_kernel_bit_matches_xla():
     assert pl_k == pl_x
     assert mk  # the session actually planned moves
     assert _colo_count_pl(pl_k) < 1018  # colocations actually dropped
+
+
+# --- the SCALE tier (ISSUE 13): lean state, sharded upload, row-chunked
+# scoring — byte parity with the single-device plan throughout ------------
+
+
+def _restricted_cluster(n, b, seed):
+    import random as _random
+
+    from kafkabalancer_tpu.utils.synth import synth_cluster
+
+    pl = synth_cluster(n, b, rf=3, seed=seed, weighted=True)
+    rng = _random.Random(seed)
+    for p in pl.iter_partitions():
+        if rng.random() < 0.5:
+            extra = [x for x in range(1, b + 1) if rng.random() < 0.5]
+            p.brokers = sorted(set(p.replicas) | set(extra))
+    return pl
+
+
+def _move_log(opl):
+    return [
+        (p.topic, p.partition, tuple(p.replicas))
+        for p in (opl.partitions or [])
+    ]
+
+
+@pytest.mark.parametrize("seed", [211, 212, 213])
+@pytest.mark.parametrize("restricted", [False, True])
+@pytest.mark.parametrize("allow_leader", [False, True])
+def test_shard_scale_matches_single_device(seed, restricted, allow_leader):
+    """Scale-tier byte parity, the randomized differential pin matrix
+    (3 seeds × restricted-brokers × leader-session): plan_sharded with
+    scale=True — fine-ladder bucket, lean on-device membership, sharded
+    upload, row-chunked scoring — produces the BYTE-identical move log
+    and final state of the single-device plan() on the same input."""
+    from kafkabalancer_tpu.parallel.shard_session import plan_sharded
+    from kafkabalancer_tpu.solvers.scan import plan
+    from kafkabalancer_tpu.utils.synth import synth_cluster
+
+    mesh = make_mesh(8, shape=(1, 8))
+
+    def fresh():
+        if restricted:
+            pl = _restricted_cluster(160, 12, seed)
+        else:
+            pl = synth_cluster(160, 12, rf=3, seed=seed, weighted=True)
+        cfg = default_rebalance_config()
+        cfg.min_unbalance = 1e-9
+        cfg.allow_leader_rebalancing = allow_leader
+        return pl, cfg
+
+    pl_s, cfg_s = fresh()
+    # row_chunk=8 forces many chunks per shard (the combine actually
+    # exercises), and the 160-row instance rides the fine ladder's
+    # power-of-two leg — the ladder switch itself is pinned in test_ops
+    opl_s = plan_sharded(
+        pl_s, cfg_s, 600, mesh, batch=8, scale=True, row_chunk=8
+    )
+    pl_1, cfg_1 = fresh()
+    opl_1 = plan(pl_1, cfg_1, 600, batch=8)
+    assert _move_log(opl_s) == _move_log(opl_1)
+    assert pl_s == pl_1
+    assert len(opl_s) > 0  # the session actually planned moves
+
+
+def test_shard_scale_row_chunk_invariant():
+    """The chunked scorer's combine is exact: any row_chunk (including
+    the unchunked 0) yields the identical plan."""
+    from kafkabalancer_tpu.parallel.shard_session import plan_sharded
+    from kafkabalancer_tpu.utils.synth import synth_cluster
+
+    mesh = make_mesh(8, shape=(1, 8))
+
+    def one(rc):
+        pl = synth_cluster(300, 20, rf=3, seed=47, weighted=True)
+        cfg = default_rebalance_config()
+        cfg.min_unbalance = 1e-7
+        cfg.allow_leader_rebalancing = True
+        opl = plan_sharded(
+            pl, cfg, 1500, mesh, batch=16, scale=True, row_chunk=rc
+        )
+        return _move_log(opl)
+
+    base = one(0)
+    assert base
+    for rc in (8, 13, 64):
+        assert one(rc) == base, rc
+
+
+def test_shard_scale_psum_load_table_and_argmin_vs_oracle():
+    """The differential pins behind the scale tier's determinism
+    contract, against the scalar oracle (balancer/steps.py):
+
+    - the sharded session's broker-LOAD table after k accepted moves is
+      BIT-identical to the single-device session's (the psum'd integer
+      counts and the replicated float loads never drift across shards,
+      chunked scoring included), and matches the oracle-side chunked
+      replay (steps.replay_broker_loads) of its own move log;
+    - the sharded argmin's first accepted move IS the scalar
+      scan_moves winner (follower scan: the session scores leader
+      moves with their true applied delta where the reference's scan
+      deliberately under-models them — scan.py module docstring — so
+      the leader axis is pinned by the plan-level byte parity above,
+      not by this oracle).
+
+    3 seeds × plain/restricted-brokers, faked 8-device CPU mesh.
+    """
+    import jax.numpy as jnp
+
+    from kafkabalancer_tpu.balancer import costmodel
+    from kafkabalancer_tpu.balancer.steps import (
+        fill_defaults,
+        replay_broker_loads,
+        scan_moves,
+    )
+    from kafkabalancer_tpu.ops import cost
+    from kafkabalancer_tpu.parallel.shard_session import sharded_session
+    from kafkabalancer_tpu.solvers.scan import _cfg_broker_mask, session
+    from kafkabalancer_tpu.utils.synth import synth_cluster
+
+    mesh = make_mesh(8, shape=(1, 8))
+    for seed in (31, 32, 33):
+        for restricted in (False, True):
+            if restricted:
+                pl = _restricted_cluster(120, 10, seed)
+            else:
+                pl = synth_cluster(120, 10, rf=3, seed=seed, weighted=True)
+            cfg = default_rebalance_config()
+            cfg.min_unbalance = 1e-9
+            fill_defaults(pl, cfg)
+            dp = tensorize(pl, cfg, min_bucket=64)
+            B = dp.bvalid.shape[0]
+            dtype = jnp.float64
+            w = jnp.asarray(dp.weights).astype(dtype)
+            nc = jnp.asarray(dp.ncons).astype(dtype)
+            loads0 = cost.broker_loads(
+                jnp.asarray(dp.replicas), w, jnp.asarray(dp.nrep_cur),
+                nc, B,
+            )
+            common = (
+                loads0, jnp.asarray(dp.replicas), jnp.asarray(dp.member),
+                jnp.asarray(dp.allowed), w, jnp.asarray(dp.nrep_cur),
+                jnp.asarray(dp.nrep_tgt), nc, jnp.asarray(dp.pvalid),
+                jnp.asarray(_cfg_broker_mask(dp, cfg)),
+                jnp.asarray(dp.bvalid),
+                jnp.int32(cfg.min_replicas_for_rebalancing),
+                jnp.asarray(cfg.min_unbalance, dtype),
+                jnp.int32(12),
+                jnp.asarray(1.5, dtype),
+            )
+            out_1 = session(
+                *common, max_moves=128, allow_leader=False, batch=8,
+            )
+            out_s = sharded_session(
+                *common, max_moves=128, allow_leader=False, batch=8,
+                mesh=mesh, engine="xla", row_chunk=4,
+            )
+            n1, ns = int(out_1[2]), int(out_s[2])
+            assert ns == n1 > 0
+            # move logs bit-identical
+            for k in (3, 4, 5, 6):
+                np.testing.assert_array_equal(
+                    np.asarray(out_s[k]), np.asarray(out_1[k]), str(k)
+                )
+            # the psum'd/replicated broker-load table: bit-identical to
+            # the single-device session's
+            loads_1 = np.asarray(out_1[1])
+            loads_s = np.asarray(out_s[1])
+            assert loads_s.tobytes() == loads_1.tobytes()
+            # ... and to the oracle-side chunked replay of the move log
+            mp = np.asarray(out_s[3])
+            mslot = np.asarray(out_s[4])
+            msrc = np.asarray(out_s[5])
+            mtgt = np.asarray(out_s[6])
+            moves = []
+            for i in range(ns):
+                p, slot = int(mp[i]), int(mslot[i])
+                delta = (
+                    dp.weights[p] * (dp.nrep_cur[p] + dp.ncons[p])
+                    if slot == 0
+                    else dp.weights[p]
+                )
+                moves.append((int(msrc[i]), int(mtgt[i]), delta))
+            bl0 = [[b, float(np.asarray(loads0)[b])] for b in range(B)]
+            replayed = np.asarray(
+                [cell[1] for cell in replay_broker_loads(bl0, moves)]
+            )
+            np.testing.assert_array_equal(replayed, loads_s)
+            # the sharded argmin's first move == the scalar scan winner
+            loads_map = costmodel.get_broker_load(pl)
+            bl = costmodel.get_bl(loads_map)
+            su = costmodel.get_unbalance_bl(bl)
+            _cu, best, _pos = scan_moves(
+                list(pl.iter_partitions()), bl, su, None, cfg, False
+            )
+            assert best is not None
+            first_part = dp.partitions[int(mp[0])]
+            assert (first_part.topic, first_part.partition) == (
+                best[0].topic, best[0].partition,
+            ), (seed, restricted)
+            assert int(dp.broker_ids[int(msrc[0])]) == best[1]
+            assert int(dp.broker_ids[int(mtgt[0])]) == best[2]
+
+
+def test_shard_scale_100k_partition_parity():
+    """The acceptance pin: a 100k-partition plan on the faked 8-device
+    CPU mesh — fine-ladder bucket (100032 rows vs the doubling ladder's
+    131072), lean membership, sharded upload, row-chunked scoring — is
+    byte-identical to the single-device plan of the same input."""
+    from kafkabalancer_tpu.parallel.shard_session import plan_sharded
+    from kafkabalancer_tpu.solvers.scan import plan
+    from kafkabalancer_tpu.utils.synth import synth_cluster
+
+    mesh = make_mesh(8, shape=(1, 8))
+
+    def fresh():
+        pl = synth_cluster(100_000, 16, rf=2, seed=7, weighted=True)
+        cfg = default_rebalance_config()
+        cfg.min_unbalance = 1e-7
+        return pl, cfg
+
+    pl_s, cfg_s = fresh()
+    opl_s = plan_sharded(
+        pl_s, cfg_s, 128, mesh, batch=64, scale=True, row_chunk=4096
+    )
+    pl_1, cfg_1 = fresh()
+    opl_1 = plan(pl_1, cfg_1, 128, batch=64)
+    log_s, log_1 = _move_log(opl_s), _move_log(opl_1)
+    assert len(log_s) == 128  # the budget-bound plan really planned
+    assert log_s == log_1
+    assert pl_s == pl_1
 
 
 def test_plan_sharded_auto_engine_rule(monkeypatch):

@@ -1168,6 +1168,160 @@ def test_continuous_batcher_mid_session_admission():
     assert cb.occupancy.get(2, 0) >= 1, cb.occupancy
 
 
+def test_lane_scheduler_mesh_exclusive_drains_and_holds():
+    """A mesh-exclusive request (the daemon's -fused-shard prediction:
+    the sharded session owns EVERY device) must (a) wait for in-flight
+    work on other lanes to drain before it dispatches, and (b) hold
+    every lane's pop loop closed while it runs — nothing lane-pinned
+    may race the mesh collectives, and nothing new starts until the
+    mesh is released."""
+    from kafkabalancer_tpu.serve.lanes import Lane, LaneScheduler
+
+    release_block = threading.Event()
+    excl_started = threading.Event()
+    excl_release = threading.Event()
+    handled = []
+    lock = threading.Lock()
+
+    def handle(req, coalesced, lane, mb):
+        name = req.argv[0]
+        if name == "block":
+            release_block.wait(20)
+        if name == "mesh":
+            excl_started.set()
+            excl_release.wait(20)
+        with lock:
+            handled.append(name)
+        req.response = {"ok": True}
+
+    sched = LaneScheduler(
+        handle, lambda r: None, [Lane(0), Lane(1)],
+        exclusive=lambda r: r.argv[0] == "mesh",
+    )
+    try:
+        results = []
+
+        def submit(req):
+            results.append(sched.submit(req))
+
+        t_block = threading.Thread(target=submit, args=(_mk_req("block"),))
+        t_block.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not any(sched._active):
+            time.sleep(0.01)
+        # exclusive submitted while the blocker is in flight on the
+        # other lane: it must park, not dispatch
+        t_mesh = threading.Thread(target=submit, args=(_mk_req("mesh"),))
+        t_mesh.start()
+        assert not excl_started.wait(0.5), (
+            "exclusive dispatched while another lane had in-flight work"
+        )
+        # a later normal request must not start while the mesh is
+        # draining (parked) ...
+        t_late = threading.Thread(target=submit, args=(_mk_req("late"),))
+        t_late.start()
+        time.sleep(0.3)
+        with lock:
+            assert "late" not in handled
+        release_block.set()
+        assert excl_started.wait(10), "exclusive never ran after drain"
+        # ... nor while the exclusive OWNS the mesh
+        time.sleep(0.3)
+        with lock:
+            assert "late" not in handled, handled
+        excl_release.set()
+        for t in (t_block, t_mesh, t_late):
+            t.join(20)
+        assert handled == ["block", "mesh", "late"]
+        assert all(r.get("ok") for r in results)
+        assert sched.mesh_exclusive == 1
+        assert sched.stats()["mesh_exclusive"] == 1.0
+        assert sched.busy() is False
+    finally:
+        release_block.set()
+        excl_release.set()
+        sched.stop()
+
+
+def test_lane_scheduler_mesh_exclusive_shutdown_answers_not_runs():
+    """stop() arriving while an exclusive request is still PARKED must
+    answer it with a structured shutdown error, never dispatch it —
+    running a mesh-wide collective beside still-in-flight lane work is
+    exactly the race the drain exists to prevent."""
+    from kafkabalancer_tpu.serve.lanes import Lane, LaneScheduler
+
+    release_block = threading.Event()
+    handled = []
+    lock = threading.Lock()
+
+    def handle(req, coalesced, lane, mb):
+        name = req.argv[0]
+        if name == "block":
+            release_block.wait(20)
+        with lock:
+            handled.append(name)
+        req.response = {"ok": True}
+
+    sched = LaneScheduler(
+        handle, lambda r: None, [Lane(0), Lane(1)],
+        exclusive=lambda r: r.argv[0] == "mesh",
+    )
+    try:
+        results = []
+
+        def submit(req):
+            results.append(sched.submit(req))
+
+        t_block = threading.Thread(target=submit, args=(_mk_req("block"),))
+        t_block.start()
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not any(sched._active):
+            time.sleep(0.01)
+        t_mesh = threading.Thread(target=submit, args=(_mk_req("mesh"),))
+        t_mesh.start()
+        # let the exclusive reach its park (popped, waiting for drain)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and not any(sched._excl_parked):
+            time.sleep(0.01)
+        assert any(sched._excl_parked)
+        # shutdown while parked: the blocker finishes, the exclusive
+        # must be ANSWERED, not dispatched
+        stopper = threading.Thread(target=sched.stop)
+        stopper.start()
+        time.sleep(0.1)
+        release_block.set()
+        t_block.join(20)
+        t_mesh.join(20)
+        stopper.join(20)
+        with lock:
+            assert "mesh" not in handled, handled
+        assert len(results) == 2
+        by_ok = {bool(r.get("ok")): r for r in results}
+        assert by_ok[True]["ok"] is True            # the blocker's plan
+        assert "shutting down" in by_ok[False]["error"]
+        assert sched.mesh_exclusive == 0  # never counted as a run
+    finally:
+        release_block.set()
+        sched.stop()
+
+
+def test_daemon_fused_shard_scheduling_predictions():
+    """The daemon-side argv predictions for -fused-shard requests: NOT
+    admissible for continuous batching (a mesh owner can never fuse
+    with lane peers), and mesh-EXCLUSIVE for the lane scheduler (it
+    must drain the fleet before dispatching)."""
+    from kafkabalancer_tpu.serve.daemon import Daemon
+
+    shard = _mk_req("x")
+    shard.argv = ["-fused=true", "-fused-shard=true"]
+    plain = _mk_req("y")
+    plain.argv = ["-fused=true"]
+    assert Daemon._admissible_request(shard) is False
+    assert Daemon._admissible_request(plain) is True
+    assert Daemon._mesh_exclusive_request(shard) is True
+    assert Daemon._mesh_exclusive_request(plain) is False
+
+
 def test_lane_scheduler_admission_hold_forms_full_batch():
     """The deterministic admission latch: with -serve-admission-hold=2
     semantics installed, a lone admissible request is NOT dispatched

@@ -83,6 +83,54 @@ def test_scan_moves_randomized_bit_parity(seed):
     _assert_scan_parity(pl, cfg, leaders=True)
 
 
+@pytest.mark.parametrize("seed", [3, 7, 19])
+def test_scan_moves_chunk_invariant(seed):
+    """The oracle-side CHUNKED replay: scan_moves' running strict-<
+    minimum replays identically at ANY chunk size (1-candidate chunks,
+    a prime width, the default) — the same combine argument the sharded
+    scale tier's per-row-block winner combine relies on, pinned here on
+    the scalar oracle itself."""
+    rng = random.Random(4000 + seed)
+    pl = random_partition_list(
+        rng,
+        n_partitions=rng.randint(8, 60),
+        n_brokers=rng.randint(3, 12),
+        max_rf=4,
+        with_consumers=True,
+        restrict_brokers=True,
+        filled=True,
+    )
+    cfg = default_rebalance_config()
+    cfg.min_unbalance = 0.0
+    parts = list(pl.iter_partitions())
+    for leaders in (False, True):
+        bl = _bl_of(pl, cfg)
+        su = costmodel.get_unbalance_bl(bl)
+        base = scan_moves(parts, copy.deepcopy(bl), su, None, cfg, leaders)
+        for chunk in (1, 7, 8192):
+            got = scan_moves(
+                parts, copy.deepcopy(bl), su, None, cfg, leaders,
+                chunk=chunk,
+            )
+            assert repr(got[0]) == repr(base[0]), (chunk, leaders)
+            assert got[1] is base[1] or got[1] == base[1]
+            assert got[2] == base[2]
+
+
+def test_replay_broker_loads_exact_op_order():
+    """replay_broker_loads applies one subtract + one add per move, in
+    move order, and never mutates the input table."""
+    from kafkabalancer_tpu.balancer.steps import replay_broker_loads
+
+    bl = [[1, 0.1], [2, 0.2], [3, 0.3]]
+    snapshot = copy.deepcopy(bl)
+    out = replay_broker_loads(bl, [(1, 3, 0.05), (3, 2, 0.025)])
+    assert bl == snapshot
+    assert out[0][1] == 0.1 - 0.05
+    assert out[2][1] == (0.3 + 0.05) - 0.025
+    assert out[1][1] == 0.2 + 0.025
+
+
 @pytest.mark.parametrize("seed", range(10))
 def test_get_broker_load_bit_matches_reference(seed):
     """The np.add.at accumulation must reproduce the reference dict
