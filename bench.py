@@ -752,7 +752,7 @@ def _run_replay_probe() -> dict:
     attribution) at smoke scale — a seeded 3-tenant fleet with diurnal
     arrival skew, weight-shift churn, a topic storm and a broker
     failure, driven closed-loop through the real client against a
-    private daemon. Lands the replay/2 artifact (per-tenant
+    private daemon. Lands the replay/3 artifact (per-tenant
     p50/p95/p99, delta-hit/resync/fallback attribution, session-thrash
     rate, padded-slot waste) so the artifact SCHEMA is pinned in bench
     rounds before the bench-host BENCH_r06 run records it at fleet
@@ -787,6 +787,48 @@ def _run_replay_probe() -> dict:
             f"{name}={e.get('delta_hit_rate', 0):.0%}"
             for name, e in sorted(per_tenant.items())
         )
+    )
+    return out
+
+
+def _run_restart_probe() -> dict:
+    """``replay_restart_recovery``: the session-durability tier under
+    the restart replay (``python -m kafkabalancer_tpu.replay
+    --restart``) at smoke scale — a private daemon with a warm spill
+    dir is SIGKILLed mid-churn and restarted on the same socket/spill
+    dir; the artifact records the restore-hit rate (tenants answered
+    from spill with NO re-register), the warm tier's exact
+    conservation identity, and the pre/post-restart latency curve —
+    the restart-recovery numbers BENCH_r06 lands beside the churn
+    ones. Scale knobs: BENCH_REPLAY_TENANTS / BENCH_REPLAY_REQUESTS.
+    """
+    out: dict = {}
+    if os.environ.get("BENCH_NO_SERVED") == "1":
+        return out
+    from kafkabalancer_tpu.replay import ReplayConfig, run_replay
+
+    fast = os.environ.get("BENCH_FAST") == "1"
+    cfg = ReplayConfig(
+        seed=int(os.environ.get("BENCH_REPLAY_SEED", "7")),
+        tenants=int(os.environ.get("BENCH_REPLAY_TENANTS", "3")),
+        requests=int(
+            os.environ.get("BENCH_REPLAY_REQUESTS", "24" if fast else "60")
+        ),
+        arrival="uniform",  # every tenant sees both phases
+        restart=True,
+    )
+    artifact = run_replay(cfg, log=log)
+    artifact.pop("request_errors", None)
+    out["replay_restart_recovery"] = artifact
+    r = artifact.get("restart") or {}
+    log(
+        f"replay restart recovery (seed {cfg.seed}, {cfg.tenants} "
+        f"tenants, kill after {r.get('kill_after')}): "
+        f"restore-hit rate {r.get('restore_hit_rate')}, "
+        f"p50/p95 pre {r.get('pre_restart_p50_s')}/"
+        f"{r.get('pre_restart_p95_s')}s post "
+        f"{r.get('post_restart_p50_s')}/{r.get('post_restart_p95_s')}s, "
+        f"identity ok {r.get('paging_identity_ok')}, ok {r.get('ok')}"
     )
     return out
 
@@ -1386,12 +1428,20 @@ def main() -> None:
         log(f"throughput probe unavailable: {exc!r}")
 
     # replay probe: the seeded multi-tenant churn harness at smoke
-    # scale — pins the replay/2 artifact schema and the per-tenant
+    # scale — pins the replay/3 artifact schema and the per-tenant
     # scrape reconciliation in every bench round
     try:
         cold.update(_run_replay_probe())
     except Exception as exc:
         log(f"replay probe unavailable: {exc!r}")
+
+    # restart-recovery probe: SIGKILL + restart mid-churn over the warm
+    # session spill tier — records the restore-hit rate and the
+    # pre/post-restart percentile curve for BENCH_r06
+    try:
+        cold.update(_run_restart_probe())
+    except Exception as exc:
+        log(f"restart probe unavailable: {exc!r}")
 
     import jax
     import jax.numpy as jnp

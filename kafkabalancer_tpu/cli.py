@@ -224,6 +224,7 @@ _NO_FORWARD_FLAGS = frozenset((
     "serve-admission-hold", "serve-slow-ms", "serve-tenant-cap",
     "serve-max-queue", "serve-tenant-inflight", "serve-watchdog",
     "serve-faults", "serve-client-timeout",
+    "serve-session-spill-dir", "serve-warm-cap-mb",
     "serve-stats", "serve-stats-json", "serve-dump-trace", "metrics-prom",
     "serve-session", "serve-no-session",
     "no-daemon", "help", "pprof", "pprof-path", "jax-profile", "input",
@@ -666,6 +667,23 @@ def _run_impl(
             "dispatch_delay, socket_drop, transfer_fail) — chaos "
             "testing only, inert by default (docs/serving.md)",
         )
+        f_serve_spill_dir = f.string(
+            "serve-session-spill-dir",
+            "",
+            "Daemon: the warm session tier — evicted/expired/shutdown "
+            "sessions spill to checksummed records in this directory "
+            "and a later digest-matching request restores them without "
+            "the client re-sending the cluster; survives SIGKILL via "
+            "the continuous per-request spill (empty disables; "
+            "docs/serving.md § Session durability)",
+        )
+        f_serve_warm_cap = f.float(
+            "serve-warm-cap-mb",
+            256.0,
+            "Daemon: byte budget of the warm session tier in MB — the "
+            "least-recently-spilled records are swept past it "
+            "(<= 0 disables the sweep)",
+        )
         f_serve_client_timeout = f.float(
             "serve-client-timeout",
             0.0,
@@ -700,7 +718,7 @@ def _run_impl(
             "serve-stats-json",
             False,
             "Scrape a live daemon's telemetry as one line of "
-            "schema-versioned JSON (kafkabalancer-tpu.serve-stats/5)",
+            "schema-versioned JSON (kafkabalancer-tpu.serve-stats/6)",
         )
         f_serve_dump_trace = f.string(
             "serve-dump-trace",
@@ -897,6 +915,8 @@ def _run_impl(
                 tenant_inflight=f_serve_tenant_inflight.value,
                 watchdog_s=f_serve_watchdog.value,
                 faults_spec=f_serve_faults.value,
+                spill_dir=f_serve_spill_dir.value,
+                warm_cap_mb=f_serve_warm_cap.value,
             ).serve_forever()
 
         if not f_no_daemon.value and not (f_pprof.value or f_jaxprof.value):
@@ -939,7 +959,7 @@ def _run_impl(
                 # else the input path ("-" for true stdin). A v2 daemon
                 # keys its resident state per (tenant, planning-flags
                 # signature) AND attributes the request's telemetry to
-                # the tenant (serve-stats/5 "tenants" block) — so the
+                # the tenant (serve-stats/6 "tenants" block) — so the
                 # label is derived even when sessions are disabled; a
                 # request with no derivable identity rolls up as
                 # "other" daemon-side.

@@ -298,6 +298,25 @@ def render_prometheus(doc: Dict[str, Any]) -> str:
             m = _prom_name(f"sessions_{key}")
             lines.append(f"# TYPE {m} {typ}")
             lines.append(f"{m} {_prom_value(v)}")
+    # the warm session tier (serve-stats/6 "paging" block): spill /
+    # restore / corrupt-drop counters under the conservation identity
+    # spills + adopted == restores + corrupt_drops + evictions +
+    # warm_entries, plus the live warm footprint gauges
+    paging = doc.get("paging")
+    if isinstance(paging, dict):
+        for key, typ in (
+            ("cap_bytes", "gauge"), ("warm_bytes", "gauge"),
+            ("warm_entries", "gauge"), ("spills", "counter"),
+            ("adopted", "counter"), ("restores", "counter"),
+            ("restore_hits", "counter"), ("corrupt_drops", "counter"),
+            ("evictions", "counter"), ("write_failures", "counter"),
+        ):
+            v = paging.get(key)
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            m = _prom_name(f"paging_{key}")
+            lines.append(f"# TYPE {m} {typ}")
+            lines.append(f"{m} {_prom_value(v)}")
     # overload protection (serve-stats/5 "admission" block): queue
     # occupancy gauges, shed counters by reason, the live retry-after
     # estimate — the scrape half of docs/serving.md § Overload
@@ -416,8 +435,11 @@ _TENANT_SCALARS = (
     ("resyncs_full", "tenant_resyncs_full", "counter"),
     ("fallbacks", "tenant_fallbacks", "counter"),
     ("sheds", "tenant_sheds", "counter"),
+    ("restores", "tenant_restores", "counter"),
     ("sessions", "tenant_sessions", "gauge"),
     ("session_bytes", "tenant_session_bytes", "gauge"),
+    ("warm_sessions", "tenant_warm_sessions", "gauge"),
+    ("warm_bytes", "tenant_warm_bytes", "gauge"),
 )
 
 
@@ -505,7 +527,7 @@ def _render_tenant_table(tenants: Any) -> List[str]:
         f"{tenants.get('cap', 0)}, {tenants.get('demoted', 0)} demoted "
         "into other)",
         "    tenant                          requests  p50       "
-        "p95       delta%  resident",
+        "p95       delta%  hot       warm",
     ]
     for label, e in rows:
         h = e.get("request_s") or {}
@@ -513,11 +535,20 @@ def _render_tenant_table(tenants: Any) -> List[str]:
         hits = int(e.get("delta_hits", 0))
         rate = f"{100.0 * hits / n:.0f}%" if n else "-"
         name = label if len(label) <= 30 else "…" + label[-29:]
+        # the tier columns: hot resident bytes beside warm (spilled)
+        # bytes — a fully demoted tenant shows 0.0KB hot but keeps its
+        # warm attribution instead of dropping out of the table
+        warm_n = int(e.get("warm_sessions", 0))
+        warm = (
+            f"{int(e.get('warm_bytes', 0)) / 1e3:.1f}KB"
+            + (f"({warm_n})" if warm_n else "")
+        )
+        hot = f"{int(e.get('session_bytes', 0)) / 1e3:.1f}KB"
         lines.append(
             f"    {name:<30}  {n:<8}  "
             f"{_fmt_latency(h.get('p50')):<8}  "
             f"{_fmt_latency(h.get('p95')):<8}  {rate:<6}  "
-            f"{int(e.get('session_bytes', 0)) / 1e3:.1f}KB"
+            f"{hot:<8}  {warm}"
         )
     return lines
 
@@ -561,6 +592,20 @@ def render_serve_stats(doc: Dict[str, Any]) -> str:
             f"{sessions.get('resyncs_full', 0)} full resyncs, "
             f"{sessions.get('evicted_lru', 0)} evicted, "
             f"{sessions.get('expired_idle', 0)} expired"
+        )
+    paging = doc.get("paging")
+    if isinstance(paging, dict) and paging.get("enabled"):
+        lines.append(
+            f"  warm tier: {paging.get('warm_entries', 0)} records "
+            f"({paging.get('warm_bytes', 0) / 1e6:.1f}MB of "
+            f"{paging.get('cap_bytes', 0) / 1e6:.0f}MB): "
+            f"{paging.get('spills', 0)} spills "
+            f"(+{paging.get('adopted', 0)} adopted), "
+            f"{paging.get('restores', 0)} restores "
+            f"({paging.get('restore_hits', 0)} hits), "
+            f"{paging.get('corrupt_drops', 0)} corrupt drops, "
+            f"{paging.get('evictions', 0)} evicted, "
+            f"{paging.get('write_failures', 0)} write failures"
         )
     fallbacks = doc.get("fallbacks")
     if isinstance(fallbacks, dict) and fallbacks:

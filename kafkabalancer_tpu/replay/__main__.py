@@ -1,6 +1,6 @@
 """``python -m kafkabalancer_tpu.replay`` — run one seeded fleet-churn
 replay against a live (or private, self-spawned) planning daemon and
-write the ``kafkabalancer-tpu.replay/2`` artifact.
+write the ``kafkabalancer-tpu.replay/3`` artifact.
 
 Examples::
 
@@ -108,6 +108,26 @@ def main(argv: list) -> int:
         "pressure)",
     )
     p.add_argument(
+        "--restart", action="store_true",
+        help="restart-recovery mode: SIGKILL the private daemon "
+        "mid-churn and restart it on the same socket + warm spill "
+        "dir — plan-byte parity on every answered request, "
+        "restore-hit rate + pre/post-restart percentiles in the "
+        "artifact (docs/serving.md § Session durability)",
+    )
+    p.add_argument(
+        "--kill-after", type=int, default=d.restart_kill_after,
+        help="restart mode: SIGKILL after this many requests "
+        "(0 = half the run)",
+    )
+    p.add_argument(
+        "--restart-faults", default=d.restart_faults,
+        help="restart mode: fault schedule armed on the RESTARTED "
+        "daemon (default: one restore_delay; '' disables). Use "
+        "--chaos-faults for the pre-kill daemon (e.g. "
+        "spill_corrupt@1)",
+    )
+    p.add_argument(
         "--out", default="-",
         help="artifact path ('-' = stdout, the default)",
     )
@@ -133,6 +153,8 @@ def main(argv: list) -> int:
         parity_sample=not a.no_parity,
         chaos=a.chaos, chaos_faults=a.chaos_faults,
         concurrency=a.concurrency,
+        restart=a.restart, restart_kill_after=a.kill_after,
+        restart_faults=a.restart_faults,
     )
     try:
         artifact = run_replay(cfg)
@@ -149,6 +171,8 @@ def main(argv: list) -> int:
             f.write(line)
     if artifact.get("mode") == "chaos":
         sys.stderr.write(render_chaos_summary(artifact))
+    elif artifact.get("mode") == "restart":
+        sys.stderr.write(render_restart_summary(artifact))
     else:
         sys.stderr.write(render_summary(artifact))
     if a.check:
@@ -174,6 +198,26 @@ def render_chaos_summary(artifact: dict) -> str:
         f"faults fired {ch.get('faults_fired')}, "
         f"daemon alive {ch.get('daemon_alive_at_end')}, "
         f"ok={ch.get('ok')}\n"
+    )
+
+
+def render_restart_summary(artifact: dict) -> str:
+    r = artifact.get("restart") or {}
+    rate = r.get("restore_hit_rate")
+    return (
+        f"-- restart replay (seed {artifact.get('seed')}): "
+        f"{artifact.get('requests_issued')} requests, SIGKILL after "
+        f"{r.get('kill_after')}, {r.get('answered')} answered "
+        f"(parity checked on every one), "
+        f"{len(r.get('wrong_plans') or [])} wrong plans; "
+        f"restores {r.get('restores')} "
+        f"(hits {r.get('restore_hits')}, rate "
+        f"{'n/a' if rate is None else f'{rate:.0%}'}), "
+        f"corrupt drops {r.get('corrupt_drops')}, "
+        f"p95 pre {r.get('pre_restart_p95_s')}s / post "
+        f"{r.get('post_restart_p95_s')}s, "
+        f"paging identity {r.get('paging_identity_ok')}, "
+        f"ok={r.get('ok')}\n"
     )
 
 

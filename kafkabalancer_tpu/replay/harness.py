@@ -8,7 +8,7 @@ multi-tenant churn, every request runs through the REAL forwarding
 client (``cli.run`` with a ``-serve-socket`` — the same code path the
 production outer loop uses, resident-session ladder included), the
 emitted plan is applied back to the tenant's state (the closed loop),
-and at the end the harness fetches the daemon's ``serve-stats/5``
+and at the end the harness fetches the daemon's ``serve-stats/6``
 scrape and reconciles:
 
 - per-tenant REQUEST COUNTS: the driver's issued counts must equal the
@@ -31,7 +31,7 @@ scrape and reconciles:
   layer's oldest pin, exercised under churn).
 
 The result is one schema-versioned artifact
-(``kafkabalancer-tpu.replay/2``) with per-tenant tails, session-thrash
+(``kafkabalancer-tpu.replay/3``) with per-tenant tails, session-thrash
 and fallback rates, and padded-slot waste — the shape bench.py's
 ``replay_fleet_churn`` probe lands in BENCH rounds and gate.sh asserts
 pre-merge. No jax is imported here or anywhere below it: the harness is
@@ -57,10 +57,39 @@ from kafkabalancer_tpu.replay.synth import FleetSynth
 # schedule, concurrent clients driving sustained overload, plan-byte
 # parity checked on EVERY answered request, and the daemon's
 # shed/requeue/quarantine accounting reconciled exactly from the scrape
-REPLAY_SCHEMA_VERSION = 2
+# v3: + mode "restart" and the "restart" block (null otherwise) — the
+# --restart run SIGKILLs the daemon mid-churn and restarts it on the
+# same socket + spill dir, asserting plan-byte parity on every answered
+# request, reporting the restore-hit rate and the pre/post-restart p95,
+# and reconciling the warm tier's conservation identity (spills +
+# adopted == restores + corrupt_drops + evictions + warm_entries) from
+# the serve-stats/6 "paging" block
+REPLAY_SCHEMA_VERSION = 3
 REPLAY_SCHEMA = f"kafkabalancer-tpu.replay/{REPLAY_SCHEMA_VERSION}"
 
 LogFn = Callable[[str], None]
+
+
+def _paging_count(paging: Dict[str, Any], key: str) -> int:
+    """One int-coerced counter from the scrape's ``paging`` block."""
+    v = paging.get(key, 0)
+    return int(v) if isinstance(v, (int, float)) else 0
+
+
+def _paging_identity_ok(paging: Dict[str, Any]) -> bool:
+    """The warm tier's conservation identity (docs/serving.md §
+    Session durability): every record that entered the tier left it
+    exactly once — restore, corrupt prune, or eviction — or is still
+    resident. Asserted by BOTH the chaos and restart reconciliations,
+    so the formula lives in one place."""
+    return _paging_count(paging, "spills") + _paging_count(
+        paging, "adopted"
+    ) == (
+        _paging_count(paging, "restores")
+        + _paging_count(paging, "corrupt_drops")
+        + _paging_count(paging, "evictions")
+        + _paging_count(paging, "warm_entries")
+    )
 
 
 class ReplayError(RuntimeError):
@@ -108,6 +137,18 @@ class ReplayConfig:
     chaos: bool = False
     chaos_faults: str = ""
     concurrency: int = 8
+    # restart mode (--restart): spawn a private daemon with a warm
+    # spill dir, SIGKILL it after `restart_kill_after` requests (0 =
+    # half the run), restart it on the same socket + spill dir, and
+    # finish the churn — plan-byte parity on EVERY answered request,
+    # restore-hit rate + post-restart p95 in the artifact. chaos_faults
+    # arms the PRE-kill daemon (e.g. a seeded spill_corrupt); the
+    # restarted daemon is armed with restart_faults (default: one
+    # restore_delay, so the recovery-path chaos site is exercised in
+    # every run)
+    restart: bool = False
+    restart_kill_after: int = 0
+    restart_faults: str = "restore_delay@1:0.01"
 
 
 def _percentile_via_buckets(walls: List[float], q: float) -> float:
@@ -150,11 +191,16 @@ def chaos_fault_spec(seed: int, requests: int) -> str:
     delays = sorted(rng.sample(pool, min(6, len(pool))))
     drops = sorted(rng.sample(range(2, max(6, n - 2)), 2))
     xfer_at = rng.randint(2, max(3, n - 2))
+    spill_fail_at = rng.randint(2, max(3, n // 2))
     return (
         f"lane_crash@{crash_at}"
         f";dispatch_delay@{','.join(str(d) for d in delays)}:0.5"
         f";socket_drop@{','.join(str(d) for d in drops)}"
         f";transfer_fail@{xfer_at}"
+        # the warm tier's write path under chaos: one continuous-spill
+        # write dies like a full disk (paging.write_failures) — the
+        # request's answer and the hot session are untouched
+        f";spill_write_fail@{spill_fail_at}"
     )
 
 
@@ -220,10 +266,32 @@ def _tenant_scrape_counts(doc: Optional[Dict[str, Any]]) -> Dict[str, int]:
     return out
 
 
+def _make_synth(cfg: ReplayConfig) -> FleetSynth:
+    """One FleetSynth wired from the config — every replay mode
+    (plain, --chaos, --restart) must drive the identical seeded
+    churn, so the knob wiring lives in one place."""
+    return FleetSynth(
+        seed=cfg.seed,
+        tenants=cfg.tenants,
+        base_partitions=cfg.base_partitions,
+        brokers=cfg.brokers,
+        replicas=cfg.replicas,
+        skew=cfg.skew,
+        arrival=cfg.arrival,
+        diurnal_period=cfg.diurnal_period,
+        diurnal_amplitude=cfg.diurnal_amplitude,
+        weight_shift_every=cfg.weight_shift_every,
+        weight_shift_frac=cfg.weight_shift_frac,
+        broker_failure_every=cfg.broker_failure_every,
+        topic_storm_every=cfg.topic_storm_every,
+        storm_size=cfg.storm_size,
+    )
+
+
 def run_replay(
     cfg: ReplayConfig, log: Optional[LogFn] = None
 ) -> Dict[str, Any]:
-    """Run one seeded replay; returns the ``kafkabalancer-tpu.replay/2``
+    """Run one seeded replay; returns the ``kafkabalancer-tpu.replay/3``
     artifact (see the module docstring). Raises :class:`ReplayError`
     only when no daemon could be reached/spawned — a reconciliation
     failure is DATA (``reconciled: false``), not an exception, so bench
@@ -238,6 +306,8 @@ def run_replay(
     )
     if cfg.chaos:
         return _run_chaos(cfg, _log)
+    if cfg.restart:
+        return _run_restart(cfg, _log)
     tmpdir = None
     sock = cfg.socket
     spawned = None
@@ -255,22 +325,7 @@ def run_replay(
             raise ReplayError(f"no live daemon on {sock}")
         baseline = _tenant_scrape_counts(sclient.fetch_stats(sock))
 
-        synth = FleetSynth(
-            seed=cfg.seed,
-            tenants=cfg.tenants,
-            base_partitions=cfg.base_partitions,
-            brokers=cfg.brokers,
-            replicas=cfg.replicas,
-            skew=cfg.skew,
-            arrival=cfg.arrival,
-            diurnal_period=cfg.diurnal_period,
-            diurnal_amplitude=cfg.diurnal_amplitude,
-            weight_shift_every=cfg.weight_shift_every,
-            weight_shift_frac=cfg.weight_shift_frac,
-            broker_failure_every=cfg.broker_failure_every,
-            topic_storm_every=cfg.topic_storm_every,
-            storm_size=cfg.storm_size,
-        )
+        synth = _make_synth(cfg)
         base_argv = [
             "kafkabalancer", "-input-json",
             f"-serve-socket={sock}",
@@ -403,6 +458,11 @@ def _run_chaos(cfg: ReplayConfig, _log: LogFn) -> Dict[str, Any]:
         "-serve-max-queue=2",
         "-serve-tenant-inflight=8",
         "-serve-watchdog=30",
+        # the warm tier rides the chaos run too: the seeded
+        # spill_write_fail exercises its failure path, and the paging
+        # identity below must reconcile exactly THROUGH the chaos
+        f"-serve-session-spill-dir={os.path.join(tmpdir, 'spill')}",
+        "-serve-warm-cap-mb=64",
         *cfg.daemon_args,
     )
     spawned = _spawn_daemon(
@@ -410,22 +470,7 @@ def _run_chaos(cfg: ReplayConfig, _log: LogFn) -> Dict[str, Any]:
         lane_args=("-serve-lanes=0",),
     )
     try:
-        synth = FleetSynth(
-            seed=cfg.seed,
-            tenants=cfg.tenants,
-            base_partitions=cfg.base_partitions,
-            brokers=cfg.brokers,
-            replicas=cfg.replicas,
-            skew=cfg.skew,
-            arrival=cfg.arrival,
-            diurnal_period=cfg.diurnal_period,
-            diurnal_amplitude=cfg.diurnal_amplitude,
-            weight_shift_every=cfg.weight_shift_every,
-            weight_shift_frac=cfg.weight_shift_frac,
-            broker_failure_every=cfg.broker_failure_every,
-            topic_storm_every=cfg.topic_storm_every,
-            storm_size=cfg.storm_size,
-        )
+        synth = _make_synth(cfg)
         base_argv = [
             "kafkabalancer", "-input-json",
             f"-serve-socket={sock}",
@@ -587,6 +632,8 @@ def _run_chaos(cfg: ReplayConfig, _log: LogFn) -> Dict[str, Any]:
             for e in (tenants_block.get("top") or {}).values()
             if isinstance(e, dict)
         ) + int((tenants_block.get("other") or {}).get("sheds", 0) or 0)
+        paging = doc.get("paging") or {}
+
         identities = {
             "sheds_sum_matches": shed_total == sum(
                 int(v) for v in sheds_by_reason.values()
@@ -600,6 +647,10 @@ def _run_chaos(cfg: ReplayConfig, _log: LogFn) -> Dict[str, Any]:
                 + int(lh.get("abandoned", 0))
             ),
             "no_lane_still_quarantined": not lh.get("quarantined"),
+            # the warm tier's conservation identity holds THROUGH the
+            # chaos (the seeded spill_write_fail sits outside it by
+            # construction — a failed write never entered the tier)
+            "paging_conserved": _paging_identity_ok(paging),
         }
         chaos_ok = (
             alive
@@ -624,6 +675,11 @@ def _run_chaos(cfg: ReplayConfig, _log: LogFn) -> Dict[str, Any]:
             "requeues": int(lh.get("requeues", 0)),
             "recoveries": int(lh.get("recoveries", 0)),
             "abandoned": int(lh.get("abandoned", 0)),
+            # the warm tier under chaos: the seeded spill_write_fail
+            # lands here, and the spill/restore counters prove the
+            # tier kept its books through the storm
+            "spill_write_failures": _paging_count(paging, "write_failures"),
+            "spills": _paging_count(paging, "spills"),
             "daemon_alive_at_end": alive,
             "identities": identities,
             "ok": chaos_ok,
@@ -634,6 +690,7 @@ def _run_chaos(cfg: ReplayConfig, _log: LogFn) -> Dict[str, Any]:
             "scrape_schema": doc.get("schema"),
             "mode": "chaos",
             "chaos": chaos_block,
+            "restart": None,
             "seed": cfg.seed,
             "config": asdict(cfg),
             "requests_issued": total,
@@ -661,6 +718,254 @@ def _run_chaos(cfg: ReplayConfig, _log: LogFn) -> Dict[str, Any]:
                 for t in synth.tenants
             },
             "reconciled": chaos_ok and not errors,
+        }
+    finally:
+        if spawned is not None:
+            try:
+                sclient.request_shutdown(sock)
+                spawned.wait(15)
+            except Exception:
+                spawned.terminate()
+        import shutil
+
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def _run_restart(cfg: ReplayConfig, _log: LogFn) -> Dict[str, Any]:
+    """The ``--restart`` closed loop: a private daemon with a warm
+    spill dir is SIGKILLed after ``restart_kill_after`` requests (no
+    shutdown flush — recovery must work from the CONTINUOUS per-request
+    spill alone), restarted on the same socket + spill dir (the PR-12
+    stale-socket takeover sweeps the dead pidfile; the spill-dir claim
+    adopts the orphaned records), and the churn finishes through it.
+
+    Every request, both phases, is checked byte-for-byte against a
+    fresh ``-no-daemon`` oracle of the identical input — a restore may
+    be slow, cold, or corrupt-dropped, but NEVER wrong. The artifact's
+    ``restart`` block reports the restore-hit rate (digest-matching
+    requests answered from spill, i.e. no re-register storm), the
+    pre/post-restart latency percentiles (the restart-recovery curve
+    BENCH_r06 records), and the warm tier's conservation identity
+    reconciled exactly from the serve-stats/6 ``paging`` scrape.
+
+    ``chaos_faults`` arms the PRE-kill daemon (a seeded
+    ``spill_corrupt`` makes a tenant's recovery a cold-but-correct
+    miss); ``restart_faults`` arms the restarted one (default: one
+    ``restore_delay``, so the recovery path's chaos site fires in
+    every run)."""
+    from kafkabalancer_tpu import cli
+    from kafkabalancer_tpu.serve import client as sclient
+
+    tmpdir = tempfile.mkdtemp(prefix="kb-restart-")
+    sock = os.path.join(tmpdir, "kb.sock")
+    spill_dir = os.path.join(tmpdir, "spill")
+    spill_args: Tuple[str, ...] = (
+        f"-serve-session-spill-dir={spill_dir}",
+        "-serve-warm-cap-mb=64",
+    )
+    pre_args = spill_args + cfg.daemon_args
+    if cfg.chaos_faults:
+        pre_args += (f"-serve-faults={cfg.chaos_faults}",)
+    spawned = _spawn_daemon(sock, cfg.tenants, pre_args, _log)
+    kill_after = cfg.restart_kill_after or max(1, cfg.requests // 2)
+    kill_after = min(kill_after, max(1, cfg.requests - 1))
+    try:
+        synth = _make_synth(cfg)
+        base_argv = [
+            "kafkabalancer", "-input-json",
+            f"-serve-socket={sock}",
+            f"-max-reassign={cfg.max_reassign}",
+            # bounded per-request wait: the mid-churn kill must cost
+            # one fallback at worst, never an hour of hanging
+            "-serve-client-timeout=30",
+        ]
+        if cfg.solver != "greedy":
+            base_argv.append(f"-solver={cfg.solver}")
+
+        issued: Dict[str, int] = {t.name: 0 for t in synth.tenants}
+        wrong: List[Dict[str, Any]] = []
+        errors: List[Dict[str, Any]] = []
+        walls_pre: List[float] = []
+        walls_post: List[float] = []
+        first_post: Dict[str, float] = {}
+        pre_tenants: set = set()
+        post_tenants: set = set()
+        answered = 0
+
+        def one_step(step: int) -> Tuple[str, float, int]:
+            nonlocal answered
+            tenant, _fired = synth.step(step)
+            text = tenant.text()
+            argv = base_argv + [f"-serve-session={tenant.name}"]
+            # the oracle FIRST (mutates nothing): the same input
+            # planned in-process is the byte truth the served answer
+            # must match — through spill, restore, corruption and all
+            out_l, err_l = io.StringIO(), io.StringIO()
+            rc_l = cli.run(
+                io.StringIO(text), out_l, err_l, argv + ["-no-daemon"],
+            )
+            out_s, err_s = io.StringIO(), io.StringIO()
+            t0 = time.perf_counter()
+            rc_s = cli.run(io.StringIO(text), out_s, err_s, argv)
+            wall = time.perf_counter() - t0
+            issued[tenant.name] += 1
+            if rc_s != rc_l:
+                errors.append({
+                    "step": step, "tenant": tenant.name,
+                    "rc": rc_s, "rc_local": rc_l,
+                    "stderr_tail": err_s.getvalue()[-300:],
+                })
+            elif rc_s == 0:
+                answered += 1
+                if out_s.getvalue() != out_l.getvalue():
+                    wrong.append({"step": step, "tenant": tenant.name})
+                tenant.apply_plan(out_s.getvalue())
+            return tenant.name, wall, rc_s
+
+        t_run0 = time.perf_counter()
+        for step in range(kill_after):
+            name, wall, _rc = one_step(step)
+            walls_pre.append(wall)
+            pre_tenants.add(name)
+
+        # SIGKILL — no shutdown op, no flush, no pidfile cleanup: the
+        # restart must recover from the continuous spill plus the
+        # PR-12 takeover rules alone
+        pid = spawned.pid
+        spawned.kill()
+        spawned.wait(15)
+        _log(f"replay: SIGKILLed daemon pid {pid} after {kill_after} requests")
+        post_args = spill_args + cfg.daemon_args
+        if cfg.restart_faults:
+            post_args += (f"-serve-faults={cfg.restart_faults}",)
+        spawned = _spawn_daemon(sock, cfg.tenants, post_args, _log)
+
+        for step in range(kill_after, cfg.requests):
+            name, wall, rc = one_step(step)
+            walls_post.append(wall)
+            post_tenants.add(name)
+            if rc == 0:
+                first_post.setdefault(name, wall)
+        wall_s = time.perf_counter() - t_run0
+
+        doc = sclient.fetch_stats(sock) or {}
+        paging = doc.get("paging") or {}
+        tenants_block = doc.get("tenants") or {}
+        sessions = doc.get("sessions") or {}
+        flt = doc.get("faults") or {}
+
+        def pg(key: str) -> int:
+            return _paging_count(paging, key)
+
+        identity_ok = _paging_identity_ok(paging)
+        # every post-restart tenant that had pre-kill traffic owns a
+        # spilled record, so its first post-restart request attempts
+        # exactly one restore: a validated read (restores) or a pruned
+        # corrupt one (corrupt_drops)
+        expected = len(pre_tenants & post_tenants)
+        attempts = pg("restores") + pg("corrupt_drops")
+        restore_hits = pg("restore_hits")
+        ok = (
+            not wrong
+            and not errors
+            and identity_ok
+            and sclient.daemon_alive(sock) is not None
+        )
+        restart_block = {
+            "kill_after": kill_after,
+            "spill_dir_reused": True,
+            "faults_pre": cfg.chaos_faults or None,
+            "faults_post": cfg.restart_faults or None,
+            "faults_fired_post": flt.get("fired") or {},
+            "answered": answered,
+            "parity_checked": answered,
+            "wrong_plans": wrong,
+            "spills": pg("spills"),
+            "adopted": pg("adopted"),
+            "restores": pg("restores"),
+            "restore_hits": restore_hits,
+            "corrupt_drops": pg("corrupt_drops"),
+            "evictions": pg("evictions"),
+            "write_failures": pg("write_failures"),
+            "warm_entries": pg("warm_entries"),
+            "warm_bytes": pg("warm_bytes"),
+            "paging_identity_ok": identity_ok,
+            "expected_restore_attempts": expected,
+            "restore_attempts": attempts,
+            "restore_attempts_ok": attempts == expected,
+            # the headline: digest-matching requests answered from
+            # spill — 1.0 means the whole fleet came back without a
+            # single re-register
+            "restore_hit_rate": (
+                round(restore_hits / expected, 4) if expected else None
+            ),
+            # re-register storm indicators on the restarted daemon: a
+            # cold miss (absent/corrupt record) answers the plan-delta
+            # with resync:full and the client re-registers — counted
+            # as a session_absent fallback + a register
+            "resyncs_full_post": int(sessions.get("resyncs_full", 0)),
+            "cold_misses_post": int(
+                (doc.get("fallbacks") or {}).get("session_absent", 0)
+            ),
+            "registered_post": int(sessions.get("registered", 0)),
+            # the restart-recovery curve: client-side percentiles
+            # before the kill vs after it (the first post-restart
+            # request per tenant pays the restore + re-settle)
+            "pre_restart_p50_s": round(
+                _percentile_via_buckets(walls_pre, 0.50), 9
+            ) if walls_pre else None,
+            "pre_restart_p95_s": round(
+                _percentile_via_buckets(walls_pre, 0.95), 9
+            ) if walls_pre else None,
+            "post_restart_p50_s": round(
+                _percentile_via_buckets(walls_post, 0.50), 9
+            ) if walls_post else None,
+            "post_restart_p95_s": round(
+                _percentile_via_buckets(walls_post, 0.95), 9
+            ) if walls_post else None,
+            "first_post_restart_max_s": (
+                round(max(first_post.values()), 6) if first_post else None
+            ),
+            "daemon_alive_at_end": sclient.daemon_alive(sock) is not None,
+            "ok": ok,
+        }
+        total = sum(issued.values())
+        return {
+            "schema": REPLAY_SCHEMA,
+            "scrape_schema": doc.get("schema"),
+            "mode": "restart",
+            "chaos": None,
+            "restart": restart_block,
+            "seed": cfg.seed,
+            "config": asdict(cfg),
+            "requests_issued": total,
+            "request_errors": errors,
+            "wall_s": round(wall_s, 3),
+            "throughput_rps": (
+                round(total / wall_s, 3) if wall_s > 0 else None
+            ),
+            "events": dict(synth.events),
+            "per_tenant": {
+                t.name: {
+                    "issued": issued[t.name],
+                    "daemon_requests": int(
+                        (
+                            (tenants_block.get("top") or {})
+                            .get(t.name) or {}
+                        ).get("requests", 0)
+                    ),
+                    "restores": int(
+                        (
+                            (tenants_block.get("top") or {})
+                            .get(t.name) or {}
+                        ).get("restores", 0)
+                    ),
+                    "moves_applied": t.moves_applied,
+                    "partitions": len(t.rows),
+                }
+                for t in synth.tenants
+            },
+            "reconciled": ok,
         }
     finally:
         if spawned is not None:
@@ -803,6 +1108,7 @@ def _build_artifact(
         "scrape_schema": (doc or {}).get("schema"),
         "mode": "churn",
         "chaos": None,
+        "restart": None,
         "seed": cfg.seed,
         "config": asdict(cfg),
         "requests_issued": total,

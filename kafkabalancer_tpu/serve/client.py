@@ -632,8 +632,10 @@ def fetch_trace(
 def release_session(
     path: str, tenant: str, timeout: float = 10.0
 ) -> Optional[int]:
-    """Drop a tenant's resident sessions on a live v2 daemon; the
-    number released, or None when no v2 daemon answers."""
+    """Drop a tenant's resident sessions on a live v2 daemon — hot
+    residents AND warm spill records (a released tenant must not be
+    silently restorable from disk); the total number released across
+    both tiers, or None when no v2 daemon answers."""
     sock = _connect(path, CONNECT_TIMEOUT_S)
     if sock is None:
         return None
@@ -655,7 +657,9 @@ def release_session(
         resp = read_frame2(sock)
         if resp is None or not resp[0].get("ok"):
             return None
-        return int(resp[0].get("released", 0))
+        return int(resp[0].get("released", 0)) + int(
+            resp[0].get("released_warm", 0) or 0
+        )
     except Exception:
         return None
     finally:

@@ -73,6 +73,7 @@ from kafkabalancer_tpu.obs.flight import PHASE_OF_SPAN, FlightRecorder
 from kafkabalancer_tpu.obs.hist import OTHER_LABEL
 from kafkabalancer_tpu.obs.trace import Span
 from kafkabalancer_tpu.serve import faults
+from kafkabalancer_tpu.serve import spill as spill_mod
 from kafkabalancer_tpu.serve.admission import AdmissionController
 from kafkabalancer_tpu.serve.devmem import device_memory_stats
 from kafkabalancer_tpu.serve.protocol import (
@@ -105,7 +106,7 @@ _TENANT_HIST_FAMILIES = ("serve.request_s", "serve.phase.queue")
 _TENANT_COUNTER_FAMILIES = (
     "serve.requests", "serve.crashed_requests", "serve.delta_hits",
     "serve.resyncs_rows", "serve.resyncs_full", "serve.fallbacks",
-    "serve.sheds",
+    "serve.sheds", "serve.restores",
 )
 
 
@@ -391,6 +392,8 @@ class Daemon:
         tenant_inflight: int = 64,
         watchdog_s: float = 120.0,
         faults_spec: str = "",
+        spill_dir: str = "",
+        warm_cap_mb: float = 256.0,
     ) -> None:
         self.socket_path = socket_path
         self.idle_timeout = idle_timeout
@@ -438,6 +441,15 @@ class Daemon:
         from kafkabalancer_tpu.serve.sessions import SessionStore
 
         self.tensorize_cache = TensorizeRowCache()
+        # the warm session tier (serve/spill.py): evicted/expired/
+        # flushed sessions spill to versioned checksummed records under
+        # spill_dir (empty = tier disabled, the pre-durability shape);
+        # the store itself is opened in serve_forever — opening claims
+        # the directory, and a CONSTRUCTED-but-never-served daemon must
+        # not leave pidfiles behind
+        self.spill_dir = spill_dir
+        self.warm_cap_mb = warm_cap_mb
+        self.spill: Optional[Any] = None
         # resident cluster sessions (protocol v2; serve/sessions.py):
         # LRU-capped per-tenant parsed/settled state + primed row cache
         self.sessions = SessionStore(cap=session_cap, idle_s=session_idle_s)
@@ -693,7 +705,16 @@ class Daemon:
             attrs["serve.sessions"] = float(ss["count"])
             attrs["serve.session_bytes"] = float(ss["bytes"])
             attrs["serve.delta_hits"] = float(ss["delta_hits"])
-            if ctx.kind in ("delta", "rebuild"):
+            if getattr(ctx, "restored", False) and ctx.kind in (
+                "delta", "rebuild"
+            ):
+                # answered from a warm spill record with NO resync —
+                # the restart-recovery acceptance gauge
+                # (docs/serving.md); a restored session whose digest
+                # drifted takes the rows path and is a restore but not
+                # a hit, matching paging.restore_hits exactly
+                attrs["serve.restore_hit"] = True
+            elif ctx.kind in ("delta", "rebuild"):
                 attrs["serve.delta_hit"] = True
         sched = self._coalescer
         if lane is not None and hasattr(sched, "stats"):
@@ -885,6 +906,19 @@ class Daemon:
                         )
                     except Exception:
                         pass  # bucket stays unmemoized; probe-only loss
+                if self.spill is not None:
+                    # the CONTINUOUS spill: every clean session request
+                    # refreshes the warm record (skipped when the
+                    # digest has not moved), so a SIGKILL at any later
+                    # instant loses at most the in-flight request —
+                    # restart recovery works from exactly this write.
+                    # One O(P) struct pack + an atomic tmp+rename per
+                    # completed request; a failed write only costs
+                    # durability, never the answer (write_failures)
+                    self.spill.spill(
+                        (ctx.session.tenant, ctx.session.sig),
+                        ctx.session,
+                    )
             self.flight.record_request({
                 "req": seq,
                 "t": round(time.time(), 3),
@@ -1164,6 +1198,15 @@ class Daemon:
             # resident cluster sessions (serve/sessions.py): count,
             # resident bytes, delta hits/resyncs — serve-stats/3
             "sessions": self.sessions.stats(),
+            # the warm session tier (serve/spill.py; serve-stats/6):
+            # spill/restore/corrupt-drop counters under the
+            # conservation identity spills + adopted == restores +
+            # corrupt_drops + evictions + warm_entries, plus the live
+            # warm footprint; key set identical with the tier disabled
+            "paging": (
+                self.spill.stats() if self.spill is not None
+                else spill_mod.SpillStore.disabled_stats()
+            ),
             # daemon-observed fallback/resync reasons, by name
             "fallbacks": fallbacks,
             # overload protection (serve-stats/5): fair-queue occupancy,
@@ -1269,16 +1312,21 @@ class Daemon:
             return int(fam["labels"].get(label, 0))
 
         by_tenant = self.sessions.stats_by_tenant()
-        # the rollup's session footprint: everything resident that is
-        # NOT attributed to a live top-K label (demoted tenants keep
-        # their sessions; the table must still reconcile with the
-        # global "sessions" block)
+        # the rollup's session footprint: everything resident (hot OR
+        # warm) that is NOT attributed to a live top-K label (demoted
+        # tenants keep their sessions; the table must still reconcile
+        # with the global "sessions"/"paging" blocks)
         top_labels = set(req_fam["labels"])
-        rolled = {"sessions": 0, "bytes": 0}
+        rolled = {
+            "sessions": 0, "bytes": 0,
+            "warm_sessions": 0, "warm_bytes": 0,
+        }
         for t_label, s in by_tenant.items():
             if t_label not in top_labels:
                 rolled["sessions"] += s["sessions"]
                 rolled["bytes"] += s["bytes"]
+                rolled["warm_sessions"] += s.get("warm_sessions", 0)
+                rolled["warm_bytes"] += s.get("warm_bytes", 0)
 
         def entry(label: str, hist: Optional[Dict[str, Any]]) -> Dict[str, Any]:
             sess = rolled if label == OTHER_LABEL else by_tenant.get(
@@ -1298,15 +1346,21 @@ class Daemon:
                 "resyncs_full": cval("serve.resyncs_full", label),
                 "fallbacks": cval("serve.fallbacks", label),
                 "sheds": cval("serve.sheds", label),
+                "restores": cval("serve.restores", label),
                 "sessions": int(sess.get("sessions", 0)),
                 "session_bytes": int(sess.get("bytes", 0)),
+                # the warm tier column: a fully demoted tenant keeps
+                # its byte attribution here instead of vanishing
+                "warm_sessions": int(sess.get("warm_sessions", 0)),
+                "warm_bytes": int(sess.get("warm_bytes", 0)),
             }
 
         other = entry(OTHER_LABEL, req_fam.get("other"))
         has_other = req_fam.get("other") is not None or any(
             other[k] for k in (
                 "requests", "crashed", "delta_hits", "resyncs_rows",
-                "resyncs_full", "fallbacks", "sheds",
+                "resyncs_full", "fallbacks", "sheds", "restores",
+                "warm_sessions",
             )
         )
         return {
@@ -1409,6 +1463,61 @@ class Daemon:
             "stderr": str(resp.get("stderr", "")),
         }, str(resp.get("stdout", "")).encode("utf-8")
 
+    def _checkout_or_restore(
+        self, key: Tuple[str, str], tenant: str
+    ) -> Tuple[Optional[Any], bool, bool]:
+        """Claim the hot session for ``key`` — or, when the hot tier
+        has none and a warm tier is attached, RESTORE the spilled
+        record into a fresh hot session (claimed before it is
+        published, so no concurrent request can half-see it).
+
+        Returns ``(session, busy, restored)``: a corrupt/absent warm
+        record is simply ``(None, False, False)`` — a clean cold miss,
+        the caller answers ``resync: full`` exactly as before the
+        tier existed."""
+        from kafkabalancer_tpu.serve.sessions import session_from_rows
+
+        sess, busy = self.sessions.checkout(key)
+        if sess is not None or busy:
+            return sess, busy, False
+        if self.spill is None:
+            return None, False, False
+        # snapshot the tenant's release generation BEFORE reading the
+        # record: a `release` op racing this restore must win — the
+        # restored session is dropped, never served
+        gen0 = self.sessions.release_gen(tenant)
+        loaded = self.spill.load(key)
+        if loaded is None:
+            return None, False, False
+        hdr, rows = loaded
+        version = hdr.get("version")
+        sess = session_from_rows(
+            tenant, key[1],
+            version if isinstance(version, int) else 1,
+            rows,
+        )
+        sess.lock.acquire()
+        sess.in_use = True
+        if not self.sessions.adopt(key, sess):
+            # a concurrent register won the key during the disk read:
+            # the fresh session holds newer state — drop the restore
+            # and claim the winner instead
+            sess.in_use = False
+            sess.lock.release()
+            hot, busy = self.sessions.checkout(key)
+            return hot, busy, False
+        if self.sessions.release_gen(tenant) != gen0:
+            # the tenant was released while we were restoring: honor
+            # the forget — sweep the just-adopted session back out and
+            # answer a clean cold miss (the record itself is already
+            # consumed and counted); only THIS session is dropped, so
+            # a fresh register that beat us to the key survives
+            self.sessions.discard(key, sess)
+            self.sessions.checkin(sess)
+            return None, False, False
+        obs.metrics.tenant_count("serve.restores", tenant or OTHER_LABEL)
+        return sess, False, True
+
     def _session_op(
         self, op: str, hdr: Dict[str, Any], blob: bytes, argv: List[str]
     ) -> Tuple[Dict[str, Any], bytes]:
@@ -1466,7 +1575,7 @@ class Daemon:
 
         if op == "plan-delta":
             digest = str(hdr.get("digest", ""))
-            sess, busy = self.sessions.checkout(key)
+            sess, busy, restored = self._checkout_or_restore(key, tenant)
             if sess is None:
                 self._count_fallback(
                     "session_busy" if busy else "session_absent", tenant
@@ -1474,15 +1583,30 @@ class Daemon:
                 return _resync_full()
             try:
                 if sess.digest is not None and digest == sess.digest:
-                    kind = "rebuild" if sess.universe_dirty else "delta"
+                    # a just-restored session has no settled list yet;
+                    # like universe_dirty, it re-derives one from the
+                    # raw shadow (the "rebuild" kind) — still no state
+                    # transfer, still one request back to steady state
+                    kind = (
+                        "rebuild"
+                        if restored or sess.universe_dirty or sess.pl is None
+                        else "delta"
+                    )
                     ctx = PlanSessionContext(
                         kind, sess,
                         resident_pl=sess.pl if kind == "delta" else None,
+                        restored=restored,
                     )
-                    self.sessions.count_delta_hit()
-                    obs.metrics.tenant_count(
-                        "serve.delta_hits", tenant or OTHER_LABEL
-                    )
+                    if restored:
+                        # the acceptance counter: a digest-matching
+                        # request answered from the warm tier, no
+                        # re-register storm
+                        self.spill.note_restore_hit()
+                    else:
+                        self.sessions.count_delta_hit()
+                        obs.metrics.tenant_count(
+                            "serve.delta_hits", tenant or OTHER_LABEL
+                        )
                     req = PlanRequest(
                         argv, None, tenant, deadline=deadline
                     )
@@ -1501,7 +1625,10 @@ class Daemon:
 
         if op == "plan-rows":
             digest = str(hdr.get("digest", ""))
-            sess, busy = self.sessions.checkout(key)
+            # restore applies here too: the row diff the client built
+            # against a (possibly restored) hash table patches onto the
+            # restored raw shadow the same as onto a hot one
+            sess, busy, restored = self._checkout_or_restore(key, tenant)
             if sess is None:
                 self._count_fallback(
                     "session_busy" if busy else "session_absent", tenant
@@ -1529,7 +1656,7 @@ class Daemon:
                 obs.metrics.tenant_count(
                     "serve.resyncs_rows", tenant or OTHER_LABEL
                 )
-                ctx = PlanSessionContext("rows", sess)
+                ctx = PlanSessionContext("rows", sess, restored=restored)
                 req = PlanRequest(argv, None, tenant, deadline=deadline)
                 req.session_ctx = ctx
                 return self._v2_plan_resp(self._dispatch_plan(req))
@@ -1578,10 +1705,29 @@ class Daemon:
             elif op == "stats":
                 write_frame2(conn, {**self._stats_doc(), "v": PROTO_V2})
             elif op == "release":
-                n = self.sessions.release(str(hdr.get("tenant", "")))
+                # an explicit forget covers BOTH tiers: dropping only
+                # the hot session would leave a warm record that
+                # silently restores the "released" state later. Warm
+                # FIRST — once the records are gone no new restore can
+                # begin, and the hot sweep (which also bumps the
+                # release generation and marks in-flight sessions
+                # `released`) then catches everything resident
+                rel_tenant = str(hdr.get("tenant", ""))
+                warm = (
+                    self.spill.release(rel_tenant)
+                    if self.spill is not None else 0
+                )
+                n = self.sessions.release(rel_tenant)
+                if self.spill is not None:
+                    # second warm sweep AFTER the hot sweep marked
+                    # in-flight sessions `released`: a continuous
+                    # spill that indexed its record between the first
+                    # sweep and the mark would otherwise survive both
+                    # its own released re-check and the sweep above
+                    warm += self.spill.release(rel_tenant)
                 write_frame2(conn, {
                     "v": PROTO_V2, "ok": True, "op": "release",
-                    "released": n,
+                    "released": n, "released_warm": warm,
                 })
             elif op == "shutdown":
                 write_frame2(conn, {"v": PROTO_V2, "ok": True})
@@ -1734,47 +1880,12 @@ class Daemon:
                 pass
 
     # -- lifecycle -------------------------------------------------------
-    @staticmethod
-    def _pid_alive(pid: int) -> bool:
-        """Is ``pid`` a live process? (signal 0 probe; a process we may
-        not signal still counts as alive). A ZOMBIE is dead for our
-        purposes — a SIGKILL'd daemon whose parent never reaped it
-        (containers without an init reaper) still answers the signal
-        probe but cannot own a socket, and must not block a restart."""
-        if pid <= 0:
-            return False
-        try:
-            os.kill(pid, 0)
-        except ProcessLookupError:
-            return False
-        except PermissionError:
-            return True
-        except OSError:
-            return False
-        try:
-            with open(f"/proc/{pid}/stat") as f:
-                # field 3, after the parenthesized comm (which may
-                # itself contain spaces/parens): parse from the LAST ')'
-                state = f.read().rsplit(")", 1)[1].split()[0]
-            return state != "Z"
-        except (OSError, IndexError):
-            return True  # no procfs: the signal probe's verdict stands
-
-    @staticmethod
-    def _pid_looks_like_daemon(pid: int) -> bool:
-        """Does ``pid``'s command line look like one of OUR daemons?
-        Guards the takeover refusal against PID RECYCLING: a SIGKILL'd
-        daemon's recorded pid can be reborn as an unrelated process,
-        and refusing forever over a stranger would re-create the
-        manual-cleanup failure mode this preflight exists to remove.
-        Unreadable cmdline (no procfs, permissions) says True —
-        refusing when unsure beats hijacking a live daemon."""
-        try:
-            with open(f"/proc/{pid}/cmdline", "rb") as f:
-                cmd = f.read()
-        except OSError:
-            return True
-        return b"kafkabalancer" in cmd or b"-serve" in cmd
+    # the ONE pidfile-verification rule set (liveness probe + pid-
+    # recycling guard), shared with the warm tier's spill-directory
+    # claim — the socket takeover and the spill-dir takeover cannot
+    # drift (serve/spill.py holds the implementations)
+    _pid_alive = staticmethod(spill_mod.pid_alive)
+    _pid_looks_like_daemon = staticmethod(spill_mod.pid_looks_like_daemon)
 
     def _pidfile_owner(self) -> Optional[int]:
         """The pid recorded next to the socket, or None."""
@@ -1845,17 +1956,40 @@ class Daemon:
 
     def serve_forever(self) -> int:
         """Run until shutdown/idle-timeout/signal; 0 on a clean exit,
-        3 when the socket is unusable (live daemon, bind failure)."""
+        3 when the socket or spill dir is unusable (live daemon, bind
+        failure, live spill-dir owner)."""
         err = self._preflight_socket()
         if err is not None:
             self._log(f"serve: {err}")
             return 3
+        if self.spill_dir:
+            # the warm tier claims its directory with the same
+            # pidfile-verification rules as the socket: records from a
+            # DEAD previous owner are adopted (SIGKILL recovery), its
+            # half-written *.tmp orphans swept, a LIVE owner refused
+            store = spill_mod.SpillStore(
+                self.spill_dir, cap_mb=self.warm_cap_mb, log=self._log,
+            )
+            err = store.open()
+            if err is not None:
+                self._log(f"serve: {err}")
+                return 3
+            self.spill = store
+            self.sessions.spill = store
+            st = store.stats()
+            self._log(
+                f"serve: warm session tier on {self.spill_dir} "
+                f"(cap {st['cap_bytes'] >> 20}MB, "
+                f"{st['warm_entries']} records adopted)"
+            )
         listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         try:
             listener.bind(self.socket_path)
         except OSError as exc:
             self._log(f"serve: cannot bind {self.socket_path}: {exc}")
             listener.close()
+            if self.spill is not None:
+                self.spill.close()
             return 3
         listener.listen(16)
         listener.settimeout(0.5)
@@ -1898,6 +2032,8 @@ class Daemon:
             except ValueError as exc:
                 self._log(f"serve: bad -serve-faults spec: {exc}")
                 listener.close()
+                if self.spill is not None:
+                    self.spill.close()
                 for path in (self.socket_path, pid_path):
                     if path:
                         try:
@@ -1978,6 +2114,20 @@ class Daemon:
             self._admission.stop()
             if self._coalescer is not None:
                 self._coalescer.stop()
+            if self.spill is not None:
+                # the SHUTDOWN FLUSH (idle timeout, SIGTERM, and the
+                # shutdown op all route through here): with the
+                # dispatchers drained, every idle resident spills so
+                # the next daemon restores instead of re-registering.
+                # SIGKILL never reaches this line — that path recovers
+                # from the continuous per-request spill instead.
+                flushed = self.sessions.flush_spill()
+                if flushed:
+                    self._log(
+                        f"serve: flushed {flushed} resident session"
+                        f"{'s' if flushed != 1 else ''} to the warm tier"
+                    )
+                self.spill.close()
             faults.disarm()
             obs.tracer.set_observer(None)
             obs.set_shared_registry(False)

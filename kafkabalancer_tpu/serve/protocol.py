@@ -72,7 +72,17 @@ v2-only session ops (serve/sessions.py, docs/serving.md):
 - ``plan-rows``  — the row-level re-sync: the blob is the packed
   changed-row records (serve/state.py); the daemon patches its
   resident raw rows, re-settles, and plans.
-- ``release``    — drop a tenant's resident sessions.
+- ``release``    — drop a tenant's resident sessions, hot AND warm
+  (the response reports both: ``released`` / ``released_warm``).
+
+Session durability (serve/spill.py, docs/serving.md § Session
+durability): with ``-serve-session-spill-dir`` set, evicted/expired/
+flushed sessions persist as checksummed disk records, and a
+``plan-delta``/``plan-rows`` for an absent session first tries to
+RESTORE the spilled record — the ``resync: "full"`` answer only
+remains for true cold misses (no record, corrupt record, foreign
+record). The wire shapes above are unchanged; durability is invisible
+to the client except as fewer full resyncs.
 
 Nothing in this module (or ``serve.client``) imports jax: the client
 side of a forwarded invocation must stay as light as an error exit —
@@ -109,7 +119,14 @@ PROTO_V2 = 2
 #     requeues / recoveries, quarantined lane list), "faults" (the
 #     chaos seam's armed spec + fired counts), per-tenant "sheds", and
 #     the flight recorder's "autodumps_suppressed"
-STATS_SCHEMA_VERSION = 5
+# v6: + "paging" (the warm session tier, serve/spill.py: spills /
+#     adopted / restores / restore_hits / corrupt_drops / evictions /
+#     write_failures under the conservation identity spills + adopted
+#     == restores + corrupt_drops + evictions + warm_entries, plus the
+#     live warm_bytes/warm_entries footprint; same key set with the
+#     tier disabled), and per-tenant "restores" / "warm_sessions" /
+#     "warm_bytes" in the tenants block
+STATS_SCHEMA_VERSION = 6
 STATS_SCHEMA = f"kafkabalancer-tpu.serve-stats/{STATS_SCHEMA_VERSION}"
 
 # a frame larger than this is a protocol error, not a payload: the

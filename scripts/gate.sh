@@ -51,7 +51,7 @@
 #                         serve.delta_hits >= 1 and session bytes
 #                         present via -serve-stats-json
 #  11. replay smoke     — seeded 3-tenant churn replay against a
-#                         private daemon: serve-stats/5 schema,
+#                         private daemon: serve-stats/6 schema,
 #                         per-tenant counts reconciling exactly with
 #                         the driver, scrape-vs-flight latency within
 #                         one histogram bucket, plan byte parity vs
@@ -64,7 +64,13 @@
 #                         EVERY answered request, shed/requeue/
 #                         quarantine accounting reconciled exactly,
 #                         daemon alive at the end
-#  13. tier-1 tests     — the ROADMAP.md verify suite (skip: --no-tests)
+#  13. session          — register -> delta -> SIGKILL -> restart ->
+#      durability smoke   delta answered from a warm spill restore
+#                         (restore_hits via -serve-stats-json, byte
+#                         parity vs -no-daemon at every step), plus a
+#                         seeded spill_corrupt restart replay that
+#                         must answer cold-but-correct
+#  14. tier-1 tests     — the ROADMAP.md verify suite (skip: --no-tests)
 #
 # Exit 0 only when every stage that ran passed. Optional tools that are
 # not installed SKIP with a notice instead of failing: the gate must be
@@ -511,7 +517,7 @@ if [ "$cb_ready" = 1 ]; then
       -serve-stats-json 2>/dev/null | "$PYTHON" -c '
 import json, sys
 p = json.loads(sys.stdin.read())
-assert p["schema"] == "kafkabalancer-tpu.serve-stats/5", p.get("schema")
+assert p["schema"] == "kafkabalancer-tpu.serve-stats/6", p.get("schema")
 assert "serve.request_s" in p["hists"], sorted(p["hists"])
 assert "serve.phase.parse" in p["hists"], sorted(p["hists"])
 assert isinstance(p["memory"], list) and p["memory"], p.get("memory")
@@ -682,7 +688,7 @@ step "replay smoke (seeded 3-tenant churn, per-tenant reconciliation)"
 # docs/observability.md § Per-tenant attribution): a seeded 3-tenant
 # churn run — weight shifts, a topic storm, a broker failure — driven
 # closed-loop through the real client against a private self-spawned
-# daemon. Asserts the serve-stats/5 scrape schema, per-tenant request
+# daemon. Asserts the serve-stats/6 scrape schema, per-tenant request
 # counts reconciling EXACTLY with the driver's issued counts, the
 # scrape's per-tenant percentiles agreeing with the flight recorder's
 # tenant-labeled request log within one histogram bucket, and plan
@@ -696,8 +702,8 @@ if JAX_PLATFORMS=cpu "$PYTHON" -m kafkabalancer_tpu.replay \
   && "$PYTHON" -c '
 import json
 a = json.load(open("'"$rp_tmp"'/replay.json"))
-assert a["schema"] == "kafkabalancer-tpu.replay/2", a["schema"]
-assert a["scrape_schema"] == "kafkabalancer-tpu.serve-stats/5", (
+assert a["schema"] == "kafkabalancer-tpu.replay/3", a["schema"]
+assert a["scrape_schema"] == "kafkabalancer-tpu.serve-stats/6", (
     a["scrape_schema"])
 assert a["reconciled_counts"] is True
 assert a["latency_checked"] is True
@@ -726,7 +732,7 @@ step "overload + chaos smoke (seeded fault injection, sheds, parity)"
 # a live retry-after estimate), EVERY answered plan byte-identical to
 # -no-daemon, no tenant starved to zero, the daemon's
 # shed/requeue/quarantine accounting reconciled exactly in the
-# serve-stats/5 scrape, and the daemon alive at the end.
+# serve-stats/6 scrape, and the daemon alive at the end.
 ch_tmp=$(mktemp -d)
 if JAX_PLATFORMS=cpu "$PYTHON" -m kafkabalancer_tpu.replay --chaos \
     --tenants 3 --requests 24 --seed 7 --arrival uniform --check \
@@ -735,7 +741,7 @@ if JAX_PLATFORMS=cpu "$PYTHON" -m kafkabalancer_tpu.replay --chaos \
 import json
 a = json.load(open("'"$ch_tmp"'/chaos.json"))
 assert a["mode"] == "chaos", a["mode"]
-assert a["scrape_schema"] == "kafkabalancer-tpu.serve-stats/5"
+assert a["scrape_schema"] == "kafkabalancer-tpu.serve-stats/6"
 c = a["chaos"]
 assert c["ok"] is True, c
 assert c["wrong_plans"] == [], c["wrong_plans"]
@@ -763,6 +769,137 @@ else
   fail=1
 fi
 rm -rf "$ch_tmp"
+
+step "session durability smoke (register -> delta -> SIGKILL -> restore)"
+# The warm session tier end to end (ISSUE 14, docs/serving.md §
+# Session durability): an outer loop registers + takes one delta move
+# against a spill-enabled daemon, the daemon is SIGKILLed (no shutdown
+# flush — recovery must work from the continuous per-request spill),
+# a second daemon takes over the same socket + spill dir (the PR-12
+# pidfile-verified sweep), and the tenant's next digest-matching
+# request restores from the spilled record: restore_hits >= 1 in the
+# -serve-stats-json paging block, the conservation identity exact, and
+# plan bytes identical to -no-daemon at EVERY step.
+sd_tmp=$(mktemp -d "${TMPDIR:-/tmp}/kb-gate-spill.XXXXXX")
+sd_sock="$sd_tmp/kb.sock"
+sd_spill="$sd_tmp/spill"
+cp tests/data/test.json "$sd_tmp/cluster.json"
+sd_daemon() {
+  JAX_PLATFORMS=cpu JAX_COMPILATION_CACHE_DIR="$sd_tmp" \
+    "$PYTHON" -m kafkabalancer_tpu -serve "-serve-socket=$sd_sock" \
+    "-serve-session-spill-dir=$sd_spill" -serve-warm-cap-mb=64 \
+    -serve-lanes=1 -serve-idle-timeout=180 >>"$sd_tmp/daemon.log" 2>&1 &
+  sd_pid=$!
+  sd_ready=0
+  for _ in $(seq 1 60); do
+    if "$PYTHON" -c "import sys
+from kafkabalancer_tpu.serve.client import daemon_alive
+sys.exit(0 if daemon_alive('$sd_sock') else 1)" 2>/dev/null; then
+      sd_ready=1; break
+    fi
+    sleep 0.25
+  done
+}
+sd_step() {
+  # one outer-loop step: served plan + -no-daemon oracle, byte parity,
+  # then apply the emitted moves to the cluster state
+  stp=$1
+  JAX_PLATFORMS=cpu "$PYTHON" -m kafkabalancer_tpu -input-json \
+    -input "$sd_tmp/cluster.json" -serve-session=gate-durable \
+    -max-reassign=1 -no-daemon >"$sd_tmp/local$stp.out" 2>/dev/null
+  JAX_PLATFORMS=cpu "$PYTHON" -m kafkabalancer_tpu -input-json \
+    -input "$sd_tmp/cluster.json" -serve-session=gate-durable \
+    -max-reassign=1 "-serve-socket=$sd_sock" \
+    >"$sd_tmp/served$stp.out" 2>/dev/null
+  if ! cmp -s "$sd_tmp/served$stp.out" "$sd_tmp/local$stp.out"; then
+    echo "durability step $stp parity FAILED"; sd_ok=0
+  fi
+  "$PYTHON" - "$sd_tmp" "$stp" <<'PYEOF'
+import json, sys
+tmp, stp = sys.argv[1], sys.argv[2]
+state = json.load(open(f"{tmp}/cluster.json"))
+plan = json.load(open(f"{tmp}/local{stp}.out"))
+for entry in plan.get("partitions") or []:
+    for row in state["partitions"]:
+        if (row["topic"] == entry["topic"]
+                and row["partition"] == entry["partition"]):
+            row["replicas"] = list(entry["replicas"])
+            break
+json.dump(state, open(f"{tmp}/cluster.json", "w"))
+PYEOF
+}
+sd_daemon
+if [ "$sd_ready" = 1 ]; then
+  sd_ok=1
+  sd_step 0   # register
+  sd_step 1   # delta fast path (also the spill the recovery will use)
+  kill -9 "$sd_pid" 2>/dev/null
+  wait "$sd_pid" 2>/dev/null
+  sd_daemon   # same socket + spill dir: takeover + record adoption
+  if [ "$sd_ready" = 1 ]; then
+    sd_step 2  # must restore from spill, byte-identical
+    if [ "$sd_ok" = 1 ] && "$PYTHON" -m kafkabalancer_tpu \
+        "-serve-socket=$sd_sock" -serve-stats-json 2>/dev/null \
+        | "$PYTHON" -c '
+import json, sys
+p = json.loads(sys.stdin.read())
+pg = p["paging"]
+assert pg["enabled"] is True, pg
+assert pg["restore_hits"] >= 1, pg
+assert pg["adopted"] >= 1, pg
+assert pg["spills"] + pg["adopted"] == (
+    pg["restores"] + pg["corrupt_drops"] + pg["evictions"]
+    + pg["warm_entries"]), pg
+assert p["sessions"]["count"] >= 1, p["sessions"]
+'; then
+      echo "SIGKILL -> restart -> spill restore: parity + restore_hits + identity: OK"
+    else
+      echo "session durability smoke FAILED (see $sd_tmp)"; fail=1
+    fi
+    "$PYTHON" -c "from kafkabalancer_tpu.serve.client import request_shutdown
+request_shutdown('$sd_sock')" || true
+    wait "$sd_pid" 2>/dev/null
+  else
+    echo "restarted daemon never became ready (see $sd_tmp/daemon.log)"
+    tail -20 "$sd_tmp/daemon.log" 2>/dev/null
+    kill "$sd_pid" 2>/dev/null
+    fail=1
+  fi
+else
+  echo "daemon never became ready (see $sd_tmp/daemon.log)"
+  tail -20 "$sd_tmp/daemon.log" 2>/dev/null
+  kill "$sd_pid" 2>/dev/null
+  fail=1
+fi
+rm -rf "$sd_tmp"
+
+# the corrupt-record half: a seeded spill_corrupt restart replay must
+# answer every request cold-but-correct (record pruned + counted,
+# plan bytes identical, paging identity exact) — driven through the
+# replay harness's --restart mode
+sc_tmp=$(mktemp -d)
+if JAX_PLATFORMS=cpu "$PYTHON" -m kafkabalancer_tpu.replay --restart \
+    --tenants 1 --requests 3 --kill-after 1 --arrival uniform \
+    --weight-shift-every 0 --chaos-faults "spill_corrupt@1" --check \
+    --out "$sc_tmp/restart.json" >/dev/null 2>"$sc_tmp/restart.log" \
+  && "$PYTHON" -c '
+import json
+a = json.load(open("'"$sc_tmp"'/restart.json"))
+assert a["mode"] == "restart", a["mode"]
+r = a["restart"]
+assert r["ok"] is True, r
+assert r["wrong_plans"] == [], r["wrong_plans"]
+assert r["corrupt_drops"] == 1 and r["restore_hits"] == 0, r
+assert r["paging_identity_ok"] is True, r
+assert not a["request_errors"], a["request_errors"]
+'; then
+  echo "seeded spill_corrupt restart: cold-but-correct + pruned + counted: OK"
+else
+  echo "spill_corrupt restart smoke FAILED (see $sc_tmp)"
+  tail -10 "$sc_tmp/restart.log" 2>/dev/null
+  fail=1
+fi
+rm -rf "$sc_tmp"
 
 if [ "$run_tests" = 1 ]; then
   step "tier-1 tests"

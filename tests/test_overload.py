@@ -860,19 +860,21 @@ def test_zombie_pidfile_process_counts_as_dead():
     """A SIGKILL'd daemon whose parent never reaped it (container
     without an init reaper) is a ZOMBIE: it answers the signal-0 probe
     but cannot own a socket — takeover must treat it as dead."""
-    pid = os.fork()
-    if pid == 0:
-        os._exit(0)
+    # an UNREAPED child: `sleep 0` exits immediately and stays a
+    # zombie of this very process until wait() below (no os.fork — a
+    # fork of the JAX-threaded test runner can deadlock the child
+    # before it reaches _exit, wedging the whole suite on waitpid)
+    p = subprocess.Popen(["sleep", "0"])
     try:
         deadline = time.monotonic() + 5
         while time.monotonic() < deadline:
-            with open(f"/proc/{pid}/stat") as f:
+            with open(f"/proc/{p.pid}/stat") as f:
                 if f.read().rsplit(")", 1)[1].split()[0] == "Z":
                     break
             time.sleep(0.01)
-        assert Daemon._pid_alive(pid) is False
+        assert Daemon._pid_alive(p.pid) is False
     finally:
-        os.waitpid(pid, 0)
+        p.wait()
     assert Daemon._pid_alive(os.getpid()) is True
 
 
@@ -935,7 +937,7 @@ def test_live_daemon_still_refuses_second_daemon(sock_dir):
 
 
 def test_scrape_carries_overload_blocks(sock_dir):
-    """serve-stats/5: admission, lane_health and faults blocks are
+    """serve-stats/6: admission, lane_health and faults blocks are
     present with their golden key sets, and tenant entries carry
     sheds."""
     sock = os.path.join(sock_dir, "kb.sock")
@@ -946,7 +948,7 @@ def test_scrape_carries_overload_blocks(sock_dir):
     assert rv == 0
     doc = sclient.fetch_stats(sock)
     golden = json.load(open(os.path.join(
-        os.path.dirname(__file__), "data", "serve_stats_schema_v5.json"
+        os.path.dirname(__file__), "data", "serve_stats_schema_v6.json"
     )))
     assert set(doc["admission"]) == set(golden["admission_keys"])
     assert set(doc["lane_health"]) == set(golden["lane_health_keys"])

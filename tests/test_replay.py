@@ -7,9 +7,9 @@ Pins:
   regression gate, not a flaky load test;
 - churn events do what they claim (weight drift, broker failure with
   allowlist rewrite, topic storms growing the row set);
-- a seeded run against a live daemon produces a replay/2 artifact whose
+- a seeded run against a live daemon produces a replay/3 artifact whose
   per-tenant request counts reconcile EXACTLY with the daemon's
-  serve-stats/5 scrape, whose scrape percentiles agree with the flight
+  serve-stats/6 scrape, whose scrape percentiles agree with the flight
   recorder's tenant-labeled request log within one histogram bucket,
   and whose sampled request has plan byte parity vs -no-daemon.
 """
@@ -150,7 +150,7 @@ def test_replay_reconciles_against_live_daemon(daemon_sock):
     )
     art = run_replay(cfg, log=lambda _m: None)
     assert art["schema"] == REPLAY_SCHEMA
-    assert art["scrape_schema"] == "kafkabalancer-tpu.serve-stats/5"
+    assert art["scrape_schema"] == "kafkabalancer-tpu.serve-stats/6"
     assert art["requests_issued"] == 36
     assert art["request_errors"] == []
     assert art["reconciled_counts"] is True
@@ -177,7 +177,7 @@ def test_replay_reconciles_against_live_daemon(daemon_sock):
 
 
 def test_replay_artifact_schema_keys(daemon_sock):
-    """The replay/2 artifact's top-level keys are the schema bench.py
+    """The replay/3 artifact's top-level keys are the schema bench.py
     lands in BENCH rounds — changing them requires a version bump."""
     cfg = ReplayConfig(
         seed=1, tenants=2, requests=8, socket=daemon_sock, spawn=False,
@@ -185,16 +185,18 @@ def test_replay_artifact_schema_keys(daemon_sock):
     )
     art = run_replay(cfg, log=lambda _m: None)
     assert set(art) == {
-        "schema", "scrape_schema", "mode", "chaos", "seed", "config",
+        "schema", "scrape_schema", "mode", "chaos", "restart", "seed",
+        "config",
         "requests_issued", "request_errors", "wall_s", "throughput_rps",
         "events", "per_tenant", "session_thrash", "fallback_rate",
         "padded_slots", "microbatched", "tenant_cap", "tenants_demoted",
         "parity", "reconciled_counts", "latency_checked",
         "reconciled_latency", "reconciled",
     }
-    # a churn (non-chaos) run marks its mode and carries no chaos block
+    # a churn run marks its mode and carries no chaos/restart block
     assert art["mode"] == "churn"
     assert art["chaos"] is None
+    assert art["restart"] is None
     assert art["parity"] is None  # parity_sample=False
     entry = art["per_tenant"]["tenant-00"]
     for key in (
@@ -208,6 +210,67 @@ def test_replay_artifact_schema_keys(daemon_sock):
         "session_bytes", "delta_hit_rate",
     ):
         assert key in entry, key
+
+
+def test_restart_replay_recovers_from_spill():
+    """The session-durability acceptance pin (ISSUE 14): a private
+    subprocess daemon with a warm spill dir is SIGKILLed mid-churn and
+    restarted on the same socket + spill dir — every answered request
+    byte-identical to -no-daemon, every pre-kill tenant's first
+    post-restart request answered from a spill restore (restore-hit
+    rate 1.0, no re-register), the restore_delay chaos site fired on
+    the recovery path, and the warm tier's conservation identity
+    exact."""
+    cfg = ReplayConfig(
+        seed=11, tenants=2, requests=10,
+        arrival="uniform",       # both tenants see both phases
+        weight_shift_every=0,    # no external drift: digests must match
+        restart=True,
+    )
+    art = run_replay(cfg, log=lambda _m: None)
+    assert art["schema"] == REPLAY_SCHEMA
+    assert art["mode"] == "restart"
+    assert art["scrape_schema"] == "kafkabalancer-tpu.serve-stats/6"
+    assert art["request_errors"] == []
+    r = art["restart"]
+    assert r["ok"] is True and art["reconciled"] is True
+    assert r["wrong_plans"] == []
+    assert r["answered"] == r["parity_checked"] == 10
+    assert r["kill_after"] == 5
+    # both tenants had pre-kill traffic; both restored on their first
+    # post-restart request with a matching digest — zero re-registers
+    assert r["expected_restore_attempts"] == 2
+    assert r["restore_attempts_ok"] is True
+    assert r["restores"] == r["restore_hits"] == 2
+    assert r["restore_hit_rate"] == 1.0
+    assert r["corrupt_drops"] == 0 and r["cold_misses_post"] == 0
+    assert r["paging_identity_ok"] is True
+    assert r["faults_fired_post"].get("restore_delay", 0) == 1
+    assert r["post_restart_p95_s"] > 0.0
+    per = art["per_tenant"]
+    assert sum(e["restores"] for e in per.values()) == 2
+
+
+def test_restart_replay_corrupt_record_is_cold_but_correct():
+    """A seeded spill_corrupt on the pre-kill daemon: the restarted
+    daemon must detect the bit-flipped record, prune it
+    (corrupt_drops), answer the request via a full re-register — and
+    every answer stays byte-identical. Never a wrong plan, only a
+    cold miss."""
+    cfg = ReplayConfig(
+        seed=3, tenants=1, requests=3,
+        arrival="uniform", weight_shift_every=0,
+        restart=True, restart_kill_after=1,
+        chaos_faults="spill_corrupt@1",
+    )
+    art = run_replay(cfg, log=lambda _m: None)
+    r = art["restart"]
+    assert r["ok"] is True and r["wrong_plans"] == []
+    assert r["corrupt_drops"] == 1
+    assert r["restores"] == 0 and r["restore_hits"] == 0
+    assert r["cold_misses_post"] == 1  # the re-register it forced
+    assert r["paging_identity_ok"] is True
+    assert art["request_errors"] == []
 
 
 def test_replay_requires_a_daemon():

@@ -1853,7 +1853,7 @@ def test_dev_cached_asarray_reuses_equal_content():
 # --- live daemon telemetry: the stats / dump-trace scrape ops --------------
 
 GOLDEN_STATS = os.path.join(
-    os.path.dirname(__file__), "data", "serve_stats_schema_v5.json"
+    os.path.dirname(__file__), "data", "serve_stats_schema_v6.json"
 )
 
 
@@ -1984,7 +1984,7 @@ def test_stats_scrape_never_blocks_on_inflight_plan(sock_dir, monkeypatch):
 def test_serve_stats_json_schema_golden(daemon):
     """Golden-file pin: the stats document's top-level keys, histogram
     entry keys, per-tenant entry keys and flight keys are VERSIONED
-    (kafkabalancer-tpu.serve-stats/5) — changing any requires a schema
+    (kafkabalancer-tpu.serve-stats/6) — changing any requires a schema
     bump and a new golden."""
     sock, _d = daemon
     rv, _out, _err = run_cli(
@@ -2015,6 +2015,10 @@ def test_serve_stats_json_schema_golden(daemon):
     assert doc["sessions"]["count"] >= 1  # the -input request registered
     assert doc["sessions"]["bytes"] > 0
     assert isinstance(doc["fallbacks"], dict)
+    # v6: the warm session tier's paging block — same key set whether
+    # the tier is enabled or not (this daemon has it off)
+    assert set(doc["paging"]) == set(golden["paging_keys"])
+    assert doc["paging"]["enabled"] is False
     # v4: per-tenant attribution (bounded top-K label families)
     tenants = doc["tenants"]
     assert set(tenants) == set(golden["tenants_keys"])
@@ -2078,7 +2082,7 @@ def test_scrape_cli_verbs_roundtrip(daemon, sock_dir):
     rv, out, _err = run_cli([f"-serve-socket={sock}", "-serve-stats-json"])
     assert rv == 0
     doc = json.loads(out)
-    assert doc["schema"] == "kafkabalancer-tpu.serve-stats/5"
+    assert doc["schema"] == "kafkabalancer-tpu.serve-stats/6"
     assert doc["hists"]["serve.request_s"]["count"] == doc["requests"]
     rv, out, _err = run_cli([f"-serve-socket={sock}", "-serve-stats"])
     assert rv == 0
