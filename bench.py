@@ -746,13 +746,190 @@ def _run_delta_probe(n_parts: int, n_brokers: int) -> dict:
     return out
 
 
+def _run_spec_probe(n_parts: int, n_brokers: int) -> dict:
+    """``served_speculative_move_s``: the speculative plan-ahead steady
+    state (serve/speculate.py, docs/serving.md) — same outer loop as the
+    delta probe, but the steady-state steps carry NO telemetry flags so
+    their answers are memoizable: after each step the daemon plans the
+    NEXT move during the idle window, and the following request answers
+    from the memo with ZERO dispatch. Attribution comes from the
+    serve-stats/7 scrape (``speculation.hits`` + the ``serve.spec.hit_s``
+    daemon-side histogram — the acceptance number: hit p50 <= 5 ms
+    daemon-side vs the ~53 ms live delta dispatch), asserted so a silent
+    live-path fallback cannot masquerade as speculative speed. A second
+    phase re-runs steps WITH -metrics-json (never memoizable — forced
+    live path) on the same speculation-enabled daemon, so
+    ``served_spec_live_p95_s`` vs the delta probe's p95 shows live
+    traffic does not regress while speculation is on.
+    """
+    import tempfile
+
+    out: dict = {}
+    if os.environ.get("BENCH_NO_SERVED") == "1":
+        return out
+    from kafkabalancer_tpu.codecs.writer import write_partition_list
+    from kafkabalancer_tpu.serve import client as serve_client
+
+    tmp = tempfile.mkdtemp(prefix="kb-spec-")
+    sock = os.path.join(tmp, "kb.sock")
+    env = dict(os.environ)
+    env.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    )
+    pl, _cfg = _flagship_case(n_parts, n_brokers)
+    buf = io.StringIO()
+    write_partition_list(buf, pl)
+    state = json.loads(buf.getvalue())
+    input_path = os.path.join(tmp, "cluster.json")
+
+    def apply_plan(plan_stdout: str) -> None:
+        plan_doc = json.loads(plan_stdout)
+        for entry in plan_doc.get("partitions") or []:
+            for row in state["partitions"]:
+                if (
+                    row["topic"] == entry["topic"]
+                    and row["partition"] == entry["partition"]
+                ):
+                    row["replicas"] = list(entry["replicas"])
+                    break
+
+    def wait_for_memo(timeout: float = 30.0) -> None:
+        # let the idle window do its work: the next step should find a
+        # memo (speculation at this scale is one warm dispatch)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            doc = serve_client.fetch_watch(sock) or {}
+            spec = doc.get("speculation") or {}
+            if spec.get("memos", 0) >= 1 and not spec.get("inflight"):
+                return
+            time.sleep(0.05)
+
+    daemon = _start_probe_daemon(sock, env, f"{n_parts}x{n_brokers}")
+    try:
+        if not _wait_probe_daemon(sock, daemon, "spec probe"):
+            return out
+        base = [
+            sys.executable, "-m", "kafkabalancer_tpu", "-input-json",
+            f"-input={input_path}", "-solver=tpu", "-max-reassign=1",
+            f"-serve-socket={sock}",
+        ]
+        samples = []
+        for step in range(N_DELTA_MOVES + 1):
+            with open(input_path, "w") as f:
+                json.dump(state, f)
+            if step > 0:
+                wait_for_memo()
+            t0 = time.perf_counter()
+            proc = subprocess.run(
+                base, capture_output=True, text=True, env=env, timeout=600,
+            )
+            wall = time.perf_counter() - t0
+            if proc.returncode != 0:
+                log(f"spec probe: step {step} rc={proc.returncode}")
+                return out
+            if step > 0:
+                samples.append(round(wall, 3))
+            apply_plan(proc.stdout)
+        doc = serve_client.fetch_stats(sock) or {}
+        spec = doc.get("speculation") or {}
+        hits = int(spec.get("hits", 0))
+        hit_h = (doc.get("hists") or {}).get("serve.spec.hit_s") or {}
+        vals = sorted(samples)
+        out["served_speculative_move_s"] = _percentile(vals, 0.5)
+        out["served_speculative_p95_s"] = _percentile(vals, 0.95)
+        out["served_speculative_samples"] = samples
+        out["served_spec_hits"] = hits
+        # the daemon-side acceptance number: a memo hit is a table read
+        out["served_spec_daemon_p50_s"] = hit_h.get("p50", 0.0)
+        out["served_spec_daemon_p99_s"] = hit_h.get("p99", 0.0)
+        # hit attribution required: every steady step must have
+        # answered from the memo, or the number above is a lie
+        out["served_spec_attribution_ok"] = hits >= len(samples)
+        out["served_spec_block"] = spec
+        log(
+            f"served speculative move (memo hits, p50 of {len(samples)}: "
+            f"{samples}): {out['served_speculative_move_s']:.3f}s "
+            f"end-to-end, daemon-side hit p50 "
+            f"{out['served_spec_daemon_p50_s'] * 1000:.2f}ms "
+            f"({hits} hits, attribution "
+            f"{'OK' if out['served_spec_attribution_ok'] else 'MISSING'})"
+        )
+        # phase 2: the live path ON the speculation-enabled daemon —
+        # -metrics-json makes the steps non-memoizable by design, so
+        # every one dispatches live while the speculator sits idle
+        # (preempted); its p95 vs the delta probe's is the
+        # no-regression evidence
+        live = []
+        metrics_path = os.path.join(tmp, "live.metrics.json")
+        for _step in range(max(3, N_DELTA_MOVES // 2)):
+            with open(input_path, "w") as f:
+                json.dump(state, f)
+            t0 = time.perf_counter()
+            proc = subprocess.run(
+                base + [f"-metrics-json={metrics_path}"],
+                capture_output=True, text=True, env=env, timeout=600,
+            )
+            wall = time.perf_counter() - t0
+            if proc.returncode != 0:
+                log(f"spec probe live phase: rc={proc.returncode}")
+                break
+            live.append(round(wall, 3))
+            apply_plan(proc.stdout)
+        if live:
+            out["served_spec_live_p95_s"] = _percentile(sorted(live), 0.95)
+            out["served_spec_live_samples"] = live
+            log(
+                "live path with speculation armed (p95 of "
+                f"{len(live)}): {out['served_spec_live_p95_s']:.3f}s"
+            )
+    finally:
+        _stop_probe_daemon(sock, daemon)
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
+def _run_watch_probe() -> dict:
+    """``replay_watch_mode``: the watch-driven continuous controller at
+    smoke scale — the replay harness's --watch scenario (fake-ZK seam,
+    zero client plan ops, plan-byte parity on every emitted move,
+    speculative hit rate + the exact speculation identity). Pins the
+    replay/4 watch artifact schema in every bench round."""
+    out: dict = {}
+    if os.environ.get("BENCH_NO_SERVED") == "1":
+        return out
+    from kafkabalancer_tpu.replay.harness import ReplayConfig, run_replay
+
+    fast = os.environ.get("BENCH_FAST") == "1"
+    cfg = ReplayConfig(
+        seed=int(os.environ.get("BENCH_REPLAY_SEED", "7")),
+        requests=int(
+            os.environ.get("BENCH_WATCH_PLANS", "8" if fast else "16")
+        ),
+        watch=True,
+    )
+    artifact = run_replay(cfg, log=log)
+    artifact.pop("request_errors", None)
+    out["replay_watch_mode"] = artifact
+    w = artifact.get("watch") or {}
+    log(
+        f"watch-mode replay: {w.get('plans_emitted')} plans emitted, "
+        f"zero client plan ops={w.get('zero_client_plan_ops')}, "
+        f"spec hit rate={w.get('spec_hit_rate')}, "
+        f"ok={w.get('ok')}"
+    )
+    return out
+
+
 def _run_replay_probe() -> dict:
     """``replay_fleet_churn``: the multi-tenant churn replay harness
     (kafkabalancer_tpu/replay/, docs/observability.md § Per-tenant
     attribution) at smoke scale — a seeded 3-tenant fleet with diurnal
     arrival skew, weight-shift churn, a topic storm and a broker
     failure, driven closed-loop through the real client against a
-    private daemon. Lands the replay/3 artifact (per-tenant
+    private daemon. Lands the replay/4 artifact (per-tenant
     p50/p95/p99, delta-hit/resync/fallback attribution, session-thrash
     rate, padded-slot waste) so the artifact SCHEMA is pinned in bench
     rounds before the bench-host BENCH_r06 run records it at fleet
@@ -1420,6 +1597,36 @@ def main() -> None:
     except Exception as exc:
         log(f"delta probe unavailable: {exc!r}")
 
+    # speculative probe: the memoized-read steady state (the daemon
+    # plans move N+1 during the idle window; the matching request
+    # answers with zero dispatch) + the live-path no-regression phase
+    try:
+        cold.update(_run_spec_probe(n_parts, n_brokers))
+    except Exception as exc:
+        log(f"speculative probe unavailable: {exc!r}")
+    if cold.get("served_spec_live_p95_s") and cold.get(
+        "served_delta_move_p95_s"
+    ):
+        # the no-regression evidence: live-path p95 ON a speculating
+        # daemon vs the plain delta probe's p95 (~1.0 = speculation
+        # costs live traffic nothing)
+        cold["spec_live_vs_delta_p95"] = round(
+            cold["served_spec_live_p95_s"]
+            / cold["served_delta_move_p95_s"],
+            3,
+        )
+        log(
+            "live-p95 with speculation vs delta probe: "
+            f"{cold['spec_live_vs_delta_p95']}x"
+        )
+
+    # watch-mode probe: the continuous controller closed-loop over the
+    # fake-ZK seam — zero client plan ops, parity on every emitted move
+    try:
+        cold.update(_run_watch_probe())
+    except Exception as exc:
+        log(f"watch probe unavailable: {exc!r}")
+
     # throughput probe third: concurrent closed-loop clients against the
     # multi-lane daemon (and, multi-device, the single-lane comparison)
     try:
@@ -1428,7 +1635,7 @@ def main() -> None:
         log(f"throughput probe unavailable: {exc!r}")
 
     # replay probe: the seeded multi-tenant churn harness at smoke
-    # scale — pins the replay/3 artifact schema and the per-tenant
+    # scale — pins the replay/4 artifact schema and the per-tenant
     # scrape reconciliation in every bench round
     try:
         cold.update(_run_replay_probe())
@@ -1712,6 +1919,14 @@ def main() -> None:
                     "delta_served_phase_breakdown",
                     "delta_served_stats_requests",
                     "delta_served_queue_series",
+                    "served_speculative_move_s",
+                    "served_speculative_p95_s",
+                    "served_speculative_samples", "served_spec_hits",
+                    "served_spec_daemon_p50_s", "served_spec_daemon_p99_s",
+                    "served_spec_attribution_ok", "served_spec_block",
+                    "served_spec_live_p95_s", "served_spec_live_samples",
+                    "spec_live_vs_delta_p95",
+                    "replay_watch_mode",
                     "served_throughput_attribution_ok",
                     "served_throughput_rps", "served_throughput_p50_s",
                     "served_throughput_p95_s", "served_throughput_lanes",
@@ -1728,6 +1943,7 @@ def main() -> None:
                     "throughput_served_stats_requests",
                     "throughput_served_queue_series",
                     "shard_scale",
+                    "replay_fleet_churn", "replay_restart_recovery",
                 ) if k in cold},
                 # before/after vs the pinned round-5 cold breakdown —
                 # only at the default scale, where the r05 pin was taken

@@ -225,6 +225,8 @@ _NO_FORWARD_FLAGS = frozenset((
     "serve-max-queue", "serve-tenant-inflight", "serve-watchdog",
     "serve-faults", "serve-client-timeout",
     "serve-session-spill-dir", "serve-warm-cap-mb",
+    "serve-speculate", "serve-speculate-off",
+    "watch", "watch-emit", "watch-poll",
     "serve-stats", "serve-stats-json", "serve-dump-trace", "metrics-prom",
     "serve-session", "serve-no-session",
     "no-daemon", "help", "pprof", "pprof-path", "jax-profile", "input",
@@ -684,6 +686,46 @@ def _run_impl(
             "least-recently-spilled records are swept past it "
             "(<= 0 disables the sweep)",
         )
+        f_serve_speculate = f.bool(
+            "serve-speculate",
+            True,
+            "Daemon: speculative plan-ahead — after a clean "
+            "session-backed plan, an idle-priority task plans the NEXT "
+            "move on the resident session and memoizes the answer; a "
+            "digest-matching next request is answered with zero "
+            "dispatch, preempted instantly by any real traffic "
+            "(docs/serving.md)",
+        )
+        f_serve_speculate_off = f.bool(
+            "serve-speculate-off",
+            False,
+            "Daemon: force speculative plan-ahead OFF (wins over "
+            "-serve-speculate)",
+        )
+        f_watch = f.string(
+            "watch",
+            "",
+            "Daemon: watch-driven continuous controller — subscribe to "
+            "this Zookeeper connection string (kazoo watches with a "
+            "-watch-poll fallback), apply change events to a resident "
+            "session, re-plan (speculation makes the steady state a "
+            "memoized read) and stream plans to -watch-emit; no client "
+            "process in the loop (requires -serve; docs/serving.md)",
+        )
+        f_watch_emit = f.string(
+            "watch-emit",
+            "",
+            "Watch mode: plan sink — a directory (one "
+            "plan-NNNNNN.json + .meta pair per emitted plan) or '-' "
+            "for the daemon's stdout",
+        )
+        f_watch_poll = f.float(
+            "watch-poll",
+            5.0,
+            "Watch mode: poll interval in seconds (the fallback "
+            "cadence when the ZK client offers no watch callbacks; "
+            "watch events wake the loop early)",
+        )
         f_serve_client_timeout = f.float(
             "serve-client-timeout",
             0.0,
@@ -718,7 +760,7 @@ def _run_impl(
             "serve-stats-json",
             False,
             "Scrape a live daemon's telemetry as one line of "
-            "schema-versioned JSON (kafkabalancer-tpu.serve-stats/6)",
+            "schema-versioned JSON (kafkabalancer-tpu.serve-stats/7)",
         )
         f_serve_dump_trace = f.string(
             "serve-dump-trace",
@@ -839,6 +881,28 @@ def _run_impl(
                 usage()
                 return 3
 
+            if f_watch.value != "" and not f_serve.value:
+                log("-watch requires -serve (the daemon is the watcher)")
+                usage()
+                return 3
+
+            if f_watch.value != "" and f_watch_emit.value == "":
+                # a sink-less watcher would plan a move nobody can ever
+                # apply and then wait forever for the cluster to catch
+                # up — refuse loudly instead
+                log(
+                    "-watch requires -watch-emit (a plan nobody "
+                    "receives can never be applied; use "
+                    "-watch-emit=- for stdout)"
+                )
+                usage()
+                return 3
+
+            if f_watch_emit.value != "" and f_watch.value == "":
+                log("-watch-emit requires -watch")
+                usage()
+                return 3
+
             if f_serve_batch_mode.value not in ("continuous", "oneshot"):
                 log(
                     f"unknown -serve-batch-mode "
@@ -900,9 +964,22 @@ def _run_impl(
             from kafkabalancer_tpu.serve.daemon import Daemon
             from kafkabalancer_tpu.serve.protocol import resolve_socket_path
 
+            idle_timeout = f_serve_idle.value
+            if f_watch.value != "" and "serve-idle-timeout" not in f.seen:
+                # watch mode's steady state has NO client traffic (that
+                # is the point), and watch ticks deliberately never
+                # touch the idle clock — the DEFAULT idle timeout would
+                # shut the watcher down mid-watch. An EXPLICIT
+                # -serve-idle-timeout is honored as given (f.seen — an
+                # explicit value EQUAL to the default included).
+                log(
+                    "watch mode: default -serve-idle-timeout disabled "
+                    "(set it explicitly to bound a watch daemon's life)"
+                )
+                idle_timeout = 0.0
             return Daemon(
                 resolve_socket_path(f_serve_socket.value),
-                idle_timeout=f_serve_idle.value,
+                idle_timeout=idle_timeout,
                 prewarm_shapes=f_serve_prewarm.value,
                 log=log,
                 lanes=f_serve_lanes.value,
@@ -917,6 +994,19 @@ def _run_impl(
                 faults_spec=f_serve_faults.value,
                 spill_dir=f_serve_spill_dir.value,
                 warm_cap_mb=f_serve_warm_cap.value,
+                speculate=(
+                    f_serve_speculate.value
+                    and not f_serve_speculate_off.value
+                ),
+                watch_conn=f_watch.value,
+                watch_emit=f_watch_emit.value,
+                watch_poll=f_watch_poll.value,
+                # the watcher plans with THIS invocation's planning
+                # flags, canonicalized exactly like a forwarded request
+                # (daemon/serve flags excluded, -no-daemon pinned)
+                watch_argv=(
+                    _forward_argv(f) if f_watch.value != "" else None
+                ),
             ).serve_forever()
 
         if not f_no_daemon.value and not (f_pprof.value or f_jaxprof.value):
@@ -959,7 +1049,7 @@ def _run_impl(
                 # else the input path ("-" for true stdin). A v2 daemon
                 # keys its resident state per (tenant, planning-flags
                 # signature) AND attributes the request's telemetry to
-                # the tenant (serve-stats/6 "tenants" block) — so the
+                # the tenant (serve-stats/7 "tenants" block) — so the
                 # label is derived even when sessions are disabled; a
                 # request with no derivable identity rolls up as
                 # "other" daemon-side.

@@ -298,7 +298,7 @@ def render_prometheus(doc: Dict[str, Any]) -> str:
             m = _prom_name(f"sessions_{key}")
             lines.append(f"# TYPE {m} {typ}")
             lines.append(f"{m} {_prom_value(v)}")
-    # the warm session tier (serve-stats/6 "paging" block): spill /
+    # the warm session tier (serve-stats/7 "paging" block): spill /
     # restore / corrupt-drop counters under the conservation identity
     # spills + adopted == restores + corrupt_drops + evictions +
     # warm_entries, plus the live warm footprint gauges
@@ -315,6 +315,42 @@ def render_prometheus(doc: Dict[str, Any]) -> str:
             if isinstance(v, bool) or not isinstance(v, (int, float)):
                 continue
             m = _prom_name(f"paging_{key}")
+            lines.append(f"# TYPE {m} {typ}")
+            lines.append(f"{m} {_prom_value(v)}")
+    # speculative plan-ahead (serve-stats/7 "speculation" block):
+    # memo-lifecycle counters under the exact identity attempts ==
+    # hits + misses + poisoned + memos (docs/observability.md)
+    spec = doc.get("speculation")
+    if isinstance(spec, dict):
+        for key, typ in (
+            ("attempts", "counter"), ("hits", "counter"),
+            ("misses", "counter"), ("poisoned", "counter"),
+            ("aborted", "counter"), ("deferred", "counter"),
+            ("wasted_dispatches", "counter"), ("memos", "gauge"),
+        ):
+            v = spec.get(key)
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            m = _prom_name(f"spec_{key}")
+            lines.append(f"# TYPE {m} {typ}")
+            lines.append(f"{m} {_prom_value(v)}")
+    # the watch-driven controller (serve-stats/7 "watch" block):
+    # tick/read/emit counters plus the lag gauges (nulls skipped —
+    # e.g. before the first read)
+    watch = doc.get("watch")
+    if isinstance(watch, dict) and watch.get("enabled"):
+        for key, typ in (
+            ("ticks", "counter"), ("reads", "counter"),
+            ("errors", "counter"), ("events", "counter"),
+            ("resyncs", "counter"), ("plans_emitted", "counter"),
+            ("noop_plans", "counter"), ("spec_hits", "counter"),
+            ("last_read_age_s", "gauge"), ("last_plan_s", "gauge"),
+            ("last_event_lag_s", "gauge"),
+        ):
+            v = watch.get(key)
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                continue
+            m = _prom_name(f"watch_{key}")
             lines.append(f"# TYPE {m} {typ}")
             lines.append(f"{m} {_prom_value(v)}")
     # overload protection (serve-stats/5 "admission" block): queue
@@ -431,6 +467,7 @@ _TENANT_SCALARS = (
     ("requests", "tenant_requests", "counter"),
     ("crashed", "tenant_crashed_requests", "counter"),
     ("delta_hits", "tenant_delta_hits", "counter"),
+    ("spec_hits", "tenant_spec_hits", "counter"),
     ("resyncs_rows", "tenant_resyncs_rows", "counter"),
     ("resyncs_full", "tenant_resyncs_full", "counter"),
     ("fallbacks", "tenant_fallbacks", "counter"),
@@ -606,6 +643,37 @@ def render_serve_stats(doc: Dict[str, Any]) -> str:
             f"{paging.get('corrupt_drops', 0)} corrupt drops, "
             f"{paging.get('evictions', 0)} evicted, "
             f"{paging.get('write_failures', 0)} write failures"
+        )
+    spec = doc.get("speculation")
+    if isinstance(spec, dict) and (
+        spec.get("enabled") or spec.get("attempts")
+    ):
+        lines.append(
+            f"  speculation: {spec.get('attempts', 0)} attempts — "
+            f"{spec.get('hits', 0)} hits, {spec.get('misses', 0)} "
+            f"misses, {spec.get('poisoned', 0)} poisoned, "
+            f"{spec.get('aborted', 0)} aborted, "
+            f"{spec.get('deferred', 0)} deferred "
+            f"({spec.get('memos', 0)} memo"
+            f"{'s' if spec.get('memos', 0) != 1 else ''} live, "
+            f"{spec.get('wasted_dispatches', 0)} wasted dispatches)"
+        )
+    watch = doc.get("watch")
+    if isinstance(watch, dict) and watch.get("enabled"):
+        age = watch.get("last_read_age_s")
+        lines.append(
+            f"  watch: {watch.get('conn')} — "
+            f"{watch.get('plans_emitted', 0)} plans emitted "
+            f"({watch.get('spec_hits', 0)} from speculation, "
+            f"{watch.get('noop_plans', 0)} no-ops), "
+            f"{watch.get('reads', 0)} reads / "
+            f"{watch.get('ticks', 0)} ticks, "
+            f"{watch.get('resyncs', 0)} resyncs, "
+            f"{watch.get('errors', 0)} errors; last read "
+            + (
+                f"{age:.1f}s ago" if isinstance(age, (int, float))
+                and not isinstance(age, bool) else "never"
+            )
         )
     fallbacks = doc.get("fallbacks")
     if isinstance(fallbacks, dict) and fallbacks:

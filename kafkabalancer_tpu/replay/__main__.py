@@ -1,6 +1,6 @@
 """``python -m kafkabalancer_tpu.replay`` — run one seeded fleet-churn
 replay against a live (or private, self-spawned) planning daemon and
-write the ``kafkabalancer-tpu.replay/3`` artifact.
+write the ``kafkabalancer-tpu.replay/4`` artifact.
 
 Examples::
 
@@ -128,6 +128,32 @@ def main(argv: list) -> int:
         "spill_corrupt@1)",
     )
     p.add_argument(
+        "--watch", action="store_true",
+        help="watch-mode scenario: spawn a -watch daemon against a "
+        "fake Zookeeper tree, apply each emitted plan back (zero "
+        "client plan ops), inject out-of-band drift, and assert "
+        "plan-byte parity vs -no-daemon on every emitted plan plus "
+        "the speculative hit rate (docs/serving.md § Watch mode)",
+    )
+    p.add_argument(
+        "--watch-topics", type=int, default=d.watch_topics,
+    )
+    p.add_argument(
+        "--watch-partitions", type=int, default=d.watch_partitions,
+    )
+    p.add_argument(
+        "--watch-poll", type=float, default=d.watch_poll_s,
+        help="watch mode: the daemon's -watch-poll interval",
+    )
+    p.add_argument(
+        "--watch-flips", type=int, default=d.watch_flips,
+        help="watch mode: out-of-band replica flips to inject",
+    )
+    p.add_argument(
+        "--watch-creates", type=int, default=d.watch_creates,
+        help="watch mode: topic creations to inject",
+    )
+    p.add_argument(
         "--out", default="-",
         help="artifact path ('-' = stdout, the default)",
     )
@@ -155,6 +181,10 @@ def main(argv: list) -> int:
         concurrency=a.concurrency,
         restart=a.restart, restart_kill_after=a.kill_after,
         restart_faults=a.restart_faults,
+        watch=a.watch, watch_topics=a.watch_topics,
+        watch_partitions=a.watch_partitions,
+        watch_poll_s=a.watch_poll,
+        watch_flips=a.watch_flips, watch_creates=a.watch_creates,
     )
     try:
         artifact = run_replay(cfg)
@@ -173,6 +203,8 @@ def main(argv: list) -> int:
         sys.stderr.write(render_chaos_summary(artifact))
     elif artifact.get("mode") == "restart":
         sys.stderr.write(render_restart_summary(artifact))
+    elif artifact.get("mode") == "watch":
+        sys.stderr.write(render_watch_summary(artifact))
     else:
         sys.stderr.write(render_summary(artifact))
     if a.check:
@@ -198,6 +230,22 @@ def render_chaos_summary(artifact: dict) -> str:
         f"faults fired {ch.get('faults_fired')}, "
         f"daemon alive {ch.get('daemon_alive_at_end')}, "
         f"ok={ch.get('ok')}\n"
+    )
+
+
+def render_watch_summary(artifact: dict) -> str:
+    w = artifact.get("watch") or {}
+    rate = w.get("spec_hit_rate")
+    return (
+        f"-- watch replay (seed {artifact.get('seed')}): "
+        f"{w.get('plans_emitted')} plans emitted with ZERO client plan "
+        f"ops (parity checked on every one), "
+        f"{len(w.get('wrong_plans') or [])} wrong plans; speculative "
+        f"hits {w.get('spec_hit_plans')} "
+        f"({'n/a' if rate is None else f'{rate:.0%}'}), "
+        f"{w.get('resyncs')} resyncs / {w.get('drift_events')} drift "
+        f"events, {w.get('errors')} errors, identity "
+        f"{w.get('speculation_identity_ok')}, ok={w.get('ok')}\n"
     )
 
 

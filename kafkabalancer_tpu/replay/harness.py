@@ -8,7 +8,7 @@ multi-tenant churn, every request runs through the REAL forwarding
 client (``cli.run`` with a ``-serve-socket`` — the same code path the
 production outer loop uses, resident-session ladder included), the
 emitted plan is applied back to the tenant's state (the closed loop),
-and at the end the harness fetches the daemon's ``serve-stats/6``
+and at the end the harness fetches the daemon's ``serve-stats/7``
 scrape and reconciles:
 
 - per-tenant REQUEST COUNTS: the driver's issued counts must equal the
@@ -31,7 +31,7 @@ scrape and reconciles:
   layer's oldest pin, exercised under churn).
 
 The result is one schema-versioned artifact
-(``kafkabalancer-tpu.replay/3``) with per-tenant tails, session-thrash
+(``kafkabalancer-tpu.replay/4``) with per-tenant tails, session-thrash
 and fallback rates, and padded-slot waste — the shape bench.py's
 ``replay_fleet_churn`` probe lands in BENCH rounds and gate.sh asserts
 pre-merge. No jax is imported here or anywhere below it: the harness is
@@ -63,8 +63,17 @@ from kafkabalancer_tpu.replay.synth import FleetSynth
 # request, reporting the restore-hit rate and the pre/post-restart p95,
 # and reconciling the warm tier's conservation identity (spills +
 # adopted == restores + corrupt_drops + evictions + warm_entries) from
-# the serve-stats/6 "paging" block
-REPLAY_SCHEMA_VERSION = 3
+# the serve-stats/7 "paging" block
+# v4: + mode "watch" and the "watch" block (null otherwise) — the
+# --watch run drives a ``-watch`` daemon through the fake-ZK seam
+# ($KAFKABALANCER_TPU_FAKE_ZK): the synthesizer publishes ZK-shaped
+# change events and applies each emitted plan back (the operator role),
+# with ZERO client plan ops; asserts plan-byte parity vs -no-daemon on
+# EVERY emitted plan (oracled against the exact state the watcher
+# planned from, via the emit-sidecar digest), the speculative hit rate,
+# external-drift resyncs, and the exact speculation identity
+# hits + misses + poisoned (+ live memos) == attempts
+REPLAY_SCHEMA_VERSION = 4
 REPLAY_SCHEMA = f"kafkabalancer-tpu.replay/{REPLAY_SCHEMA_VERSION}"
 
 LogFn = Callable[[str], None]
@@ -149,6 +158,18 @@ class ReplayConfig:
     restart: bool = False
     restart_kill_after: int = 0
     restart_faults: str = "restore_delay@1:0.01"
+    # watch mode (--watch): spawn a -watch daemon against a fake-ZK
+    # directory tree ($KAFKABALANCER_TPU_FAKE_ZK), let it emit
+    # `requests` plans closed-loop (the harness applies each plan back
+    # to the fake cluster — zero client plan ops), and inject seeded
+    # ZK-shaped change events: `watch_flips` out-of-band replica flips
+    # and `watch_creates` topic creations, spread through the run
+    watch: bool = False
+    watch_topics: int = 3
+    watch_partitions: int = 6
+    watch_poll_s: float = 0.15
+    watch_flips: int = 1
+    watch_creates: int = 1
 
 
 def _percentile_via_buckets(walls: List[float], q: float) -> float:
@@ -210,6 +231,7 @@ def _spawn_daemon(
     extra: Tuple[str, ...],
     log: LogFn,
     lane_args: Tuple[str, ...] = ("-serve-lanes=1",),
+    env: Optional[Dict[str, str]] = None,
 ) -> Any:
     """Start a private daemon subprocess on ``sock`` and wait for its
     hello. ``-serve-lanes=1`` keeps the jax-free single-lane dispatcher
@@ -234,6 +256,7 @@ def _spawn_daemon(
         args,
         stdout=subprocess.DEVNULL,
         stderr=subprocess.DEVNULL,
+        env={**os.environ, **env} if env else None,
     )
     deadline = time.monotonic() + 60.0
     while time.monotonic() < deadline:
@@ -291,7 +314,7 @@ def _make_synth(cfg: ReplayConfig) -> FleetSynth:
 def run_replay(
     cfg: ReplayConfig, log: Optional[LogFn] = None
 ) -> Dict[str, Any]:
-    """Run one seeded replay; returns the ``kafkabalancer-tpu.replay/3``
+    """Run one seeded replay; returns the ``kafkabalancer-tpu.replay/4``
     artifact (see the module docstring). Raises :class:`ReplayError`
     only when no daemon could be reached/spawned — a reconciliation
     failure is DATA (``reconciled: false``), not an exception, so bench
@@ -308,6 +331,8 @@ def run_replay(
         return _run_chaos(cfg, _log)
     if cfg.restart:
         return _run_restart(cfg, _log)
+    if cfg.watch:
+        return _run_watch(cfg, _log)
     tmpdir = None
     sock = cfg.socket
     spawned = None
@@ -691,6 +716,7 @@ def _run_chaos(cfg: ReplayConfig, _log: LogFn) -> Dict[str, Any]:
             "mode": "chaos",
             "chaos": chaos_block,
             "restart": None,
+            "watch": None,
             "seed": cfg.seed,
             "config": asdict(cfg),
             "requests_issued": total,
@@ -746,7 +772,7 @@ def _run_restart(cfg: ReplayConfig, _log: LogFn) -> Dict[str, Any]:
     requests answered from spill, i.e. no re-register storm), the
     pre/post-restart latency percentiles (the restart-recovery curve
     BENCH_r06 records), and the warm tier's conservation identity
-    reconciled exactly from the serve-stats/6 ``paging`` scrape.
+    reconciled exactly from the serve-stats/7 ``paging`` scrape.
 
     ``chaos_faults`` arms the PRE-kill daemon (a seeded
     ``spill_corrupt`` makes a tenant's recovery a cold-but-correct
@@ -936,6 +962,7 @@ def _run_restart(cfg: ReplayConfig, _log: LogFn) -> Dict[str, Any]:
             "mode": "restart",
             "chaos": None,
             "restart": restart_block,
+            "watch": None,
             "seed": cfg.seed,
             "config": asdict(cfg),
             "requests_issued": total,
@@ -965,6 +992,210 @@ def _run_restart(cfg: ReplayConfig, _log: LogFn) -> Dict[str, Any]:
                 }
                 for t in synth.tenants
             },
+            "reconciled": ok,
+        }
+    finally:
+        if spawned is not None:
+            try:
+                sclient.request_shutdown(sock)
+                spawned.wait(15)
+            except Exception:
+                spawned.terminate()
+        import shutil
+
+        shutil.rmtree(tmpdir, ignore_errors=True)
+
+
+def _run_watch(cfg: ReplayConfig, _log: LogFn) -> Dict[str, Any]:
+    """The ``--watch`` closed loop: a private ``-watch`` daemon reads a
+    fake Zookeeper tree (``$KAFKABALANCER_TPU_FAKE_ZK`` — the
+    codecs/zookeeper.py ``FileZkClient`` seam works across processes),
+    plans continuously, and emits plans to a directory sink; the
+    harness plays the OPERATOR — it applies each emitted plan back to
+    the fake cluster and injects seeded out-of-band change events
+    (replica flips, topic creations) — and issues ZERO client plan ops
+    (asserted from the scrape's ``requests``). Every emitted plan is
+    byte-compared against a ``-no-daemon`` oracle of the EXACT state
+    the watcher planned from (the emit sidecar's digest indexes the
+    synthesizer's snapshot mirror, so read/mutation interleavings
+    cannot confuse the oracle). The watch run is ``-max-reassign=1``
+    by construction: one emitted move touches one topic file, so every
+    state a concurrent watch read can observe is one the mirror knows.
+
+    Reconciles, exactly: the speculation identity
+    ``attempts == hits + misses + poisoned + memos``, resyncs >= the
+    injected drift events, zero watch errors, and the speculative hit
+    rate (the steady state should be memo reads)."""
+    import glob as glob_mod
+
+    from kafkabalancer_tpu import cli
+    from kafkabalancer_tpu.replay.synth import ZkClusterSynth
+    from kafkabalancer_tpu.serve import client as sclient
+
+    tmpdir = tempfile.mkdtemp(prefix="kb-watch-")
+    sock = os.path.join(tmpdir, "kb.sock")
+    zk_root = os.path.join(tmpdir, "zk")
+    emit_dir = os.path.join(tmpdir, "plans")
+    synth = ZkClusterSynth(
+        cfg.seed, zk_root,
+        topics=cfg.watch_topics,
+        partitions_per=cfg.watch_partitions,
+        brokers=cfg.brokers,
+        replicas=cfg.replicas,
+    )
+    spawned = _spawn_daemon(
+        sock, cfg.tenants,
+        (
+            "-watch=fake:2181",
+            f"-watch-emit={emit_dir}",
+            f"-watch-poll={cfg.watch_poll_s}",
+            "-serve-idle-timeout=300",
+            "-max-reassign=1",
+            *(() if cfg.solver == "greedy" else (f"-solver={cfg.solver}",)),
+            *cfg.daemon_args,
+        ),
+        _log,
+        env={"KAFKABALANCER_TPU_FAKE_ZK": zk_root},
+    )
+    try:
+        if sclient.daemon_alive(sock) is None:
+            raise ReplayError(f"no live watch daemon on {sock}")
+        target = max(4, cfg.requests)
+        flip_at = sorted(
+            max(2, (i + 1) * target // (cfg.watch_flips + 1))
+            for i in range(max(0, cfg.watch_flips))
+        )
+        create_at = sorted(
+            max(3, (i + 1) * target // (cfg.watch_creates + 1)) + 1
+            for i in range(max(0, cfg.watch_creates))
+        )
+        wrong: List[Dict[str, Any]] = []
+        oracle_missing = 0
+        spec_hit_plans = 0
+        seen = 0
+        converged = False
+        t_run0 = time.perf_counter()
+        last_progress = time.monotonic()
+        while seen < target:
+            files = sorted(
+                glob_mod.glob(os.path.join(emit_dir, "plan-*.json"))
+            )
+            if len(files) <= seen:
+                if time.monotonic() - last_progress > 30.0:
+                    break  # wedged or converged: reconcile what we have
+                w = (sclient.fetch_watch(sock) or {}).get("watch") or {}
+                if (
+                    w.get("state_digest") == synth.digest()
+                    and int(w.get("noop_plans", 0) or 0) >= 1
+                ):
+                    converged = True
+                    break
+                time.sleep(min(0.05, cfg.watch_poll_s))
+                continue
+            last_progress = time.monotonic()
+            path = files[seen]
+            plan_text = open(path).read()
+            try:
+                meta = json.load(open(path[: -len(".json")] + ".meta"))
+            except (OSError, ValueError):
+                meta = {}
+            if meta.get("spec_hit"):
+                spec_hit_plans += 1
+            # oracle the plan against the EXACT state it was computed
+            # from (the sidecar digest indexes the snapshot mirror)
+            oracle_text = synth.snapshots.get(str(meta.get("digest")))
+            if oracle_text is None:
+                oracle_missing += 1
+            else:
+                out_l, err_l = io.StringIO(), io.StringIO()
+                rc_l = cli.run(
+                    io.StringIO(oracle_text), out_l, err_l,
+                    [
+                        "kafkabalancer", "-input-json",
+                        "-max-reassign=1", "-no-daemon",
+                    ] + (
+                        [] if cfg.solver == "greedy"
+                        else [f"-solver={cfg.solver}"]
+                    ),
+                )
+                if rc_l != 0 or out_l.getvalue() != plan_text:
+                    wrong.append({"plan": seen + 1, "rc_local": rc_l})
+            synth.apply_plan(plan_text)
+            seen += 1
+            if seen in flip_at:
+                synth.external_flip()
+            if seen in create_at:
+                synth.create_topic()
+        wall_s = time.perf_counter() - t_run0
+
+        doc = sclient.fetch_stats(sock) or {}
+        watch = doc.get("watch") or {}
+        spec = doc.get("speculation") or {}
+        ident_ok = int(spec.get("attempts", -1)) == (
+            int(spec.get("hits", 0)) + int(spec.get("misses", 0))
+            + int(spec.get("poisoned", 0)) + int(spec.get("memos", 0))
+        )
+        drift_events = sum(synth.events.values())
+        zero_client_ops = int(doc.get("requests", -1)) == 0
+        spec_hits = int(watch.get("spec_hits", 0) or 0)
+        hit_rate = round(spec_hit_plans / seen, 4) if seen else None
+        ok = (
+            seen >= 3
+            and not wrong
+            and oracle_missing == 0
+            and ident_ok
+            and zero_client_ops
+            and int(watch.get("errors", 0) or 0) == 0
+            # drift was noticed: back-to-back events can coalesce into
+            # one watcher read, so >= 1 resync per run with any drift
+            # (parity after each drift is covered per emitted plan)
+            and (
+                drift_events == 0
+                or int(watch.get("resyncs", 0) or 0) >= 1
+            )
+            and spec_hits >= 1
+        )
+        watch_block = {
+            "plans_emitted": seen,
+            "daemon_plans_emitted": int(watch.get("plans_emitted", 0) or 0),
+            "parity_checked": seen - oracle_missing,
+            "oracle_missing": oracle_missing,
+            "wrong_plans": wrong,
+            "spec_hit_plans": spec_hit_plans,
+            "spec_hit_rate": hit_rate,
+            "resyncs": int(watch.get("resyncs", 0) or 0),
+            "drift_events": drift_events,
+            "noop_plans": int(watch.get("noop_plans", 0) or 0),
+            "errors": int(watch.get("errors", 0) or 0),
+            "reads": int(watch.get("reads", 0) or 0),
+            "ticks": int(watch.get("ticks", 0) or 0),
+            "converged": converged,
+            "last_event_lag_s": watch.get("last_event_lag_s"),
+            "last_plan_s": watch.get("last_plan_s"),
+            "speculation": spec,
+            "speculation_identity_ok": ident_ok,
+            "zero_client_plan_ops": zero_client_ops,
+            "ok": ok,
+        }
+        return {
+            "schema": REPLAY_SCHEMA,
+            "scrape_schema": doc.get("schema"),
+            "mode": "watch",
+            "chaos": None,
+            "restart": None,
+            "watch": watch_block,
+            "seed": cfg.seed,
+            "config": asdict(cfg),
+            # the whole point: the plans above required NO client
+            # plan-family requests at all
+            "requests_issued": 0,
+            "request_errors": [],
+            "wall_s": round(wall_s, 3),
+            "throughput_rps": (
+                round(seen / wall_s, 3) if wall_s > 0 else None
+            ),
+            "events": dict(synth.events),
+            "per_tenant": {},
             "reconciled": ok,
         }
     finally:
@@ -1109,6 +1340,7 @@ def _build_artifact(
         "mode": "churn",
         "chaos": None,
         "restart": None,
+        "watch": None,
         "seed": cfg.seed,
         "config": asdict(cfg),
         "requests_issued": total,

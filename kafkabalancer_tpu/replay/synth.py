@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import json
 import math
+import os
 import random
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -39,6 +40,9 @@ EV_PLAN = "plan"
 EV_WEIGHT_SHIFT = "weight_shift"
 EV_BROKER_FAILURE = "broker_failure"
 EV_TOPIC_STORM = "topic_storm"
+# watch-mode (ZkClusterSynth) change events
+EV_EXTERNAL_FLIP = "external_flip"
+EV_TOPIC_CREATE = "topic_create"
 
 
 class TenantState:
@@ -177,6 +181,172 @@ class TenantState:
             for r in self.rows[base:]:
                 r["brokers"] = list(self.brokers)
         return max(1, size)
+
+
+class ZkClusterSynth:
+    """A seeded Zookeeper-shaped cluster for the ``--watch`` replay:
+    the synthesizer owns the fake-ZK directory tree
+    (``$KAFKABALANCER_TPU_FAKE_ZK`` layout, codecs/zookeeper.py
+    ``FileZkClient``) AND a mirror of every state it has ever
+    published, keyed by the watch digest — so the harness can oracle
+    any emitted plan against exactly the state the watcher planned
+    from, regardless of read/mutation interleaving. Every mutation is
+    ONE atomic topic-file publish (tmp+rename), so a concurrent watch
+    read always sees a state the mirror knows."""
+
+    def __init__(
+        self,
+        seed: int,
+        zk_root: str,
+        topics: int = 3,
+        partitions_per: int = 6,
+        brokers: int = 6,
+        replicas: int = 2,
+    ) -> None:
+        self.rng = random.Random(seed ^ 0x2A7C)
+        self.zk_root = zk_root
+        self.brokers = list(range(max(replicas + 1, brokers)))
+        self._topics_dir = os.path.join(zk_root, "brokers", "topics")
+        os.makedirs(self._topics_dir, exist_ok=True)
+        nrep = max(1, min(replicas, len(self.brokers)))
+        # deliberately skewed initial placement (most replicas on the
+        # first few brokers): the planner has real work to do
+        skewed = self.brokers[:max(2, nrep)]
+        self.state: Dict[str, Dict[str, List[int]]] = {}
+        for t in range(max(1, topics)):
+            name = f"watch-t{t}"
+            self.state[name] = {
+                str(i): list(self.rng.sample(
+                    skewed if self.rng.random() < 0.8 else self.brokers,
+                    nrep,
+                ))
+                for i in range(max(1, partitions_per))
+            }
+        self._nrep = nrep
+        self._topic_seq = 0
+        self.events: Dict[str, int] = {
+            EV_EXTERNAL_FLIP: 0, EV_TOPIC_CREATE: 0,
+        }
+        # digest -> rendered oracle input text of every published state
+        self.snapshots: Dict[str, str] = {}
+        for name in self.state:
+            self._write_topic(name)
+        self.snapshot()
+
+    # -- publishing --------------------------------------------------------
+    def _write_topic(self, name: str) -> None:
+        path = os.path.join(self._topics_dir, name)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {"version": 1, "partitions": self.state[name]},
+                f, separators=(",", ":"),
+            )
+        os.replace(tmp, path)
+
+    def input_text(self) -> str:
+        """The current state as reassignment-JSON input — EXACTLY the
+        rows (topic-sorted, partition-id int-sorted, replicas only) a
+        watch read of the fake tree produces, so a ``-no-daemon`` run
+        on this text is the byte oracle for the watcher's plan."""
+        rows = [
+            {
+                "topic": t,
+                "partition": int(pid),
+                "replicas": self.state[t][pid],
+            }
+            for t in sorted(self.state)
+            for pid in sorted(self.state[t], key=int)
+        ]
+        return json.dumps(
+            {"version": 1, "partitions": rows}, separators=(",", ":")
+        )
+
+    def digest(self) -> str:
+        """The watch digest of the current state (serve/state.py over
+        ZK-decoded rows — version 0, the ZK read's PartitionList
+        default; replicas-only rows)."""
+        from kafkabalancer_tpu.models import Partition
+        from kafkabalancer_tpu.serve import state as sstate
+
+        canon = [
+            sstate.canonical_row_bytes(*sstate.partition_fields(
+                Partition(
+                    topic=t, partition=int(pid),
+                    replicas=list(self.state[t][pid]),
+                )
+            ))
+            for t in sorted(self.state)
+            for pid in sorted(self.state[t], key=int)
+        ]
+        return sstate.rows_digest(0, canon)
+
+    def snapshot(self) -> str:
+        """Record the current state's oracle text under its digest;
+        returns the digest."""
+        d = self.digest()
+        self.snapshots[d] = self.input_text()
+        return d
+
+    # -- the closed loop ---------------------------------------------------
+    def apply_plan(self, plan_text: str) -> int:
+        """Apply an emitted plan to the fake cluster (the role the
+        operator's reassignment tool plays in production) — one atomic
+        topic publish per touched topic. Returns rows changed."""
+        try:
+            doc = json.loads(plan_text)
+        except ValueError:
+            return 0
+        changed = 0
+        touched = set()
+        for entry in doc.get("partitions") or []:
+            if not isinstance(entry, dict):
+                continue
+            tmap = self.state.get(entry.get("topic", ""))
+            if tmap is None:
+                continue
+            pid = str(entry.get("partition"))
+            new = entry.get("replicas")
+            if pid in tmap and isinstance(new, list) and new != tmap[pid]:
+                tmap[pid] = [int(b) for b in new]
+                touched.add(entry["topic"])
+                changed += 1
+        for name in touched:
+            self._write_topic(name)
+        if changed:
+            self.snapshot()
+        return changed
+
+    # -- churn events ------------------------------------------------------
+    def external_flip(self) -> str:
+        """Out-of-band drift: one partition's replica set changes
+        under the watcher's feet (an operator move it did not emit) —
+        the watcher must resync, never emit a stale plan."""
+        name = self.rng.choice(sorted(self.state))
+        pid = self.rng.choice(sorted(self.state[name], key=int))
+        cur = self.state[name][pid]
+        free = [b for b in self.brokers if b not in cur]
+        if free:
+            i = self.rng.randrange(len(cur))
+            cur = list(cur)
+            cur[i] = self.rng.choice(free)
+            self.state[name][pid] = cur
+        self._write_topic(name)
+        self.events[EV_EXTERNAL_FLIP] += 1
+        return self.snapshot()
+
+    def create_topic(self, partitions: int = 2) -> str:
+        """Structural drift: a new topic appears (row count changes —
+        the watcher re-adopts from the fresh read)."""
+        self._topic_seq += 1
+        name = f"watch-new{self._topic_seq}"
+        self.state[name] = {
+            str(i): list(self.rng.sample(self.brokers, self._nrep))
+            for i in range(max(1, partitions))
+        }
+        self._write_topic(name)
+        self.events[EV_TOPIC_CREATE] += 1
+        return self.snapshot()
 
 
 class FleetSynth:

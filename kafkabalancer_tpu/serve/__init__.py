@@ -44,7 +44,13 @@ package removes the fresh process from the hot path entirely:
   ``{op: "overload", retry_after_ms}`` frame;
 - ``faults`` — the chaos fault-injection seam (inert by default;
   ``-serve-faults`` arms a deterministic schedule for the ``--chaos``
-  replay and the failure-path tests).
+  replay and the failure-path tests);
+- ``speculate`` — speculative plan-ahead (the idle window after
+  request N computes request N+1's answer; a digest-matching request
+  is a zero-dispatch memo read, preempted instantly by real traffic)
+  and the ``-watch`` continuous controller (the daemon subscribes to
+  Zookeeper itself and streams plans to a sink — no client process in
+  the steady state).
 
 HARD CONSTRAINT: ``protocol`` and ``client`` import no jax (directly or
 transitively) — a forwarded invocation must stay as light as an

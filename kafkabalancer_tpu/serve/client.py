@@ -629,6 +629,17 @@ def fetch_trace(
     return _scrape(path, "dump-trace", timeout)
 
 
+def fetch_watch(
+    path: str, timeout: float = 10.0
+) -> Optional[Dict[str, Any]]:
+    """The watch-lag scrape (the ``watch`` protocol op): a response
+    carrying the daemon's ``watch`` block (ticks/reads/lag/emitted
+    plans) and its ``speculation`` block, or None when no live,
+    version-compatible daemon answers. Much cheaper than ``stats`` —
+    the replay harness polls it between fake-ZK mutations."""
+    return _scrape(path, "watch", timeout)
+
+
 def release_session(
     path: str, tenant: str, timeout: float = 10.0
 ) -> Optional[int]:

@@ -73,6 +73,7 @@ from kafkabalancer_tpu.obs.flight import PHASE_OF_SPAN, FlightRecorder
 from kafkabalancer_tpu.obs.hist import OTHER_LABEL
 from kafkabalancer_tpu.obs.trace import Span
 from kafkabalancer_tpu.serve import faults
+from kafkabalancer_tpu.serve import speculate as spec_mod
 from kafkabalancer_tpu.serve import spill as spill_mod
 from kafkabalancer_tpu.serve.admission import AdmissionController
 from kafkabalancer_tpu.serve.devmem import device_memory_stats
@@ -106,7 +107,7 @@ _TENANT_HIST_FAMILIES = ("serve.request_s", "serve.phase.queue")
 _TENANT_COUNTER_FAMILIES = (
     "serve.requests", "serve.crashed_requests", "serve.delta_hits",
     "serve.resyncs_rows", "serve.resyncs_full", "serve.fallbacks",
-    "serve.sheds", "serve.restores",
+    "serve.sheds", "serve.restores", "serve.spec.hits",
 )
 
 
@@ -148,7 +149,7 @@ class PlanRequest:
     __slots__ = (
         "argv", "stdin", "done", "response", "bucket", "bucketed", "staged",
         "mb_entered", "t_submit", "session_ctx", "tenant", "deadline",
-        "started",
+        "started", "internal",
     )
 
     def __init__(
@@ -184,6 +185,13 @@ class PlanRequest:
         # identity admitted == requests + abandoned cannot double-count
         # a wedged-mid-handling request
         self.started = False
+        # daemon-internal work (serve/speculate.py): "spec" for a
+        # speculative plan-ahead, "watch" for a watch-mode re-plan,
+        # None for real client traffic. Internal requests never touch
+        # the idle clock, serve.requests/request_s, admission feedback,
+        # the flight request log or the `abandoned` identity — they
+        # carry their own serve.spec.*/serve.watch.* telemetry
+        self.internal: Optional[str] = None
 
 
 class Coalescer:
@@ -261,7 +269,11 @@ class Coalescer:
                     "error": "dispatcher died; request abandoned",
                 }
                 r.done.set()
-                flushed += 1
+                # internal (speculative/watch) requests never passed
+                # admission, so counting them here would break the
+                # admitted == requests + abandoned identity
+                if getattr(r, "internal", None) is None:
+                    flushed += 1
         with self._cv:
             self.abandoned += flushed
         t = threading.Thread(
@@ -394,6 +406,11 @@ class Daemon:
         faults_spec: str = "",
         spill_dir: str = "",
         warm_cap_mb: float = 256.0,
+        speculate: bool = False,
+        watch_conn: str = "",
+        watch_emit: str = "",
+        watch_poll: float = 5.0,
+        watch_argv: Optional[List[str]] = None,
     ) -> None:
         self.socket_path = socket_path
         self.idle_timeout = idle_timeout
@@ -453,6 +470,22 @@ class Daemon:
         # resident cluster sessions (protocol v2; serve/sessions.py):
         # LRU-capped per-tenant parsed/settled state + primed row cache
         self.sessions = SessionStore(cap=session_cap, idle_s=session_idle_s)
+        # speculative plan-ahead (serve/speculate.py): the idle-priority
+        # worker that plans request N+1 on the resident session and
+        # memoizes the answer; the store retires memos through it so
+        # the speculation block's conservation identity stays exact.
+        # Always constructed (the scrape block exists with the feature
+        # off); its worker thread starts in serve_forever.
+        self.speculator = spec_mod.Speculator(self, enabled=speculate)
+        self.sessions.spec = self.speculator
+        # watch mode (serve/speculate.py ZkWatcher): the daemon itself
+        # subscribes to Zookeeper and streams plans to watch_emit — no
+        # client process in the steady state
+        self.watch_conn = watch_conn
+        self.watch_emit = watch_emit
+        self.watch_poll = watch_poll
+        self.watch_argv = list(watch_argv) if watch_argv else None
+        self.watcher: Optional[spec_mod.ZkWatcher] = None
         # per-tenant telemetry label bound: top-K tenants by recent
         # activity keep individual hists/counters, the rest roll into
         # "other" (obs/hist.py HistFamily) — a million-tenant fleet
@@ -485,6 +518,10 @@ class Daemon:
             tenant_inflight=tenant_inflight,
             parallel=1,
         )
+        # every real plan-family ARRIVAL (admitted or shed) preempts
+        # any in-flight speculative dispatch (serve/speculate.py):
+        # idle plan-ahead work must never cost live traffic its p95
+        self._admission.on_arrival = self.speculator.note_real_traffic
 
     # -- warmup ----------------------------------------------------------
     def _warm_body(self) -> None:
@@ -577,7 +614,12 @@ class Daemon:
             # lane (one dict get + a float store per span)
             lane.last_beat = time.monotonic()
         phase = PHASE_OF_SPAN.get(sp.name)
-        if phase is not None:
+        if phase is not None and not sp.thread_name.startswith(
+            "serve-int-"
+        ):
+            # internal (speculative/watch) runs keep the lane heartbeat
+            # above but stay out of the serve.phase.* histograms — the
+            # per-phase breakdowns must describe real traffic only
             obs.metrics.hist_observe(
                 f"serve.phase.{phase}", (t1 - sp.t0_ns) / 1e9
             )
@@ -668,13 +710,26 @@ class Daemon:
         # the watchdog later abandons mid-handling still lands in the
         # requests counter when it resumes, never in `abandoned`
         req.started = True
+        internal = req.internal
+        if internal == "spec" and (
+            self.speculator.preempted() or self._admission.busy()
+        ):
+            # abort-before-start: real traffic arrived while the
+            # speculative request sat queued — defer, never delay a
+            # live request behind idle work (the speculator counts the
+            # non-ok response as aborted)
+            req.response = {
+                "v": PROTO_VERSION, "ok": False,
+                "error": "speculation deferred (real traffic waiting)",
+            }
+            return
         # chaos seam (serve/faults.py; inert unless -serve-faults armed):
         # a scheduled dispatch_delay sleeps HERE — observable by the
         # lane watchdog exactly like a wedged host call
         faults.fire("dispatch_delay")
         t_start = time.perf_counter()
         tenant_label = req.tenant or OTHER_LABEL
-        if req.t_submit is not None:
+        if req.t_submit is not None and internal is None:
             # queue wait: accept-thread submit to dispatcher pickup —
             # global hist AND the tenant family (who waits behind whom)
             queue_s = t_start - req.t_submit
@@ -683,9 +738,13 @@ class Daemon:
                 "serve.phase.queue", tenant_label, queue_s
             )
         with self._lock:
-            self._requests += 1
-            if coalesced:
-                self._coalesced += 1
+            if internal is None:
+                # internal (speculative/watch) work is NOT a request:
+                # serve.requests stays the real-traffic truth and the
+                # admitted == requests + abandoned identity holds
+                self._requests += 1
+                if coalesced:
+                    self._coalesced += 1
             n = self._requests
             n_coal = self._coalesced
             self._seq += 1
@@ -790,6 +849,14 @@ class Daemon:
             # answered with a structured error, never a wrong plan
             faults.fire("transfer_fail")
             with contextlib.ExitStack() as st:
+                if internal == "spec":
+                    # the cooperative preemption hook: checked per
+                    # solver chunk round and per applied move; a raise
+                    # unwinds the whole run (caught below)
+                    spec_mod.install_abort_check(
+                        self.speculator.maybe_abort
+                    )
+                    st.callback(spec_mod.install_abort_check, None)
                 if lane is not None:
                     st.enter_context(lane.context())
                 if ctx is not None:
@@ -800,19 +867,32 @@ class Daemon:
                     st.enter_context(ctx.activate())
                 if mb is not None:
                     st.enter_context(mb.member(req))
-                rc_box.append(
-                    cli.run(
-                        i, out, err, ["kafkabalancer"] + req.argv,
-                        attrs=attrs,
-                        refresh_attrs=refresh if lane is not None else None,
-                        session=ctx,
+                try:
+                    rc_box.append(
+                        cli.run(
+                            i, out, err, ["kafkabalancer"] + req.argv,
+                            attrs=attrs,
+                            refresh_attrs=(
+                                refresh if lane is not None else None
+                            ),
+                            session=ctx,
+                        )
                     )
-                )
+                except spec_mod.SpeculationAborted:
+                    # a preempted speculative run: no rc, no traceback
+                    # noise — the empty rc_box reads as a non-ok
+                    # response and the speculator counts it aborted
+                    pass
 
         # a named thread per request: the request's telemetry spans get
-        # their own track ("serve-req-N") in -stats / -trace output,
-        # and the flight recorder attributes phase spans to it by name
-        thread_name = f"serve-req-{seq}"
+        # their own track ("serve-req-N"; internal speculative/watch
+        # work runs as "serve-int-N" so the phase histograms can skip
+        # it) in -stats / -trace output, and the flight recorder
+        # attributes phase spans to it by name
+        thread_name = (
+            f"serve-int-{seq}" if internal is not None
+            else f"serve-req-{seq}"
+        )
         t = threading.Thread(target=body, name=thread_name)
         if lane is not None:
             self._thread_lanes[thread_name] = lane
@@ -825,9 +905,11 @@ class Daemon:
                 # masquerade as one of the CLI's documented exit codes —
                 # an ok:false response makes the client fall back and
                 # plan in-process
-                self._log(
-                    f"serve: request {seq} crashed (see traceback above)"
-                )
+                if internal is None:
+                    self._log(
+                        f"serve: request {seq} crashed "
+                        "(see traceback above)"
+                    )
                 if mb is not None and not req.mb_entered:
                     # the body died BEFORE joining its microbatch
                     # barrier (lane-context entry failure): release the
@@ -837,7 +919,10 @@ class Daemon:
                 req.response = {
                     "v": PROTO_VERSION,
                     "ok": False,
-                    "error": "internal error: planner thread died",
+                    "error": (
+                        "speculation aborted" if internal == "spec"
+                        else "internal error: planner thread died"
+                    ),
                 }
             else:
                 req.response = {
@@ -847,25 +932,38 @@ class Daemon:
                     "stdout": out.getvalue(),
                     "stderr": err.getvalue(),
                 }
-            self._touch()
+            if internal is None:
+                # internal work must not reset the idle clock: a daemon
+                # that is only speculating (or watch-ticking) still
+                # honors -serve-idle-timeout (the PR-12 hello/scrape
+                # rule extended)
+                self._touch()
         finally:
             # the flight-recorder request summary + the reconciliation
             # histogram: EVERY _handle_plan call (crash paths included)
             # lands exactly one serve.request_s observation, so a
             # post-traffic scrape's hist count equals serve.requests
             wall = time.perf_counter() - t_start
-            obs.metrics.hist_observe("serve.request_s", wall)
-            # feed the admission layer's retry-after estimate
-            self._admission.note_service(wall)
-            # the tenant dimension: same invariant per label — every
-            # _handle_plan call lands exactly one serve.request_s
-            # family observation and one serve.requests count, so a
-            # replay driver's per-tenant issued counts reconcile
-            # EXACTLY against the scrape (kafkabalancer_tpu/replay/)
-            obs.metrics.tenant_hist_observe(
-                "serve.request_s", tenant_label, wall
-            )
-            obs.metrics.tenant_count("serve.requests", tenant_label)
+            if internal is None:
+                obs.metrics.hist_observe("serve.request_s", wall)
+                # feed the admission layer's retry-after estimate
+                self._admission.note_service(wall)
+                # the tenant dimension: same invariant per label —
+                # every _handle_plan call lands exactly one
+                # serve.request_s family observation and one
+                # serve.requests count, so a replay driver's per-tenant
+                # issued counts reconcile EXACTLY against the scrape
+                # (kafkabalancer_tpu/replay/)
+                obs.metrics.tenant_hist_observe(
+                    "serve.request_s", tenant_label, wall
+                )
+                obs.metrics.tenant_count("serve.requests", tenant_label)
+            else:
+                # speculative/watch work carries its OWN wall hist —
+                # never serve.request_s (its count must equal
+                # serve.requests exactly) and never the retry-after
+                # EWMA (idle work must not skew overload estimates)
+                obs.metrics.hist_observe(f"serve.{internal}.plan_s", wall)
             phases = self.flight.pop_request_phases(thread_name)
             self._thread_lanes.pop(thread_name, None)
             rc_val = rc_box[0] if rc_box else None
@@ -906,7 +1004,7 @@ class Daemon:
                         )
                     except Exception:
                         pass  # bucket stays unmemoized; probe-only loss
-                if self.spill is not None:
+                if self.spill is not None and internal is None:
                     # the CONTINUOUS spill: every clean session request
                     # refreshes the warm record (skipped when the
                     # digest has not moved), so a SIGKILL at any later
@@ -914,25 +1012,30 @@ class Daemon:
                     # restart recovery works from exactly this write.
                     # One O(P) struct pack + an atomic tmp+rename per
                     # completed request; a failed write only costs
-                    # durability, never the answer (write_failures)
+                    # durability, never the answer (write_failures).
+                    # INTERNAL (speculative/watch) runs never spill:
+                    # their post-run state is ahead of what the client
+                    # has seen — the last real request's record is the
+                    # one a restore must match (serve/sessions.py)
                     self.spill.spill(
                         (ctx.session.tenant, ctx.session.sig),
                         ctx.session,
                     )
-            self.flight.record_request({
-                "req": seq,
-                "t": round(time.time(), 3),
-                "lane": lane.index if lane is not None else 0,
-                "tenant": req.tenant or None,
-                "bucket": list(req.bucket) if req.bucket else None,
-                "rc": rc_val,
-                "coalesced": coalesced,
-                "wall_s": round(wall, 6),
-                "phases": {k: round(v, 6) for k, v in sorted(
-                    phases.items()
-                )},
-            })
-            if rc_val is None:
+            if internal is None:
+                self.flight.record_request({
+                    "req": seq,
+                    "t": round(time.time(), 3),
+                    "lane": lane.index if lane is not None else 0,
+                    "tenant": req.tenant or None,
+                    "bucket": list(req.bucket) if req.bucket else None,
+                    "rc": rc_val,
+                    "coalesced": coalesced,
+                    "wall_s": round(wall, 6),
+                    "phases": {k: round(v, 6) for k, v in sorted(
+                        phases.items()
+                    )},
+                })
+            if rc_val is None and internal is None:
                 with self._lock:
                     self._crashed += 1
                 obs.metrics.count("serve.crashed_requests")
@@ -944,7 +1047,11 @@ class Daemon:
                     directory=self.flight_dir or None,
                     log=self._log,
                 )
-            elif self.slow_ms > 0 and wall * 1000.0 >= self.slow_ms:
+            elif (
+                internal is None
+                and self.slow_ms > 0
+                and wall * 1000.0 >= self.slow_ms
+            ):
                 with self._lock:
                     self._slow += 1
                 obs.metrics.count("serve.slow_requests")
@@ -1045,6 +1152,10 @@ class Daemon:
         group) — see LaneScheduler._run_group/_run_continuous.
         Conservative on purpose: a false negative costs a missed fusion,
         a false positive stalls the batch's live peers."""
+        if req.internal is not None:
+            # idle speculative/watch work must never couple its
+            # lifetime to a live request's fused batch
+            return False
         if _argv_value(req.argv, "fused") != "true":
             return False
         if _argv_value(req.argv, "rebalance-leader") == "true":
@@ -1198,7 +1309,7 @@ class Daemon:
             # resident cluster sessions (serve/sessions.py): count,
             # resident bytes, delta hits/resyncs — serve-stats/3
             "sessions": self.sessions.stats(),
-            # the warm session tier (serve/spill.py; serve-stats/6):
+            # the warm session tier (serve/spill.py; serve-stats/7):
             # spill/restore/corrupt-drop counters under the
             # conservation identity spills + adopted == restores +
             # corrupt_drops + evictions + warm_entries, plus the live
@@ -1206,6 +1317,17 @@ class Daemon:
             "paging": (
                 self.spill.stats() if self.spill is not None
                 else spill_mod.SpillStore.disabled_stats()
+            ),
+            # speculative plan-ahead (serve-stats/7; serve/speculate.py)
+            # under the exact identity attempts == hits + misses +
+            # poisoned + memos at every scrape instant
+            "speculation": self.speculator.stats(),
+            # the watch-driven continuous controller (serve-stats/7):
+            # ticks/reads/lag + emitted-plan attribution; same key set
+            # with the mode off
+            "watch": (
+                self.watcher.stats() if self.watcher is not None
+                else spec_mod.ZkWatcher.disabled_stats(self.watch_conn)
             ),
             # daemon-observed fallback/resync reasons, by name
             "fallbacks": fallbacks,
@@ -1342,6 +1464,7 @@ class Daemon:
                 "request_s": hist,
                 "queue_s": queue,
                 "delta_hits": cval("serve.delta_hits", label),
+                "spec_hits": cval("serve.spec.hits", label),
                 "resyncs_rows": cval("serve.resyncs_rows", label),
                 "resyncs_full": cval("serve.resyncs_full", label),
                 "fallbacks": cval("serve.fallbacks", label),
@@ -1358,9 +1481,9 @@ class Daemon:
         other = entry(OTHER_LABEL, req_fam.get("other"))
         has_other = req_fam.get("other") is not None or any(
             other[k] for k in (
-                "requests", "crashed", "delta_hits", "resyncs_rows",
-                "resyncs_full", "fallbacks", "sheds", "restores",
-                "warm_sessions",
+                "requests", "crashed", "delta_hits", "spec_hits",
+                "resyncs_rows", "resyncs_full", "fallbacks", "sheds",
+                "restores", "warm_sessions",
             )
         )
         return {
@@ -1407,6 +1530,8 @@ class Daemon:
             return None
         # t_submit anchors the queue-wait histogram at ARRIVAL: the
         # fair-queue wait is part of what a tenant waits behind
+        # (admission.acquire preempts any in-flight speculation via
+        # its arrival hook — idle work never costs live traffic p95)
         req.t_submit = time.perf_counter()
         shed = self._admission.acquire(req)
         if shed is not None:
@@ -1518,6 +1643,81 @@ class Daemon:
         obs.metrics.tenant_count("serve.restores", tenant or OTHER_LABEL)
         return sess, False, True
 
+    def _answer_from_memo(
+        self,
+        key: Tuple[str, str],
+        sess: Any,
+        memo: Any,
+        tenant: str,
+        deadline: Optional[float],
+        argv: List[str],
+        t0: float,
+    ) -> Dict[str, Any]:
+        """Answer a digest-and-argv-matching ``plan-delta`` from the
+        speculative memo (serve/speculate.py): ZERO dispatch, ZERO
+        parse — the answer was computed during the idle window after
+        the previous request. The memo hit is a REAL request: it rides
+        admission (so the fairness caps and the conservation identity
+        ``admitted == requests + abandoned`` hold), counts in
+        ``serve.requests``/``serve.request_s``/the flight log like any
+        served request (with its near-zero wall — that IS the
+        speedup), counts a delta hit (it is the delta fast path at its
+        fastest) and carries the ``serve.spec.*`` hit attribution the
+        acceptance gate reads. The caller still holds the session
+        checkout and has already CONSUMED the memo via
+        ``Speculator.take_memo`` (the CAS that makes hit-vs-poison
+        retirement exactly-once)."""
+        req = PlanRequest(argv, None, tenant, deadline=deadline)
+        shed = self._admission.acquire(req)
+        if shed is not None:
+            # the answer was never delivered: put the memo back so the
+            # client's backoff retry (same digest) can still hit
+            self.speculator.untake_memo(sess, memo)
+            return shed
+        try:
+            tenant_label = tenant or OTHER_LABEL
+            obs.metrics.tenant_count("serve.spec.hits", tenant_label)
+            self.sessions.count_delta_hit()
+            obs.metrics.tenant_count("serve.delta_hits", tenant_label)
+            with self._lock:
+                self._requests += 1
+                self._seq += 1
+                seq = self._seq
+            sess.last_used = time.monotonic()
+            if self.spill is not None:
+                # the continuous-spill invariant moves with the hit:
+                # the client now advances to the memo's post-move
+                # state, which is exactly the session's current raw
+                # shadow — persist it so a SIGKILL still restores with
+                # a digest match
+                self.spill.spill(key, sess)
+            wall = time.perf_counter() - t0
+            obs.metrics.hist_observe("serve.spec.hit_s", wall)
+            obs.metrics.hist_observe("serve.request_s", wall)
+            obs.metrics.tenant_hist_observe(
+                "serve.request_s", tenant_label, wall
+            )
+            obs.metrics.tenant_count("serve.requests", tenant_label)
+            self.flight.record_request({
+                "req": seq,
+                "t": round(time.time(), 3),
+                "lane": 0,
+                "tenant": tenant or None,
+                "bucket": list(sess.bucket) if sess.bucket else None,
+                "rc": memo.rc,
+                "coalesced": False,
+                "spec_hit": True,
+                "wall_s": round(wall, 6),
+                "phases": {},
+            })
+            self._touch()
+            return {
+                "v": PROTO_VERSION, "ok": True, "rc": memo.rc,
+                "stdout": memo.stdout, "stderr": memo.stderr,
+            }
+        finally:
+            self._admission.release(req)
+
     def _session_op(
         self, op: str, hdr: Dict[str, Any], blob: bytes, argv: List[str]
     ) -> Tuple[Dict[str, Any], bytes]:
@@ -1561,6 +1761,7 @@ class Daemon:
                 try:
                     req = PlanRequest(argv, text, tenant, deadline=deadline)
                     req.session_ctx = ctx
+                    sess.last_argv = list(argv)
                     resp = self._dispatch_plan(req)
                 finally:
                     sess.in_use = False
@@ -1571,17 +1772,55 @@ class Daemon:
                 and ctx.snapshotted
             ):
                 self.sessions.put(key, sess)
+                # the freshly registered session's next move can start
+                # computing right away (idle-priority)
+                self.speculator.enqueue(key)
             return self._v2_plan_resp(resp)
 
         if op == "plan-delta":
             digest = str(hdr.get("digest", ""))
+            spec = self.speculator
+            t_hit0 = time.perf_counter()
             sess, busy, restored = self._checkout_or_restore(key, tenant)
+            if sess is None and busy and spec.wait_for_key(
+                key, digest, argv,
+                (deadline - time.monotonic()) if deadline else 120.0,
+            ):
+                # speculation held the session: a MATCHING in-flight
+                # run just computed this very answer (the memo path
+                # below consumes it); a mismatching one was aborted —
+                # either way, re-claim and proceed
+                sess, busy, restored = self._checkout_or_restore(
+                    key, tenant
+                )
             if sess is None:
                 self._count_fallback(
                     "session_busy" if busy else "session_absent", tenant
                 )
                 return _resync_full()
+            enqueue_spec = False
             try:
+                memo = sess.spec_memo
+                if memo is not None:
+                    if (
+                        memo.key_digest == digest
+                        and memo.argv == argv
+                        and spec.take_memo(sess, memo)
+                    ):
+                        # the tentpole fast path: the answer was
+                        # planned before it was asked for (take_memo
+                        # is the CAS — a concurrently poisoned memo
+                        # falls through to the live ladder below)
+                        resp = self._answer_from_memo(
+                            key, sess, memo, tenant, deadline, argv,
+                            t_hit0,
+                        )
+                        enqueue_spec = bool(resp.get("ok"))
+                        return self._v2_plan_resp(resp)
+                    # the memo cannot serve this request (drifted
+                    # digest or changed flags): drop it and fall back
+                    # to the live ladder — parity over latency, always
+                    spec.retire_miss(sess, memo)
                 if sess.digest is not None and digest == sess.digest:
                     # a just-restored session has no settled list yet;
                     # like universe_dirty, it re-derives one from the
@@ -1611,7 +1850,14 @@ class Daemon:
                         argv, None, tenant, deadline=deadline
                     )
                     req.session_ctx = ctx
-                    return self._v2_plan_resp(self._dispatch_plan(req))
+                    sess.last_argv = list(argv)
+                    resp = self._dispatch_plan(req)
+                    enqueue_spec = (
+                        resp is not None
+                        and bool(resp.get("ok"))
+                        and resp.get("rc") == 0
+                    )
+                    return self._v2_plan_resp(resp)
                 # mismatch: offer the row-level diff — the client ships
                 # only the rows whose hashes differ
                 self._count_fallback("session_digest_mismatch", tenant)
@@ -1622,19 +1868,39 @@ class Daemon:
                 }, table
             finally:
                 self.sessions.checkin(sess)
+                if enqueue_spec:
+                    # plan-ahead AFTER the checkin (the speculator
+                    # needs the session lock): the next request's
+                    # answer starts computing in the idle window
+                    spec.enqueue(key)
 
         if op == "plan-rows":
             digest = str(hdr.get("digest", ""))
             # restore applies here too: the row diff the client built
             # against a (possibly restored) hash table patches onto the
             # restored raw shadow the same as onto a hot one
+            spec = self.speculator
             sess, busy, restored = self._checkout_or_restore(key, tenant)
+            if sess is None and busy and spec.wait_for_key(
+                key, "", [],
+                (deadline - time.monotonic()) if deadline else 30.0,
+            ):
+                # a resync can never use an in-flight speculation:
+                # abort it, wait it out, re-claim
+                sess, busy, restored = self._checkout_or_restore(
+                    key, tenant
+                )
             if sess is None:
                 self._count_fallback(
                     "session_busy" if busy else "session_absent", tenant
                 )
                 return _resync_full()
+            enqueue_spec = False
             try:
+                rows_memo = sess.spec_memo
+                if rows_memo is not None:
+                    # a resyncing client has drifted past the memo
+                    spec.retire_miss(sess, rows_memo)
                 try:
                     patches = sstate.unpack_rows(blob)
                 except ValueError:
@@ -1659,9 +1925,18 @@ class Daemon:
                 ctx = PlanSessionContext("rows", sess, restored=restored)
                 req = PlanRequest(argv, None, tenant, deadline=deadline)
                 req.session_ctx = ctx
-                return self._v2_plan_resp(self._dispatch_plan(req))
+                sess.last_argv = list(argv)
+                resp = self._dispatch_plan(req)
+                enqueue_spec = (
+                    resp is not None
+                    and bool(resp.get("ok"))
+                    and resp.get("rc") == 0
+                )
+                return self._v2_plan_resp(resp)
             finally:
                 self.sessions.checkin(sess)
+                if enqueue_spec:
+                    spec.enqueue(key)
 
         return {
             "v": PROTO_V2, "ok": False, "op": "error",
@@ -1704,6 +1979,18 @@ class Daemon:
                 write_frame2(conn, {**self._hello(), "v": PROTO_V2})
             elif op == "stats":
                 write_frame2(conn, {**self._stats_doc(), "v": PROTO_V2})
+            elif op == "watch":
+                write_frame2(conn, {
+                    "v": PROTO_V2, "ok": True, "op": "watch",
+                    "watch": (
+                        self.watcher.stats()
+                        if self.watcher is not None
+                        else spec_mod.ZkWatcher.disabled_stats(
+                            self.watch_conn
+                        )
+                    ),
+                    "speculation": self.speculator.stats(),
+                })
             elif op == "release":
                 # an explicit forget covers BOTH tiers: dropping only
                 # the hot session would leave a warm record that
@@ -1823,6 +2110,22 @@ class Daemon:
                     write_frame(conn, {
                         "v": PROTO_VERSION, "ok": True, "op": "dump-trace",
                         "trace": self.flight.to_perfetto(),
+                    })
+                elif op == "watch":
+                    # the watch-lag scrape: answered on the connection
+                    # thread like stats, passive for the idle clock —
+                    # the replay harness polls it to sequence fake-ZK
+                    # mutations against the watcher's reads
+                    write_frame(conn, {
+                        "v": PROTO_VERSION, "ok": True, "op": "watch",
+                        "watch": (
+                            self.watcher.stats()
+                            if self.watcher is not None
+                            else spec_mod.ZkWatcher.disabled_stats(
+                                self.watch_conn
+                            )
+                        ),
+                        "speculation": self.speculator.stats(),
                     })
                 elif op == "plan":
                     self._touch()
@@ -2043,6 +2346,32 @@ class Daemon:
                 return 3
             self._log(f"serve: FAULT INJECTION ARMED: {plan.spec}")
 
+        # speculative plan-ahead worker (idle-priority; no-op thread
+        # unless -serve-speculate) and, with -watch, the continuous
+        # controller — both wait out the dispatcher-ready latch before
+        # touching planning, so startup order is unchanged
+        self.speculator.start()
+        if self.speculator.enabled:
+            self._log("serve: speculative plan-ahead enabled")
+        if self.watch_conn:
+            self.watcher = spec_mod.ZkWatcher(
+                self,
+                self.watch_conn,
+                emit=self.watch_emit,
+                poll_s=self.watch_poll,
+                argv=self.watch_argv,
+            )
+            self.watcher.start()
+            self._log(
+                f"serve: watching zookeeper {self.watch_conn} "
+                f"(poll {self.watch_poll:g}s"
+                + (
+                    f", emitting plans to {self.watch_emit}"
+                    if self.watch_emit else ""
+                )
+                + ")"
+            )
+
         if self.warm:
             # the dispatcher is built on the warm thread (its lane
             # resolution pays the backend attach) so the accept loop
@@ -2109,11 +2438,20 @@ class Daemon:
                 ).start()
         finally:
             listener.close()
+            # internal producers first: the speculator/watcher stop
+            # FEEDING the dispatcher (their in-flight runs abort at the
+            # next preemption check and drain through dispatcher stop)
+            self.speculator.request_stop()
+            if self.watcher is not None:
+                self.watcher.request_stop()
             # flush the fair queue FIRST (its waiters would otherwise
             # block their connection threads through dispatcher stop)
             self._admission.stop()
             if self._coalescer is not None:
                 self._coalescer.stop()
+            self.speculator.join()
+            if self.watcher is not None:
+                self.watcher.join()
             if self.spill is not None:
                 # the SHUTDOWN FLUSH (idle timeout, SIGTERM, and the
                 # shutdown op all route through here): with the

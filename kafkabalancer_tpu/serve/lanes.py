@@ -768,7 +768,13 @@ class LaneScheduler:
                 else:
                     orphaned.append(r)
             self.requeues += moved
-            self.abandoned += len(orphaned)
+            # internal (speculative/watch) requests never passed
+            # admission: excluded from `abandoned` so the identity
+            # admitted == requests + abandoned stays exact
+            self.abandoned += sum(
+                1 for r in orphaned
+                if getattr(r, "internal", None) is None
+            )
             if moved:
                 self._cv.notify_all()
         for r in orphaned:
@@ -838,10 +844,17 @@ class LaneScheduler:
             # abandoned = admitted work that never BEGAN handling and
             # got an error instead; a request wedged mid-handling still
             # reaches the requests counter, so counting it here too
-            # would double-book the conservation identity
-            self.abandoned += len(
-                [r for r in stuck if not getattr(r, "started", False)]
-            ) + len(orphaned)
+            # would double-book the conservation identity — and
+            # internal (speculative/watch) requests never passed
+            # admission at all, so they are excluded outright
+            self.abandoned += len([
+                r for r in stuck
+                if not getattr(r, "started", False)
+                and getattr(r, "internal", None) is None
+            ]) + sum(
+                1 for r in orphaned
+                if getattr(r, "internal", None) is None
+            )
             # affinity for buckets owned by the sick lane re-resolves
             # on the next route (a healthy lane takes ownership)
             self._affinity = {

@@ -40,6 +40,13 @@ Requests carry ``"op"``:
   the plan dispatcher — a scrape must not pause planning;
 - ``dump-trace`` — the flight recorder's span ring + request log as a
   Perfetto-loadable Chrome trace document (the client writes the file);
+- ``watch``    — the watch-mode lag scrape (serve/speculate.py
+  ``ZkWatcher``): ticks/reads/errors, emitted-plan and speculation-hit
+  counts, ``last_read_age_s`` / ``last_event_lag_s`` staleness, and
+  the watcher's current state digest — answered on the connection
+  thread like ``stats`` and equally passive for the idle clock. The
+  replay harness polls it to sequence fake-ZK mutations against the
+  watcher's reads; the same block also rides the ``stats`` document;
 - ``shutdown`` — orderly daemon exit (acknowledged before the listener
   closes).
 
@@ -126,7 +133,14 @@ PROTO_V2 = 2
 #     live warm_bytes/warm_entries footprint; same key set with the
 #     tier disabled), and per-tenant "restores" / "warm_sessions" /
 #     "warm_bytes" in the tenants block
-STATS_SCHEMA_VERSION = 6
+# v7: + "speculation" (speculative plan-ahead, serve/speculate.py:
+#     attempts / hits / misses / poisoned / aborted / deferred /
+#     wasted_dispatches / memos / inflight under the exact identity
+#     attempts == hits + misses + poisoned + memos), "watch" (the
+#     -watch continuous controller: ticks / reads / events / resyncs /
+#     plans_emitted / lag fields; same key set with the mode off), and
+#     per-tenant "spec_hits" in the tenants block
+STATS_SCHEMA_VERSION = 7
 STATS_SCHEMA = f"kafkabalancer-tpu.serve-stats/{STATS_SCHEMA_VERSION}"
 
 # a frame larger than this is a protocol error, not a payload: the

@@ -52,7 +52,7 @@ from contextlib import contextmanager
 from typing import Any, Dict, Iterable, Iterator, List, Optional, Set, Tuple
 
 from kafkabalancer_tpu.models import Partition, PartitionList
-from kafkabalancer_tpu.serve import state as sstate
+from kafkabalancer_tpu.serve import speculate, state as sstate
 
 SessionKey = Tuple[str, str]
 
@@ -126,6 +126,12 @@ class ClusterSession:
         self.universe_dirty = False
         self.bucket: Optional[Any] = None
         self.approx_bytes = 0
+        # speculative plan-ahead (serve/speculate.py): the canonical
+        # argv of the last clean session request (what the speculator
+        # re-plans with) and the live memoized answer, if any — the
+        # memo is owned/retired through the Speculator's counters
+        self.last_argv: Optional[List[str]] = None
+        self.spec_memo: Optional[Any] = None
         from kafkabalancer_tpu.serve.cache import TensorizeRowCache
 
         self.row_cache = TensorizeRowCache()
@@ -349,6 +355,9 @@ class SessionStore:
         self.cap = max(1, cap)
         self.idle_s = idle_s
         self.spill = spill
+        # the daemon's Speculator (serve/speculate.py), when one is
+        # attached: session removal retires any live memo as poisoned
+        self.spec: Optional[Any] = None
         self._lock = threading.Lock()
         self._sessions: Dict[SessionKey, ClusterSession] = {}
         self.registered = 0
@@ -371,6 +380,14 @@ class SessionStore:
         self._release_gens: Dict[str, int] = {}
 
     def _retire(self, sess: ClusterSession) -> None:
+        # a removed/replaced session's memoized answer can never be
+        # served: retire it as poisoned BEFORE the zombie park (the
+        # state it predicts is superseded either way)
+        if sess.spec_memo is not None:
+            if self.spec is not None:
+                self.spec.poison_session(sess)
+            else:
+                sess.spec_memo = None
         if sess.in_use:
             self._zombies.append(sess)
             return
@@ -448,8 +465,13 @@ class SessionStore:
     def _spill_locked(self, key: SessionKey, sess: ClusterSession) -> None:
         """Demote one session to the warm tier (no-op without one, or
         for a session whose prediction is poisoned — the spill layer
-        refuses untrustworthy state itself)."""
-        if self.spill is not None:
+        refuses untrustworthy state itself). A session with a LIVE
+        speculative memo is deliberately NOT re-spilled: its in-memory
+        state has advanced past the answer the client has seen, while
+        the continuous spill of the last REAL request already persisted
+        exactly the state the client will describe next — overwriting
+        that record would turn the next restore into a resync."""
+        if self.spill is not None and sess.spec_memo is None:
             self.spill.spill(key, sess)
 
     def put(self, key: SessionKey, sess: ClusterSession) -> None:
@@ -524,6 +546,11 @@ class SessionStore:
             for z in self._zombies:
                 if z.tenant == tenant:
                     z.released = True
+                    if z.spec_memo is not None:
+                        if self.spec is not None:
+                            self.spec.poison_session(z)
+                        else:
+                            z.spec_memo = None
             self.released += len(keys)
             return len(keys)
 
@@ -580,7 +607,14 @@ class SessionStore:
         with self._lock:
             flushed = 0
             for k, s in self._sessions.items():
-                if not s.in_use and self.spill.spill(k, s):
+                # spec-memo sessions keep their last REAL spill record
+                # (see _spill_locked) — flushing the advanced state
+                # would break the next restore's digest match
+                if (
+                    not s.in_use
+                    and s.spec_memo is None
+                    and self.spill.spill(k, s)
+                ):
                     flushed += 1
             return flushed
 
@@ -686,6 +720,10 @@ class PlanSessionContext:
             self.snapshotted = True
 
     def change(self, part: Partition) -> None:
+        # per-applied-move preemption seam: a speculative run aborts
+        # here (one getattr for every real request — see
+        # serve/speculate.py maybe_abort_dispatch)
+        speculate.maybe_abort_dispatch()
         rec = self.session.change(part)
         if rec is not None:
             self._log.append(rec)

@@ -1009,8 +1009,14 @@ def _dispatch_chunk(dp, cfg: RebalanceConfig, chunk: int, *a, **kw) -> "np.ndarr
     """One chunk through the AOT dispatch policy (see :func:`packed_call`
     for the argument assembly and the raw-numpy contract). A thread with
     a microbatch group installed offers the dispatch for cross-request
-    fusion first; a declined offer (or any group failure) runs solo."""
+    fusion first; a declined offer (or any group failure) runs solo.
+    A SPECULATIVE daemon run (serve/speculate.py) checks its preemption
+    flag here, once per chunk round — real traffic aborts idle
+    plan-ahead work before the next device dispatch starts."""
     from kafkabalancer_tpu.ops import aot
+    from kafkabalancer_tpu.serve.speculate import maybe_abort_dispatch
+
+    maybe_abort_dispatch()
 
     args, statics = packed_call(dp, cfg, chunk, *a, **kw)
     obs.metrics.count("solver.chunks")

@@ -92,6 +92,11 @@ class FlagSet:
         self.output = output
         self.flags: Dict[str, Flag] = {}
         self.args: List[str] = []  # positional remainder after parsing
+        # names EXPLICITLY set by parse() — Go's flag.Visit equivalent:
+        # "was this flag given?" is distinct from "does its value equal
+        # the default?" (an explicit -serve-idle-timeout=900 must not
+        # read as unset)
+        self.seen: set = set()
         self.usage: Optional[Callable[[], None]] = None
 
     # --- definition -----------------------------------------------------
@@ -173,6 +178,7 @@ class FlagSet:
                     self._usage()
                     return False
                 return self._fail(f"flag provided but not defined: -{name}")
+            self.seen.add(name)
 
             if fl.kind == "bool":
                 if has_value:

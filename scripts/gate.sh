@@ -50,8 +50,17 @@
 #                         parity vs -no-daemon at every step),
 #                         serve.delta_hits >= 1 and session bytes
 #                         present via -serve-stats-json
+#  10b. speculative     — register -> 3 outer-loop moves with
+#      plan-ahead smoke    memoizable answers: >= 1 serve.spec hit via
+#                         the serve-stats/7 scrape (attribution
+#                         required), the speculation identity exact,
+#                         byte parity vs -no-daemon at every step
+#  10c. watch-mode      — a -watch daemon over the fake-ZK seam emits
+#      smoke              one plan with ZERO client plan ops, byte-
+#                         identical to -no-daemon on the same state;
+#                         watch lag observable via the `watch` op
 #  11. replay smoke     — seeded 3-tenant churn replay against a
-#                         private daemon: serve-stats/6 schema,
+#                         private daemon: serve-stats/7 schema,
 #                         per-tenant counts reconciling exactly with
 #                         the driver, scrape-vs-flight latency within
 #                         one histogram bucket, plan byte parity vs
@@ -517,7 +526,7 @@ if [ "$cb_ready" = 1 ]; then
       -serve-stats-json 2>/dev/null | "$PYTHON" -c '
 import json, sys
 p = json.loads(sys.stdin.read())
-assert p["schema"] == "kafkabalancer-tpu.serve-stats/6", p.get("schema")
+assert p["schema"] == "kafkabalancer-tpu.serve-stats/7", p.get("schema")
 assert "serve.request_s" in p["hists"], sorted(p["hists"])
 assert "serve.phase.parse" in p["hists"], sorted(p["hists"])
 assert isinstance(p["memory"], list) and p["memory"], p.get("memory")
@@ -683,12 +692,189 @@ else
 fi
 rm -rf "$ss_tmp"
 
+step "speculative plan-ahead smoke (register + 3 moves, memo hits + parity)"
+# The tentpole fast path end to end (docs/serving.md § Speculative
+# plan-ahead): an outer loop registers, then takes three moves with NO
+# telemetry flags (memoizable answers). After each answered move the
+# daemon plans the NEXT one during the idle window; the following
+# digest-matching request must answer from the memo — serve.spec.hits
+# >= 1 through the serve-stats/7 scrape (hit attribution REQUIRED, so
+# a silent live-path fallback cannot masquerade), the speculation
+# identity exact, and plan bytes identical to -no-daemon at EVERY step.
+sp_tmp=$(mktemp -d "${TMPDIR:-/tmp}/kb-gate-spec.XXXXXX")
+sp_sock="$sp_tmp/kb.sock"
+cp tests/data/test.json "$sp_tmp/cluster.json"
+JAX_PLATFORMS=cpu JAX_COMPILATION_CACHE_DIR="$sp_tmp" \
+  "$PYTHON" -m kafkabalancer_tpu -serve "-serve-socket=$sp_sock" \
+  -serve-idle-timeout=180 >"$sp_tmp/daemon.log" 2>&1 &
+sp_pid=$!
+sp_ready=0
+for _ in $(seq 1 60); do
+  if "$PYTHON" -c "import sys
+from kafkabalancer_tpu.serve.client import daemon_alive
+sys.exit(0 if daemon_alive('$sp_sock') else 1)" 2>/dev/null; then
+    sp_ready=1; break
+  fi
+  sleep 0.25
+done
+if [ "$sp_ready" = 1 ]; then
+  sp_ok=1
+  for stp in 0 1 2 3; do
+    JAX_PLATFORMS=cpu "$PYTHON" -m kafkabalancer_tpu -input-json \
+      -input "$sp_tmp/cluster.json" -serve-session=gate-spec \
+      -max-reassign=1 -no-daemon >"$sp_tmp/local$stp.out" 2>/dev/null
+    JAX_PLATFORMS=cpu "$PYTHON" -m kafkabalancer_tpu -input-json \
+      -input "$sp_tmp/cluster.json" -serve-session=gate-spec \
+      -max-reassign=1 "-serve-socket=$sp_sock" \
+      >"$sp_tmp/served$stp.out" 2>/dev/null
+    if ! cmp -s "$sp_tmp/served$stp.out" "$sp_tmp/local$stp.out"; then
+      echo "speculative step $stp parity FAILED"; sp_ok=0
+    fi
+    "$PYTHON" - "$sp_tmp" "$stp" <<'PYEOF'
+import json, sys
+tmp, stp = sys.argv[1], sys.argv[2]
+state = json.load(open(f"{tmp}/cluster.json"))
+plan = json.load(open(f"{tmp}/local{stp}.out"))
+for entry in plan.get("partitions") or []:
+    for row in state["partitions"]:
+        if (row["topic"] == entry["topic"]
+                and row["partition"] == entry["partition"]):
+            row["replicas"] = list(entry["replicas"])
+            break
+json.dump(state, open(f"{tmp}/cluster.json", "w"))
+PYEOF
+    # the idle window: let the speculator finish planning the next move
+    "$PYTHON" - "$sp_sock" <<'PYEOF'
+import sys, time
+from kafkabalancer_tpu.serve.client import fetch_watch
+deadline = time.monotonic() + 20
+while time.monotonic() < deadline:
+    doc = fetch_watch(sys.argv[1]) or {}
+    spec = doc.get("speculation") or {}
+    if spec.get("memos", 0) >= 1 and not spec.get("inflight"):
+        break
+    time.sleep(0.05)
+PYEOF
+  done
+  if [ "$sp_ok" = 1 ] && "$PYTHON" -m kafkabalancer_tpu \
+      "-serve-socket=$sp_sock" -serve-stats-json 2>/dev/null \
+      | "$PYTHON" -c '
+import json, sys
+p = json.loads(sys.stdin.read())
+assert p["schema"] == "kafkabalancer-tpu.serve-stats/7", p.get("schema")
+s = p["speculation"]
+assert s["enabled"] is True, s
+assert s["hits"] >= 1, s
+assert s["attempts"] == (
+    s["hits"] + s["misses"] + s["poisoned"] + s["memos"]), s
+assert "serve.spec.hit_s" in p["hists"], sorted(p["hists"])
+assert p["hists"]["serve.spec.hit_s"]["count"] == s["hits"], (
+    p["hists"]["serve.spec.hit_s"]["count"], s)
+# request_s still reconciles exactly WITH memo hits counted as requests
+assert p["hists"]["serve.request_s"]["count"] == p["requests"]
+'; then
+    echo "register + 3 moves: parity + spec hits + exact identity: OK"
+  else
+    echo "speculative plan-ahead smoke FAILED (see $sp_tmp)"; fail=1
+  fi
+  "$PYTHON" -c "from kafkabalancer_tpu.serve.client import request_shutdown
+request_shutdown('$sp_sock')" || true
+  if wait "$sp_pid"; then
+    echo "daemon clean shutdown: OK"
+  else
+    echo "daemon exited nonzero"; fail=1
+  fi
+else
+  echo "daemon never became ready (see $sp_tmp/daemon.log)"
+  tail -20 "$sp_tmp/daemon.log" 2>/dev/null
+  kill "$sp_pid" 2>/dev/null
+  fail=1
+fi
+rm -rf "$sp_tmp"
+
+step "watch-mode smoke (fake ZK seam, zero client plan ops)"
+# The continuous controller end to end (docs/serving.md § Watch mode):
+# a -watch daemon reads a fake Zookeeper tree (the FileZkClient seam),
+# plans, and emits a plan file with NO client planning request at all —
+# the emitted bytes must equal a -no-daemon run on the same state, the
+# scrape's `requests` must stay 0, and watch lag must be observable
+# through the `watch` protocol op.
+wm_tmp=$(mktemp -d "${TMPDIR:-/tmp}/kb-gate-watch.XXXXXX")
+wm_sock="$wm_tmp/kb.sock"
+mkdir -p "$wm_tmp/zk/brokers/topics" "$wm_tmp/plans"
+"$PYTHON" - "$wm_tmp" <<'PYEOF'
+import json, sys
+tmp = sys.argv[1]
+# a skewed 8-partition topic over 4 brokers: the planner has one
+# obvious move; the same rows render the -no-daemon oracle input
+parts = {str(i): [0, 1] for i in range(8)}
+parts["0"] = [2, 3]
+with open(f"{tmp}/zk/brokers/topics/gate", "w") as f:
+    json.dump({"version": 1, "partitions": parts}, f)
+rows = [
+    {"topic": "gate", "partition": int(p), "replicas": parts[p]}
+    for p in sorted(parts, key=int)
+]
+with open(f"{tmp}/oracle.json", "w") as f:
+    json.dump({"version": 1, "partitions": rows}, f)
+PYEOF
+KAFKABALANCER_TPU_FAKE_ZK="$wm_tmp/zk" JAX_PLATFORMS=cpu \
+  JAX_COMPILATION_CACHE_DIR="$wm_tmp" \
+  "$PYTHON" -m kafkabalancer_tpu -serve "-serve-socket=$wm_sock" \
+  "-watch=fake:2181" "-watch-emit=$wm_tmp/plans" -watch-poll=0.25 \
+  -max-reassign=1 >"$wm_tmp/daemon.log" 2>&1 &
+wm_pid=$!
+wm_plan=""
+for _ in $(seq 1 120); do
+  wm_plan=$(ls "$wm_tmp/plans"/plan-*.json 2>/dev/null | head -1)
+  if [ -n "$wm_plan" ]; then break; fi
+  sleep 0.25
+done
+if [ -n "$wm_plan" ]; then
+  JAX_PLATFORMS=cpu "$PYTHON" -m kafkabalancer_tpu -input-json \
+    -input "$wm_tmp/oracle.json" -max-reassign=1 -no-daemon \
+    >"$wm_tmp/oracle.out" 2>/dev/null
+  if cmp -s "$wm_plan" "$wm_tmp/oracle.out"; then
+    echo "watch-emitted plan byte parity vs -no-daemon: OK"
+  else
+    echo "watch-emitted plan parity FAILED"; fail=1
+  fi
+  if "$PYTHON" - "$wm_sock" <<'PYEOF'
+import sys
+from kafkabalancer_tpu.serve.client import fetch_stats, fetch_watch
+doc = fetch_stats(sys.argv[1])
+assert doc is not None, "no scrape"
+# ZERO client plan ops: the daemon planned on its own
+assert doc["requests"] == 0, doc["requests"]
+w = doc["watch"]
+assert w["enabled"] is True and w["plans_emitted"] >= 1, w
+assert w["errors"] == 0, w
+# watch lag observable through the dedicated protocol op too
+lag = fetch_watch(sys.argv[1])
+assert lag is not None and lag["watch"]["reads"] >= 1, lag
+assert lag["watch"]["last_event_lag_s"] is not None, lag
+PYEOF
+  then
+    echo "zero client plan ops + watch lag scrape: OK"
+  else
+    echo "watch scrape assertions FAILED"; fail=1
+  fi
+else
+  echo "watch daemon never emitted a plan (see $wm_tmp/daemon.log)"
+  tail -20 "$wm_tmp/daemon.log" 2>/dev/null
+  fail=1
+fi
+"$PYTHON" -c "from kafkabalancer_tpu.serve.client import request_shutdown
+request_shutdown('$wm_sock')" || true
+wait "$wm_pid" 2>/dev/null
+rm -rf "$wm_tmp"
+
 step "replay smoke (seeded 3-tenant churn, per-tenant reconciliation)"
 # The fleet-churn replay harness end to end (ROADMAP item 5,
 # docs/observability.md § Per-tenant attribution): a seeded 3-tenant
 # churn run — weight shifts, a topic storm, a broker failure — driven
 # closed-loop through the real client against a private self-spawned
-# daemon. Asserts the serve-stats/6 scrape schema, per-tenant request
+# daemon. Asserts the serve-stats/7 scrape schema, per-tenant request
 # counts reconciling EXACTLY with the driver's issued counts, the
 # scrape's per-tenant percentiles agreeing with the flight recorder's
 # tenant-labeled request log within one histogram bucket, and plan
@@ -702,8 +888,8 @@ if JAX_PLATFORMS=cpu "$PYTHON" -m kafkabalancer_tpu.replay \
   && "$PYTHON" -c '
 import json
 a = json.load(open("'"$rp_tmp"'/replay.json"))
-assert a["schema"] == "kafkabalancer-tpu.replay/3", a["schema"]
-assert a["scrape_schema"] == "kafkabalancer-tpu.serve-stats/6", (
+assert a["schema"] == "kafkabalancer-tpu.replay/4", a["schema"]
+assert a["scrape_schema"] == "kafkabalancer-tpu.serve-stats/7", (
     a["scrape_schema"])
 assert a["reconciled_counts"] is True
 assert a["latency_checked"] is True
@@ -732,7 +918,7 @@ step "overload + chaos smoke (seeded fault injection, sheds, parity)"
 # a live retry-after estimate), EVERY answered plan byte-identical to
 # -no-daemon, no tenant starved to zero, the daemon's
 # shed/requeue/quarantine accounting reconciled exactly in the
-# serve-stats/6 scrape, and the daemon alive at the end.
+# serve-stats/7 scrape, and the daemon alive at the end.
 ch_tmp=$(mktemp -d)
 if JAX_PLATFORMS=cpu "$PYTHON" -m kafkabalancer_tpu.replay --chaos \
     --tenants 3 --requests 24 --seed 7 --arrival uniform --check \
@@ -741,7 +927,7 @@ if JAX_PLATFORMS=cpu "$PYTHON" -m kafkabalancer_tpu.replay --chaos \
 import json
 a = json.load(open("'"$ch_tmp"'/chaos.json"))
 assert a["mode"] == "chaos", a["mode"]
-assert a["scrape_schema"] == "kafkabalancer-tpu.serve-stats/6"
+assert a["scrape_schema"] == "kafkabalancer-tpu.serve-stats/7"
 c = a["chaos"]
 assert c["ok"] is True, c
 assert c["wrong_plans"] == [], c["wrong_plans"]

@@ -7,9 +7,9 @@ Pins:
   regression gate, not a flaky load test;
 - churn events do what they claim (weight drift, broker failure with
   allowlist rewrite, topic storms growing the row set);
-- a seeded run against a live daemon produces a replay/3 artifact whose
+- a seeded run against a live daemon produces a replay/4 artifact whose
   per-tenant request counts reconcile EXACTLY with the daemon's
-  serve-stats/6 scrape, whose scrape percentiles agree with the flight
+  serve-stats/7 scrape, whose scrape percentiles agree with the flight
   recorder's tenant-labeled request log within one histogram bucket,
   and whose sampled request has plan byte parity vs -no-daemon.
 """
@@ -150,7 +150,7 @@ def test_replay_reconciles_against_live_daemon(daemon_sock):
     )
     art = run_replay(cfg, log=lambda _m: None)
     assert art["schema"] == REPLAY_SCHEMA
-    assert art["scrape_schema"] == "kafkabalancer-tpu.serve-stats/6"
+    assert art["scrape_schema"] == "kafkabalancer-tpu.serve-stats/7"
     assert art["requests_issued"] == 36
     assert art["request_errors"] == []
     assert art["reconciled_counts"] is True
@@ -177,7 +177,7 @@ def test_replay_reconciles_against_live_daemon(daemon_sock):
 
 
 def test_replay_artifact_schema_keys(daemon_sock):
-    """The replay/3 artifact's top-level keys are the schema bench.py
+    """The replay/4 artifact's top-level keys are the schema bench.py
     lands in BENCH rounds — changing them requires a version bump."""
     cfg = ReplayConfig(
         seed=1, tenants=2, requests=8, socket=daemon_sock, spawn=False,
@@ -185,18 +185,19 @@ def test_replay_artifact_schema_keys(daemon_sock):
     )
     art = run_replay(cfg, log=lambda _m: None)
     assert set(art) == {
-        "schema", "scrape_schema", "mode", "chaos", "restart", "seed",
-        "config",
+        "schema", "scrape_schema", "mode", "chaos", "restart", "watch",
+        "seed", "config",
         "requests_issued", "request_errors", "wall_s", "throughput_rps",
         "events", "per_tenant", "session_thrash", "fallback_rate",
         "padded_slots", "microbatched", "tenant_cap", "tenants_demoted",
         "parity", "reconciled_counts", "latency_checked",
         "reconciled_latency", "reconciled",
     }
-    # a churn run marks its mode and carries no chaos/restart block
+    # a churn run marks its mode and carries no chaos/restart/watch block
     assert art["mode"] == "churn"
     assert art["chaos"] is None
     assert art["restart"] is None
+    assert art["watch"] is None
     assert art["parity"] is None  # parity_sample=False
     entry = art["per_tenant"]["tenant-00"]
     for key in (
@@ -230,7 +231,7 @@ def test_restart_replay_recovers_from_spill():
     art = run_replay(cfg, log=lambda _m: None)
     assert art["schema"] == REPLAY_SCHEMA
     assert art["mode"] == "restart"
-    assert art["scrape_schema"] == "kafkabalancer-tpu.serve-stats/6"
+    assert art["scrape_schema"] == "kafkabalancer-tpu.serve-stats/7"
     assert art["request_errors"] == []
     r = art["restart"]
     assert r["ok"] is True and art["reconciled"] is True
@@ -271,6 +272,42 @@ def test_restart_replay_corrupt_record_is_cold_but_correct():
     assert r["cold_misses_post"] == 1  # the re-register it forced
     assert r["paging_identity_ok"] is True
     assert art["request_errors"] == []
+
+
+def test_watch_replay_zero_client_plan_ops():
+    """The watch-mode scenario (ISSUE 15): a private -watch subprocess
+    daemon over the fake-ZK seam plans closed-loop — the harness plays
+    the operator (applies each emitted plan, injects drift) and never
+    issues a plan-family request. Every emitted plan byte-identical to
+    -no-daemon on the exact state it was planned from, the steady
+    state answered from the speculative memo, and the speculation
+    identity exact."""
+    cfg = ReplayConfig(seed=7, requests=8, watch=True)
+    art = run_replay(cfg, log=lambda _m: None)
+    assert art["schema"] == REPLAY_SCHEMA
+    assert art["mode"] == "watch"
+    assert art["scrape_schema"] == "kafkabalancer-tpu.serve-stats/7"
+    assert art["chaos"] is None and art["restart"] is None
+    w = art["watch"]
+    assert w["ok"] is True and art["reconciled"] is True, w
+    assert w["wrong_plans"] == [] and w["oracle_missing"] == 0
+    assert w["plans_emitted"] >= 3
+    assert w["parity_checked"] == w["plans_emitted"]
+    # no client plan ops, ever — the daemon planned on its own
+    assert w["zero_client_plan_ops"] is True
+    assert art["requests_issued"] == 0
+    # the steady state is memo reads
+    assert w["spec_hit_plans"] >= 1
+    assert w["errors"] == 0
+    # drift was injected and noticed
+    assert w["drift_events"] >= 1 and w["resyncs"] >= 1
+    # exact speculation reconciliation (live memos included)
+    s = w["speculation"]
+    assert s["attempts"] == (
+        s["hits"] + s["misses"] + s["poisoned"] + s["memos"]
+    ), s
+    assert w["speculation_identity_ok"] is True
+    assert w["last_event_lag_s"] is not None
 
 
 def test_replay_requires_a_daemon():

@@ -1853,7 +1853,7 @@ def test_dev_cached_asarray_reuses_equal_content():
 # --- live daemon telemetry: the stats / dump-trace scrape ops --------------
 
 GOLDEN_STATS = os.path.join(
-    os.path.dirname(__file__), "data", "serve_stats_schema_v6.json"
+    os.path.dirname(__file__), "data", "serve_stats_schema_v7.json"
 )
 
 
@@ -1984,7 +1984,7 @@ def test_stats_scrape_never_blocks_on_inflight_plan(sock_dir, monkeypatch):
 def test_serve_stats_json_schema_golden(daemon):
     """Golden-file pin: the stats document's top-level keys, histogram
     entry keys, per-tenant entry keys and flight keys are VERSIONED
-    (kafkabalancer-tpu.serve-stats/6) — changing any requires a schema
+    (kafkabalancer-tpu.serve-stats/7) — changing any requires a schema
     bump and a new golden."""
     sock, _d = daemon
     rv, _out, _err = run_cli(
@@ -2019,6 +2019,11 @@ def test_serve_stats_json_schema_golden(daemon):
     # the tier is enabled or not (this daemon has it off)
     assert set(doc["paging"]) == set(golden["paging_keys"])
     assert doc["paging"]["enabled"] is False
+    # v7: speculation + watch blocks — same key set with both off
+    assert set(doc["speculation"]) == set(golden["speculation_keys"])
+    assert doc["speculation"]["enabled"] is False
+    assert set(doc["watch"]) == set(golden["watch_keys"])
+    assert doc["watch"]["enabled"] is False
     # v4: per-tenant attribution (bounded top-K label families)
     tenants = doc["tenants"]
     assert set(tenants) == set(golden["tenants_keys"])
@@ -2082,7 +2087,7 @@ def test_scrape_cli_verbs_roundtrip(daemon, sock_dir):
     rv, out, _err = run_cli([f"-serve-socket={sock}", "-serve-stats-json"])
     assert rv == 0
     doc = json.loads(out)
-    assert doc["schema"] == "kafkabalancer-tpu.serve-stats/6"
+    assert doc["schema"] == "kafkabalancer-tpu.serve-stats/7"
     assert doc["hists"]["serve.request_s"]["count"] == doc["requests"]
     rv, out, _err = run_cli([f"-serve-socket={sock}", "-serve-stats"])
     assert rv == 0

@@ -1,0 +1,655 @@
+"""Speculative plan-ahead + the watch-driven continuous controller
+(serve/speculate.py; ISSUE 15).
+
+The load-bearing pins:
+
+- the memoized answer is BYTE-IDENTICAL to the live delta path (which
+  is itself pinned byte-identical to ``-no-daemon``): speculation can
+  make a request faster, never different;
+- a mismatching request (drifted digest, changed flags) drops the memo
+  and falls back to the live ladder — parity intact;
+- speculation never feeds ``serve.requests``/``serve.request_s`` or
+  the flight request log, and never resets the idle clock — a daemon
+  that is only speculating still honors ``-serve-idle-timeout`` (the
+  satellite pin);
+- the speculation block's conservation identity is exact at every
+  instant: ``attempts == hits + misses + poisoned + memos``;
+- a matching request arriving while its answer is still being
+  speculated WAITS for it instead of resyncing;
+- the watcher plans with no client in the loop: plans stream to the
+  emit sink byte-identical to ``-no-daemon`` on the same state, the
+  steady state is memo reads, external drift resyncs.
+"""
+
+import io
+import json
+import os
+import re
+import tempfile
+import threading
+import time
+
+import pytest
+
+from kafkabalancer_tpu import cli
+from kafkabalancer_tpu.codecs import zookeeper as zkmod
+from kafkabalancer_tpu.serve import client as sclient
+from kafkabalancer_tpu.serve import speculate as spec_mod
+from kafkabalancer_tpu.serve.daemon import Daemon
+
+FIXTURE = os.path.join(os.path.dirname(__file__), "data", "test.json")
+
+_TS = re.compile(r"^\d{4}/\d{2}/\d{2} \d{2}:\d{2}:\d{2} ", re.M)
+
+
+def run_cli(args, stdin=""):
+    out, err = io.StringIO(), io.StringIO()
+    rv = cli.run(io.StringIO(stdin), out, err, ["kafkabalancer"] + args)
+    return rv, out.getvalue(), err.getvalue()
+
+
+def strip_ts(err: str) -> str:
+    return _TS.sub("", err)
+
+
+def _fixture_state() -> dict:
+    with open(FIXTURE) as f:
+        return json.load(f)
+
+
+def _apply_plan(state: dict, plan_stdout: str) -> None:
+    plan = json.loads(plan_stdout)
+    for entry in plan.get("partitions") or []:
+        for row in state["partitions"]:
+            if (
+                row["topic"] == entry["topic"]
+                and row["partition"] == entry["partition"]
+            ):
+                row["replicas"] = list(entry["replicas"])
+                break
+
+
+def _wait_spec_settled(d, timeout=15.0):
+    """Wait until the speculator holds a memo and is out of flight
+    (the idle window did its work); returns the stats snapshot."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        st = d.speculator.stats()
+        if st["memos"] >= 1 and not st["inflight"]:
+            return st
+        time.sleep(0.02)
+    return d.speculator.stats()
+
+
+def _identity_ok(st) -> bool:
+    return st["attempts"] == (
+        st["hits"] + st["misses"] + st["poisoned"] + st["memos"]
+    )
+
+
+@pytest.fixture
+def sock_dir():
+    import shutil
+
+    d = tempfile.mkdtemp(prefix="kbspec-")
+    yield d
+    shutil.rmtree(d, ignore_errors=True)
+
+
+def _start_daemon(sock, **kw):
+    kw.setdefault("idle_timeout", 60.0)
+    kw.setdefault("warm", False)
+    kw.setdefault("log", lambda _m: None)
+    kw.setdefault("speculate", True)
+    d = Daemon(sock, **kw)
+    rc_box = []
+    t = threading.Thread(
+        target=lambda: rc_box.append(d.serve_forever()), daemon=True
+    )
+    t.start()
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        if sclient.daemon_alive(sock) is not None:
+            return d, t, rc_box
+        time.sleep(0.02)
+    pytest.fail("daemon never became ready")
+
+
+@pytest.fixture
+def daemon(sock_dir):
+    sock = os.path.join(sock_dir, "kb.sock")
+    d, t, rc_box = _start_daemon(sock)
+    yield sock, d
+    sclient.request_shutdown(sock)
+    t.join(15)
+    assert rc_box == [0], rc_box
+
+
+# --- the steady state -------------------------------------------------------
+
+
+def test_steady_state_answers_from_memo_byte_identical(daemon, sock_dir):
+    """Register + 3 predicted moves with memoizable argv: every steady
+    step after the memo lands answers from it — zero dispatch — and
+    stays byte-identical (stdout AND rc; stderr modulo timestamps) to
+    -no-daemon. Hits count as requests AND delta hits, so every
+    existing reconciliation (request_s count == requests) holds."""
+    sock, d = daemon
+    state = _fixture_state()
+    input_path = os.path.join(sock_dir, "cluster.json")
+    args = ["-input-json", f"-input={input_path}", "-max-reassign=1"]
+    for step in range(4):
+        with open(input_path, "w") as f:
+            json.dump(state, f)
+        want_rv, want_out, want_err = run_cli(args + ["-no-daemon"])
+        got_rv, got_out, got_err = run_cli(args + [f"-serve-socket={sock}"])
+        assert (got_rv, got_out) == (want_rv, want_out), f"step {step}"
+        assert strip_ts(got_err) == strip_ts(want_err), f"step {step}"
+        _apply_plan(state, want_out)
+        _wait_spec_settled(d)
+    st = d.speculator.stats()
+    assert st["attempts"] >= 3, st
+    assert st["hits"] >= 2, st
+    assert _identity_ok(st), st
+    # memo hits are REAL requests: counted, histogrammed, delta-hit
+    assert d._requests == 4
+    doc = sclient.fetch_stats(sock)
+    assert doc["hists"]["serve.request_s"]["count"] == doc["requests"] == 4
+    assert doc["sessions"]["delta_hits"] >= 3
+    assert doc["speculation"]["hits"] == st["hits"]
+    # the hit wall rides its own histogram too
+    assert doc["hists"]["serve.spec.hit_s"]["count"] == st["hits"]
+    # per-tenant attribution through the PR-11 families
+    tenant = os.path.abspath(input_path)
+    assert doc["tenants"]["top"][tenant]["spec_hits"] == st["hits"]
+    # flight log carries one record per REAL request (hits included,
+    # speculative dispatches excluded)
+    trace = sclient.fetch_trace(sock)
+    reqs = trace["trace"]["otherData"]["requests"]
+    assert len(reqs) == 4
+    assert sum(1 for r in reqs if r.get("spec_hit")) == st["hits"]
+
+
+def test_external_drift_drops_memo_falls_back_correct(daemon, sock_dir):
+    """A memo exists but the cluster drifted out-of-band: the request's
+    digest matches neither the memo nor the session — counted a MISS,
+    answered through the live resync ladder, byte-identical."""
+    sock, d = daemon
+    state = _fixture_state()
+    input_path = os.path.join(sock_dir, "cluster.json")
+    args = ["-input-json", f"-input={input_path}", "-max-reassign=1"]
+    with open(input_path, "w") as f:
+        json.dump(state, f)
+    rv, out, _ = run_cli(args + [f"-serve-socket={sock}"])
+    assert rv == 0
+    _apply_plan(state, out)
+    _wait_spec_settled(d)
+    # out-of-band drift the prediction cannot know about
+    state["partitions"][0]["replicas"] = [2, 3]
+    with open(input_path, "w") as f:
+        json.dump(state, f)
+    want = run_cli(args + ["-no-daemon"])
+    got = run_cli(args + [f"-serve-socket={sock}"])
+    assert (got[0], got[1]) == (want[0], want[1])
+    st = d.speculator.stats()
+    assert st["misses"] >= 1, st
+    assert _identity_ok(st), st
+    assert st["wasted_dispatches"] == st["misses"] + st["poisoned"]
+
+
+def test_changed_flags_miss_then_live(daemon, sock_dir):
+    """Same digest, different argv (the client added -metrics-json):
+    the memo cannot serve it — dropped as a miss, live path answers
+    byte-identical (via the rows resync, since speculation advanced
+    the resident state past the client's)."""
+    sock, d = daemon
+    state = _fixture_state()
+    input_path = os.path.join(sock_dir, "cluster.json")
+    metrics = os.path.join(sock_dir, "m.json")
+    args = ["-input-json", f"-input={input_path}", "-max-reassign=1"]
+    with open(input_path, "w") as f:
+        json.dump(state, f)
+    rv, out, _ = run_cli(args + [f"-serve-socket={sock}"])
+    assert rv == 0
+    _apply_plan(state, out)
+    _wait_spec_settled(d)
+    with open(input_path, "w") as f:
+        json.dump(state, f)
+    want = run_cli(args + ["-no-daemon"])
+    got = run_cli(
+        args + [f"-serve-socket={sock}", f"-metrics-json={metrics}"]
+    )
+    assert (got[0], got[1]) == (want[0], want[1])
+    payload = json.load(open(metrics))
+    assert payload["gauges"]["served"] is True
+    st = d.speculator.stats()
+    assert st["misses"] >= 1 and _identity_ok(st), st
+
+
+def test_non_memoizable_argv_never_speculates(daemon, sock_dir):
+    """Steps that carry telemetry flags produce per-invocation side
+    effects — never memoized, and never even speculated on."""
+    sock, d = daemon
+    state = _fixture_state()
+    input_path = os.path.join(sock_dir, "cluster.json")
+    metrics = os.path.join(sock_dir, "m.json")
+    args = ["-input-json", f"-input={input_path}", "-max-reassign=1",
+            f"-metrics-json={metrics}", f"-serve-socket={sock}"]
+    for _step in range(2):
+        with open(input_path, "w") as f:
+            json.dump(state, f)
+        rv, out, _ = run_cli(args)
+        assert rv == 0
+        _apply_plan(state, out)
+    time.sleep(0.3)
+    st = d.speculator.stats()
+    assert st["attempts"] == 0 and st["memos"] == 0, st
+
+
+def test_request_waits_for_inflight_speculation(
+    daemon, sock_dir, monkeypatch
+):
+    """A digest-matching request arriving while its answer is still
+    being speculated WAITS for the in-flight run and answers from the
+    fresh memo — never a resync, never a duplicate dispatch."""
+    sock, d = daemon
+    started = threading.Event()
+    real_run = cli.run
+
+    def slow_internal(i, o, e, args, **kw):
+        if threading.current_thread().name.startswith("serve-int-"):
+            started.set()
+            time.sleep(0.8)
+        return real_run(i, o, e, args, **kw)
+
+    monkeypatch.setattr(cli, "run", slow_internal)
+    state = _fixture_state()
+    input_path = os.path.join(sock_dir, "cluster.json")
+    args = ["-input-json", f"-input={input_path}", "-max-reassign=1"]
+    with open(input_path, "w") as f:
+        json.dump(state, f)
+    rv, out, _ = run_cli(args + [f"-serve-socket={sock}"])
+    assert rv == 0
+    _apply_plan(state, out)
+    assert started.wait(10), "speculation never started"
+    # fire the matching next request while speculation is in flight
+    with open(input_path, "w") as f:
+        json.dump(state, f)
+    want = run_cli(args + ["-no-daemon"])
+    got = run_cli(args + [f"-serve-socket={sock}"])
+    assert (got[0], got[1]) == (want[0], want[1])
+    st = d.speculator.stats()
+    assert st["hits"] >= 1, st
+    assert d.sessions.stats()["resyncs_rows"] == 0, d.sessions.stats()
+    assert d.sessions.stats()["resyncs_full"] == 0
+
+
+def test_real_traffic_preempts_speculation(daemon, sock_dir, monkeypatch):
+    """Another tenant's request arriving mid-speculation is never
+    stuck behind idle work: the arrival hook preempts, the speculative
+    run aborts at its next check, and the live request answers."""
+    sock, d = daemon
+    started = threading.Event()
+    real_run = cli.run
+
+    def slow_internal(i, o, e, args, **kw):
+        if threading.current_thread().name.startswith("serve-int-"):
+            started.set()
+            time.sleep(0.6)
+        return real_run(i, o, e, args, **kw)
+
+    monkeypatch.setattr(cli, "run", slow_internal)
+    state = _fixture_state()
+    a_path = os.path.join(sock_dir, "a.json")
+    b_path = os.path.join(sock_dir, "b.json")
+    with open(a_path, "w") as f:
+        json.dump(state, f)
+    with open(b_path, "w") as f:
+        json.dump(state, f)
+    rv, _out, _ = run_cli(
+        ["-input-json", f"-input={a_path}", "-max-reassign=1",
+         f"-serve-socket={sock}"]
+    )
+    assert rv == 0
+    assert started.wait(10)
+    t0 = time.perf_counter()
+    rv_b, out_b, _ = run_cli(
+        ["-input-json", f"-input={b_path}", "-max-reassign=1",
+         f"-serve-socket={sock}"]
+    )
+    wall = time.perf_counter() - t0
+    assert rv_b == 0 and out_b
+    assert wall < 10.0
+    # the speculator is out of flight shortly after; its books balance
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        st = d.speculator.stats()
+        if not st["inflight"]:
+            break
+        time.sleep(0.02)
+    assert _identity_ok(d.speculator.stats())
+
+
+def test_release_poisons_live_memo(daemon, sock_dir):
+    sock, d = daemon
+    state = _fixture_state()
+    input_path = os.path.join(sock_dir, "cluster.json")
+    with open(input_path, "w") as f:
+        json.dump(state, f)
+    rv, _out, _ = run_cli(
+        ["-input-json", f"-input={input_path}", "-max-reassign=1",
+         f"-serve-socket={sock}"]
+    )
+    assert rv == 0
+    st = _wait_spec_settled(d)
+    assert st["memos"] == 1, st
+    released = sclient.release_session(sock, os.path.abspath(input_path))
+    assert released >= 1
+    st = d.speculator.stats()
+    assert st["poisoned"] >= 1 and st["memos"] == 0, st
+    assert _identity_ok(st), st
+
+
+def test_speculating_daemon_honors_idle_timeout(sock_dir):
+    """THE satellite pin: speculation must not touch the idle clock —
+    a daemon whose only post-request activity is speculative planning
+    still shuts itself down on -serve-idle-timeout."""
+    sock = os.path.join(sock_dir, "kb.sock")
+    d, t, rc_box = _start_daemon(sock, idle_timeout=2.0)
+    state = _fixture_state()
+    input_path = os.path.join(sock_dir, "cluster.json")
+    with open(input_path, "w") as f:
+        json.dump(state, f)
+    t_last = time.monotonic()
+    rv, _out, _ = run_cli(
+        ["-input-json", f"-input={input_path}", "-max-reassign=1",
+         f"-serve-socket={sock}"]
+    )
+    assert rv == 0
+    st = _wait_spec_settled(d)
+    assert st["attempts"] >= 1, st  # it DID speculate after the request
+    t.join(15)
+    assert rc_box == [0], rc_box
+    # shutdown at ~idle_timeout after the LAST REQUEST — the
+    # speculative run that followed it did not reset the clock
+    assert time.monotonic() - t_last < 12.0
+
+
+def test_speculation_off_by_default_ctor(sock_dir):
+    """Directly-constructed daemons (the test-suite shape) keep
+    speculation off unless asked; the scrape block still exists with
+    the same keys."""
+    sock = os.path.join(sock_dir, "kb.sock")
+    d, t, rc_box = _start_daemon(sock, speculate=False)
+    try:
+        state = _fixture_state()
+        input_path = os.path.join(sock_dir, "cluster.json")
+        with open(input_path, "w") as f:
+            json.dump(state, f)
+        rv, _out, _ = run_cli(
+            ["-input-json", f"-input={input_path}", "-max-reassign=1",
+             f"-serve-socket={sock}"]
+        )
+        assert rv == 0
+        time.sleep(0.3)
+        doc = sclient.fetch_stats(sock)
+        spec = doc["speculation"]
+        assert spec["enabled"] is False
+        assert spec["attempts"] == 0 and spec["memos"] == 0
+    finally:
+        sclient.request_shutdown(sock)
+        t.join(15)
+    assert rc_box == [0]
+
+
+def test_memo_hit_refreshes_spill_record(sock_dir):
+    """The durability invariant moves with the hit: after a memo-hit
+    answer, the warm record holds the post-move state the client now
+    describes — a restore after SIGKILL still digest-matches."""
+    sock = os.path.join(sock_dir, "kb.sock")
+    spill_dir = os.path.join(sock_dir, "spill")
+    d, t, rc_box = _start_daemon(sock, spill_dir=spill_dir)
+    try:
+        state = _fixture_state()
+        input_path = os.path.join(sock_dir, "cluster.json")
+        args = ["-input-json", f"-input={input_path}", "-max-reassign=1",
+                f"-serve-socket={sock}"]
+        for _step in range(3):
+            with open(input_path, "w") as f:
+                json.dump(state, f)
+            rv, out, _ = run_cli(args)
+            assert rv == 0
+            _apply_plan(state, out)
+            _wait_spec_settled(d)
+        assert d.speculator.stats()["hits"] >= 1
+        key = next(iter(d.sessions._sessions))
+        sess = d.sessions._sessions[key]
+        loaded = d.spill.load(key)
+        assert loaded is not None
+        hdr, _rows = loaded
+        # the record predicts the CLIENT's next read (the session's
+        # post-hit digest), not the speculation-advanced... the session
+        # digest has advanced past it by exactly the live memo
+        memo = sess.spec_memo
+        assert memo is not None
+        assert hdr["digest"] == memo.key_digest
+    finally:
+        sclient.request_shutdown(sock)
+        t.join(15)
+    assert rc_box == [0]
+
+
+def test_memo_retirement_is_exactly_once():
+    """The CAS discipline: one memo retires exactly once even when a
+    hit and a lifecycle poison race — the conservation identity cannot
+    drift."""
+
+    class _D:
+        pass
+
+    class _S:
+        released = False
+        spec_memo = None
+
+    sp = spec_mod.Speculator(_D(), enabled=True)
+    sess = _S()
+    memo = spec_mod.SpecMemo("d0", [], 0, "", "", "d1")
+    sp.attach_memo(sess, memo)
+    assert sp.stats()["memos"] == 1
+    # a concurrent poison wins; the hit's CAS then fails
+    sp.poison_session(sess)
+    assert not sp.take_memo(sess, memo)
+    sp.retire_miss(sess, memo)  # and a late miss is a no-op too
+    st = sp.stats()
+    assert (st["hits"], st["misses"], st["poisoned"]) == (0, 0, 1)
+    assert _identity_ok(st), st
+    # the shed-undo path: take then untake restores the memo intact
+    memo2 = spec_mod.SpecMemo("d1", [], 0, "", "", "d2")
+    sp.attach_memo(sess, memo2)
+    assert sp.take_memo(sess, memo2)
+    sp.untake_memo(sess, memo2)
+    assert sess.spec_memo is memo2
+    st = sp.stats()
+    assert st["hits"] == 0 and st["memos"] == 1 and _identity_ok(st)
+    # a released session refuses the put-back (consumed stays consumed)
+    assert sp.take_memo(sess, memo2)
+    sess.released = True
+    sp.untake_memo(sess, memo2)
+    assert sess.spec_memo is None
+    assert _identity_ok(sp.stats())
+
+
+def test_watch_flag_validation():
+    """-watch without -serve, -watch without a sink, and -watch-emit
+    without -watch all refuse loudly (exit 3) — a sink-less watcher
+    would plan a move nobody can apply and wait forever."""
+    rv, _out, err = run_cli(["-watch=zk:2181"])
+    assert rv == 3 and "-watch requires -serve" in err
+    rv, _out, err = run_cli(["-serve", "-watch=zk:2181"])
+    assert rv == 3 and "requires -watch-emit" in err
+    rv, _out, err = run_cli(["-watch-emit=/tmp/x"])
+    assert rv == 3 and "-watch-emit requires -watch" in err
+
+
+def test_abort_check_thread_local_machinery():
+    calls = []
+    spec_mod.install_abort_check(lambda: calls.append(1))
+    try:
+        spec_mod.maybe_abort_dispatch()
+        assert calls == [1]
+    finally:
+        spec_mod.install_abort_check(None)
+    spec_mod.maybe_abort_dispatch()  # cleared: no-op
+    assert calls == [1]
+
+    class _D:
+        pass
+
+    sp = spec_mod.Speculator(_D(), enabled=True)
+    assert not sp.preempted()
+    sp._inflight = spec_mod._Inflight(("t", "s"), "d", [])
+    sp.note_real_traffic()
+    assert sp.preempted()
+    with pytest.raises(spec_mod.SpeculationAborted):
+        sp.maybe_abort()
+    # SpeculationAborted must NOT be catchable as Exception (the
+    # solver fail-open ladders catch Exception broadly)
+    assert not issubclass(spec_mod.SpeculationAborted, Exception)
+
+
+# --- the watcher ------------------------------------------------------------
+
+
+class _MutableZk:
+    """An in-process dict-backed ZK fake whose whole tree swaps
+    atomically (one attribute rebind) — used by the watcher tests."""
+
+    def __init__(self):
+        self.tree = {}
+
+    # kazoo surface
+    def start(self, timeout=10):
+        pass
+
+    def stop(self):
+        pass
+
+    def close(self):
+        pass
+
+    def get_children(self, path, watcher=None):
+        return sorted(self.tree)
+
+    def get(self, path, watcher=None):
+        name = path.rsplit("/", 1)[1]
+        return json.dumps(
+            {"version": 1, "partitions": self.tree[name]}
+        ).encode("utf-8"), None
+
+
+@pytest.fixture
+def fake_zk():
+    zk = _MutableZk()
+    zkmod.set_zk_client_factory(lambda hosts: zk)
+    yield zk
+    zkmod.set_zk_client_factory(None)
+
+
+def _zk_oracle_input(tree) -> str:
+    rows = [
+        {"topic": t, "partition": int(pid), "replicas": tree[t][pid]}
+        for t in sorted(tree)
+        for pid in sorted(tree[t], key=int)
+    ]
+    return json.dumps({"version": 1, "partitions": rows})
+
+
+def test_watcher_plans_with_no_client_and_hits_memo(sock_dir, fake_zk):
+    """The continuous controller end to end, in process: the watcher
+    reads the (fake) ZK tree, emits plans byte-identical to -no-daemon
+    on the same state, consumes the speculator's memo once the applier
+    confirms each move, resyncs on out-of-band drift — and the daemon
+    serves ZERO client plan ops throughout."""
+    fake_zk.tree = {"w": {str(i): [0, 1] for i in range(8)}}
+    fake_zk.tree["w"]["0"] = [2, 3]
+    emit = os.path.join(sock_dir, "plans")
+    sock = os.path.join(sock_dir, "kb.sock")
+    d, t, rc_box = _start_daemon(
+        sock,
+        idle_timeout=0.0,
+        watch_conn="fake:2181",
+        watch_emit=emit,
+        watch_poll=0.1,
+        watch_argv=["-no-daemon=true", "-max-reassign=1"],
+    )
+    try:
+        seen = 0
+        parity_rounds = 0
+        for _round in range(5):
+            path = None
+            deadline = time.monotonic() + 20
+            while time.monotonic() < deadline:
+                files = sorted(
+                    f for f in os.listdir(emit) if f.endswith(".json")
+                ) if os.path.isdir(emit) else []
+                if len(files) > seen:
+                    path = os.path.join(emit, files[seen])
+                    break
+                time.sleep(0.03)
+            if path is None:
+                break
+            want = run_cli(
+                ["-input-json", "-max-reassign=1", "-no-daemon"],
+                stdin=_zk_oracle_input(fake_zk.tree),
+            )
+            got = open(path).read()
+            assert got == want[1], f"round {_round}"
+            parity_rounds += 1
+            # the applier role: apply the emitted plan to the fake tree
+            tree = json.loads(json.dumps(fake_zk.tree))
+            _apply = json.loads(got)
+            for entry in _apply.get("partitions") or []:
+                tree[entry["topic"]][str(entry["partition"])] = list(
+                    entry["replicas"]
+                )
+            fake_zk.tree = tree
+            seen += 1
+        assert parity_rounds >= 3
+        w = sclient.fetch_watch(sock)
+        assert w is not None
+        assert w["watch"]["plans_emitted"] >= 3
+        assert w["watch"]["spec_hits"] >= 1, w["watch"]
+        assert w["watch"]["errors"] == 0
+        assert w["watch"]["last_event_lag_s"] is not None
+        assert _identity_ok(w["speculation"])
+        # no client ever planned
+        assert sclient.fetch_stats(sock)["requests"] == 0
+        # out-of-band drift: flip a replica set under the watcher
+        tree = json.loads(json.dumps(fake_zk.tree))
+        tree["w"]["3"] = [4, 5]
+        fake_zk.tree = tree
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            w2 = (sclient.fetch_watch(sock) or {}).get("watch") or {}
+            if w2.get("resyncs", 0) >= 1:
+                break
+            time.sleep(0.05)
+        assert w2.get("resyncs", 0) >= 1, w2
+    finally:
+        sclient.request_shutdown(sock)
+        t.join(20)
+    assert rc_box == [0]
+
+
+def test_watch_disabled_block_and_op(daemon):
+    """A watch-less daemon still answers the `watch` op and carries
+    the disabled block with the full key set."""
+    sock, _d = daemon
+    doc = sclient.fetch_stats(sock)
+    w = doc["watch"]
+    assert w["enabled"] is False
+    assert set(w) == set(
+        spec_mod.ZkWatcher.disabled_stats()
+    )
+    resp = sclient.fetch_watch(sock)
+    assert resp is not None and resp["watch"]["enabled"] is False

@@ -120,3 +120,121 @@ def test_zk_cli_error_paths_unchanged(fake_kazoo):
     rv = run(io.StringIO(""), out, err, ["kafkabalancer", "-from-zk=."])
     assert rv == 2
     assert "failed parsing zk connection string" in err.getvalue()
+
+
+# --- the watch machinery (ISSUE 15): factory seam, event decode, -----------
+# --- watcher registration, and the cross-process file fake -----------------
+
+from kafkabalancer_tpu.codecs import zookeeper as zkmod  # noqa: E402
+
+
+@pytest.fixture
+def client_factory():
+    """The injectable-client seam (set_zk_client_factory) — wins over
+    kazoo AND the env fake; always uninstalled."""
+    created = []
+
+    def install(tree, watch_support=True):
+        def factory(hosts):
+            zk = FakeKazooClient(hosts)
+            zk.tree_local = tree
+            if not watch_support:
+                # simulate a client whose get/get_children take no
+                # watcher argument at all
+                def gc(path):
+                    assert path == "/brokers/topics"
+                    return list(tree)
+
+                def g(path):
+                    topic = path.rsplit("/", 1)[1]
+                    state = {"version": 3, "partitions": tree[topic]}
+                    return json.dumps(state).encode("utf-8"), object()
+
+                zk.get_children = gc
+                zk.get = g
+            created.append(zk)
+            return zk
+
+        zkmod.set_zk_client_factory(factory)
+        return created
+
+    yield install
+    zkmod.set_zk_client_factory(None)
+
+
+def test_watch_event_decode():
+    """The znode payload decode the -watch loop shares with the
+    one-shot read: numeric pid order, int-coerced replica ids, empty
+    state tolerated."""
+    parts = zkmod.decode_topic_state(
+        "t",
+        json.dumps(
+            {"version": 1, "partitions": {"11": [1], "2": ["3", 4]}}
+        ).encode("utf-8"),
+    )
+    assert [(p.partition, p.replicas) for p in parts] == [
+        (2, [3, 4]), (11, [1]),
+    ]
+    assert zkmod.decode_topic_state("t", b'{"version":1}') == []
+
+
+def test_factory_seam_wins_and_watcher_registers(client_factory, fake_kazoo):
+    """make_zk_client + read_cluster with a watcher: the factory's
+    client is used (chroot on the hosts string), kazoo-style watch
+    callbacks are registered on the children node and every topic."""
+    registered = []
+
+    class WatchingFake(FakeKazooClient):
+        def get_children(self, path, watcher=None):
+            if watcher is not None:
+                registered.append(("children", watcher))
+            return super().get_children(path)
+
+        def get(self, path, watcher=None):
+            if watcher is not None:
+                registered.append((path.rsplit("/", 1)[1], watcher))
+            return super().get(path)
+
+    zkmod.set_zk_client_factory(lambda hosts: WatchingFake(hosts))
+    zk = zkmod.make_zk_client("h1:2181,h2:2182/kafka")
+    assert zk.hosts == "h1:2181,h2:2182/kafka"
+    cb = lambda *a: None  # noqa: E731
+    pl = zkmod.read_cluster(zk, watcher=cb)
+    assert len(pl) == 5
+    assert [k for k, _w in registered] == ["children", "alpha", "zebra"]
+    assert all(w is cb for _k, w in registered)
+
+
+def test_watcherless_client_falls_back(client_factory, fake_kazoo):
+    """A client whose get/get_children accept NO watcher argument
+    (TypeError) still reads — the poll interval is the fallback."""
+    client_factory(FakeKazooClient.tree, watch_support=False)
+    zk = zkmod.make_zk_client("h:2181")
+    pl = zkmod.read_cluster(zk, watcher=lambda *a: None)
+    assert len(pl) == 5
+
+
+def test_file_zk_client_roundtrip(tmp_path, monkeypatch):
+    """The cross-process $KAFKABALANCER_TPU_FAKE_ZK seam: topic files
+    under <root>/brokers/topics, half-written .tmp publishes invisible
+    to readers."""
+    tdir = tmp_path / "zk" / "brokers" / "topics"
+    tdir.mkdir(parents=True)
+    (tdir / "ft").write_text(
+        json.dumps({"version": 1, "partitions": {"0": [1, 2], "1": [2, 3]}})
+    )
+    (tdir / "ft.tmp").write_text("{torn write")
+    monkeypatch.setenv("KAFKABALANCER_TPU_FAKE_ZK", str(tmp_path / "zk"))
+    pl = get_partition_list_from_zookeeper("fake:2181")
+    assert [
+        (p.topic, p.partition, p.replicas) for p in pl.iter_partitions()
+    ] == [("ft", 0, [1, 2]), ("ft", 1, [2, 3])]
+
+
+def test_file_zk_client_missing_root(tmp_path, monkeypatch):
+    monkeypatch.setenv(
+        "KAFKABALANCER_TPU_FAKE_ZK", str(tmp_path / "absent")
+    )
+    with pytest.raises(CodecError) as ei:
+        get_partition_list_from_zookeeper("fake:2181")
+    assert str(ei.value).startswith("failed reading topic list from zk")
