@@ -1,11 +1,15 @@
 """jaxlint — driver for the JAX-aware static analysis.
 
 ``python -m kafkabalancer_tpu.analysis kafkabalancer_tpu/`` walks the
-given files/directories, runs the registered rules (R1–R5, see
-``rules/``), subtracts inline suppressions and the baseline, and reports
-remaining findings (human or ``--format json``). Exit code 0 = clean,
-1 = findings, 2 = usage/internal error — the contract ``scripts/gate.sh``
-builds on.
+given files/directories, runs the registered per-file rules (R1–R5,
+see ``rules/``), subtracts inline suppressions and the baseline, and
+reports remaining findings (human or ``--format json``).
+``--contracts [ROOT]`` instead runs the whole-program contract passes
+(R6–R9 + SUP, see ``contracts.py``) over the manifest's package.
+``--list-rules lint|contracts`` prints the registered rule ids — the
+one list scripts/gate.sh labels both stages from. Exit code 0 = clean,
+1 = findings, 2 = usage/internal error — the contract
+``scripts/gate.sh`` builds on, identical in both modes.
 
 Baseline: ``--write-baseline`` snapshots the current findings into a
 JSON file of ``(rule, path, source-line)`` fingerprints (line-number
@@ -166,9 +170,22 @@ def format_json(findings: Sequence[Finding]) -> str:
 
 
 def _rule_list() -> str:
-    return "\n".join(
-        f"  {rid}  {mod.TITLE}" for rid, mod in sorted(ALL_RULES.items())
+    from kafkabalancer_tpu.analysis.contracts import (
+        SUP_RULE_ID,
+        SUP_TITLE,
     )
+    from kafkabalancer_tpu.analysis.rules import CONTRACT_RULES
+
+    lines = [
+        f"  {rid}  {mod.TITLE}" for rid, mod in sorted(ALL_RULES.items())
+    ]
+    lines.append("contract rules (--contracts):")
+    lines.extend(
+        f"  {rid}  {mod.TITLE}"
+        for rid, mod in sorted(CONTRACT_RULES.items())
+    )
+    lines.append(f"  {SUP_RULE_ID}  {SUP_TITLE}")
+    return "\n".join(lines)
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -178,7 +195,14 @@ def build_parser() -> argparse.ArgumentParser:
         epilog="rules:\n" + _rule_list(),
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
-    ap.add_argument("paths", nargs="+", help="files or directories to lint")
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        help=(
+            "files or directories to lint; with --contracts, at most "
+            "one tree root (default: .)"
+        ),
+    )
     ap.add_argument(
         "--format",
         choices=("human", "json"),
@@ -212,18 +236,58 @@ def build_parser() -> argparse.ArgumentParser:
             "lint rules (the no-mypy fallback half of the typing gate)"
         ),
     )
+    ap.add_argument(
+        "--contracts",
+        action="store_true",
+        help=(
+            "run the whole-program contract passes (R6-R9 + SUP) over "
+            "the manifest's package under the given root"
+        ),
+    )
+    ap.add_argument(
+        "--list-rules",
+        choices=("lint", "contracts"),
+        default=None,
+        help=(
+            "print the registered rule ids for the given mode and "
+            "exit — the single list gate stages label themselves from"
+        ),
+    )
     return ap
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     args = build_parser().parse_args(argv)
 
+    if args.list_rules:
+        from kafkabalancer_tpu.analysis.contracts import SUP_RULE_ID
+        from kafkabalancer_tpu.analysis.rules import CONTRACT_RULES
+
+        ids = (
+            sorted(ALL_RULES)
+            if args.list_rules == "lint"
+            else sorted(CONTRACT_RULES) + [SUP_RULE_ID]
+        )
+        print(" ".join(ids))
+        return 0
+
+    if args.contracts:
+        from kafkabalancer_tpu.analysis.contracts import SUP_RULE_ID
+        from kafkabalancer_tpu.analysis.rules import CONTRACT_RULES
+
+        valid = set(CONTRACT_RULES) | {SUP_RULE_ID}
+    else:
+        valid = set(ALL_RULES)
+        if not args.paths:
+            print("jaxlint: no paths given", file=sys.stderr)
+            return 2
+
     rules: Optional[Tuple[str, ...]] = None
     if args.select:
         rules = tuple(
             r.strip().upper() for r in args.select.split(",") if r.strip()
         )
-        unknown = [r for r in rules if r not in ALL_RULES]
+        unknown = [r for r in rules if r not in valid]
         if unknown:
             print(
                 f"jaxlint: unknown rule(s): {', '.join(unknown)}",
@@ -231,10 +295,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             )
             return 2
     try:
-        if args.annotations:
+        if args.contracts:
+            from kafkabalancer_tpu.analysis.contracts import run_contracts
+
+            if len(args.paths) > 1:
+                print(
+                    "jaxlint: --contracts takes at most one tree root",
+                    file=sys.stderr,
+                )
+                return 2
+            root = args.paths[0] if args.paths else "."
+            findings: List[Finding] = run_contracts(root, rules=rules)
+        elif args.annotations:
             from kafkabalancer_tpu.analysis.annotations import check_paths
 
-            findings: List[Finding] = check_paths(args.paths)
+            findings = check_paths(args.paths)
         else:
             findings = lint_paths(args.paths, rules=rules)
     except (OSError, UnicodeDecodeError) as exc:

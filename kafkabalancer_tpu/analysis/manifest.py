@@ -1,0 +1,311 @@
+"""The contract manifest: what the whole-program passes (R6–R9) check.
+
+The analyzer is generic; THIS module is the project-specific
+declaration — which modules must stay jax/numpy-free (R6), which
+thread roles exist and what each may never reach (R8), which snapshot
+builders are pinned by which golden key sets and where each schema
+family's version number lives (R9). Fixture tests build their own
+``ContractManifest`` against a throwaway tree; the shipped tree is
+checked against ``default_manifest()``.
+
+Declared members use exact module names or a ``pkg.sub.*`` glob (which
+includes ``pkg.sub`` itself). Forbidden/boundary call patterns are
+``fnmatch`` globs over dotted names (``jax.*``,
+``…SessionStore._*``).
+
+See docs/static-analysis.md § Contract passes for the vocabulary.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+#: the thread-role vocabulary ``# thread-role:`` comments may use
+ROLES: Tuple[str, ...] = (
+    "accept-loop",  # the socket accept loop and per-connection threads
+    "request",      # serve-req-N per-request handler threads
+    "lane-worker",  # per-device lane executor threads
+    "warm",         # the startup warm/prewarm thread
+    "speculate",    # the speculative plan-ahead worker
+    "watch",        # the watch-mode controller thread
+    "any",          # thread-agnostic utility (documentation only)
+)
+
+
+@dataclass(frozen=True)
+class PuritySet:
+    """Modules whose module-level import closure must not reach any of
+    the ``forbidden`` top-level third-party modules."""
+
+    name: str
+    forbidden: Tuple[str, ...]
+    members: Tuple[str, ...]
+
+
+@dataclass(frozen=True)
+class RoleRule:
+    """Dotted-name call patterns a thread of ``role`` must never reach
+    through the intra-package call graph."""
+
+    role: str
+    forbidden: Tuple[str, ...]
+    why: str
+
+
+@dataclass(frozen=True)
+class Boundary:
+    """A function key pattern role propagation does not descend into —
+    a guarded seam whose body is allowed what its callers are not.
+    Every boundary carries its justification."""
+
+    pattern: str
+    reason: str
+
+
+@dataclass(frozen=True)
+class BuilderSpec:
+    """One snapshot-builder function whose emitted key set R9 collects:
+    dict literals assigned to ``var`` (plus ``var["k"] = …``,
+    ``var.update({...})`` and ``var.append({...})``), or — when ``var``
+    is None — every dict literal the function returns."""
+
+    path: str  # module path relative to the analyzed root
+    qualname: str  # "Daemon._core_snapshot" / "Daemon._tenants_block.entry"
+    var: Optional[str] = None
+
+
+@dataclass(frozen=True)
+class SchemaGolden:
+    """One golden pin: the union of the named ``keysets`` in the golden
+    JSON must equal the union of keys the ``builders`` emit."""
+
+    golden: str  # path relative to the analyzed root
+    keysets: Tuple[str, ...]
+    builders: Tuple[BuilderSpec, ...]
+    allowed_extra: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class VersionAuthority:
+    """Where a schema family's current version number is declared; every
+    full ``kafkabalancer-tpu.<family>/<n>`` literal elsewhere must
+    agree with it."""
+
+    family: str  # "serve-stats"
+    path: str  # module that declares the constant
+    symbol: str  # integer constant name, e.g. "STATS_SCHEMA_VERSION"
+
+
+@dataclass(frozen=True)
+class FlagTableSpec:
+    """README flag documentation vs the registered flag set: every flag
+    the CLI registers (minus ``exempt``) must be named in the README
+    section, and every table row's leading flag must be registered."""
+
+    readme: str
+    registrar: str  # module that registers flags on a FlagSet
+    section_start: str
+    section_end: str
+    exempt: Tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class ContractManifest:
+    package: str
+    extra_files: Tuple[str, ...] = ()
+    purity: Tuple[PuritySet, ...] = ()
+    roles: Tuple[str, ...] = ROLES
+    role_rules: Tuple[RoleRule, ...] = ()
+    boundaries: Tuple[Boundary, ...] = ()
+    goldens: Tuple[SchemaGolden, ...] = ()
+    versions: Tuple[VersionAuthority, ...] = ()
+    flag_table: Optional[FlagTableSpec] = None
+    text_files: Tuple[str, ...] = ()  # extra docs scanned for version drift
+
+
+_D = "kafkabalancer_tpu/serve/daemon.py"
+
+
+def default_manifest() -> ContractManifest:
+    """The shipped tree's contracts."""
+    return ContractManifest(
+        package="kafkabalancer_tpu",
+        extra_files=("bench.py",),
+        purity=(
+            # The static twin of tests/test_serve.py's no-jax subprocess
+            # pin: a forwarded invocation imports cli + serve.client and
+            # must touch neither jax nor numpy at module level.
+            PuritySet(
+                name="client-path",
+                forbidden=("jax", "jaxlib", "numpy"),
+                members=(
+                    "kafkabalancer_tpu",
+                    "kafkabalancer_tpu.cli",
+                    "kafkabalancer_tpu.serve",
+                    "kafkabalancer_tpu.serve.client",
+                    "kafkabalancer_tpu.serve.protocol",
+                    "kafkabalancer_tpu.serve.state",
+                ),
+            ),
+            # Host-side machinery that must run anywhere the repo checks
+            # out: observability rendering, the linter itself, the flag
+            # parser. obs/convergence's numpy stays function-local
+            # (gated), which the module-level graph correctly excludes.
+            PuritySet(
+                name="host-pure",
+                forbidden=("jax", "jaxlib", "numpy"),
+                members=(
+                    "kafkabalancer_tpu.obs.*",
+                    "kafkabalancer_tpu.analysis.*",
+                    "kafkabalancer_tpu.utils.flags",
+                    "kafkabalancer_tpu.codecs.*",
+                    "kafkabalancer_tpu.models.*",
+                    "kafkabalancer_tpu.serve.sessions",
+                    "kafkabalancer_tpu.serve.spill",
+                ),
+            ),
+        ),
+        role_rules=(
+            RoleRule(
+                role="accept-loop",
+                forbidden=(
+                    "jax.*",
+                    "kafkabalancer_tpu.serve.devmem.*",
+                    "kafkabalancer_tpu.ops.*",
+                    "kafkabalancer_tpu.solvers.*",
+                ),
+                why=(
+                    "accept/connection threads answer hello and stats "
+                    "instantly; an unlatched backend attach (the PR-9 "
+                    "hello-thread bug) blocks every probe behind device "
+                    "init"
+                ),
+            ),
+            RoleRule(
+                role="request",
+                # _[!_]* — single-underscore internals (_retire,
+                # _spill_locked, _insert), NOT dunders: constructing a
+                # store is not the bug class, holding its lock without
+                # checkout is.
+                forbidden=(
+                    "kafkabalancer_tpu.serve.sessions."
+                    "SessionStore._[!_]*",
+                ),
+                why=(
+                    "SessionStore internals assume checkout ownership; "
+                    "a request thread reaching them directly bypasses "
+                    "the busy/generation protocol (the PR-12 bug class)"
+                ),
+            ),
+        ),
+        boundaries=(
+            Boundary(
+                pattern=(
+                    "kafkabalancer_tpu.serve.daemon."
+                    "Daemon._memory_snapshot"
+                ),
+                reason=(
+                    "the devmem no-device query inside is latched on "
+                    "_warm_done (never blocks on an unattached backend)"
+                ),
+            ),
+            Boundary(
+                pattern=(
+                    "kafkabalancer_tpu.serve.daemon."
+                    "Daemon._make_dispatcher"
+                ),
+                reason=(
+                    "the warm-off startup attach: serve_forever calls "
+                    "it once before the accept loop starts accepting "
+                    "(no probe exists yet to block); with -serve-warm "
+                    "it runs on the warm thread instead"
+                ),
+            ),
+            Boundary(
+                pattern=(
+                    "kafkabalancer_tpu.serve.sessions."
+                    "SessionStore.[!_]*"
+                ),
+                reason=(
+                    "the store's public API IS the checkout protocol — "
+                    "internals it calls under its own lock are not a "
+                    "caller-side bypass"
+                ),
+            ),
+        ),
+        goldens=(
+            SchemaGolden(
+                golden="tests/data/serve_stats_schema_v7.json",
+                keysets=("top_level_keys", "lane_keys"),
+                builders=(
+                    BuilderSpec(_D, "Daemon._core_snapshot", var="out"),
+                    BuilderSpec(_D, "Daemon._stats_doc", var="doc"),
+                ),
+            ),
+            SchemaGolden(
+                golden="tests/data/serve_stats_schema_v7.json",
+                keysets=("tenants_keys",),
+                builders=(
+                    BuilderSpec(_D, "Daemon._tenants_block", var=None),
+                ),
+            ),
+            SchemaGolden(
+                golden="tests/data/serve_stats_schema_v7.json",
+                keysets=("tenant_entry_keys",),
+                builders=(
+                    BuilderSpec(
+                        _D, "Daemon._tenants_block.entry", var=None
+                    ),
+                ),
+            ),
+            SchemaGolden(
+                golden="tests/data/serve_stats_schema_v7.json",
+                keysets=("memory_keys",),
+                builders=(
+                    BuilderSpec(_D, "Daemon._memory_snapshot", var="out"),
+                ),
+            ),
+            SchemaGolden(
+                golden="tests/data/metrics_schema_v1.json",
+                keysets=("top_level_keys",),
+                builders=(
+                    BuilderSpec(
+                        "kafkabalancer_tpu/obs/export.py",
+                        "metrics_payload",
+                        var=None,
+                    ),
+                ),
+            ),
+        ),
+        versions=(
+            VersionAuthority(
+                "serve-stats",
+                "kafkabalancer_tpu/serve/protocol.py",
+                "STATS_SCHEMA_VERSION",
+            ),
+            VersionAuthority(
+                "metrics",
+                "kafkabalancer_tpu/obs/metrics.py",
+                "SCHEMA_VERSION",
+            ),
+            VersionAuthority(
+                "explain",
+                "kafkabalancer_tpu/obs/convergence.py",
+                "EXPLAIN_SCHEMA_VERSION",
+            ),
+            VersionAuthority(
+                "replay",
+                "kafkabalancer_tpu/replay/harness.py",
+                "REPLAY_SCHEMA_VERSION",
+            ),
+        ),
+        flag_table=FlagTableSpec(
+            readme="README.md",
+            registrar="kafkabalancer_tpu/cli.py",
+            section_start="### Flags",
+            section_end="Exit codes",
+            exempt=("help", "h"),
+        ),
+        text_files=("README.md", "docs"),
+    )

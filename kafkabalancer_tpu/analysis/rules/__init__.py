@@ -1,9 +1,13 @@
-"""Rule registry for the JAX-aware linter.
+"""Rule registry for the JAX-aware linter and the contract analyzer.
 
-Each rule module exposes ``RULE_ID`` (``"R1"``…), ``TITLE`` (one line),
-and ``check(ctx: ModuleContext) -> Iterator[Finding]``. Registration is
-explicit — a rule the registry doesn't name does not run — so the gate's
-behaviour is reviewable in one place.
+Each per-file rule module exposes ``RULE_ID`` (``"R1"``…), ``TITLE``
+(one line), and ``check(ctx: ModuleContext) -> Iterator[Finding]``;
+each whole-program contract rule exposes ``RULE_ID`` (``"R6"``…),
+``TITLE``, and ``check_program(program, manifest)``. Registration is
+explicit — a rule the registry doesn't name does not run — so the
+gate's behaviour is reviewable in one place, and ``--list-rules``
+(which scripts/gate.sh derives its stage labels from) reads these two
+dicts rather than a second copy of the list.
 """
 
 from __future__ import annotations
@@ -17,6 +21,10 @@ from kafkabalancer_tpu.analysis.rules import (
     r3_host_sync,
     r4_dtype_policy,
     r5_bool_indexing,
+    r6_import_purity,
+    r7_lock_order,
+    r8_thread_roles,
+    r9_schema_drift,
 )
 
 ALL_RULES: Dict[str, ModuleType] = {
@@ -30,4 +38,14 @@ ALL_RULES: Dict[str, ModuleType] = {
     )
 }
 
-__all__ = ["ALL_RULES"]
+CONTRACT_RULES: Dict[str, ModuleType] = {
+    mod.RULE_ID: mod
+    for mod in (
+        r6_import_purity,
+        r7_lock_order,
+        r8_thread_roles,
+        r9_schema_drift,
+    )
+}
+
+__all__ = ["ALL_RULES", "CONTRACT_RULES"]

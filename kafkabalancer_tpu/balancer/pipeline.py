@@ -41,7 +41,9 @@ _HEAD_REPAIR: List[Tuple[str, StepFn]] = [
 _COMMON_HEAD: List[Tuple[str, StepFn]] = _HEAD_VALIDATE + _HEAD_REPAIR
 
 
-def _tpu_move_leaders(pl, cfg):
+def _tpu_move_leaders(
+    pl: PartitionList, cfg: RebalanceConfig
+) -> Optional[PartitionList]:
     try:
         from kafkabalancer_tpu.solvers.tpu import tpu_move_leaders
     except ImportError as exc:
@@ -50,7 +52,9 @@ def _tpu_move_leaders(pl, cfg):
     return tpu_move_leaders(pl, cfg)
 
 
-def _tpu_move_non_leaders(pl, cfg):
+def _tpu_move_non_leaders(
+    pl: PartitionList, cfg: RebalanceConfig
+) -> Optional[PartitionList]:
     try:
         from kafkabalancer_tpu.solvers.tpu import tpu_move_non_leaders
     except ImportError as exc:
@@ -59,7 +63,9 @@ def _tpu_move_non_leaders(pl, cfg):
     return tpu_move_non_leaders(pl, cfg)
 
 
-def _beam_move(pl, cfg):
+def _beam_move(
+    pl: PartitionList, cfg: RebalanceConfig
+) -> Optional[PartitionList]:
     try:
         from kafkabalancer_tpu.solvers.beam import beam_move
     except ImportError as exc:

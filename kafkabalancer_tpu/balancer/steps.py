@@ -23,6 +23,7 @@ from typing import List, Optional, Sequence, Tuple
 # -> steps), and a module-level numpy import would put ~0.1 s back into
 # every forwarded invocation's startup — the exact cost serving removes
 from kafkabalancer_tpu.balancer.costmodel import (
+    BrokerLoadList,
     get_bl,
     get_broker_list,
     get_broker_list_by_load,
@@ -384,7 +385,7 @@ def classify_no_move(pl: PartitionList, cfg: RebalanceConfig) -> dict:
 
 
 def scan_partition_move(
-    p: Partition, bl, cu: float, best: Optional[tuple],
+    p: Partition, bl: BrokerLoadList, cu: float, best: Optional[tuple],
     cfg: RebalanceConfig, leaders: bool,
 ) -> "tuple[float, Optional[tuple]]":
     """One partition's slice of the greedy scan (reference ``move`` loop
@@ -443,7 +444,9 @@ def scan_partition_move(
 _SCAN_CHUNK = 8192
 
 
-def replay_broker_loads(bl, moves) -> list:
+def replay_broker_loads(
+    bl: BrokerLoadList, moves: Sequence[Tuple[int, int, float]]
+) -> list:
     """Oracle-side replay of a move log onto a broker-load table with
     the session's exact IEEE-754 op order: per move, ONE subtract on the
     source cell then ONE add on the target cell (the two ops both the
@@ -469,7 +472,7 @@ def replay_broker_loads(bl, moves) -> list:
 
 def scan_moves(
     parts: Sequence[Partition],
-    bl,
+    bl: BrokerLoadList,
     cu: float,
     best: Optional[tuple],
     cfg: RebalanceConfig,

@@ -178,10 +178,18 @@ class StreamingHist:
     def merge_from(self, other: "StreamingHist") -> None:
         """Fold ``other``'s whole state — lifetime AND windowed — into
         this hist: the label-demotion primitive behind
-        :class:`HistFamily`. Lock order is fixed (self, then other);
-        the family only ever merges INTO its one rollup hist, so the
-        opposite order can never be in flight."""
-        with self._lock, other._lock:
+        :class:`HistFamily`. Acquisition is id-ordered: HistFamily only
+        ever merges INTO its one rollup hist, but nothing enforces that
+        for other callers — two hists merged in opposite directions on
+        two threads must never deadlock on the lock pair."""
+        if other is self:
+            return  # self-merge is a no-op (and _lock is not reentrant)
+        first, second = (
+            (self._lock, other._lock)
+            if id(self._lock) <= id(other._lock)
+            else (other._lock, self._lock)
+        )
+        with first, second:
             self._count += other._count
             self._sum += other._sum
             if other._count:

@@ -9,7 +9,7 @@ both axes are 1 and everything degenerates to the plain jitted path.
 from __future__ import annotations
 
 import math
-from typing import Optional, Sequence, Tuple
+from typing import Any, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -35,7 +35,7 @@ except (ValueError, TypeError):  # pragma: no cover - exotic wrappers
     _HAS_CHECK_VMA = True  # assume the current API
 
 
-def shard_map(*args, **kwargs):  # type: ignore[no-untyped-def]
+def shard_map(*args: Any, **kwargs: Any) -> Any:
     if not _HAS_CHECK_VMA and "check_vma" in kwargs:
         kwargs["check_rep"] = kwargs.pop("check_vma")
     return _shard_map_impl(*args, **kwargs)
@@ -53,7 +53,9 @@ def balanced_factors(n: int) -> Tuple[int, int]:
     return a, n // a
 
 
-def shard_put(arr, mesh: Mesh, axis: str = PART_AXIS):
+def shard_put(
+    arr: Any, mesh: Mesh, axis: str = PART_AXIS
+) -> jax.Array:
     """Materialize a host array as a GLOBAL mesh array sharded over
     ``axis`` on its leading dimension, transferring each device's slice
     directly from the host buffer (``jax.make_array_from_callback``).
@@ -77,7 +79,7 @@ def shard_put(arr, mesh: Mesh, axis: str = PART_AXIS):
     )
 
 
-def replicate_put(arr, mesh: Mesh):
+def replicate_put(arr: Any, mesh: Mesh) -> jax.Array:
     """Materialize a host array fully replicated across ``mesh`` —
     the upload twin of :func:`shard_put` for the O(P)/O(B) session
     vectors (weights, validity, loads) whose bytes are trivial next to

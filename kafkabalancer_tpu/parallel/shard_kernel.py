@@ -49,6 +49,7 @@ cost.paired_best apply them.
 from __future__ import annotations
 
 from functools import partial
+from typing import Any, Optional, Tuple
 
 from kafkabalancer_tpu.ops.runtime import ensure_x64
 
@@ -71,10 +72,10 @@ SHARD_TILE_P = 256
 
 
 def _kernel(
-    *refs,
+    *refs: Any,
     allow_leader: bool,
     with_colo: bool,
-):
+) -> None:
     """Gridded scoring kernel. Positional refs, in order:
 
     replicas [T, R] i32 | cols [T, 5] f32 (w | ncur | ntgt | ncons |
@@ -150,7 +151,7 @@ def _kernel(
     # same accumulation, both failed to legalize in Mosaic on the bench
     # toolchain ("failed to legalize operation 'func.return'").
     @pl.when(ti == 0)
-    def _():
+    def _() -> None:
         vf_ref[...] = jnp.full((1, B), jnp.inf, f32)
         pf_ref[...] = jnp.zeros((1, B), i32)
         vl_ref[...] = jnp.full((1, B), jnp.inf, f32)
@@ -168,7 +169,8 @@ def _kernel(
     big_p = jnp.full((T, B2), jnp.iinfo(jnp.int32).max, i32)
     inf_tp = jnp.full((T, B2), jnp.inf, f32)
 
-    def dsel(m, sel):  # [T, B] @ [B, B2] one-hot column selection (exact)
+    def dsel(m: jax.Array, sel: jax.Array) -> jax.Array:
+        # [T, B] @ [B, B2] one-hot column selection (exact)
         return jax.lax.dot_general(
             m, sel,
             dimension_numbers=(((1,), (0,)), ((), ())),
@@ -263,21 +265,21 @@ def _kernel(
 
 
 def shard_score(
-    replicas,  # [P_l, R] i32
-    cols,      # [P_l, 5] f32 packed per-partition columns (pack_cols)
-    member,    # [P_l, B] bool
-    allowed,   # [P_l, B] bool
-    loads,     # [1, B] f32
-    F,         # [1, B] f32
-    bvalid,    # [1, B] bool
-    scal,      # [1, 3] f32: avg | min_replicas | lam
-    ssel,      # [B, B2] f32 hot one-hot columns (cost.pair_frame)
-    tsel,      # [B, B2] f32 cold one-hot columns
-    c_rows=None,  # [P_l, B] f32 same-topic counts (colocation mode)
+    replicas: jax.Array,  # [P_l, R] i32
+    cols: jax.Array,      # [P_l, 5] f32 per-partition columns (pack_cols)
+    member: jax.Array,    # [P_l, B] bool
+    allowed: jax.Array,   # [P_l, B] bool
+    loads: jax.Array,     # [1, B] f32
+    F: jax.Array,         # [1, B] f32
+    bvalid: jax.Array,    # [1, B] bool
+    scal: jax.Array,      # [1, 3] f32: avg | min_replicas | lam
+    ssel: jax.Array,      # [B, B2] f32 hot one-hots (cost.pair_frame)
+    tsel: jax.Array,      # [B, B2] f32 cold one-hot columns
+    c_rows: Optional[jax.Array] = None,  # [P_l, B] f32 colocation mode
     *,
     allow_leader: bool,
     interpret: bool = False,
-):
+) -> Tuple[jax.Array, ...]:
     """One fused scoring pass over this shard's local rows. Returns
     ``(vals_f [B], p_f [B], vals_l [B], p_l [B], vals_pf [B2], p_pf [B2],
     vals_pl [B2], p_pl [B2])`` — raw ``A+C`` minima (no ``su`` offset)
@@ -298,10 +300,10 @@ def shard_score(
     # index maps cast to int32 explicitly: under global x64 the grid
     # indices trace as 64-bit and Mosaic fails to legalize the whole
     # kernel ("failed to legalize operation 'func.return'")
-    def tile_map(i):
+    def tile_map(i: Any) -> Tuple[Any, Any]:
         return (jnp.int32(i), jnp.int32(0))
 
-    def const_map(i):
+    def const_map(i: Any) -> Tuple[Any, Any]:
         return (jnp.int32(0), jnp.int32(0))
 
     in_specs = [
@@ -355,7 +357,13 @@ def shard_score(
     )
 
 
-def pack_cols(weights, nrep_cur, nrep_tgt, ncons, pvalid):
+def pack_cols(
+    weights: jax.Array,
+    nrep_cur: jax.Array,
+    nrep_tgt: jax.Array,
+    ncons: jax.Array,
+    pvalid: jax.Array,
+) -> jax.Array:
     """Pack the session-static per-partition vectors into the kernel's
     single gridded ``[P_l, 5]`` f32 input (all values are exact in f32:
     weights are f32 inputs, counts are small ints)."""

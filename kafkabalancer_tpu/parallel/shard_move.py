@@ -18,6 +18,7 @@ one ``all_gather`` of three scalars per shard is negligible.
 from __future__ import annotations
 
 from functools import partial
+from typing import Tuple
 
 from kafkabalancer_tpu.ops.runtime import ensure_x64
 
@@ -35,21 +36,21 @@ from kafkabalancer_tpu.solvers.tpu import score_moves  # noqa: E402
 
 @partial(jax.jit, static_argnames=("leaders", "mesh"))
 def sharded_score_moves(
-    loads,
-    replicas,
-    allowed,
-    member,
-    weights,
-    nrep_cur,
-    nrep_tgt,
-    pvalid,
-    bvalid,
-    nb,
-    min_replicas,
+    loads: jax.Array,
+    replicas: jax.Array,
+    allowed: jax.Array,
+    member: jax.Array,
+    weights: jax.Array,
+    nrep_cur: jax.Array,
+    nrep_tgt: jax.Array,
+    pvalid: jax.Array,
+    bvalid: jax.Array,
+    nb: jax.Array,
+    min_replicas: jax.Array,
     *,
     leaders: bool,
     mesh: Mesh,
-):
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Global best move with the partition axis sharded over ``mesh``'s
     ``part`` axis. Returns ``(u_min, global flat idx, su, perm)`` — the
     same contract as ``solvers.tpu.score_moves`` without the tie window.
@@ -83,8 +84,12 @@ def sharded_score_moves(
         # analysis can't see it is replicated after the all_gather+min
         check_vma=False,
     )
-    def run(loads, replicas, allowed, member, weights, nrep_cur, nrep_tgt,
-            pvalid, bvalid, nb, min_replicas):
+    def run(
+        loads: jax.Array, replicas: jax.Array, allowed: jax.Array,
+        member: jax.Array, weights: jax.Array, nrep_cur: jax.Array,
+        nrep_tgt: jax.Array, pvalid: jax.Array, bvalid: jax.Array,
+        nb: jax.Array, min_replicas: jax.Array,
+    ) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
         # the unsharded scorer, applied to this device's partition shard
         u, idx, su, perm = score_moves(
             loads, replicas, allowed, member, weights, nrep_cur, nrep_tgt,

@@ -42,6 +42,7 @@ topologies.
 from __future__ import annotations
 
 from functools import partial
+from typing import Any, Optional, Tuple
 
 from kafkabalancer_tpu.ops.runtime import ensure_x64
 
@@ -54,6 +55,10 @@ from jax import lax  # noqa: E402
 from jax.sharding import Mesh  # noqa: E402
 from jax.sharding import PartitionSpec as PS  # noqa: E402
 
+from kafkabalancer_tpu.models import (  # noqa: E402
+    PartitionList,
+    RebalanceConfig,
+)
 from kafkabalancer_tpu.models.config import (  # noqa: E402
     default_dtype,
     kernel_dtype,
@@ -71,23 +76,23 @@ from kafkabalancer_tpu.solvers.scan import prefix_accept  # noqa: E402
     ),
 )
 def sharded_session(
-    loads,
-    replicas,
-    member,
-    allowed,
-    weights,
-    nrep_cur,
-    nrep_tgt,
-    ncons,
-    pvalid,
-    always_valid,
-    universe_valid,
-    min_replicas,
-    min_unbalance,
-    budget,
-    churn_gate,
-    tid=None,
-    lam=None,
+    loads: jax.Array,
+    replicas: jax.Array,
+    member: Optional[jax.Array],
+    allowed: Optional[jax.Array],
+    weights: jax.Array,
+    nrep_cur: jax.Array,
+    nrep_tgt: jax.Array,
+    ncons: jax.Array,
+    pvalid: jax.Array,
+    always_valid: jax.Array,
+    universe_valid: jax.Array,
+    min_replicas: jax.Array,
+    min_unbalance: jax.Array,
+    budget: jax.Array,
+    churn_gate: jax.Array,
+    tid: Optional[jax.Array] = None,
+    lam: Optional[jax.Array] = None,
     *,
     max_moves: int,
     allow_leader: bool,
@@ -98,7 +103,7 @@ def sharded_session(
     lean: bool = False,
     all_allowed: bool = False,
     row_chunk: int = 0,
-):
+) -> Tuple[jax.Array, ...]:
     """``scan.session``'s batch path with the partition axis sharded over
     ``mesh``'s ``part`` axis; same return contract ``(replicas, loads, n,
     move_p, move_slot, move_src, move_tgt, final_su)`` with ``replicas``
@@ -225,7 +230,7 @@ def sharded_session(
         # cannot see they are replicated after the gather+min combine
         check_vma=False,
     )
-    def run(*xs):
+    def run(*xs: jax.Array) -> Tuple[jax.Array, ...]:
         it = iter(xs)
         loads = next(it)
         replicas = next(it)
@@ -237,7 +242,7 @@ def sharded_session(
         shard_i = lax.axis_index(PART_AXIS)
         off = (shard_i * P_l).astype(jnp.int32)
 
-        def lslice(v):
+        def lslice(v: jax.Array) -> jax.Array:
             return lax.dynamic_slice_in_dim(v, off, P_l)
 
         w_l = lslice(weights)
@@ -297,7 +302,7 @@ def sharded_session(
             P_pad = n_chunks * row_chunk
             pad_n = P_pad - P_l
 
-            def _chunk_rows(a, fill):
+            def _chunk_rows(a: jax.Array, fill: Any) -> jax.Array:
                 # [P_l, ...] -> [n_chunks, row_chunk, ...]; pad rows are
                 # neutral (pvalid False / replicas -1 / member False) so
                 # their candidates score +inf and never win
@@ -318,11 +323,15 @@ def sharded_session(
             tid_c = _chunk_rows(tid_l, 0) if n_topics else None
             offs_c = jnp.arange(n_chunks, dtype=jnp.int32) * row_chunk
 
-            def _chunked_best(loads, replicas, member, counts, bvalid, nb):
+            def _chunked_best(
+                loads: jax.Array, replicas: jax.Array,
+                member: jax.Array, counts: jax.Array,
+                bvalid: jax.Array, nb: jax.Array,
+            ) -> Tuple[jax.Array, ...]:
                 reps_c = _chunk_rows(replicas, -1)
                 mem_c = _chunk_rows(member, False)
 
-                def one(xs):
+                def one(xs: Tuple[Any, ...]) -> Tuple[jax.Array, ...]:
                     reps, mem, alw, w_, ncur_, ntgt_, ncons_, pv_, tid_ = xs
                     if alw is None:
                         alw = jnp.broadcast_to(
@@ -348,7 +357,10 @@ def sharded_session(
                      ncons_c, pvalid_c, tid_c),
                 )
 
-                def combine(vals_all, p_all, slot_all):
+                def combine(
+                    vals_all: jax.Array, p_all: jax.Array,
+                    slot_all: jax.Array,
+                ) -> Tuple[jax.Array, jax.Array, jax.Array]:
                     # chunk-local winner rows -> shard-local; min under
                     # (val, is_leader, row), exactly the cross-shard key
                     pg = p_all + offs_c[:, None]
@@ -361,7 +373,7 @@ def sharded_session(
                     )
                     k = jnp.argmin(tiekey, axis=0)
 
-                    def take(a):
+                    def take(a: jax.Array) -> jax.Array:
                         return jnp.take_along_axis(a, k[None, :], axis=0)[0]
 
                     return vmin, take(pg).astype(jnp.int32), take(slot_all)
@@ -387,8 +399,11 @@ def sharded_session(
             slot_iota_r = jnp.arange(R)[None, :]
             iota_bb = jnp.arange(B, dtype=jnp.int32)[:, None]
 
-        def _score_pallas(loads, replicas, member, bvalid, nb,
-                          c_rows=None):
+        def _score_pallas(
+            loads: jax.Array, replicas: jax.Array, member: jax.Array,
+            bvalid: jax.Array, nb: jax.Array,
+            c_rows: Optional[jax.Array] = None,
+        ) -> Tuple[jax.Array, ...]:
             """Kernel-backed analog of the XLA branch's
             ``factored_target_best`` + ``paired_best`` calls: same
             avg/F/su/rank arithmetic, the fused kernel for the [P_l, B] +
@@ -458,7 +473,7 @@ def sharded_session(
                 su + vals_p_raw, p_p, slot_p, s_i, t_i,
             )
 
-        def _applied_delta(p, slot):
+        def _applied_delta(p: jax.Array, slot: jax.Array) -> jax.Array:
             # full-vector lookups: p is a GLOBAL partition index
             return jnp.where(
                 slot == 0,
@@ -466,11 +481,11 @@ def sharded_session(
                 weights[p],
             )
 
-        def cond(state):
+        def cond(state: Tuple[jax.Array, ...]) -> jax.Array:
             n, done = state[4], state[5]
             return (~done) & (n < budget) & (n < max_moves)
 
-        def body(state):
+        def body(state: Tuple[jax.Array, ...]) -> Tuple[jax.Array, ...]:
             (loads, replicas, member, bcount, n, done, mp, mslot, msrc,
              mtgt, counts) = state
 
@@ -664,8 +679,9 @@ def _resolve_row_chunk(requested: "int | None", P_l: int) -> int:
     return 0 if rc >= P_l else rc
 
 
-def _mesh_cached_put(cache: dict, name: str, arr, mesh: Mesh,
-                     sharded: bool):
+def _mesh_cached_put(
+    cache: dict, name: str, arr: Any, mesh: Mesh, sharded: bool
+) -> jax.Array:
     """Digest-keyed mesh upload: ``parallel.mesh.shard_put`` /
     ``replicate_put`` behind ``scan._dev_cached_asarray``'s ONE cache
     discipline (its ``upload`` seam) — a multi-chunk scale session
@@ -688,7 +704,10 @@ def _mesh_cached_put(cache: dict, name: str, arr, mesh: Mesh,
 
 
 @partial(jax.jit, static_argnames=("dtype",))
-def _scale_prep(replicas, weights, nrep_cur, ncons, bvalid, *, dtype):
+def _scale_prep(
+    replicas: jax.Array, weights: jax.Array, nrep_cur: jax.Array,
+    ncons: jax.Array, bvalid: jax.Array, *, dtype: Any,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """The scale tier's device input prep: exactly ``_device_prep``'s
     dtype casts and broker-load scatter (the same IEEE op sequence, so
     the [B] loads are bit-identical to what the single-device session
@@ -702,7 +721,7 @@ def _scale_prep(replicas, weights, nrep_cur, ncons, bvalid, *, dtype):
     return loads, w, nc
 
 
-def _globalize(args, mesh: Mesh):
+def _globalize(args: Tuple[Any, ...], mesh: Mesh) -> Tuple[Any, ...]:
     """Promote host-resident session inputs to global arrays for a mesh
     spanning multiple processes. Every process passes identical host
     values (tensorize of the same partition list), so ``device_put``
@@ -720,11 +739,11 @@ def _globalize(args, mesh: Mesh):
 
 
 def plan_sharded(
-    pl,
-    cfg,
+    pl: PartitionList,
+    cfg: RebalanceConfig,
     max_reassign: int,
     mesh: Mesh,
-    dtype=None,
+    dtype: Any = None,
     batch: int = 128,
     chunk_moves: "int | None" = None,
     churn_gate: "float | None" = None,
@@ -733,7 +752,7 @@ def plan_sharded(
     anti_colocation: "float | None" = None,
     scale: bool = False,
     row_chunk: "int | None" = None,
-):
+) -> PartitionList:
     """Mesh-sharded analog of ``solvers.scan.plan`` — repairs settle
     host-side first, sharded move-session chunks re-enter like ``plan``.
     Output/mutation contract matches ``plan``, including the

@@ -39,7 +39,7 @@ from __future__ import annotations
 import copy
 from dataclasses import dataclass
 from functools import partial
-from typing import List, Optional, Sequence
+from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 from kafkabalancer_tpu import obs
 from kafkabalancer_tpu.models import PartitionList, RebalanceConfig
@@ -108,8 +108,12 @@ class SweepResult:
     # of the reassignment budget, like a CLI loop iteration)
 
 
-def _evacuate(replicas, member, allowed_s, weights, nrep_cur, ncons, pvalid,
-              universe_valid, budget, max_evac: int):
+def _evacuate(
+    replicas: jax.Array, member: jax.Array, allowed_s: jax.Array,
+    weights: jax.Array, nrep_cur: jax.Array, ncons: jax.Array,
+    pvalid: jax.Array, universe_valid: jax.Array, budget: jax.Array,
+    max_evac: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
     """Drain disallowed replicas one at a time (module docstring).
 
     Each evacuation consumes one unit of the reassignment ``budget``, like
@@ -120,12 +124,15 @@ def _evacuate(replicas, member, allowed_s, weights, nrep_cur, ncons, pvalid,
     flat_iota = jnp.arange(Ppad * R)
     big = Ppad * R + 1
 
-    def cond(st):
+    def cond(st: Tuple[jax.Array, ...]) -> jax.Array:
         replicas, member, n, feasible = st
         stranded = _stranded_mask(replicas, allowed_s, nrep_cur, pvalid)
         return stranded.any() & feasible & (n < budget) & (n < max_evac)
 
-    def _stranded_mask(replicas, allowed_s, nrep_cur, pvalid):
+    def _stranded_mask(
+        replicas: jax.Array, allowed_s: jax.Array,
+        nrep_cur: jax.Array, pvalid: jax.Array,
+    ) -> jax.Array:
         slot = jnp.arange(R)[None, :]
         valid = (slot < nrep_cur[:, None]) & pvalid[:, None]
         target_ok = jnp.take_along_axis(
@@ -133,7 +140,7 @@ def _evacuate(replicas, member, allowed_s, weights, nrep_cur, ncons, pvalid,
         )  # [P, R]: replica's broker allowed?
         return valid & ~target_ok
 
-    def body(st):
+    def body(st: Tuple[jax.Array, ...]) -> Tuple[jax.Array, ...]:
         replicas, member, n, feasible = st
         stranded = _stranded_mask(replicas, allowed_s, nrep_cur, pvalid)
         flat = jnp.where(stranded.reshape(-1), flat_iota, big)
@@ -151,7 +158,9 @@ def _evacuate(replicas, member, allowed_s, weights, nrep_cur, ncons, pvalid,
 
         s = replicas[p, slot]
 
-        def apply(args):
+        def apply(
+            args: Tuple[jax.Array, jax.Array]
+        ) -> Tuple[jax.Array, jax.Array]:
             replicas, member = args
             replicas = replicas.at[p, slot].set(t.astype(replicas.dtype))
             member = member.at[p, s].set(False).at[p, t].set(True)
@@ -165,11 +174,14 @@ def _evacuate(replicas, member, allowed_s, weights, nrep_cur, ncons, pvalid,
 
 
 def _scenario_body(
-    replicas, member, allowed_base, has_explicit, scenario_mask, weights,
-    nrep_cur, nrep_tgt, ncons, pvalid, universe_valid, min_replicas,
-    min_unbalance, budget, *, max_moves: int, max_evac: int,
+    replicas: jax.Array, member: jax.Array, allowed_base: jax.Array,
+    has_explicit: jax.Array, scenario_mask: jax.Array,
+    weights: jax.Array, nrep_cur: jax.Array, nrep_tgt: jax.Array,
+    ncons: jax.Array, pvalid: jax.Array, universe_valid: jax.Array,
+    min_replicas: jax.Array, min_unbalance: jax.Array,
+    budget: jax.Array, *, max_moves: int, max_evac: int,
     allow_leader: bool, batch: int, engine: str = "xla",
-):
+) -> Tuple[jax.Array, ...]:
     """One scenario end-to-end on device: evacuation + move session
     (``engine`` selects the XLA while_loop or the whole-session Pallas
     kernel — the kernel cuts per-iteration launch overhead ~5x on the
@@ -239,20 +251,20 @@ def _scenario_body(
     ),
 )
 def _sweep_exec(
-    scenario_mask,
-    replicas,
-    member,
-    allowed,
-    has_explicit,
-    weights,
-    nrep_cur,
-    nrep_tgt,
-    ncons,
-    pvalid,
-    universe_valid,
-    min_replicas,
-    min_unbalance,
-    budget,
+    scenario_mask: jax.Array,
+    replicas: jax.Array,
+    member: jax.Array,
+    allowed: jax.Array,
+    has_explicit: jax.Array,
+    weights: jax.Array,
+    nrep_cur: jax.Array,
+    nrep_tgt: jax.Array,
+    ncons: jax.Array,
+    pvalid: jax.Array,
+    universe_valid: jax.Array,
+    min_replicas: jax.Array,
+    min_unbalance: jax.Array,
+    budget: jax.Array,
     *,
     mesh: Mesh,
     max_moves: int,
@@ -261,7 +273,7 @@ def _sweep_exec(
     batch: int,
     engine: str = "xla",
     per_scenario: bool = False,
-):
+) -> Tuple[jax.Array, ...]:
     """Module-level jitted sweep executor: repeat sweeps with the same shape
     buckets and mesh reuse one compiled executable (a per-call shard_map
     closure would retrace every invocation).
@@ -294,10 +306,18 @@ def _sweep_exec(
         # inputs inside lax.cond branches; skip the varying-mode check
         check_vma=False,
     )
-    def run(mask_shard, replicas, member, allowed, has_explicit, weights,
-            nrep_cur, nrep_tgt, ncons, pvalid, universe_valid, min_replicas,
-            min_unbalance, budget):
-        def body(mask, reps_s, member_s, ncur_s, budget_s):
+    def run(
+        mask_shard: jax.Array, replicas: jax.Array, member: jax.Array,
+        allowed: jax.Array, has_explicit: jax.Array, weights: jax.Array,
+        nrep_cur: jax.Array, nrep_tgt: jax.Array, ncons: jax.Array,
+        pvalid: jax.Array, universe_valid: jax.Array,
+        min_replicas: jax.Array, min_unbalance: jax.Array,
+        budget: jax.Array,
+    ) -> Tuple[jax.Array, ...]:
+        def body(
+            mask: jax.Array, reps_s: jax.Array, member_s: jax.Array,
+            ncur_s: jax.Array, budget_s: jax.Array,
+        ) -> Tuple[jax.Array, ...]:
             return _scenario_body(
                 reps_s, member_s, allowed, has_explicit, mask, weights,
                 ncur_s, nrep_tgt, ncons, pvalid, universe_valid,
@@ -369,7 +389,7 @@ def sweep(
     scenarios: Sequence[Sequence[int]],
     max_reassign: int = 1 << 16,
     mesh: Optional[Mesh] = None,
-    dtype=None,
+    dtype: Any = None,
     batch: int = 1,
     engine: str = "xla",
 ) -> List[SweepResult]:
@@ -528,7 +548,7 @@ def sweep(
         budget_arg = jnp.int32(min(max_reassign, 2**31 - 1))
         ncur_dec = [dp.nrep_cur] * S
     else:
-        def stack(get):
+        def stack(get: Callable[[Any], Any]) -> Any:
             rows = [get(sdp) for sdp in scen_dps]
             rows += [rows[0]] * (S_pad - len(rows))  # pad rows: scenario 0
             return stack_instances(rows)

@@ -255,7 +255,8 @@ def run(argv: List[str]) -> int:
 
         # explicit operator request, the prewarm analog of bench's
         # BENCH_* dtype override
-        dtype = jnp.float32 if ns.dtype == "f32" else jnp.float64  # jaxlint: disable=R4
+        # jaxlint: disable=R4 — explicit operator dtype request
+        dtype = jnp.float32 if ns.dtype == "f32" else jnp.float64
 
     written = skipped = failed = verified = 0
     keys: List[Dict[str, Any]] = []

@@ -318,6 +318,7 @@ class Coalescer:
             self._cv.notify_all()
         self._thread.join(timeout)
 
+    # thread-role: request
     def _loop(self) -> None:
         while True:
             with self._cv:
@@ -524,6 +525,7 @@ class Daemon:
         self._admission.on_arrival = self.speculator.note_real_traffic
 
     # -- warmup ----------------------------------------------------------
+    # thread-role: warm
     def _warm_body(self) -> None:
         """Background startup warm: dispatcher construction FIRST (lane
         resolution performs the jax import + device query — the backend
@@ -841,7 +843,7 @@ class Daemon:
         out, err = io.StringIO(), io.StringIO()
         rc_box: List[int] = []
 
-        def body() -> None:
+        def body() -> None:  # thread-role: request
             import contextlib
 
             # chaos seam: a scheduled transfer_fail raises before the
@@ -2052,6 +2054,7 @@ class Daemon:
                     "error": f"unknown op {op!r}",
                 })
 
+    # thread-role: accept-loop
     def _serve_connection(self, conn: socket.socket) -> None:
         try:
             conn.settimeout(PLAN_CONNECTION_TIMEOUT_S)
@@ -2257,6 +2260,7 @@ class Daemon:
                     return f"cannot remove stale socket {path}: {exc}"
         return None
 
+    # thread-role: accept-loop
     def serve_forever(self) -> int:
         """Run until shutdown/idle-timeout/signal; 0 on a clean exit,
         3 when the socket or spill dir is unusable (live daemon, bind
@@ -2469,6 +2473,8 @@ class Daemon:
             faults.disarm()
             obs.tracer.set_observer(None)
             obs.set_shared_registry(False)
+            # ops.tensorize is numpy-only at import — no backend attach
+            # jaxlint: disable=R8 — clearing a module-global hook
             set_row_cache(None)
             for sig, handler in old_handlers:
                 try:

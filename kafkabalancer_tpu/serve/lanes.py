@@ -1008,6 +1008,7 @@ class LaneScheduler:
         except Exception:
             return False
 
+    # thread-role: lane-worker
     def _worker(self, i: int) -> None:
         lane = self.lanes[i]
         while True:
@@ -1179,7 +1180,7 @@ class LaneScheduler:
             nxt.staged = True
         stage = self._stage
 
-        def body() -> None:
+        def body() -> None:  # thread-role: lane-worker
             try:
                 stage(nxt, lane)
             except Exception:
@@ -1369,6 +1370,7 @@ class LaneScheduler:
             # retirement (notified by the batcher) or the next poll tick
             cb.wait_change(self._admission_tick_s)
 
+    # thread-role: lane-worker
     def _run_one(
         self,
         lane: Lane,

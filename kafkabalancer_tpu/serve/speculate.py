@@ -346,6 +346,7 @@ class Speculator:
         disp = d._coalescer
         return disp is not None and bool(disp.busy())
 
+    # thread-role: speculate
     def _loop(self) -> None:
         while True:
             with self._cv:
@@ -607,6 +608,7 @@ class ZkWatcher:
         )
 
     # -- the loop ---------------------------------------------------------
+    # thread-role: watch
     def _loop(self) -> None:
         d = self._d
         d._dispatcher_ready.wait(600.0)

@@ -35,7 +35,7 @@ sequence improves or the reassignment budget runs out.
 from __future__ import annotations
 
 from functools import partial
-from typing import Optional
+from typing import Any, Callable, List, Optional, Tuple
 
 from kafkabalancer_tpu.models import PartitionList, RebalanceConfig
 from kafkabalancer_tpu.models.config import default_dtype
@@ -57,11 +57,24 @@ from kafkabalancer_tpu.solvers.scan import (  # noqa: E402
 
 
 def _scan_factory(
-    allowed, weights, nrep_cur, nrep_tgt, ncons, pvalid, always_valid,
-    universe_valid, topic_id, min_replicas, lam, dtype, P, R, B,
+    allowed: jax.Array,
+    weights: jax.Array,
+    nrep_cur: jax.Array,
+    nrep_tgt: jax.Array,
+    ncons: jax.Array,
+    pvalid: jax.Array,
+    always_valid: jax.Array,
+    universe_valid: jax.Array,
+    topic_id: jax.Array,
+    min_replicas: jax.Array,
+    lam: Any,
+    dtype: Any,
+    P: int,
+    R: int,
+    B: int,
     *, width: int, depth: int, allow_leader: bool, n_topics: int,
     siblings: bool = False,
-):
+) -> Callable[..., Tuple[jax.Array, ...]]:
     """Build the depth-scan ``run(loads, replicas, member, depth_cap)``
     shared by :func:`beam_search` (one search) and :func:`beam_session`
     (the device-fused receding-horizon loop).
@@ -75,7 +88,9 @@ def _scan_factory(
     """
     W, D = width, depth
 
-    def state_cost(loads, bcount, colo):
+    def state_cost(
+        loads: jax.Array, bcount: jax.Array, colo: jax.Array
+    ) -> jax.Array:
         """True objective from the INCREMENTAL beam state: broker validity
         via the per-broker replica counts (no [P, B] reduction) and the
         colocation total as the tracked scalar (no [T, B] reduction)."""
@@ -87,8 +102,17 @@ def _scan_factory(
             u = u + colo
         return u
 
-    def expand(loads, replicas, member, counts, bcount, colo, alive,
-               last_p, last_t):
+    def expand(
+        loads: jax.Array,
+        replicas: jax.Array,
+        member: jax.Array,
+        counts: Optional[jax.Array],
+        bcount: jax.Array,
+        colo: jax.Array,
+        alive: jax.Array,
+        last_p: jax.Array,
+        last_t: jax.Array,
+    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
         """Per-TARGET best candidate of one beam via the shared factorized
         scorer (ops/cost.py factored_target_best); the frontier takes the
         top-W of the W×B per-target bests. Restricting to one candidate per
@@ -167,8 +191,17 @@ def _scan_factory(
         return vals, p, slot
 
     def apply_move_masked(
-        loads, replicas, member, counts, bcount, colo, p, slot, t, ok
-    ):
+        loads: jax.Array,
+        replicas: jax.Array,
+        member: jax.Array,
+        counts: Optional[jax.Array],
+        bcount: jax.Array,
+        colo: jax.Array,
+        p: jax.Array,
+        slot: jax.Array,
+        t: jax.Array,
+        ok: jax.Array,
+    ) -> Tuple[Any, ...]:
         """Apply one move to one beam, as a NO-OP when ``ok`` is false —
         mask folded into the arithmetic so the whole [W] batch applies as
         one vmapped op (the round-3 version lax.cond-ed per beam inside a
@@ -206,7 +239,12 @@ def _scan_factory(
             counts = counts.at[tid, s].add(-okf).at[tid, t].add(okf)
         return loads, replicas, member, counts, bcount, colo
 
-    def run(loads, replicas, member, depth_cap):
+    def run(
+        loads: jax.Array,
+        replicas: jax.Array,
+        member: jax.Array,
+        depth_cap: jax.Array,
+    ) -> Tuple[jax.Array, ...]:
         # colocation counts and per-broker replica counts build ONCE per
         # search (one scatter / one reduction), then ride as incremental
         # beam state through apply_move_masked
@@ -239,7 +277,9 @@ def _scan_factory(
         colo_b = jnp.broadcast_to(colo0, (W,))
         alive = jnp.zeros(W, bool).at[0].set(True)
 
-        def depth_step(carry, _):
+        def depth_step(
+            carry: Tuple[Any, ...], _: Any
+        ) -> Tuple[Tuple[Any, ...], Any]:
             (loads_b, replicas_b, member_b, counts_b, bcount_b, colo_b,
              alive, last_p, last_t, best) = carry
 
@@ -361,27 +401,27 @@ def _scan_factory(
     static_argnames=("width", "depth", "allow_leader", "n_topics", "siblings"),
 )
 def beam_search(
-    loads,
-    replicas,
-    member,
-    allowed,
-    weights,
-    nrep_cur,
-    nrep_tgt,
-    ncons,
-    pvalid,
-    always_valid,
-    universe_valid,
-    topic_id,
-    min_replicas,
-    lam,
+    loads: jax.Array,
+    replicas: jax.Array,
+    member: jax.Array,
+    allowed: jax.Array,
+    weights: jax.Array,
+    nrep_cur: jax.Array,
+    nrep_tgt: jax.Array,
+    ncons: jax.Array,
+    pvalid: jax.Array,
+    always_valid: jax.Array,
+    universe_valid: jax.Array,
+    topic_id: jax.Array,
+    min_replicas: jax.Array,
+    lam: Any,
     *,
     width: int,
     depth: int,
     allow_leader: bool,
     n_topics: int,
     siblings: bool = False,
-):
+) -> Tuple[jax.Array, ...]:
     """One beam search from a single start state.
 
     Returns ``(su0, best_u, best_beam, best_depth, parents [D, W],
@@ -406,22 +446,22 @@ def beam_search(
     ),
 )
 def beam_session(
-    loads,
-    replicas,
-    member,
-    allowed,
-    weights,
-    nrep_cur,
-    nrep_tgt,
-    ncons,
-    pvalid,
-    always_valid,
-    universe_valid,
-    topic_id,
-    min_replicas,
-    lam,
-    min_unbalance,
-    budget,
+    loads: jax.Array,
+    replicas: jax.Array,
+    member: jax.Array,
+    allowed: jax.Array,
+    weights: jax.Array,
+    nrep_cur: jax.Array,
+    nrep_tgt: jax.Array,
+    ncons: jax.Array,
+    pvalid: jax.Array,
+    always_valid: jax.Array,
+    universe_valid: jax.Array,
+    topic_id: jax.Array,
+    min_replicas: jax.Array,
+    lam: Any,
+    min_unbalance: Any,
+    budget: jax.Array,
     *,
     width: int,
     depth: int,
@@ -429,7 +469,7 @@ def beam_session(
     n_topics: int,
     max_moves: int,
     siblings: bool = False,
-):
+) -> jax.Array:
     """Device-fused receding-horizon beam planning: rounds of depth-``depth``
     beam search, each adopting the winning sequence's state, inside one
     ``while_loop`` — one dispatch for the whole plan (per-search host round
@@ -453,11 +493,11 @@ def beam_session(
 
     mp0 = jnp.full(ML, -1, jnp.int32)
 
-    def cond(state):
+    def cond(state: Tuple[jax.Array, ...]) -> jax.Array:
         n, done = state[3], state[4]
         return (~done) & (n < budget)
 
-    def body(state):
+    def body(state: Tuple[jax.Array, ...]) -> Tuple[jax.Array, ...]:
         loads, replicas, member, n, _done, mp, mslot, mtgt = state
         depth_cap = jnp.minimum(jnp.int32(depth), budget - n)
         (su0, best_u, best_beam, best_depth, parents, smp, sslot, smtgt,
@@ -468,7 +508,9 @@ def beam_session(
 
         # walk the parent chain from best_depth back to 0, writing the
         # accepted prefix into the global logs at positions n..n+best_depth
-        def walk(k, carry):
+        def walk(
+            k: jax.Array, carry: Tuple[jax.Array, ...]
+        ) -> Tuple[jax.Array, ...]:
             beam, mp, mslot, mtgt = carry
             idx = best_depth - k
             valid = accept & (k <= best_depth)
@@ -507,7 +549,14 @@ def beam_session(
     )
 
 
-def _reconstruct(best_beam, best_depth, parents, mp, mslot, mtgt):
+def _reconstruct(
+    best_beam: Any,
+    best_depth: Any,
+    parents: Any,
+    mp: Any,
+    mslot: Any,
+    mtgt: Any,
+) -> List[Tuple[int, int, int]]:
     """Walk the parent pointers back to depth 0; returns [(p, slot, t_dense)]
     in application order."""
     seq = []
@@ -521,7 +570,9 @@ def _reconstruct(best_beam, best_depth, parents, mp, mslot, mtgt):
     return seq
 
 
-def _device_setup(pl, cfg, dtype):
+def _device_setup(
+    pl: PartitionList, cfg: RebalanceConfig, dtype: Any
+) -> Tuple[Any, ...]:
     """Shared device-setup for one search/round: dense plan, prepped
     device inputs (one compiled program — see scan._device_prep), dtype,
     colocation config. Keeps beam_move (_search_once) and _beam_round
@@ -537,8 +588,12 @@ def _device_setup(pl, cfg, dtype):
     return dp, dtype, loads, w_dev, nc_dev, allowed_dev, lam, n_topics
 
 
-def _search_once(pl: PartitionList, cfg: RebalanceConfig, depth: int,
-                 dtype=None):
+def _search_once(
+    pl: PartitionList,
+    cfg: RebalanceConfig,
+    depth: int,
+    dtype: Any = None,
+) -> Optional[Tuple[Any, List[Tuple[int, int, int]]]]:
     """One beam search on the live list; returns the accepted move sequence
     as ``[(partition row, slot, target broker id)]`` with its DensePlan, or
     ``None`` when no sequence clears ``min_unbalance``."""
@@ -592,8 +647,8 @@ def _auto_chunk(npart: int) -> int:
 
 
 def beam_plan(
-    pl: PartitionList, cfg: RebalanceConfig, max_reassign: int, dtype=None,
-    chunk_moves: "int | None" = None,
+    pl: PartitionList, cfg: RebalanceConfig, max_reassign: int,
+    dtype: Any = None, chunk_moves: "int | None" = None,
 ) -> PartitionList:
     """Receding-horizon beam planning, fused on device: rounds of
     ``beam_depth`` lookahead, each adopting the best sequence, inside one
@@ -633,7 +688,13 @@ def beam_plan(
     return opl
 
 
-def _beam_round(pl, cfg, opl, budget, dtype):
+def _beam_round(
+    pl: PartitionList,
+    cfg: RebalanceConfig,
+    opl: PartitionList,
+    budget: int,
+    dtype: Any,
+) -> int:
     """One fused beam dispatch of up to 2^16 moves; applies the moves to the
     live list and appends them to ``opl``; returns the move count."""
     dp, dtype, loads, w_dev, nc_dev, allowed_dev, lam, n_topics = (

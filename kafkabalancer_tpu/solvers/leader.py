@@ -52,6 +52,7 @@ reference trajectory (which ``batch=1`` replays exactly):
 from __future__ import annotations
 
 from functools import partial
+from typing import Tuple
 
 from kafkabalancer_tpu.ops.runtime import ensure_x64
 
@@ -70,25 +71,25 @@ SWAP_SLOT = -2
 
 @partial(jax.jit, static_argnames=("max_moves", "allow_leader", "batch"))
 def leader_session(
-    loads,
-    replicas,
-    member,
-    allowed,
-    weights,
-    nrep_cur,
-    nrep_tgt,
-    ncons,
-    pvalid,
-    always_valid,
-    universe_valid,
-    min_replicas,
-    min_unbalance,
-    budget,
+    loads: jax.Array,
+    replicas: jax.Array,
+    member: jax.Array,
+    allowed: jax.Array,
+    weights: jax.Array,
+    nrep_cur: jax.Array,
+    nrep_tgt: jax.Array,
+    ncons: jax.Array,
+    pvalid: jax.Array,
+    always_valid: jax.Array,
+    universe_valid: jax.Array,
+    min_replicas: jax.Array,
+    min_unbalance: jax.Array,
+    budget: jax.Array,
     *,
     max_moves: int,
     allow_leader: bool,
     batch: int = 1,
-):
+) -> Tuple[jax.Array, ...]:
     """Fused rebalance-leaders Balance loop (see module docstring).
 
     Returns ``(replicas, loads, n, move_p, move_slot, move_tgt)``; log
@@ -113,11 +114,11 @@ def leader_session(
         (member & pvalid[:, None]).astype(jnp.int32), axis=0, dtype=jnp.int32
     )
 
-    def cond(st):
+    def cond(st: Tuple[jax.Array, ...]) -> jax.Array:
         n, done = st[4], st[5]
         return (~done) & (n < budget) & (n < max_moves)
 
-    def body(st):
+    def body(st: Tuple[jax.Array, ...]) -> Tuple[jax.Array, ...]:
         loads, replicas, member, bcount, n, _done, mp, mslot, mtgt = st
         bvalid = (always_valid | (bcount > 0)) & universe_valid
         nb = jnp.sum(bvalid, dtype=jnp.int32)
@@ -178,7 +179,10 @@ def leader_session(
             ) & eligible_p
             leader_fire = (su >= min_unbalance) & jnp.any(lead_mask)
 
-        def _transfer(state, p, light, log_idx):
+        def _transfer(
+            state: Tuple[jax.Array, ...], p: jax.Array,
+            light: jax.Array, log_idx: jax.Array,
+        ) -> Tuple[jax.Array, ...]:
             """Hand leadership of partition ``p`` to broker ``light`` —
             the shared replacepl analog (utils.go:166-197): swap branch
             when ``light`` is already a follower (positions exchange, only
@@ -219,11 +223,17 @@ def leader_session(
 
         if batched:
 
-            def leader_branch(args):
-                def apply_k(k, carry):
+            def leader_branch(
+                args: Tuple[jax.Array, ...]
+            ) -> Tuple[jax.Array, ...]:
+                def apply_k(
+                    k: jax.Array, carry: Tuple[jax.Array, ...]
+                ) -> Tuple[jax.Array, ...]:
                     state, cnt = carry
 
-                    def do(c):
+                    def do(
+                        c: Tuple[jax.Array, ...]
+                    ) -> Tuple[jax.Array, ...]:
                         state, cnt = c
                         state = _transfer(state, p_star[k], lk[k], n + cnt)
                         return state, cnt + 1
@@ -237,12 +247,16 @@ def leader_session(
 
         else:
 
-            def leader_branch(args):
+            def leader_branch(
+                args: Tuple[jax.Array, ...]
+            ) -> Tuple[jax.Array, ...]:
                 p = jnp.min(jnp.where(lead_mask, iota_p, P))
                 p = jnp.clip(p, 0, P - 1)
                 return (*_transfer(args, p, light, n), jnp.int32(1))
 
-        def move_branch(args):
+        def move_branch(
+            args: Tuple[jax.Array, ...]
+        ) -> Tuple[jax.Array, ...]:
             loads, replicas, member, bcount, mp, mslot, mtgt = args
             # one greedy move, batch=1 parity semantics (mirror of
             # scan.session's non-batch body; the [P, R, B] scoring core is
@@ -253,7 +267,7 @@ def leader_session(
                 pvalid, nbf, min_replicas,
             )
 
-            def best(mask_slots):
+            def best(mask_slots: jax.Array) -> Tuple[jax.Array, jax.Array]:
                 flat = jnp.where(
                     mask_slots[None, :, None], u, jnp.inf
                 ).reshape(-1)
@@ -281,7 +295,7 @@ def leader_session(
                 weights[p],
             )
 
-            def apply(a):
+            def apply(a: Tuple[jax.Array, ...]) -> Tuple[jax.Array, ...]:
                 loads, replicas, member, bcount, mp, mslot, mtgt = a
                 loads = loads.at[s_dense].add(-delta).at[t_dense].add(delta)
                 replicas = replicas.at[p, slot].set(

@@ -58,6 +58,7 @@ exact candidate ties differently — counts and final unbalance match.
 from __future__ import annotations
 
 from functools import partial
+from typing import Any, Optional, Tuple
 
 from kafkabalancer_tpu.ops.runtime import ensure_x64
 
@@ -81,31 +82,31 @@ TILE_P = 128
 
 def _kernel(
     # scalars (SMEM)
-    budget_ref,
-    batch_ref,
-    minrep_ref,
-    minunb_ref,
-    churn_ref,
+    budget_ref: Any,
+    batch_ref: Any,
+    minrep_ref: Any,
+    minunb_ref: Any,
+    churn_ref: Any,
     # arrays (VMEM)
-    loads0_ref,
-    replicas0_ref,  # [R, P] f32 TRANSPOSED (broker idx as exact floats)
-    allowed_ref,  # [P, B] i8 (placeholder [1, B] when all_allowed)
-    cols_ref,  # [5, P] f32 packed per-partition columns:
+    loads0_ref: Any,
+    replicas0_ref: Any,  # [R, P] f32 TRANSPOSED (broker idx as exact floats)
+    allowed_ref: Any,  # [P, B] i8 (placeholder [1, B] when all_allowed)
+    cols_ref: Any,  # [5, P] f32 packed per-partition columns:
     #            [weight, nrep_cur, nrep_tgt, num_consumers, pvalid]
-    always_ref,
-    universe_ref,
-    lanef_ref,  # [1, B] f32 broker indices (tpu.iota is int-only and
-    slotf_ref,  # [1, R] f32 slot indices    sitofp fails to legalize)
+    always_ref: Any,
+    universe_ref: Any,
+    lanef_ref: Any,  # [1, B] f32 broker indices (tpu.iota is int-only and
+    slotf_ref: Any,  # [1, R] f32 slot indices    sitofp fails to legalize)
     # outputs
-    loads_ref,
-    replicas_ref,
-    n_ref,
-    mp_ref,
-    mslot_ref,
-    msrc_ref,
-    mtgt_ref,
+    loads_ref: Any,
+    replicas_ref: Any,
+    n_ref: Any,
+    mp_ref: Any,
+    mslot_ref: Any,
+    msrc_ref: Any,
+    mtgt_ref: Any,
     # scratch
-    bcount_ref,
+    bcount_ref: Any,
     *,
     P: int,
     R: int,
@@ -113,7 +114,7 @@ def _kernel(
     ML: int,
     allow_leader: bool,
     all_allowed: bool,
-):
+) -> None:
     f32 = kernel_dtype()
 
     # ---- initialize mutable state from the inputs -----------------------
@@ -137,7 +138,9 @@ def _kernel(
         == lax.broadcasted_iota(jnp.int32, (TILE_P, TILE_P), 1)
     ).astype(f32)
 
-    def _dot(a, b, ca, cb):
+    def _dot(
+        a: jax.Array, b: jax.Array, ca: int, cb: int
+    ) -> jax.Array:
         return jax.lax.dot_general(
             a, b,
             dimension_numbers=(((ca,), (cb,)), ((), ())),
@@ -145,7 +148,7 @@ def _kernel(
             precision=jax.lax.Precision.HIGHEST,
         )
 
-    def read_tile(off):
+    def read_tile(off: jax.Array) -> Tuple[jax.Array, ...]:
         """One partition tile in compute orientation: replicas [T, R] f32
         and per-partition columns w/nrc/nrt/ncons/pvalid (each [T, 1])."""
         reps = _dot(eye_t, replicas_ref[:, pl.ds(off, TILE_P)], 1, 1)
@@ -155,7 +158,7 @@ def _kernel(
             colst[:, 3:4], colst[:, 4:5],
         )
 
-    def _member_tile(off):
+    def _member_tile(off: jax.Array) -> jax.Array:
         reps, _w, nrc, _nrt, _nc, pv_t = read_tile(off)
         lanef0 = lanef_ref[:]
         m = jnp.zeros((TILE_P, B), jnp.int32)
@@ -165,7 +168,7 @@ def _kernel(
             m = jnp.where((col == lanef0) & valid, jnp.ones_like(m), m)
         return m
 
-    def init_tile(ti, _):
+    def init_tile(ti: jax.Array, _: Any) -> Any:
         bcount_ref[:] = bcount_ref[:] + jnp.sum(
             _member_tile(ti * TILE_P).astype(kernel_dtype()), axis=0,
             keepdims=True,
@@ -197,7 +200,7 @@ def _kernel(
         == lax.broadcasted_iota(jnp.int32, (B, B), 1)
     ).astype(f32)
 
-    def to_col0(vec_f32):  # [B] lanes -> [B, 1] sublanes (MXU transpose)
+    def to_col0(vec_f32: jax.Array) -> jax.Array:  # [B] lanes -> [B, 1] sublanes (MXU transpose)
         return jax.lax.dot_general(
             eye_b,
             vec_f32.reshape(1, B),
@@ -206,7 +209,9 @@ def _kernel(
             precision=jax.lax.Precision.HIGHEST,
         )
 
-    def iteration(carry):
+    def iteration(
+        carry: Tuple[jax.Array, jax.Array]
+    ) -> Tuple[jax.Array, jax.Array]:
         n, _done = carry
 
         loads = loads_ref[0, :]  # [B]
@@ -247,7 +252,9 @@ def _kernel(
             [loads.reshape(B, 1), F.reshape(B, 1)], axis=1
         )  # [B, 2]
 
-        def tile_body(ti, bc):
+        def tile_body(
+            ti: jax.Array, bc: Tuple[jax.Array, ...]
+        ) -> Tuple[jax.Array, ...]:
             (bestv, bestp, bestpay, bestv_l, bestp_l, bestpay_l,
              bv_pf, bp_pf, pay_pf, bv_pl, bp_pl, pay_pl) = bc
             off = ti * TILE_P
@@ -495,7 +502,7 @@ def _kernel(
         M1 = (bcol == krow).astype(f32)  # [B, K] lanes 0..B-1
         M2 = (bcol[:B2, :] == (krow - jnp.asarray(B, f32))).astype(f32)
 
-        def cat(vt, vp):  # [B] lanes ++ [B2] lanes -> [K] lanes (exact)
+        def cat(vt: jax.Array, vp: jax.Array) -> jax.Array:  # [B] lanes ++ [B2] lanes -> [K] lanes (exact)
             return (
                 _dot(vt.reshape(1, B), M1, 1, 0)
                 + _dot(vp.reshape(1, B2), M2, 1, 0)
@@ -516,7 +523,7 @@ def _kernel(
         # dynamic-slice along lanes is not portable Mosaic)
         lane_k = lax.broadcasted_iota(jnp.int32, (1, K), 1)  # [1, K]
 
-        def ext_k(vec, i):
+        def ext_k(vec: jax.Array, i: jax.Array) -> jax.Array:
             # exactly one lane matches and all extracted values are >= 0;
             # max does not promote the accumulator dtype (integer sums
             # would upcast to unsupported int64 under global x64)
@@ -538,7 +545,7 @@ def _kernel(
         iotaK_c = lax.broadcasted_iota(jnp.int32, (K, K), 1)
         eyeK = (iotaK_r == iotaK_c).astype(f32)
 
-        def to_colK(vec_f32):  # [K] lanes -> [K, 1] sublanes
+        def to_colK(vec_f32: jax.Array) -> jax.Array:  # [K] lanes -> [K, 1] sublanes
             return jax.lax.dot_general(
                 eyeK,
                 vec_f32.reshape(1, K),
@@ -608,7 +615,7 @@ def _kernel(
         # ---- apply: loads and bcount (vectorized one-hot scatters) ------
         okd = jnp.where(ok, w_u, jnp.zeros_like(w_u))  # [K]
 
-        def scat(vec_k, M):  # Σ_k vec_k · onehot(broker axis) -> [B]
+        def scat(vec_k: jax.Array, M: jax.Array) -> jax.Array:  # Σ_k vec_k · onehot(broker axis) -> [B]
             return jax.lax.dot_general(
                 vec_k.reshape(1, K),
                 M,
@@ -628,11 +635,11 @@ def _kernel(
         lane_t = lax.broadcasted_iota(jnp.int32, (1, TILE_P), 1)
         sub_r = lax.broadcasted_iota(jnp.int32, (R, 1), 0)
 
-        def commit(i, n_acc):
+        def commit(i: jax.Array, n_acc: jax.Array) -> jax.Array:
             ok_i = ext_k(oki, i) > 0
 
             @pl.when(ok_i)
-            def _():
+            def _() -> None:
                 p_i = ext_k(cp_u, i)
                 s_i = ext_k(cs_u, i)
                 slot_i = ext_k(cslot_u, i)
@@ -658,7 +665,7 @@ def _kernel(
                 lane128 = lax.broadcasted_iota(jnp.int32, (1, 128), 1)
                 hit = lane128 == at_ln
 
-                def logw(ref, val):
+                def logw(ref: Any, val: jax.Array) -> None:
                     row = ref[pl.ds(at_row, 1), :]
                     ref[pl.ds(at_row, 1), :] = jnp.where(hit, val, row)
 
@@ -673,7 +680,7 @@ def _kernel(
 
         return n + cnt, cnt == 0
 
-    def cond(carry):
+    def cond(carry: Tuple[jax.Array, jax.Array]) -> jax.Array:
         n, done = carry
         return (~done) & (n < budget) & (n < ML)
 
@@ -686,28 +693,28 @@ def _kernel(
     static_argnames=("max_moves", "allow_leader", "interpret", "all_allowed"),
 )
 def pallas_session(
-    loads,
-    replicas,
-    member,  # ignored (None accepted): membership is derived in-kernel
-    allowed,  # from the replica matrix and never stored or transferred
-    weights,
-    nrep_cur,
-    nrep_tgt,
-    ncons,
-    pvalid,
-    always_valid,
-    universe_valid,
-    min_replicas,
-    min_unbalance,
-    budget,
-    batch,
-    churn_gate=DEFAULT_CHURN_GATE,
+    loads: jax.Array,
+    replicas: jax.Array,
+    member: Optional[jax.Array],  # ignored (None accepted): membership is
+    allowed: Optional[jax.Array],  # derived in-kernel from the replica
+    weights: jax.Array,  # matrix and never stored or transferred
+    nrep_cur: jax.Array,
+    nrep_tgt: jax.Array,
+    ncons: jax.Array,
+    pvalid: jax.Array,
+    always_valid: jax.Array,
+    universe_valid: jax.Array,
+    min_replicas: jax.Array,
+    min_unbalance: Any,
+    budget: jax.Array,
+    batch: Any,
+    churn_gate: Any = DEFAULT_CHURN_GATE,
     *,
     max_moves: int,
     allow_leader: bool,
     interpret: bool = False,
     all_allowed: bool = False,
-):
+) -> Tuple[jax.Array, ...]:
     """Device-resident batched session; same contract as ``scan.session``
     restricted to the batch path: returns ``(replicas, loads, n, move_p,
     move_slot, move_src, move_tgt)`` (no final objective — the caller
@@ -740,7 +747,7 @@ def pallas_session(
     i32 = jnp.int32
     i8 = jnp.int8
 
-    def scalar(x, dt):
+    def scalar(x: Any, dt: Any) -> jax.Array:
         return jnp.asarray(x, dt).reshape(1, 1)
 
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
@@ -799,7 +806,16 @@ def pallas_session(
     )
 
 
-def _call(kernel, P, R, B, ML, smem, vmem, interpret=False):
+def _call(
+    kernel: Any,
+    P: int,
+    R: int,
+    B: int,
+    ML: int,
+    smem: Any,
+    vmem: Any,
+    interpret: bool = False,
+) -> Any:
     f32 = kernel_dtype()
     i32 = jnp.int32
     i8 = jnp.int8

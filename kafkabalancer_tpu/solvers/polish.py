@@ -52,7 +52,7 @@ leader premiums (utils.go:96-101) never enter the swap delta.
 from __future__ import annotations
 
 from functools import partial
-from typing import Tuple
+from typing import Any, Optional, Tuple
 
 from kafkabalancer_tpu.ops.runtime import ensure_x64
 
@@ -77,7 +77,10 @@ N_SHIFTS = 4
 SWAP_REL_EPS = 1e-4
 
 
-def nearest_occupied(holder, tgt_b, pair_live, pe_c, rq):
+def nearest_occupied(
+    holder: jax.Array, tgt_b: jax.Array, pair_live: jax.Array,
+    pe_c: jax.Array, rq: jax.Array,
+) -> Tuple[jax.Array, jax.Array]:
     """Per-query nearest entries held by the query's paired cold broker,
     in the static weight order. With ``occ[k, j] = (holder[j] ==
     tgt_b[k]) & pair_live[k]`` and ``k = pe_c[q]``:
@@ -110,7 +113,7 @@ def nearest_occupied(holder, tgt_b, pair_live, pe_c, rq):
 
 
 def entry_table(
-    dp, min_replicas: int, min_bucket: int = 256
+    dp: Any, min_replicas: int, min_bucket: int = 256
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
     """Static weight-sorted follower-entry table for the swap search.
 
@@ -147,29 +150,29 @@ def entry_table(
 
 
 def _swap_loop(
-    loads,
-    replicas,
-    member,
-    n,
-    mp,
-    mslot,
-    mtgt,
+    loads: jax.Array,
+    replicas: jax.Array,
+    member: jax.Array,
+    n: jax.Array,
+    mp: jax.Array,
+    mslot: jax.Array,
+    mtgt: jax.Array,
     *,
-    ew,
-    ep,
-    er,
-    evalid,
-    allowed,
-    pvalid,
-    always_valid,
-    universe_valid,
-    min_unbalance,
-    budget,
+    ew: jax.Array,
+    ep: jax.Array,
+    er: jax.Array,
+    evalid: jax.Array,
+    allowed: jax.Array,
+    pvalid: jax.Array,
+    always_valid: jax.Array,
+    universe_valid: jax.Array,
+    min_unbalance: jax.Array,
+    budget: jax.Array,
     ML: int,
-    tid=None,
-    lam=None,
+    tid: Optional[jax.Array] = None,
+    lam: Optional[jax.Array] = None,
     n_topics: int = 0,
-):
+) -> Tuple[jax.Array, ...]:
     """Fused pair-swap loop (see module docstring). Mutates the carried
     state/logs; logs each swap as its two constituent moves. Returns the
     updated ``(loads, replicas, member, n, mp, mslot, mtgt)``.
@@ -191,11 +194,11 @@ def _swap_loop(
     i_pair = jnp.arange(nh, dtype=jnp.int32)
     BIGI = jnp.int32(Nc + 1)
 
-    def cond(st):
+    def cond(st: Tuple[jax.Array, ...]) -> jax.Array:
         n, streak = st[3], st[4]
         return (streak < N_SHIFTS) & (n + 2 <= budget) & (n + 2 <= ML)
 
-    def body(st):
+    def body(st: Tuple[jax.Array, ...]) -> Tuple[jax.Array, ...]:
         loads, replicas, member, n, streak, it, mp, mslot, mtgt = st
 
         bcount = jnp.sum(
@@ -266,7 +269,9 @@ def _swap_loop(
         va = (rq < Nc) & (j_above < BIGI)
         vb = (rq > 0) & (j_below >= 0)
 
-        def cand_score(j2, ok2):
+        def cand_score(
+            j2: jax.Array, ok2: jax.Array
+        ) -> Tuple[jax.Array, jax.Array]:
             j2c = jnp.clip(j2, 0, Nc - 1)
             w2 = ew[j2c]
             p2 = ep[j2c]
@@ -388,26 +393,26 @@ def _swap_loop(
 
 
 def _leader_shuffle_loop(
-    loads,
-    replicas,
-    member,
-    n,
-    mp,
-    mslot,
-    mtgt,
+    loads: jax.Array,
+    replicas: jax.Array,
+    member: jax.Array,
+    n: jax.Array,
+    mp: jax.Array,
+    mslot: jax.Array,
+    mtgt: jax.Array,
     *,
-    weights,
-    nrep_cur,
-    nrep_tgt,
-    ncons,
-    pvalid,
-    always_valid,
-    universe_valid,
-    min_replicas,
-    min_unbalance,
-    budget,
+    weights: jax.Array,
+    nrep_cur: jax.Array,
+    nrep_tgt: jax.Array,
+    ncons: jax.Array,
+    pvalid: jax.Array,
+    always_valid: jax.Array,
+    universe_valid: jax.Array,
+    min_replicas: jax.Array,
+    min_unbalance: jax.Array,
+    budget: jax.Array,
     ML: int,
-):
+) -> Tuple[jax.Array, ...]:
     """Intra-partition leadership transfers: hand the leader role to one
     of the partition's OWN followers. This shifts exactly the leader
     premium ``w*(replicas+consumers) - w`` between two member brokers
@@ -423,11 +428,11 @@ def _leader_shuffle_loop(
     dtype = loads.dtype
     slot_iota = jnp.arange(R, dtype=jnp.int32)[None, :]
 
-    def cond(st):
+    def cond(st: Tuple[jax.Array, ...]) -> jax.Array:
         n, done = st[3], st[4]
         return (~done) & (n + 1 <= budget) & (n + 1 <= ML)
 
-    def body(st):
+    def body(st: Tuple[jax.Array, ...]) -> Tuple[jax.Array, ...]:
         loads, replicas, member, n, _done, mp, mslot, mtgt = st
         bcount = jnp.sum(
             (member & pvalid[:, None]).astype(jnp.int32), axis=0,
@@ -468,7 +473,7 @@ def _leader_shuffle_loop(
         l_b = lead[p]
         f_b = replicas[p, r]
 
-        def apply(a):
+        def apply(a: Tuple[jax.Array, ...]) -> Tuple[jax.Array, ...]:
             loads, replicas, mp, mslot, mtgt = a
             loads = loads.at[l_b].add(-extra[p]).at[f_b].add(extra[p])
             replicas = replicas.at[p, 0].set(f_b).at[p, r].set(
@@ -500,26 +505,26 @@ def _leader_shuffle_loop(
     ),
 )
 def converge_session(
-    loads,
-    replicas,
-    allowed,
-    weights,
-    nrep_cur,
-    nrep_tgt,
-    ncons,
-    pvalid,
-    always_valid,
-    universe_valid,
-    min_replicas,
-    min_unbalance,
-    budget,
-    ew,
-    ep,
-    er,
-    evalid,
-    churn_gate=DEFAULT_CHURN_GATE,
-    tid=None,
-    lam=None,
+    loads: jax.Array,
+    replicas: jax.Array,
+    allowed: Optional[jax.Array],
+    weights: jax.Array,
+    nrep_cur: jax.Array,
+    nrep_tgt: jax.Array,
+    ncons: jax.Array,
+    pvalid: jax.Array,
+    always_valid: jax.Array,
+    universe_valid: jax.Array,
+    min_replicas: jax.Array,
+    min_unbalance: jax.Array,
+    budget: jax.Array,
+    ew: jax.Array,
+    ep: jax.Array,
+    er: jax.Array,
+    evalid: jax.Array,
+    churn_gate: Any = DEFAULT_CHURN_GATE,
+    tid: Optional[jax.Array] = None,
+    lam: Optional[jax.Array] = None,
     *,
     max_moves: int,
     allow_leader: bool,
@@ -527,7 +532,7 @@ def converge_session(
     engine: str = "xla",
     all_allowed: bool = False,
     n_topics: int = 0,
-):
+) -> jax.Array:
     """Move phases and swap phases alternated on device until neither
     commits — one dispatch for the whole plan-to-convergence.
 
@@ -585,11 +590,11 @@ def converge_session(
         mslot = lax.dynamic_update_slice(mslot, pmslot, (0,))
         mtgt = lax.dynamic_update_slice(mtgt, pmtgt, (0,))
 
-    def outer_cond(st):
+    def outer_cond(st: Tuple[jax.Array, ...]) -> jax.Array:
         n, done = st[3], st[4]
         return (~done) & (n + 1 <= budget)
 
-    def outer_body(st):
+    def outer_body(st: Tuple[jax.Array, ...]) -> Tuple[jax.Array, ...]:
         loads, replicas, member, n, _done, mp, mslot, mtgt = st
         n0 = n
 

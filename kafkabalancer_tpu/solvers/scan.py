@@ -85,10 +85,23 @@ def auto_chunk_moves(npart: int) -> int:
 
 
 def prefix_accept(
-    vals, p, s_, t, w_k, loads, avg, su,
-    min_unbalance, churn_gate, n, batch, budget, max_moves,
-    topic=None, colo_d=None,
-):
+    vals: jax.Array,
+    p: jax.Array,
+    s_: jax.Array,
+    t: jax.Array,
+    w_k: jax.Array,
+    loads: jax.Array,
+    avg: jax.Array,
+    su: jax.Array,
+    min_unbalance: Any,
+    churn_gate: Any,
+    n: jax.Array,
+    batch: int,
+    budget: jax.Array,
+    max_moves: int,
+    topic: Optional[jax.Array] = None,
+    colo_d: Optional[jax.Array] = None,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """PREFIX-EXACT batched-commit acceptance over a candidate pool.
 
     Replaces broker-disjointness: order claimants by (gain, index) —
@@ -214,7 +227,7 @@ PALLAS_VMEM_CELLS_RESTRICTED = 65536 * 128
 _gate_mem: dict = {}
 
 
-def _gate_cache_path():
+def _gate_cache_path() -> Optional[str]:
     from kafkabalancer_tpu.ops import aot
 
     d = aot.aot_dir()
@@ -314,7 +327,8 @@ def _is_scoped_vmem_oom(exc: BaseException) -> bool:
 
 
 def pallas_session_fits(
-    dp, dtype, all_allowed: bool, allow_leader: bool, max_moves: int
+    dp: Any, dtype: Any, all_allowed: bool, allow_leader: bool,
+    max_moves: int,
 ) -> bool:
     """Does the whole-session kernel fit THIS device at ``dp``'s buckets
     with a ``max_moves``-sized move log?
@@ -403,29 +417,29 @@ def pallas_session_fits(
     static_argnames=("max_moves", "allow_leader", "batch", "n_topics"),
 )
 def session(
-    loads,
-    replicas,
-    member,
-    allowed,
-    weights,
-    nrep_cur,
-    nrep_tgt,
-    ncons,
-    pvalid,
-    always_valid,
-    universe_valid,
-    min_replicas,
-    min_unbalance,
-    budget,
-    churn_gate=DEFAULT_CHURN_GATE,
-    topic_id=None,
-    lam=None,
+    loads: jax.Array,
+    replicas: jax.Array,
+    member: jax.Array,
+    allowed: jax.Array,
+    weights: jax.Array,
+    nrep_cur: jax.Array,
+    nrep_tgt: jax.Array,
+    ncons: jax.Array,
+    pvalid: jax.Array,
+    always_valid: jax.Array,
+    universe_valid: jax.Array,
+    min_replicas: jax.Array,
+    min_unbalance: Any,
+    budget: jax.Array,
+    churn_gate: Any = DEFAULT_CHURN_GATE,
+    topic_id: Optional[jax.Array] = None,
+    lam: Any = None,
     *,
     max_moves: int,
     allow_leader: bool,
     batch: int = 1,
     n_topics: int = 0,
-):
+) -> Tuple[jax.Array, ...]:
     """Run up to ``min(budget, max_moves)`` accepted moves on device.
 
     ``max_moves`` (static) sizes the move-log buffers and is bucketed by the
@@ -494,11 +508,11 @@ def session(
     else:
         counts0 = jnp.zeros((1, 1), dtype)
 
-    def cond(state):
+    def cond(state: Tuple[jax.Array, ...]) -> jax.Array:
         n, done = state[4], state[5]
         return (~done) & (n < budget) & (n < max_moves)
 
-    def _applied_delta(p, slot):
+    def _applied_delta(p: jax.Array, slot: jax.Array) -> jax.Array:
         # applied load delta: the leader premium travels with slot 0
         # (utils.go:96-101) even though scoring used the plain weight
         return jnp.where(
@@ -507,7 +521,12 @@ def session(
             weights[p],
         )
 
-    def _scored(loads, replicas, member, bcount):
+    def _scored(
+        loads: jax.Array,
+        replicas: jax.Array,
+        member: jax.Array,
+        bcount: jax.Array,
+    ) -> Tuple[jax.Array, jax.Array, jax.Array]:
         # (load, ID) target ordering for reference-style tie-breaks
         bvalid = (always_valid | (bcount > 0)) & universe_valid
         nb = jnp.sum(bvalid, dtype=jnp.int32).astype(dtype)
@@ -519,7 +538,7 @@ def session(
         )
         return u, su, perm
 
-    def body_batch(state):
+    def body_batch(state: Tuple[jax.Array, ...]) -> Tuple[jax.Array, ...]:
         (loads, replicas, member, bcount, n, done, mp, mslot, msrc, mtgt,
          counts) = state
 
@@ -608,12 +627,12 @@ def session(
             mtgt, counts,
         )
 
-    def body(state):
+    def body(state: Tuple[jax.Array, ...]) -> Tuple[jax.Array, ...]:
         (loads, replicas, member, bcount, n, done, mp, mslot, msrc, mtgt,
          counts) = state
         u, su, perm = _scored(loads, replicas, member, bcount)
 
-        def best(mask_slots):
+        def best(mask_slots: jax.Array) -> Tuple[jax.Array, jax.Array]:
             flat = jnp.where(mask_slots[None, :, None], u, jnp.inf).reshape(-1)
             i = jnp.argmin(flat)
             return flat[i], i
@@ -636,7 +655,7 @@ def session(
         s_dense = replicas[p, slot]
         delta = _applied_delta(p, slot)
 
-        def apply(args):
+        def apply(args: Tuple[jax.Array, ...]) -> Tuple[jax.Array, ...]:
             loads, replicas, member, bcount, mp, mslot, msrc, mtgt = args
             loads = loads.at[s_dense].add(-delta).at[t_dense].add(delta)
             replicas = replicas.at[p, slot].set(t_dense.astype(replicas.dtype))
@@ -689,7 +708,7 @@ def session(
     )
 
 
-def _cfg_broker_mask(dp, cfg: RebalanceConfig) -> "np.ndarray":
+def _cfg_broker_mask(dp: Any, cfg: RebalanceConfig) -> "np.ndarray":
     """Dense mask of the configured always-in-table brokers
     (``cfg.Brokers`` zero-fill, steps.go:150-155)."""
     mask = np.zeros(dp.bvalid.shape[0], dtype=bool)
@@ -700,9 +719,17 @@ def _cfg_broker_mask(dp, cfg: RebalanceConfig) -> "np.ndarray":
 
 @partial(jax.jit, static_argnames=("dtype", "all_allowed"))
 def _device_prep(
-    replicas, weights, nrep_cur, ncons, allowed, bvalid,
-    ew, *, dtype, all_allowed: bool,
-):
+    replicas: jax.Array,
+    weights: jax.Array,
+    nrep_cur: jax.Array,
+    ncons: jax.Array,
+    allowed: Optional[jax.Array],
+    bvalid: jax.Array,
+    ew: Optional[jax.Array],
+    *,
+    dtype: Any,
+    all_allowed: bool,
+) -> Tuple[Any, ...]:
     """All per-chunk device input preparation as ONE compiled program.
 
     A cold process pays a full relay round trip per jitted program it
@@ -729,12 +756,16 @@ def _device_prep(
 
 
 @partial(jax.jit, static_argnames=())
-def _pack_log(mp, mslot, mtgt, n):
+def _pack_log(
+    mp: jax.Array, mslot: jax.Array, mtgt: jax.Array, n: jax.Array
+) -> jax.Array:
     """Device-side packing of the move log + count into one transfer."""
     return jnp.concatenate([mp, mslot, mtgt, n.astype(jnp.int32).reshape(1)])
 
 
-def member_from(replicas, nrep_cur, pvalid, B: int):
+def member_from(
+    replicas: jax.Array, nrep_cur: jax.Array, pvalid: jax.Array, B: int
+) -> jax.Array:
     """Recompute the ``[P, B]`` membership mask from the replica matrix
     on device (skips transferring the largest boolean session input)."""
     R = replicas.shape[1]
@@ -752,27 +783,27 @@ def member_from(replicas, nrep_cur, pvalid, B: int):
     ),
 )
 def session_packed(
-    replicas,
-    weights,
-    nrep_cur,
-    nrep_tgt,
-    ncons,
-    allowed,
-    pvalid,
-    always_valid,
-    universe_valid,
-    min_replicas,
-    min_unbalance,
-    budget,
-    churn_gate,
-    ew,
-    ep,
-    er,
-    evalid,
-    tid=None,
-    lam=None,
+    replicas: jax.Array,
+    weights: jax.Array,
+    nrep_cur: jax.Array,
+    nrep_tgt: jax.Array,
+    ncons: jax.Array,
+    allowed: Optional[jax.Array],
+    pvalid: jax.Array,
+    always_valid: jax.Array,
+    universe_valid: jax.Array,
+    min_replicas: jax.Array,
+    min_unbalance: Any,
+    budget: jax.Array,
+    churn_gate: Any,
+    ew: Optional[jax.Array],
+    ep: Optional[jax.Array],
+    er: Optional[jax.Array],
+    evalid: Optional[jax.Array],
+    tid: Optional[jax.Array] = None,
+    lam: Any = None,
     *,
-    dtype,
+    dtype: Any,
     all_allowed: bool,
     max_moves: int,
     allow_leader: bool,
@@ -781,7 +812,7 @@ def session_packed(
     polish: bool = False,
     leader: bool = False,
     n_topics: int = 0,
-):
+) -> jax.Array:
     """The ENTIRE per-chunk device program as ONE dispatch.
 
     A cold process on a remote-attached TPU pays a full relay round trip
@@ -857,11 +888,24 @@ def session_packed(
 
 
 def packed_call(
-    dp, cfg: RebalanceConfig, chunk: int, dtype, batch: int, engine: str,
-    polish: bool, leader: bool, all_allowed: bool, churn_gate: float,
-    ew=None, ep=None, er=None, evalid=None,
-    tid=None, lam=None, n_topics: int = 0,
-):
+    dp: Any,
+    cfg: RebalanceConfig,
+    chunk: int,
+    dtype: Any,
+    batch: int,
+    engine: str,
+    polish: bool,
+    leader: bool,
+    all_allowed: bool,
+    churn_gate: float,
+    ew: Any = None,
+    ep: Any = None,
+    er: Any = None,
+    evalid: Any = None,
+    tid: Any = None,
+    lam: Any = None,
+    n_topics: int = 0,
+) -> Tuple[Tuple[Any, ...], dict]:
     """Assemble :func:`session_packed`'s ``(args, statics)`` from a
     DensePlan — shared by :func:`_dispatch_chunk` (the live dispatch)
     and ``kafkabalancer_tpu.prewarm`` (which AOT-compiles the same
@@ -977,7 +1021,7 @@ def session_packed_batched(
     polish: bool = False,
     leader: bool = False,
     n_topics: int = 0,
-):
+) -> jax.Array:
     """K independent same-signature instances as ONE device dispatch.
 
     ``args`` is :func:`session_packed`'s argument tuple with every array
@@ -1005,7 +1049,9 @@ def session_packed_batched(
     return lax.map(one, args)
 
 
-def _dispatch_chunk(dp, cfg: RebalanceConfig, chunk: int, *a, **kw) -> "np.ndarray":
+def _dispatch_chunk(
+    dp: Any, cfg: RebalanceConfig, chunk: int, *a: Any, **kw: Any
+) -> "np.ndarray":
     """One chunk through the AOT dispatch policy (see :func:`packed_call`
     for the argument assembly and the raw-numpy contract). A thread with
     a microbatch group installed offers the dispatch for cross-request
@@ -1043,7 +1089,9 @@ def _dispatch_chunk(dp, cfg: RebalanceConfig, chunk: int, *a, **kw) -> "np.ndarr
 from kafkabalancer_tpu.ops.tensorize import all_allowed_of  # noqa: E402
 
 
-def _dev_cached_asarray(cache, name: str, arr, upload=None):
+def _dev_cached_asarray(
+    cache: Optional[dict], name: str, arr: Any, upload: Any = None
+) -> jax.Array:
     """``jnp.asarray`` behind a session-scoped digest-keyed reuse cache.
 
     A multi-chunk session re-tensorizes between chunks, producing FRESH
@@ -1095,7 +1143,13 @@ def _dev_cached_asarray(cache, name: str, arr, upload=None):
     return dev
 
 
-def _prep_from_dp(dp, dtype, all_allowed=None, ew=None, dev_cache=None):
+def _prep_from_dp(
+    dp: Any,
+    dtype: Any,
+    all_allowed: Optional[bool] = None,
+    ew: Any = None,
+    dev_cache: Optional[dict] = None,
+) -> Tuple[bool, Tuple[Any, ...]]:
     """:func:`_device_prep` from a DensePlan — the one call site shared by
     ``plan``, ``_leader_plan`` and ``parallel.shard_session.plan_sharded``.
 
@@ -1135,7 +1189,7 @@ def _prep_from_dp(dp, dtype, all_allowed=None, ew=None, dev_cache=None):
     )
 
 
-def _superseded_mask(mp, mslot) -> "np.ndarray":
+def _superseded_mask(mp: Any, mslot: Any) -> "np.ndarray":
     """``keep`` mask collapsing consecutive same-slot runs per partition.
 
     A batched session can re-move a (partition, slot) cell a later
@@ -1161,7 +1215,7 @@ def _superseded_mask(mp, mslot) -> "np.ndarray":
 
 
 def _decode_packed(
-    packed: "np.ndarray", dp, opl: PartitionList,
+    packed: "np.ndarray", dp: Any, opl: PartitionList,
     drop_superseded: bool = False,
 ) -> int:
     """Replay a packed ``[move_p | move_slot | move_tgt | n]`` move log
@@ -1293,7 +1347,7 @@ def _leader_plan(
     pl: PartitionList,
     cfg: RebalanceConfig,
     max_reassign: int,
-    dtype,
+    dtype: Any,
     chunk_moves: int,
     opl: PartitionList,
     batch: int = 1,
@@ -1468,7 +1522,7 @@ def plan(
     pl: PartitionList,
     cfg: RebalanceConfig,
     max_reassign: int,
-    dtype=None,
+    dtype: Any = None,
     batch: int = 1,
     chunk_moves: "int | None" = None,
     engine: str = "auto",

@@ -61,7 +61,7 @@ Parity semantics pinned against the greedy oracle:
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -93,21 +93,21 @@ MIN_DEVICE_CANDIDATES = 20_000
 
 
 def score_moves(
-    loads,
-    replicas,
-    allowed,
-    member,
-    weights,
-    nrep_cur,
-    nrep_tgt,
-    pvalid,
-    bvalid,
-    nb,
-    min_replicas,
+    loads: jax.Array,
+    replicas: jax.Array,
+    allowed: Optional[jax.Array],
+    member: jax.Array,
+    weights: jax.Array,
+    nrep_cur: jax.Array,
+    nrep_tgt: jax.Array,
+    pvalid: jax.Array,
+    bvalid: jax.Array,
+    nb: jax.Array,
+    min_replicas: jax.Array,
     *,
     leaders: bool,
     tie_k: int = 0,
-):
+) -> Tuple[jax.Array, ...]:
     """Score every candidate move with the rank-1 objective update.
 
     Returns ``(u_min, flat_idx, su, perm)`` and, when ``tie_k > 0``,
@@ -157,8 +157,10 @@ def score_moves(
     return u_min, idx, su, perm, perpart
 
 
-def _score_window(ints, floats, allowed, *, leaders: bool,
-                  all_allowed: bool):
+def _score_window(
+    ints: jax.Array, floats: jax.Array, allowed: Optional[jax.Array],
+    *, leaders: bool, all_allowed: bool,
+) -> Tuple[jax.Array, ...]:
     """``score_moves`` with the transfer layout of the stateless per-move
     deployment unit (one move per CLI run, README.md:21-33): on a
     remote-attached TPU every device_put and every fetch pays a full
@@ -248,7 +250,9 @@ _score_window_jit = jax.jit(
 )
 
 
-def _pack_window_args(dp: DensePlan, loads_np, cfg: RebalanceConfig):
+def _pack_window_args(
+    dp: DensePlan, loads_np: Any, cfg: RebalanceConfig
+) -> Tuple[Any, Any, Any, bool]:
     """The window scorer's transfer layout (see ``_score_window``), in ONE
     place shared by ``find_best_move`` and the layout parity test —
     returns ``(ints, floats64, allowed_or_None, all_allowed)``; the caller
@@ -273,7 +277,9 @@ def _pack_window_args(dp: DensePlan, loads_np, cfg: RebalanceConfig):
     return ints, floats64, None if all_allowed else dp.allowed, all_allowed
 
 
-def _oracle_loads(pl: PartitionList, cfg: RebalanceConfig):
+def _oracle_loads(
+    pl: PartitionList, cfg: RebalanceConfig
+) -> Dict[int, float]:
     """Broker loads in the oracle's accumulation order, with the reference
     ``move()`` zero-fill of configured brokers (steps.go:150-155)."""
     loads = costmodel.get_broker_load(pl)
@@ -284,7 +290,10 @@ def _oracle_loads(pl: PartitionList, cfg: RebalanceConfig):
 
 
 def find_best_move(
-    dp: DensePlan, cfg: RebalanceConfig, leaders: bool, loads_map=None
+    dp: DensePlan,
+    cfg: RebalanceConfig,
+    leaders: bool,
+    loads_map: Optional[Dict[int, float]] = None,
 ) -> Optional[Tuple[int, int, int]]:
     """Best accepted move on a dense plan, or ``None`` if no candidate
     improves by more than ``cfg.min_unbalance``.

@@ -25,7 +25,7 @@ module re-implements the Go semantics the reference relies on:
 from __future__ import annotations
 
 import re
-from typing import Callable, Dict, List, Optional
+from typing import Any, Callable, Dict, List, Optional, TextIO
 
 _GO_INT_RE = re.compile(r"^[+-]?[0-9]+$")
 _GO_FLOAT_RE = re.compile(
@@ -52,7 +52,7 @@ def go_parse_float(s: str) -> float:
 class Flag:
     __slots__ = ("name", "kind", "default", "usage", "value")
 
-    def __init__(self, name: str, kind: str, default, usage: str):
+    def __init__(self, name: str, kind: str, default: Any, usage: str):
         self.name = name
         self.kind = kind  # bool | int | float | string
         self.default = default
@@ -87,7 +87,7 @@ def _format_default(fl: Flag) -> str:
 
 
 class FlagSet:
-    def __init__(self, name: str, output=None):
+    def __init__(self, name: str, output: Optional[TextIO] = None):
         self.name = name
         self.output = output
         self.flags: Dict[str, Flag] = {}
@@ -100,7 +100,7 @@ class FlagSet:
         self.usage: Optional[Callable[[], None]] = None
 
     # --- definition -----------------------------------------------------
-    def _add(self, name: str, kind: str, default, usage: str) -> Flag:
+    def _add(self, name: str, kind: str, default: Any, usage: str) -> Flag:
         fl = Flag(name, kind, default, usage)
         self.flags[name] = fl
         return fl

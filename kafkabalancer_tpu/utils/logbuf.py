@@ -15,12 +15,15 @@ from __future__ import annotations
 import gc
 import threading
 import time
+from typing import TextIO
 
 
 class BufferingWriter:
     """Size/time/GC-flushed buffering writer (logbuf/logbuf.go:11-111)."""
 
-    def __init__(self, w, flush_time: float = 0.1, flush_size: int = 4096):
+    def __init__(
+        self, w: TextIO, flush_time: float = 0.1, flush_size: int = 4096
+    ):
         self._w = w
         self._flush_time = flush_time
         self._flush_size = flush_size
@@ -119,7 +122,7 @@ class Logger:
     (kafkabalancer.go:73-75); messages gain a trailing newline if absent.
     """
 
-    def __init__(self, w):
+    def __init__(self, w: "BufferingWriter"):
         self._w = w
 
     def printf(self, msg: str) -> None:

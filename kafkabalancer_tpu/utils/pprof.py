@@ -24,9 +24,10 @@ Line{1 function_id, 2 line}; Function{1 id, 2 name, 3 system_name,
 
 from __future__ import annotations
 
+import cProfile
 import gzip
 import time
-from typing import List
+from typing import Any, Iterable, List, Sequence
 
 
 def _varint(n: int) -> bytes:
@@ -50,7 +51,7 @@ def _field_bytes(field: int, payload: bytes) -> bytes:
     return _varint(field << 3 | 2) + _varint(len(payload)) + payload
 
 
-def _packed(field: int, values) -> bytes:
+def _packed(field: int, values: Iterable[int]) -> bytes:
     body = b"".join(_varint(v) for v in values)
     return _field_bytes(field, body)
 
@@ -59,7 +60,7 @@ def _value_type(type_idx: int, unit_idx: int) -> bytes:
     return _field_varint(1, type_idx) + _field_varint(2, unit_idx)
 
 
-def encode_profile(entries, duration_ns: int) -> bytes:
+def encode_profile(entries: Sequence[Any], duration_ns: int) -> bytes:
     """Encode ``cProfile.Profile.getstats()`` entries as an uncompressed
     profile.proto message."""
     strings: List[str] = [""]
@@ -128,7 +129,9 @@ def encode_profile(entries, duration_ns: int) -> bytes:
     )
 
 
-def write_pprof(profiler, path: str, duration_ns: int = 0) -> None:
+def write_pprof(
+    profiler: cProfile.Profile, path: str, duration_ns: int = 0
+) -> None:
     """Write ``profiler`` (a ``cProfile.Profile``) as a gzipped pprof
     profile readable by ``go tool pprof``."""
     data = encode_profile(profiler.getstats(), duration_ns)

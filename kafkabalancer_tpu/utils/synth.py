@@ -8,7 +8,7 @@ partition/broker counts. Deterministic per seed.
 from __future__ import annotations
 
 import random
-
+from typing import List, Optional
 
 from kafkabalancer_tpu.models import Partition, PartitionList
 
@@ -137,7 +137,10 @@ def rotation_locked_cluster(
         x, y, z = 3 * g + 1, 3 * g + 2, 3 * g + 3
         A, B, C = f"rotA{g}", f"rotB{g}", f"rotC{g}"
 
-        def part(topic, pid, leader, follower, allowed):
+        def part(
+            topic: str, pid: int, leader: int, follower: int,
+            allowed: Optional[List[int]],
+        ) -> None:
             parts.append(
                 Partition(
                     topic=topic,

@@ -2,11 +2,17 @@
 # Pre-merge correctness gate for kafkabalancer-tpu.
 #
 # Runs, in order:
-#   1. jaxlint          — the project's JAX-aware linter (rules R1-R5),
-#                         over the package AND bench.py
+#   1. jaxlint          — the project's JAX-aware linter (per-module
+#                         rules, --list-rules lint), over the package
+#                         AND bench.py
+#   1b. contracts       — the whole-program contract analyzer
+#                         (--list-rules contracts): import-purity
+#                         reachability, lock-order + thread-role
+#                         concurrency lint, schema-drift vs goldens
+#                         (docs/static-analysis.md)
 #   2. annotation floor — strict-annotation coverage of the typed
-#                         subpackages (models/, ops/, codecs/); the
-#                         dependency-free half of the typing gate
+#                         subpackages (every package in $typed_pkgs);
+#                         the dependency-free half of the typing gate
 #   3. mypy --strict    — on the same subpackages, when mypy is installed
 #   4. ruff check       — when ruff is installed
 #   5. cold-start smoke — fresh single-move CLI subprocess against a
@@ -103,22 +109,39 @@ done
 fail=0
 step() { printf '\n== %s\n' "$1"; }
 
-step "jaxlint (R1-R5)"
+# stage labels name the rules they run so the gate output and the
+# analyzer cannot drift apart — both lists come from --list-rules
+lint_rules=$("$PYTHON" -m kafkabalancer_tpu.analysis --list-rules lint)
+contract_rules=$("$PYTHON" -m kafkabalancer_tpu.analysis --list-rules contracts)
+
+step "jaxlint ($lint_rules)"
 # bench.py rides along: it is outside the package tree but carries the
 # same jax-dtype/dispatch idioms the rules police
 "$PYTHON" -m kafkabalancer_tpu.analysis kafkabalancer_tpu/ bench.py || fail=1
 
-step "annotation coverage (mypy --strict floor)"
-"$PYTHON" -m kafkabalancer_tpu.analysis --annotations \
-  kafkabalancer_tpu/models kafkabalancer_tpu/ops kafkabalancer_tpu/codecs \
-  kafkabalancer_tpu/obs kafkabalancer_tpu/serve \
-  || fail=1
+step "contracts ($contract_rules)"
+# whole-program pass: import-purity reachability vs the declared
+# manifest, lock-order + thread-role concurrency lint over serve/+obs/,
+# schema drift vs the golden pins. Zero unsuppressed findings to merge;
+# every suppression must carry a reason (SUP).
+"$PYTHON" -m kafkabalancer_tpu.analysis --contracts || fail=1
 
-step "mypy --strict (models/ ops/ codecs/ obs/ serve/)"
+# the typed subpackages — one list feeds both the annotation floor and
+# the mypy stage so they cannot drift apart
+typed_pkgs="kafkabalancer_tpu/models kafkabalancer_tpu/ops \
+  kafkabalancer_tpu/codecs kafkabalancer_tpu/obs kafkabalancer_tpu/serve \
+  kafkabalancer_tpu/balancer kafkabalancer_tpu/solvers \
+  kafkabalancer_tpu/parallel kafkabalancer_tpu/replay \
+  kafkabalancer_tpu/utils"
+
+step "annotation coverage (mypy --strict floor)"
+# shellcheck disable=SC2086  # word-splitting the path list is the point
+"$PYTHON" -m kafkabalancer_tpu.analysis --annotations $typed_pkgs || fail=1
+
+step "mypy --strict (typed subpackages)"
 if command -v mypy >/dev/null 2>&1; then
-  mypy --strict kafkabalancer_tpu/models kafkabalancer_tpu/ops \
-    kafkabalancer_tpu/codecs kafkabalancer_tpu/obs kafkabalancer_tpu/serve \
-    || fail=1
+  # shellcheck disable=SC2086
+  mypy --strict $typed_pkgs || fail=1
 else
   echo "mypy not installed — skipped (annotation-coverage floor ran above)"
 fi

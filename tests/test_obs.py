@@ -503,6 +503,38 @@ def test_hist_merge_buckets_matches_combined_stream():
         assert percentile_from_buckets(merged, q) == both.percentile(q)
 
 
+def test_hist_merge_from_opposite_directions_no_deadlock():
+    """Regression for the R7 contract-lint finding: two hists merged in
+    opposite directions on two threads take the SAME lock pair in
+    opposite orders — merge_from id-orders the acquisition, so the
+    classic unordered-pair deadlock cannot fire. Also pins the
+    self-merge no-op (the same non-reentrant lock twice)."""
+    from kafkabalancer_tpu.obs.hist import StreamingHist
+
+    a, b = StreamingHist(), StreamingHist()
+    a.observe(1.0)
+    b.observe(2.0)
+    a.merge_from(a)  # self-merge: no-op, must not self-deadlock
+    assert a.snapshot()["count"] == 1
+
+    start = threading.Barrier(2)
+
+    def fold(dst, src):
+        start.wait()
+        for _ in range(300):
+            dst.merge_from(src)
+
+    threads = [
+        threading.Thread(target=fold, args=(a, b)),
+        threading.Thread(target=fold, args=(b, a)),
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not any(t.is_alive() for t in threads), "merge_from deadlocked"
+
+
 def test_hist_windowed_rotation():
     """The ring of sub-epoch buckets: observations age out of the
     windowed view after window_s while the lifetime view keeps them."""
