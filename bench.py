@@ -505,6 +505,23 @@ def _scrape_phase_breakdown(sock: str, tag: str) -> dict:
                 "p95": h.get("p95", 0.0),
                 "max": h.get("max", 0.0),
             }
+    dispatch = {}
+    for name, h in sorted(doc.get("hists", {}).items()):
+        if name.startswith("serve.dispatch_"):
+            # dispatch-TIME occupancy/padding distributions (one
+            # observation per fused dispatch, recorded by the
+            # scheduler's sink as the dispatch lands — serve/lanes.py
+            # _note_fused), unlike the cumulative start-gauge counters
+            # the hello block carries
+            dispatch[name] = {
+                "count": h.get("count", 0),
+                "mean": round(
+                    h.get("sum", 0.0) / h.get("count", 1), 3
+                ) if h.get("count") else 0.0,
+                "p50": h.get("p50", 0.0),
+                "p95": h.get("p95", 0.0),
+                "max": h.get("max", 0.0),
+            }
     if phases:
         out["served_phase_breakdown"] = phases
         out["served_stats_requests"] = doc.get("requests")
@@ -516,6 +533,8 @@ def _scrape_phase_breakdown(sock: str, tag: str) -> dict:
             )
     if series:
         out["served_queue_series"] = series
+    if dispatch:
+        out["served_dispatch_breakdown"] = dispatch
     return out
 
 
@@ -753,7 +772,7 @@ def _run_spec_probe(n_parts: int, n_brokers: int) -> dict:
     their answers are memoizable: after each step the daemon plans the
     NEXT move during the idle window, and the following request answers
     from the memo with ZERO dispatch. Attribution comes from the
-    serve-stats/7 scrape (``speculation.hits`` + the ``serve.spec.hit_s``
+    serve-stats/8 scrape (``speculation.hits`` + the ``serve.spec.hit_s``
     daemon-side histogram — the acceptance number: hit p50 <= 5 ms
     daemon-side vs the ~53 ms live delta dispatch), asserted so a silent
     live-path fallback cannot masquerade as speculative speed. A second
@@ -891,12 +910,252 @@ def _run_spec_probe(n_parts: int, n_brokers: int) -> dict:
     return out
 
 
+def _parse_merged_trace(path: str) -> dict:
+    """One merged -trace document (obs/export.py merged_trace) reduced
+    to per-phase durations: client ``client.*`` phase spans, daemon
+    footer spans (second process track), the attribution window and its
+    coverage. Returns {} when the doc is unreadable or carries no
+    client phase spans."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    other = doc.get("otherData") or {}
+    evs = [
+        e for e in doc.get("traceEvents", [])
+        if e.get("ph") == "X"
+    ]
+    client: dict = {}
+    daemon: dict = {}
+    window = []
+    for e in evs:
+        name = str(e.get("name", ""))
+        ts, dur = e.get("ts"), e.get("dur")
+        if not isinstance(ts, (int, float)) or not isinstance(
+            dur, (int, float)
+        ):
+            continue
+        if name.startswith("client."):
+            key = name[len("client."):]
+            client[key] = client.get(key, 0.0) + dur / 1e6
+            window.append((ts, ts + dur))
+        elif (e.get("args") or {}).get("daemon"):
+            daemon[name] = daemon.get(name, 0.0) + dur / 1e6
+    if not client:
+        return {}
+    e2e_s = (
+        max(t1 for _, t1 in window) - min(t0 for t0, _ in window)
+    ) / 1e6
+    covered_s = sum(client.values())
+    return {
+        "client_s": client,
+        "daemon_s": daemon,
+        "e2e_s": e2e_s,
+        # the attribution fraction: how much of the edge window the
+        # NAMED client phases explain (daemon time overlaps
+        # wait_first_byte, so the client chain alone must cover it)
+        "coverage": covered_s / e2e_s if e2e_s > 0 else 0.0,
+        "served": bool(other.get("served")),
+        "spec_hit": bool(other.get("spec_hit")),
+        "trace_id": other.get("trace_id"),
+        "clock_offset_ns": other.get("clock_offset_ns"),
+        "daemon_wall_s": other.get("daemon_wall_s"),
+    }
+
+
+def _run_edge_probe(n_parts: int, n_brokers: int) -> dict:
+    """``edge_breakdown``: the end-to-end edge attribution of the two
+    steady states the daemon-side histograms cannot see past — the
+    delta path (live dispatch) and the speculative memo-hit path —
+    from the merged ``-trace`` documents (obs/export.py merged_trace)
+    of each steady-state step at flagship scale.
+
+    Each step is a full client invocation with ``-trace``: the client's
+    phase chain (input_read → canonicalize → digest → connect →
+    handshake → send → wait_first_byte → receive, obs/edge.py) and the
+    daemon's reply-footer span subtree land in ONE document, aligned by
+    the handshake clock-offset estimate. The probe reports a per-phase
+    p50/p95 table for both paths and the attribution coverage —
+    acceptance: the named client+daemon phases explain >= 95% of the
+    delta-path end-to-end edge wall (``edge_attribution_ok``). The
+    delta steps also carry ``-metrics-json`` (forces the live path AND
+    lets the probe reconcile the daemon-stamped ``trace_id`` +
+    ``client.phase.*`` gauges against the trace doc); spec steps carry
+    only ``-trace`` — un-forwarded, so their requests stay memoizable.
+    """
+    import tempfile
+
+    out: dict = {}
+    if os.environ.get("BENCH_NO_SERVED") == "1":
+        return out
+    from kafkabalancer_tpu.codecs.writer import write_partition_list
+    from kafkabalancer_tpu.serve import client as serve_client
+
+    tmp = tempfile.mkdtemp(prefix="kb-edge-")
+    sock = os.path.join(tmp, "kb.sock")
+    env = dict(os.environ)
+    env.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    )
+    pl, _cfg = _flagship_case(n_parts, n_brokers)
+    buf = io.StringIO()
+    write_partition_list(buf, pl)
+    state = json.loads(buf.getvalue())
+    input_path = os.path.join(tmp, "cluster.json")
+
+    def apply_plan(plan_stdout: str) -> None:
+        plan_doc = json.loads(plan_stdout)
+        for entry in plan_doc.get("partitions") or []:
+            for row in state["partitions"]:
+                if (
+                    row["topic"] == entry["topic"]
+                    and row["partition"] == entry["partition"]
+                ):
+                    row["replicas"] = list(entry["replicas"])
+                    break
+
+    def wait_for_memo(timeout: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            doc = serve_client.fetch_watch(sock) or {}
+            spec = doc.get("speculation") or {}
+            if spec.get("memos", 0) >= 1 and not spec.get("inflight"):
+                return
+            time.sleep(0.05)
+
+    def phase_table(parsed: list) -> dict:
+        names: dict = {}
+        for p in parsed:
+            for k, v in p["client_s"].items():
+                names.setdefault(f"client.{k}", []).append(v)
+            for k, v in p["daemon_s"].items():
+                names.setdefault(f"daemon.{k}", []).append(v)
+        return {
+            name: {
+                "p50_ms": round(_percentile(sorted(vals), 0.5) * 1e3, 3),
+                "p95_ms": round(_percentile(sorted(vals), 0.95) * 1e3, 3),
+            }
+            for name, vals in sorted(names.items())
+        }
+
+    daemon = _start_probe_daemon(sock, env, f"{n_parts}x{n_brokers}")
+    try:
+        if not _wait_probe_daemon(sock, daemon, "edge probe"):
+            return out
+        trace_path = os.path.join(tmp, "step.trace.json")
+        metrics_path = os.path.join(tmp, "step.metrics.json")
+        base = [
+            sys.executable, "-m", "kafkabalancer_tpu", "-input-json",
+            f"-input={input_path}", "-solver=tpu", "-max-reassign=1",
+            f"-serve-socket={sock}", f"-trace={trace_path}",
+        ]
+        delta_parsed: list = []
+        reconciled = True
+        for step in range(N_DELTA_MOVES + 1):
+            with open(input_path, "w") as f:
+                json.dump(state, f)
+            proc = subprocess.run(
+                base + [f"-metrics-json={metrics_path}"],
+                capture_output=True, text=True, env=env, timeout=600,
+            )
+            if proc.returncode != 0:
+                log(f"edge probe: delta step {step} rc={proc.returncode}")
+                return out
+            apply_plan(proc.stdout)
+            if step == 0:
+                continue  # the register step is not the steady state
+            parsed = _parse_merged_trace(trace_path)
+            if not parsed or not parsed["served"]:
+                log(f"edge probe: delta step {step} not served/traced")
+                return out
+            delta_parsed.append(parsed)
+            # reconcile the daemon-written metrics line against the
+            # trace doc: same trace id, client phases stamped
+            try:
+                with open(metrics_path) as f:
+                    gauges = json.load(f).get("gauges", {})
+            except (OSError, ValueError):
+                gauges = {}
+            reconciled = reconciled and (
+                gauges.get("trace_id") == parsed["trace_id"]
+                and any(
+                    k.startswith("client.phase.") for k in gauges
+                )
+            )
+        cov = sorted(p["coverage"] for p in delta_parsed)
+        e2e = sorted(p["e2e_s"] for p in delta_parsed)
+        edge: dict = {
+            "delta": {
+                "phases": phase_table(delta_parsed),
+                "e2e_p50_s": round(_percentile(e2e, 0.5), 4),
+                "e2e_p95_s": round(_percentile(e2e, 0.95), 4),
+                "coverage_p50": round(_percentile(cov, 0.5), 4),
+                "samples": len(delta_parsed),
+            },
+        }
+        out["edge_attribution_ok"] = (
+            _percentile(cov, 0.5) >= 0.95 and reconciled
+        )
+        log(
+            f"edge breakdown (delta path, {len(delta_parsed)} steps): "
+            f"e2e p50 {edge['delta']['e2e_p50_s']}s, coverage p50 "
+            f"{edge['delta']['coverage_p50']}, metrics reconciliation "
+            f"{'OK' if reconciled else 'MISSING'}"
+        )
+        # the spec-hit path: -trace only (un-forwarded, memoizable) —
+        # the same table for the fastest answer the daemon can give,
+        # where the edge IS essentially the whole end-to-end wall
+        spec_parsed: list = []
+        for step in range(max(3, N_DELTA_MOVES // 2)):
+            with open(input_path, "w") as f:
+                json.dump(state, f)
+            wait_for_memo()
+            proc = subprocess.run(
+                base, capture_output=True, text=True, env=env,
+                timeout=600,
+            )
+            if proc.returncode != 0:
+                log(f"edge probe: spec step {step} rc={proc.returncode}")
+                break
+            apply_plan(proc.stdout)
+            parsed = _parse_merged_trace(trace_path)
+            if parsed and parsed["served"]:
+                spec_parsed.append(parsed)
+        if spec_parsed:
+            cov_s = sorted(p["coverage"] for p in spec_parsed)
+            e2e_s = sorted(p["e2e_s"] for p in spec_parsed)
+            edge["spec"] = {
+                "phases": phase_table(spec_parsed),
+                "e2e_p50_s": round(_percentile(e2e_s, 0.5), 4),
+                "e2e_p95_s": round(_percentile(e2e_s, 0.95), 4),
+                "coverage_p50": round(_percentile(cov_s, 0.5), 4),
+                "spec_hits": sum(
+                    1 for p in spec_parsed if p["spec_hit"]
+                ),
+                "samples": len(spec_parsed),
+            }
+            log(
+                f"edge breakdown (spec path, {len(spec_parsed)} steps, "
+                f"{edge['spec']['spec_hits']} memo hits): e2e p50 "
+                f"{edge['spec']['e2e_p50_s']}s"
+            )
+        out["edge_breakdown"] = edge
+    finally:
+        _stop_probe_daemon(sock, daemon)
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def _run_watch_probe() -> dict:
     """``replay_watch_mode``: the watch-driven continuous controller at
     smoke scale — the replay harness's --watch scenario (fake-ZK seam,
     zero client plan ops, plan-byte parity on every emitted move,
     speculative hit rate + the exact speculation identity). Pins the
-    replay/4 watch artifact schema in every bench round."""
+    replay/5 watch artifact schema in every bench round."""
     out: dict = {}
     if os.environ.get("BENCH_NO_SERVED") == "1":
         return out
@@ -929,7 +1188,7 @@ def _run_replay_probe() -> dict:
     attribution) at smoke scale — a seeded 3-tenant fleet with diurnal
     arrival skew, weight-shift churn, a topic storm and a broker
     failure, driven closed-loop through the real client against a
-    private daemon. Lands the replay/4 artifact (per-tenant
+    private daemon. Lands the replay/5 artifact (per-tenant
     p50/p95/p99, delta-hit/resync/fallback attribution, session-thrash
     rate, padded-slot waste) so the artifact SCHEMA is pinned in bench
     rounds before the bench-host BENCH_r06 run records it at fleet
@@ -1354,6 +1613,22 @@ def _run_throughput_probe(n_parts: int, n_brokers: int) -> dict:
         for w in burst:
             w.join()
 
+    def dispatch_snapshot(sock: str) -> tuple:
+        """(dispatches, occupancy_sum, padded_sum) from the dispatch-time
+        hists — the BENCH_r06 seam: recorded per fused dispatch as it
+        lands (serve/lanes.py _note_fused), so a per-level delta is the
+        exact occupancy/waste OF that level's dispatches, which the
+        cumulative start-gauge hello counters could never attribute."""
+        doc = serve_client.fetch_stats(sock) or {}
+        hists = doc.get("hists") or {}
+        occ = hists.get("serve.dispatch_occupancy") or {}
+        pad = hists.get("serve.dispatch_padded") or {}
+        return (
+            int(occ.get("count", 0)),
+            float(occ.get("sum", 0.0)),
+            float(pad.get("sum", 0.0)),
+        )
+
     def run_levels(sock: str, tag: str) -> dict:
         res: dict = {"rps": {}, "p50_s": {}, "p95_s": {}}
         for C in levels:
@@ -1364,6 +1639,7 @@ def _run_throughput_probe(n_parts: int, n_brokers: int) -> dict:
             served_flags: list = []
             lock = threading.Lock()
             hello0 = serve_client.daemon_alive(sock) or {}
+            disp0 = dispatch_snapshot(sock)
 
             def client(slot: int) -> None:
                 for _ in range(reqs_per_client):
@@ -1460,6 +1736,22 @@ def _run_throughput_probe(n_parts: int, n_brokers: int) -> dict:
             res.setdefault("residency_hits", {})[str(C)] = int(
                 r1.get("hits", 0)
             ) - int(r0.get("hits", 0))
+            # dispatch-TIME attribution for this level's window: mean
+            # live occupancy per fused dispatch and the padded-slot
+            # waste fraction, from per-dispatch hist deltas — NOT the
+            # cumulative hello gauges above
+            disp1 = dispatch_snapshot(sock)
+            d_n = disp1[0] - disp0[0]
+            d_occ = disp1[1] - disp0[1]
+            d_pad = disp1[2] - disp0[2]
+            res.setdefault("dispatch_occupancy_mean", {})[str(C)] = (
+                round(d_occ / d_n, 3) if d_n else 0.0
+            )
+            res.setdefault("dispatch_padded_waste", {})[str(C)] = (
+                round(d_pad / (d_occ + d_pad), 3)
+                if (d_occ + d_pad) else 0.0
+            )
+            res.setdefault("dispatches", {})[str(C)] = d_n
             log(
                 f"throughput[{tag}] C={C}: {rps:.2f} rps over {n} reqs "
                 f"(p50 {res['p50_s'][str(C)]}s, p95 {res['p95_s'][str(C)]}s, "
@@ -1503,6 +1795,13 @@ def _run_throughput_probe(n_parts: int, n_brokers: int) -> dict:
         out["served_mb_occupancy"] = multi.get("occupancy", {})
         out["served_mb_padded_waste"] = multi.get("padded_waste", {})
         out["served_residency_hits"] = multi.get("residency_hits", {})
+        out["served_dispatch_occupancy_mean"] = multi.get(
+            "dispatch_occupancy_mean", {}
+        )
+        out["served_dispatch_padded_waste"] = multi.get(
+            "dispatch_padded_waste", {}
+        )
+        out["served_dispatches"] = multi.get("dispatches", {})
         for k, v in scrape.items():
             # the throughput ladder's phase/series block; the
             # single-move probe's breakdown keeps its own keys
@@ -1525,6 +1824,18 @@ def _run_throughput_probe(n_parts: int, n_brokers: int) -> dict:
                     ctl = run_levels(sock_ctl, "oneshot")
                     if ctl["rps"]:
                         out["served_throughput_oneshot_rps"] = ctl["rps"]
+                        # the 0.89x diagnosis seam: the same
+                        # dispatch-time distributions for the one-shot
+                        # barrier, so the artifact shows whether
+                        # continuous mode actually fused wider per
+                        # dispatch (or merely differently) than the
+                        # barrier it is supposed to beat
+                        out["served_oneshot_dispatch_occupancy_mean"] = (
+                            ctl.get("dispatch_occupancy_mean", {})
+                        )
+                        out["served_oneshot_dispatch_padded_waste"] = (
+                            ctl.get("dispatch_padded_waste", {})
+                        )
                         top = str(max(levels))
                         if top in multi["rps"] and top in ctl["rps"]:
                             speed = multi["rps"][top] / ctl["rps"][top]
@@ -1620,6 +1931,15 @@ def main() -> None:
             f"{cold['spec_live_vs_delta_p95']}x"
         )
 
+    # edge probe: the end-to-end phase attribution of the delta and
+    # spec-hit steady states from merged -trace docs (client phase
+    # chain + daemon footer spans on one clock) — the e2e story the
+    # daemon-side histograms alone cannot tell
+    try:
+        cold.update(_run_edge_probe(n_parts, n_brokers))
+    except Exception as exc:
+        log(f"edge probe unavailable: {exc!r}")
+
     # watch-mode probe: the continuous controller closed-loop over the
     # fake-ZK seam — zero client plan ops, parity on every emitted move
     try:
@@ -1635,7 +1955,7 @@ def main() -> None:
         log(f"throughput probe unavailable: {exc!r}")
 
     # replay probe: the seeded multi-tenant churn harness at smoke
-    # scale — pins the replay/4 artifact schema and the per-tenant
+    # scale — pins the replay/5 artifact schema and the per-tenant
     # scrape reconciliation in every bench round
     try:
         cold.update(_run_replay_probe())

@@ -236,7 +236,7 @@ def default_manifest() -> ContractManifest:
         ),
         goldens=(
             SchemaGolden(
-                golden="tests/data/serve_stats_schema_v7.json",
+                golden="tests/data/serve_stats_schema_v8.json",
                 keysets=("top_level_keys", "lane_keys"),
                 builders=(
                     BuilderSpec(_D, "Daemon._core_snapshot", var="out"),
@@ -244,14 +244,14 @@ def default_manifest() -> ContractManifest:
                 ),
             ),
             SchemaGolden(
-                golden="tests/data/serve_stats_schema_v7.json",
+                golden="tests/data/serve_stats_schema_v8.json",
                 keysets=("tenants_keys",),
                 builders=(
                     BuilderSpec(_D, "Daemon._tenants_block", var=None),
                 ),
             ),
             SchemaGolden(
-                golden="tests/data/serve_stats_schema_v7.json",
+                golden="tests/data/serve_stats_schema_v8.json",
                 keysets=("tenant_entry_keys",),
                 builders=(
                     BuilderSpec(
@@ -260,7 +260,7 @@ def default_manifest() -> ContractManifest:
                 ),
             ),
             SchemaGolden(
-                golden="tests/data/serve_stats_schema_v7.json",
+                golden="tests/data/serve_stats_schema_v8.json",
                 keysets=("memory_keys",),
                 builders=(
                     BuilderSpec(_D, "Daemon._memory_snapshot", var="out"),

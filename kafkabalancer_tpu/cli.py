@@ -28,6 +28,7 @@ sessions is *not* reproduced; repairs here update state cleanly.)
 
 from __future__ import annotations
 
+import contextlib
 import io
 import os
 import sys
@@ -230,13 +231,20 @@ _NO_FORWARD_FLAGS = frozenset((
     "serve-stats", "serve-stats-json", "serve-dump-trace", "metrics-prom",
     "serve-session", "serve-no-session",
     "no-daemon", "help", "pprof", "pprof-path", "jax-profile", "input",
+    # -trace is answered by the CLIENT on a forwarded invocation: the
+    # daemon's reply footer (its span subtree) merges with the client's
+    # own span tree into ONE Perfetto doc (obs/export.py merged_trace),
+    # so forwarding the flag would only produce the daemon-half twice.
+    # Closes the silent gap where a forwarded -trace wrote a document
+    # with no client-side spans at all.
+    "trace",
 ))
 # flags whose value names a filesystem path the DAEMON will write — made
 # absolute against the client's cwd ("-" = stdout stays as-is). -explain
 # forwards like any other flag: the daemon writes the document (or
 # appends it to the relayed stdout with "-") and the plan bytes are
 # pinned unchanged either way.
-_PATH_VALUE_FLAGS = frozenset(("metrics-json", "trace", "explain"))
+_PATH_VALUE_FLAGS = frozenset(("metrics-json", "explain"))
 
 
 def _forward_argv(f: FlagSet) -> List[str]:
@@ -760,7 +768,7 @@ def _run_impl(
             "serve-stats-json",
             False,
             "Scrape a live daemon's telemetry as one line of "
-            "schema-versioned JSON (kafkabalancer-tpu.serve-stats/7)",
+            "schema-versioned JSON (kafkabalancer-tpu.serve-stats/8)",
         )
         f_serve_dump_trace = f.string(
             "serve-dump-trace",
@@ -1024,110 +1032,169 @@ def _run_impl(
             sock = resolve_socket_path(f_serve_socket.value)
             forwardable = serve_client.socket_exists(sock)
             stdin_text: Optional[str] = None
-            if forwardable:
-                if f_input.value != "":
-                    # the CLIENT reads the input file and inlines it as
-                    # request stdin: the daemon needs no filesystem
-                    # access, and an unreadable file falls through to
-                    # the in-process open below — whose error message
-                    # names the path exactly as the user spelled it
-                    # (forwarding the flag absolutized it, which broke
-                    # served-vs-stateless stderr parity for relative
-                    # paths on exit-1)
-                    try:
-                        with open(f_input.value, "r") as fh:
-                            stdin_text = fh.read()
-                    except OSError:
-                        forwardable = False
-                elif f_zk.value == "":
-                    # the input rides the request; kept for the replay
-                    # below when the daemon turns out unreachable
-                    stdin_text = i.read()
-            if forwardable:
-                declined: List[str] = []
-                # the tenant identity: an explicit -serve-session name,
-                # else the input path ("-" for true stdin). A v2 daemon
-                # keys its resident state per (tenant, planning-flags
-                # signature) AND attributes the request's telemetry to
-                # the tenant (serve-stats/7 "tenants" block) — so the
-                # label is derived even when sessions are disabled; a
-                # request with no derivable identity rolls up as
-                # "other" daemon-side.
-                tenant = f_serve_session.value or (
-                    os.path.abspath(f_input.value)
-                    if f_input.value != ""
-                    else ("-" if stdin_text is not None else "")
-                )
-                session_spec = None
-                if (
-                    stdin_text is not None
-                    and not f_serve_no_session.value
-                    and f_zk.value == ""
-                ):
-                    session_spec = serve_client.SessionSpec(
-                        tenant=tenant,
-                        text=stdin_text,
-                        is_json=f_json.value,
-                        topics=[
-                            t for t in f_topics.value.split(",")
-                            if len(t) >= 1
-                        ],
-                    )
+            # the edge recorder (obs/edge.py): ALWAYS-ON for a forward
+            # attempt, no flag needed — it owns the invocation's trace
+            # id, times the client phase chain through the observer
+            # seam, collects the hello clock samples and the daemon's
+            # reply footer so the merged -trace export can stitch one
+            # causal timeline across both processes
+            edge_rec = obs.edge.EdgeContext() if forwardable else None
+            with contextlib.ExitStack() as edge_scope:
+                if edge_rec is not None:
+                    edge_scope.enter_context(edge_rec.install())
+                if forwardable:
+                    if f_input.value != "":
+                        # the CLIENT reads the input file and inlines it
+                        # as request stdin: the daemon needs no
+                        # filesystem access, and an unreadable file
+                        # falls through to the in-process open below —
+                        # whose error message names the path exactly as
+                        # the user spelled it (forwarding the flag
+                        # absolutized it, which broke
+                        # served-vs-stateless stderr parity for
+                        # relative paths on exit-1)
+                        try:
+                            with edge_rec.phase("input_read"):
+                                with open(f_input.value, "r") as fh:
+                                    stdin_text = fh.read()
+                        except OSError:
+                            forwardable = False
+                    elif f_zk.value == "":
+                        # the input rides the request; kept for the
+                        # replay below when the daemon turns out
+                        # unreachable
+                        with edge_rec.phase("input_read"):
+                            stdin_text = i.read()
+                if forwardable:
+                    declined: List[str] = []
+                    with edge_rec.phase("canonicalize"):
+                        # the tenant identity: an explicit
+                        # -serve-session name, else the input path
+                        # ("-" for true stdin). A v2 daemon keys its
+                        # resident state per (tenant, planning-flags
+                        # signature) AND attributes the request's
+                        # telemetry to the tenant (serve-stats/8
+                        # "tenants" block) — so the label is derived
+                        # even when sessions are disabled; a request
+                        # with no derivable identity rolls up as
+                        # "other" daemon-side.
+                        tenant = f_serve_session.value or (
+                            os.path.abspath(f_input.value)
+                            if f_input.value != ""
+                            else ("-" if stdin_text is not None else "")
+                        )
+                        fwd_argv = _forward_argv(f)
+                        session_spec = None
+                        if (
+                            stdin_text is not None
+                            and not f_serve_no_session.value
+                            and f_zk.value == ""
+                        ):
+                            session_spec = serve_client.SessionSpec(
+                                tenant=tenant,
+                                text=stdin_text,
+                                is_json=f_json.value,
+                                topics=[
+                                    t for t in f_topics.value.split(",")
+                                    if len(t) >= 1
+                                ],
+                            )
 
-                def _note_fallback(reason: str) -> None:
-                    # attributable fallbacks: the reason lands as a
-                    # counter in THIS invocation's registry. For every
-                    # fall-back-to-in-process reason (daemon_down,
-                    # handshake_mismatch, frame_cap, declined,
-                    # transport_error) the invocation ends planning
-                    # locally, so the counter reaches its own
-                    # -stats/-metrics-json export. Session-resync notes
-                    # observed mid-forward on a request that ends up
-                    # SERVED are deliberately not re-exported here (the
-                    # daemon's export is the authoritative one); the
-                    # daemon counts them in its scrape's "fallbacks"
-                    # block. stderr stays byte-identical to a
-                    # daemon-less build either way.
-                    obs.metrics.count(f"serve.fallbacks.{reason}")
+                    def _note_fallback(reason: str) -> None:
+                        # attributable fallbacks: the reason lands as a
+                        # counter in THIS invocation's registry. For
+                        # every fall-back-to-in-process reason
+                        # (daemon_down, handshake_mismatch, frame_cap,
+                        # declined, transport_error) the invocation
+                        # ends planning locally, so the counter reaches
+                        # its own -stats/-metrics-json export.
+                        # Session-resync notes observed mid-forward on
+                        # a request that ends up SERVED are
+                        # deliberately not re-exported here (the
+                        # daemon's export is the authoritative one);
+                        # the daemon counts them in its scrape's
+                        # "fallbacks" block. stderr stays
+                        # byte-identical to a daemon-less build either
+                        # way.
+                        obs.metrics.count(f"serve.fallbacks.{reason}")
 
-                with obs.span("serve.forward", socket=sock):
-                    served = serve_client.forward_plan(
-                        sock, _forward_argv(f), stdin_text,
-                        on_fallback=declined.append,
-                        session=session_spec,
-                        note=_note_fallback,
-                        tenant=tenant,
-                        client_timeout=max(
-                            0.0, f_serve_client_timeout.value
-                        ),
-                    )
-                if served is None and declined:
-                    # the daemon POSITIVELY declined (structured error
-                    # frame / frame-cap overflow) — name the reason
-                    # instead of a generic silent fallback. Silent
-                    # failure modes (daemon down, stale socket) log
-                    # nothing, preserving daemon-down stderr parity.
-                    log(
-                        f"daemon declined request ({declined[0]}); "
-                        "planning in-process"
-                    )
-                if served is not None:
-                    obs.metrics.count("cli.served")
-                    o.write(served.stdout)
-                    be.write(served.stderr)
-                    # the daemon's own run() already exported the
-                    # telemetry trio (its stdout/stderr/files carry it);
-                    # exporting this process's near-empty registry on
-                    # top would double-write the metrics line
-                    tel.stats = False
-                    tel.metrics_path = ""
-                    tel.trace_path = ""
-                    return served.rc
-                if stdin_text is not None and f_input.value == "":
-                    # true-stdin input was consumed by the read above;
-                    # replay it for the in-process path (-input inputs
-                    # are simply re-opened below)
-                    i = io.StringIO(stdin_text)
+                    with obs.span(
+                        "serve.forward", socket=sock,
+                        trace_id=edge_rec.trace_id,
+                    ) as fwd_sp:
+                        # the cross-process parent handle: daemon
+                        # footer spans render under this span in the
+                        # merged export
+                        edge_rec.parent_sid = getattr(fwd_sp, "sid", 0)
+                        served = serve_client.forward_plan(
+                            sock, fwd_argv, stdin_text,
+                            on_fallback=declined.append,
+                            session=session_spec,
+                            note=_note_fallback,
+                            tenant=tenant,
+                            client_timeout=max(
+                                0.0, f_serve_client_timeout.value
+                            ),
+                            edge=edge_rec,
+                        )
+                    if served is None:
+                        # the whole wasted edge wall becomes the
+                        # "fallback" phase (obs/edge.py glossary)
+                        edge_rec.note_fallback()
+                    if served is None and declined:
+                        # the daemon POSITIVELY declined (structured
+                        # error frame / frame-cap overflow) — name the
+                        # reason instead of a generic silent fallback.
+                        # Silent failure modes (daemon down, stale
+                        # socket) log nothing, preserving daemon-down
+                        # stderr parity.
+                        log(
+                            f"daemon declined request ({declined[0]}); "
+                            "planning in-process"
+                        )
+                    if served is not None:
+                        obs.metrics.count("cli.served")
+                        edge_rec.finish(served.trace)
+                        o.write(served.stdout)
+                        be.write(served.stderr)
+                        if tel.trace_path:
+                            # -trace on a SERVED invocation: the client
+                            # writes ONE merged Perfetto doc — its own
+                            # span tree plus the daemon's reply-footer
+                            # subtree aligned by the handshake
+                            # clock-offset estimate (obs/export.py
+                            # merged_trace) — instead of forwarding the
+                            # flag and getting a daemon-only doc with
+                            # no client spans
+                            try:
+                                from kafkabalancer_tpu.obs import (
+                                    export as obs_export,
+                                )
+
+                                obs_export.write_merged_trace(
+                                    tel.trace_path, obs.tracer, edge_rec
+                                )
+                            except Exception as exc:
+                                log(
+                                    "failed writing merged trace to "
+                                    f"{tel.trace_path}: {exc}"
+                                )
+                        # the daemon's own run() already exported the
+                        # -stats/-metrics-json telemetry (its
+                        # stdout/stderr/files carry it); exporting this
+                        # process's near-empty registry on top would
+                        # double-write the metrics line. The merged
+                        # trace was just written above, so the local
+                        # exporter must not overwrite it either.
+                        tel.stats = False
+                        tel.metrics_path = ""
+                        tel.trace_path = ""
+                        return served.rc
+                    if stdin_text is not None and f_input.value == "":
+                        # true-stdin input was consumed by the read
+                        # above; replay it for the in-process path
+                        # (-input inputs are simply re-opened below)
+                        i = io.StringIO(stdin_text)
 
         topics = [t for t in f_topics.value.split(",") if len(t) >= 1]
 

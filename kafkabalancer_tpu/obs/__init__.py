@@ -33,7 +33,7 @@ from __future__ import annotations
 import threading
 from typing import Any, Optional
 
-from kafkabalancer_tpu.obs import convergence, flight, hist  # noqa: F401
+from kafkabalancer_tpu.obs import convergence, edge, flight, hist  # noqa: F401
 from kafkabalancer_tpu.obs.metrics import (  # noqa: F401
     REGISTRY,
     SCHEMA,

@@ -120,6 +120,99 @@ def write_trace(path: str, tracer: Tracer) -> None:
         json.dump(chrome_trace(tracer), f, default=str)
 
 
+def merged_trace(tracer: Tracer, edge: Any) -> Dict[str, Any]:
+    """ONE Perfetto document for a forwarded invocation: the client's
+    own span tree (exactly :func:`chrome_trace`) plus a second process
+    track holding the daemon's reply-footer span subtree
+    (serve/protocol.py § End-to-end tracing), aligned onto the
+    client's monotonic timeline.
+
+    Alignment: the daemon stamps are raw daemon ``perf_counter_ns``;
+    the edge recorder's handshake clock-offset estimate
+    (``obs.edge.estimate_offset`` — NTP-style, min-RTT sample, error
+    bounded by rtt/2) maps them as ``client_ns = daemon_ns -
+    offset_ns``. Mapped spans are additionally CLAMPED to start no
+    earlier than their client parent (the ``serve.forward`` span) —
+    causality must survive a worst-case asymmetric-RTT estimate. With
+    no usable handshake sample (degenerate single-frame session, clock
+    refused) the daemon track is pinned to the forward span's start
+    instead, and ``otherData.clock_offset_ns`` is null.
+
+    Both process tracks carry the invocation's trace id; daemon spans
+    parent under the forward span (``args.parent_sid``)."""
+    doc = chrome_trace(tracer)
+    footer = getattr(edge, "footer", None)
+    if not isinstance(footer, dict):
+        return doc
+    pid = os.getpid()
+    dpid = pid + 1  # a distinct synthetic process track
+    trace_id = str(footer.get("id") or edge.trace_id)
+    fwd_sid = getattr(edge, "parent_sid", None)
+    # the forward span's client-clock start (ns since tracer base)
+    fwd_start_us: Optional[float] = None
+    for sp in tracer.snapshot():
+        if sp["sid"] == fwd_sid or (
+            fwd_start_us is None and sp["name"] == "serve.forward"
+        ):
+            fwd_start_us = float(sp["start_us"])
+            if sp["sid"] == fwd_sid:
+                break
+    events = doc["traceEvents"]
+    events.append({
+        "ph": "M", "name": "process_name", "pid": dpid, "tid": 0,
+        "args": {
+            "name": "kafkabalancer-tpu daemon",
+            "trace_id": trace_id,
+        },
+    })
+    events.append({
+        "ph": "M", "name": "thread_name", "pid": dpid, "tid": 1,
+        "args": {"name": "serve-req (footer)"},
+    })
+    off = edge.clock_offset()
+    offset_ns = off[0] if off is not None else None
+    spans = footer.get("spans") or []
+    base_ns = tracer.base_ns
+    if offset_ns is None and spans and fwd_start_us is not None:
+        # degenerate fallback: pin the earliest daemon span to the
+        # forward span's start
+        d_min = min(int(s["t0_ns"]) for s in spans)
+        offset_ns = d_min - (base_ns + int(fwd_start_us * 1e3))
+    for s in spans:
+        try:
+            t0_ns = int(s["t0_ns"]) - (offset_ns or 0)
+            t1_ns = int(s["t1_ns"]) - (offset_ns or 0)
+        except (KeyError, TypeError, ValueError):
+            continue
+        ts_us = (t0_ns - base_ns) / 1e3
+        dur_us = max(0.0, (t1_ns - t0_ns) / 1e3)
+        if fwd_start_us is not None and ts_us < fwd_start_us:
+            ts_us = fwd_start_us  # causality clamp (see docstring)
+        args: Dict[str, Any] = {"trace_id": trace_id, "daemon": True}
+        if fwd_sid is not None:
+            args["parent_sid"] = fwd_sid
+        events.append({
+            "ph": "X", "name": str(s.get("name", "?")), "pid": dpid,
+            "tid": 1, "ts": round(max(0.0, ts_us), 1),
+            "dur": round(dur_us, 1), "args": args,
+        })
+    other = doc.setdefault("otherData", {})
+    other["served"] = True
+    other["trace_id"] = trace_id
+    other["clock_offset_ns"] = off[0] if off is not None else None
+    other["clock_rtt_ns"] = off[1] if off is not None else None
+    if footer.get("spec_hit"):
+        other["spec_hit"] = True
+    if isinstance(footer.get("wall_s"), (int, float)):
+        other["daemon_wall_s"] = footer["wall_s"]
+    return doc
+
+
+def write_merged_trace(path: str, tracer: Tracer, edge: Any) -> None:
+    with open(path, "w") as f:
+        json.dump(merged_trace(tracer, edge), f, default=str)
+
+
 def render_stats(
     registry: MetricsRegistry, tracer: Tracer, rc: Optional[int] = None
 ) -> str:
@@ -298,7 +391,7 @@ def render_prometheus(doc: Dict[str, Any]) -> str:
             m = _prom_name(f"sessions_{key}")
             lines.append(f"# TYPE {m} {typ}")
             lines.append(f"{m} {_prom_value(v)}")
-    # the warm session tier (serve-stats/7 "paging" block): spill /
+    # the warm session tier (serve-stats/8 "paging" block): spill /
     # restore / corrupt-drop counters under the conservation identity
     # spills + adopted == restores + corrupt_drops + evictions +
     # warm_entries, plus the live warm footprint gauges
@@ -317,7 +410,7 @@ def render_prometheus(doc: Dict[str, Any]) -> str:
             m = _prom_name(f"paging_{key}")
             lines.append(f"# TYPE {m} {typ}")
             lines.append(f"{m} {_prom_value(v)}")
-    # speculative plan-ahead (serve-stats/7 "speculation" block):
+    # speculative plan-ahead (serve-stats/8 "speculation" block):
     # memo-lifecycle counters under the exact identity attempts ==
     # hits + misses + poisoned + memos (docs/observability.md)
     spec = doc.get("speculation")
@@ -334,7 +427,7 @@ def render_prometheus(doc: Dict[str, Any]) -> str:
             m = _prom_name(f"spec_{key}")
             lines.append(f"# TYPE {m} {typ}")
             lines.append(f"{m} {_prom_value(v)}")
-    # the watch-driven controller (serve-stats/7 "watch" block):
+    # the watch-driven controller (serve-stats/8 "watch" block):
     # tick/read/emit counters plus the lag gauges (nulls skipped —
     # e.g. before the first read)
     watch = doc.get("watch")
@@ -521,6 +614,21 @@ def _render_prometheus_tenants(
     emitted_type = False
     for label, e in entries:
         h = e.get("request_s")
+        if not isinstance(h, dict):
+            continue
+        if not emitted_type:
+            lines.append(f"# TYPE {m} summary")
+            emitted_type = True
+        _prom_summary_samples(
+            lines, m, f'tenant="{_prom_label(label)}"', h
+        )
+    # serve-stats/8: the per-tenant edge overhead summary (client
+    # pre-send phases + RTT, milliseconds — obs/edge.py); absent until
+    # a tracing client reports, so pre-tracing scrapes are unchanged
+    m = _prom_name("tenant_edge_ms")
+    emitted_type = False
+    for label, e in entries:
+        h = e.get("edge_ms")
         if not isinstance(h, dict):
             continue
         if not emitted_type:

@@ -144,6 +144,30 @@ class FlightRecorder:
         with self._lock:
             return [dict(r) for r in self._requests]
 
+    def spans_for_thread(
+        self, thread: str, cap: int = 64
+    ) -> List[Dict[str, Any]]:
+        """The LAST ``cap`` completed spans recorded on ``thread`` —
+        the reply footer's bounded daemon span subtree. Request-thread
+        names are unique per request (``serve-req-<seq>``), so a ring
+        scan filtered by thread name is exactly that request's spans;
+        raw ``perf_counter_ns`` stamps are kept so the client can map
+        them through its clock-offset estimate."""
+        out: List[Dict[str, Any]] = []
+        with self._lock:
+            for rec in reversed(self._spans):
+                if rec.get("thread") != thread:
+                    continue
+                out.append({
+                    "name": rec["name"],
+                    "t0_ns": rec["t0_ns"],
+                    "t1_ns": rec["t1_ns"],
+                })
+                if len(out) >= max(1, cap):
+                    break
+        out.reverse()
+        return out
+
     def to_perfetto(self) -> Dict[str, Any]:
         """The ring as Chrome trace-event / Perfetto JSON: one ``X``
         complete event per recorded span on one track per thread, with

@@ -23,7 +23,7 @@ gate verdicts, and the solver/session counters. Four metric families:
   reads mid-traffic; tests reset them explicitly via
   :meth:`reset_hists`. Excluded from :meth:`snapshot` on purpose — the
   ``kafkabalancer-tpu.metrics/1`` schema is golden-pinned, and the
-  scrape document (``kafkabalancer-tpu.serve-stats/7``) is the
+  scrape document (``kafkabalancer-tpu.serve-stats/8``) is the
   histograms' export seam;
 - **label families** — bounded label-dimensioned histogram/counter
   families (``tenant_hist_observe`` / ``tenant_count``): per-tenant

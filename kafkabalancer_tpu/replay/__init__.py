@@ -5,14 +5,14 @@ plus a closed-loop driver (replay/harness.py) that plans every request
 through the REAL forwarding client against a live daemon, applies each
 emitted plan back to the tenant's state, and reconciles client-side
 counts and tail latencies against the daemon's per-tenant
-``serve-stats/7`` scrape — Clipper's continuously-measured-p99
+``serve-stats/8`` scrape — Clipper's continuously-measured-p99
 methodology (PAPERS.md) as a regression gate, the workload the
 per-tenant observability dimension exists to exercise.
 
 Entry points:
 
 - ``python -m kafkabalancer_tpu.replay`` — run a seeded replay,
-  emitting the ``kafkabalancer-tpu.replay/4`` artifact (see
+  emitting the ``kafkabalancer-tpu.replay/5`` artifact (see
   docs/observability.md § Per-tenant attribution and README.md);
 - :func:`run_replay` — the library seam bench.py's
   ``replay_fleet_churn`` probe and gate.sh's replay smoke stage call.

@@ -1,6 +1,6 @@
 """``python -m kafkabalancer_tpu.replay`` — run one seeded fleet-churn
 replay against a live (or private, self-spawned) planning daemon and
-write the ``kafkabalancer-tpu.replay/4`` artifact.
+write the ``kafkabalancer-tpu.replay/5`` artifact.
 
 Examples::
 

@@ -8,7 +8,7 @@ multi-tenant churn, every request runs through the REAL forwarding
 client (``cli.run`` with a ``-serve-socket`` — the same code path the
 production outer loop uses, resident-session ladder included), the
 emitted plan is applied back to the tenant's state (the closed loop),
-and at the end the harness fetches the daemon's ``serve-stats/7``
+and at the end the harness fetches the daemon's ``serve-stats/8``
 scrape and reconciles:
 
 - per-tenant REQUEST COUNTS: the driver's issued counts must equal the
@@ -31,7 +31,7 @@ scrape and reconciles:
   layer's oldest pin, exercised under churn).
 
 The result is one schema-versioned artifact
-(``kafkabalancer-tpu.replay/4``) with per-tenant tails, session-thrash
+(``kafkabalancer-tpu.replay/5``) with per-tenant tails, session-thrash
 and fallback rates, and padded-slot waste — the shape bench.py's
 ``replay_fleet_churn`` probe lands in BENCH rounds and gate.sh asserts
 pre-merge. No jax is imported here or anywhere below it: the harness is
@@ -63,7 +63,7 @@ from kafkabalancer_tpu.replay.synth import FleetSynth
 # request, reporting the restore-hit rate and the pre/post-restart p95,
 # and reconciling the warm tier's conservation identity (spills +
 # adopted == restores + corrupt_drops + evictions + warm_entries) from
-# the serve-stats/7 "paging" block
+# the serve-stats/8 "paging" block
 # v4: + mode "watch" and the "watch" block (null otherwise) — the
 # --watch run drives a ``-watch`` daemon through the fake-ZK seam
 # ($KAFKABALANCER_TPU_FAKE_ZK): the synthesizer publishes ZK-shaped
@@ -73,7 +73,13 @@ from kafkabalancer_tpu.replay.synth import FleetSynth
 # planned from, via the emit-sidecar digest), the speculative hit rate,
 # external-drift resyncs, and the exact speculation identity
 # hits + misses + poisoned (+ live memos) == attempts
-REPLAY_SCHEMA_VERSION = 4
+# v5: + the "trace" block — end-to-end trace-id reconciliation: every
+# served request's daemon flight record must carry the client's trace
+# id EXACTLY (the client publishes each invocation's id via the
+# ``client.trace_id`` gauge; the harness matches them one-to-one
+# against the flight log's per-request ``trace`` keys), and the
+# reconciliation verdict folds into the top-level ``reconciled``
+REPLAY_SCHEMA_VERSION = 5
 REPLAY_SCHEMA = f"kafkabalancer-tpu.replay/{REPLAY_SCHEMA_VERSION}"
 
 LogFn = Callable[[str], None]
@@ -314,7 +320,7 @@ def _make_synth(cfg: ReplayConfig) -> FleetSynth:
 def run_replay(
     cfg: ReplayConfig, log: Optional[LogFn] = None
 ) -> Dict[str, Any]:
-    """Run one seeded replay; returns the ``kafkabalancer-tpu.replay/4``
+    """Run one seeded replay; returns the ``kafkabalancer-tpu.replay/5``
     artifact (see the module docstring). Raises :class:`ReplayError`
     only when no daemon could be reached/spawned — a reconciliation
     failure is DATA (``reconciled: false``), not an exception, so bench
@@ -322,6 +328,7 @@ def run_replay(
     import sys
 
     from kafkabalancer_tpu import cli
+    from kafkabalancer_tpu.obs import metrics as obs_metrics
     from kafkabalancer_tpu.serve import client as sclient
 
     _log: LogFn = log or (
@@ -363,6 +370,10 @@ def run_replay(
             t.name: [] for t in synth.tenants
         }
         issued: Dict[str, int] = {t.name: 0 for t in synth.tenants}
+        # one entry per SUCCESSFUL step: the trace id the client minted
+        # for that forwarded invocation (None when the forward fell back
+        # in-process — then no daemon flight record exists to match)
+        trace_ids: List[Optional[str]] = []
         errors: List[Dict[str, Any]] = []
         parity: Optional[Dict[str, Any]] = None
         parity_step = cfg.requests // 2 if cfg.parity_sample else -1
@@ -385,6 +396,11 @@ def run_replay(
                     "rc_local": rc_l, "stdout_local": out_l.getvalue(),
                 }
             out, err = io.StringIO(), io.StringIO()
+            # clear any stale trace id first: against an in-process
+            # multi-lane daemon the registry is SHARED (daemon-lifetime
+            # stores, no begin_invocation reset), so without this a
+            # fallback step would re-read the previous step's id
+            obs_metrics.gauge("client.trace_id", None)
             t0 = time.perf_counter()
             rc = cli.run(io.StringIO(text), out, err, argv)
             wall = time.perf_counter() - t0
@@ -407,6 +423,12 @@ def run_replay(
                 continue
             walls[tenant.name].append(wall)
             issued[tenant.name] += 1
+            # the served invocation's trace id: the edge recorder
+            # published it as a gauge right before cli.run returned
+            # (the registry is only reset by the NEXT invocation's
+            # begin_invocation, so the read-after-return is safe)
+            tid = obs_metrics.snapshot()["gauges"].get("client.trace_id")
+            trace_ids.append(tid if isinstance(tid, str) and tid else None)
             tenant.apply_plan(out.getvalue())
         wall_s = time.perf_counter() - t_run0
 
@@ -429,7 +451,7 @@ def run_replay(
                     ]
         return _build_artifact(
             cfg, synth, walls, issued, errors, parity, baseline, doc,
-            flight_requests, wall_s,
+            flight_requests, wall_s, trace_ids,
         )
     finally:
         if spawned is not None:
@@ -772,7 +794,7 @@ def _run_restart(cfg: ReplayConfig, _log: LogFn) -> Dict[str, Any]:
     requests answered from spill, i.e. no re-register storm), the
     pre/post-restart latency percentiles (the restart-recovery curve
     BENCH_r06 records), and the warm tier's conservation identity
-    reconciled exactly from the serve-stats/7 ``paging`` scrape.
+    reconciled exactly from the serve-stats/8 ``paging`` scrape.
 
     ``chaos_faults`` arms the PRE-kill daemon (a seeded
     ``spill_corrupt`` makes a tenant's recovery a cold-but-correct
@@ -1221,6 +1243,7 @@ def _build_artifact(
     doc: Optional[Dict[str, Any]],
     flight_requests: List[Dict[str, Any]],
     wall_s: float,
+    trace_ids: Optional[List[Optional[str]]] = None,
 ) -> Dict[str, Any]:
     tenants_block = (
         doc.get("tenants") if isinstance(doc, dict) else None
@@ -1322,13 +1345,56 @@ def _build_artifact(
             )
         per_tenant[name] = rec
 
+    # -- end-to-end trace-id reconciliation (replay/5): every served
+    # request's daemon flight record must carry the client's trace id,
+    # EXACTLY. The client minted one id per forwarded invocation (read
+    # back from the ``client.trace_id`` gauge); the daemon stamped it
+    # into the flight ring's per-request record. Verifiable only when
+    # every successful step actually forwarded (no fallbacks — a
+    # fallback leaves no flight record to match) and the ring still
+    # holds one record per issued request (512-entry ring, shared
+    # daemons pollute it). Unverifiable is flagged unchecked, never
+    # conflated with a reconciliation failure.
+    captured = [t for t in (trace_ids or []) if isinstance(t, str)]
+    flight_trace_counts: Dict[str, int] = {}
+    flight_tagged = 0
+    for r in flight_requests:
+        rt = r.get("trace")
+        if isinstance(rt, str):
+            flight_tagged += 1
+            flight_trace_counts[rt] = flight_trace_counts.get(rt, 0) + 1
+    n_issued_total = sum(issued.values())
+    trace_checked = (
+        n_issued_total > 0
+        and len(captured) == n_issued_total
+        and len(flight_requests) == n_issued_total
+    )
+    if trace_checked:
+        trace_ok = (
+            len(set(captured)) == len(captured)
+            and flight_tagged == n_issued_total
+            and all(
+                flight_trace_counts.get(t, 0) == 1 for t in captured
+            )
+        )
+    else:
+        trace_ok = True  # vacuous; flagged via "checked" below
+    trace_block = {
+        "ids_issued": len(captured),
+        "ids_unique": len(set(captured)) == len(captured),
+        "flight_tagged": flight_tagged,
+        "flight_records": len(flight_requests),
+        "checked": trace_checked,
+        "reconciled": trace_ok,
+    }
+
     sessions = (doc or {}).get("sessions") or {}
     total = sum(issued.values())
     fallbacks_total = sum(
         e.get("fallbacks", 0) for e in per_tenant.values()
         if isinstance(e, dict)
     )
-    reconciled = counts_ok and latency_ok and not errors
+    reconciled = counts_ok and latency_ok and trace_ok and not errors
     if parity is not None and "ok" not in parity:
         # safety net: never let the raw plan text reach the artifact
         parity.pop("stdout_local", None)
@@ -1386,6 +1452,9 @@ def _build_artifact(
             for e in per_tenant.values() if e["issued"]
         ),
         "reconciled_latency": latency_ok,
+        # the trace-id reconciliation evidence (see the block above);
+        # its verdict participates in "reconciled"
+        "trace": trace_block,
         "reconciled": reconciled,
     }
 

@@ -15,6 +15,7 @@ daemon is that the client process never pays the jax import.
 
 from __future__ import annotations
 
+import contextlib
 import os
 import random
 import socket
@@ -79,11 +80,18 @@ class _Overload(Exception):
 
 
 class ServedResult(NamedTuple):
-    """One forwarded invocation's outcome, relayed verbatim."""
+    """One forwarded invocation's outcome, relayed verbatim.
+
+    ``trace`` is the daemon's reply footer when the request carried a
+    trace context (v2 only): the request's trace id, daemon wall and
+    the bounded daemon span subtree — raw daemon ``perf_counter_ns``
+    stamps the caller maps through its clock-offset estimate
+    (obs/edge.py). None on v1 exchanges and for trace-less requests."""
 
     rc: int
     stdout: str
     stderr: str
+    trace: Optional[Dict[str, Any]] = None
 
 
 class SessionSpec(NamedTuple):
@@ -248,6 +256,7 @@ def forward_plan(
     note: Optional[Callable[[str], None]] = None,
     tenant: str = "",
     client_timeout: float = 0.0,
+    edge: Any = None,
 ) -> Optional[ServedResult]:
     """Forward one invocation to the daemon at ``path``.
 
@@ -292,6 +301,15 @@ def forward_plan(
     (``op: "overload"``) responses are retried with capped, jittered
     exponential backoff honoring ``retry_after_ms`` before the
     in-process fallback (attributed ``overload``).
+
+    ``edge`` is the CLI's edge recorder (obs/edge.py ``EdgeContext``),
+    DUCK-TYPED so this module never imports ``obs``: when given, the
+    connect/handshake/digest/send/wait/receive phases are timed, the
+    hello requests the daemon's clock stamps (one NTP-style offset
+    sample per handshake), and every plan-family v2 header carries the
+    recorder's trace context. ``edge=None`` (every pre-existing caller)
+    changes nothing — and a v1 exchange stays byte-identical either
+    way except for the opt-in ``clock`` hello key.
     """
 
     def _declined(reason: str) -> None:
@@ -308,7 +326,13 @@ def forward_plan(
             except Exception:
                 pass
 
-    sock = _connect(path, connect_timeout)
+    def _phase(name: str) -> "contextlib.AbstractContextManager[Any]":
+        if edge is not None:
+            return edge.phase(name)
+        return contextlib.nullcontext()
+
+    with _phase("connect"):
+        sock = _connect(path, connect_timeout)
     if sock is None:
         _note("daemon_down")
         return None
@@ -319,14 +343,27 @@ def forward_plan(
     budget = client_timeout if client_timeout > 0 else plan_timeout
     deadline = time.monotonic() + budget
     try:
-        write_frame(
-            sock, {"v": PROTO_VERSION, "op": "hello", "max_v": PROTO_V2}
-        )
-        hello = read_frame(sock)
+        hello_req: Dict[str, Any] = {
+            "v": PROTO_VERSION, "op": "hello", "max_v": PROTO_V2,
+        }
+        if edge is not None:
+            # opt-in clock handshake: ONLY a hello carrying this key
+            # gets monotonic stamps back, so scrape hellos (and their
+            # hello-vs-stats key parity pin) are untouched
+            hello_req["clock"] = True
+        with _phase("handshake"):
+            t_hello0 = time.perf_counter_ns()
+            write_frame(sock, hello_req)
+            hello = read_frame(sock)
+            t_hello1 = time.perf_counter_ns()
         if not _hello_ok(hello):
             _note("handshake_mismatch")
             return None
         assert isinstance(hello, dict)
+        if edge is not None:
+            edge.note_clock_sample(
+                t_hello0, hello.get("clock"), t_hello1
+            )
         max_v = hello.get("max_v")
         v2 = isinstance(max_v, int) and max_v >= PROTO_V2
         # writes need a generous timeout too: a multi-MB register blob
@@ -353,6 +390,7 @@ def forward_plan(
                         path=path, deadline=deadline, progress=progress,
                         send_deadline=not progress,
                         state_cache=state_cache,
+                        edge=edge,
                     )
                 req: Dict[str, Any] = {
                     "v": PROTO_VERSION, "op": "plan", "argv": argv,
@@ -362,7 +400,8 @@ def forward_plan(
                 if stdin_text is not None:
                     req["stdin"] = stdin_text
                 try:
-                    write_frame(sock, req)
+                    with _phase("send"):
+                        write_frame(sock, req)
                 except ValueError as exc:
                     # the input is too large for one protocol frame — a
                     # positive local refusal, not a daemon failure
@@ -371,8 +410,10 @@ def forward_plan(
                     )
                     _note("frame_cap")
                     return None
-                _await_reply(sock, path, deadline, progress)
-                resp = read_frame(sock)
+                with _phase("wait_first_byte"):
+                    _await_reply(sock, path, deadline, progress)
+                with _phase("receive"):
+                    resp = read_frame(sock)
                 if (
                     isinstance(resp, dict)
                     and resp.get("op") == "overload"
@@ -444,10 +485,12 @@ def _v2_result(
         else:
             _note("transport_error")
         return None
+    footer = hdr.get("trace")
     return ServedResult(
         rc=int(hdr["rc"]),
         stdout=blob.decode("utf-8", errors="replace"),
         stderr=str(hdr.get("stderr", "")),
+        trace=footer if isinstance(footer, dict) else None,
     )
 
 
@@ -465,6 +508,7 @@ def _forward_v2(
     progress: bool,
     send_deadline: bool,
     state_cache: Dict[str, Any],
+    edge: Any = None,
 ) -> Optional[ServedResult]:
     """The v2 exchange after a successful hello negotiation: the
     session ladder (plan-delta -> plan-rows -> register) when a session
@@ -474,15 +518,32 @@ def _forward_v2(
     ``send_deadline`` adds the remaining budget as ``deadline_ms``.
     The wait-contract parameters are keyword-REQUIRED: a caller that
     forgot them would silently disable wedge detection and deadlines."""
-    from kafkabalancer_tpu.serve import state as sstate
+    def _phase(name: str) -> "contextlib.AbstractContextManager[Any]":
+        if edge is not None:
+            return edge.phase(name)
+        return contextlib.nullcontext()
+
+    # loading serve/state pulls in the codecs readers — a multi-ms
+    # one-time cost that is digest machinery, so on the session path it
+    # must land in the digest phase rather than an unattributed gap
+    with (_phase("digest") if session is not None
+          else contextlib.nullcontext()):
+        from kafkabalancer_tpu.serve import state as sstate
 
     def _read2() -> "Optional[Tuple[Dict[str, Any], bytes]]":
-        _await_reply(sock, path, deadline, progress)
-        return read_frame2(sock)
+        with _phase("wait_first_byte"):
+            _await_reply(sock, path, deadline, progress)
+        with _phase("receive"):
+            return read_frame2(sock)
 
     def _stamp(hdr: Dict[str, Any]) -> Dict[str, Any]:
         if send_deadline:
             hdr["deadline_ms"] = _remaining_ms(deadline)
+        if edge is not None:
+            # the trace context rides EVERY plan-family v2 header (the
+            # pre-send client phases are final by the first send; a
+            # ladder follow-up or overload retry re-stamps the same id)
+            hdr["trace"] = edge.trace_context()
         return hdr
 
     state = None
@@ -495,9 +556,10 @@ def _forward_v2(
         if "state" in state_cache:
             state = state_cache["state"]
         else:
-            state = state_cache["state"] = sstate.client_state(
-                session.text, session.is_json, session.topics
-            )
+            with _phase("digest"):
+                state = state_cache["state"] = sstate.client_state(
+                    session.text, session.is_json, session.topics
+                )
     if state is None or session is None:
         hdr: Dict[str, Any] = {
             "v": PROTO_V2, "op": "plan", "argv": argv,
@@ -509,17 +571,20 @@ def _forward_v2(
             hdr["tenant"] = tenant
         blob = stdin_text.encode("utf-8") if stdin_text is not None else b""
         try:
-            write_frame2(sock, _stamp(hdr), blob)
+            with _phase("send"):
+                write_frame2(sock, _stamp(hdr), blob)
         except ValueError as exc:
             _declined(f"request exceeds the protocol frame cap: {exc}")
             _note("frame_cap")
             return None
         return _v2_result(_read2(), _declined, _note)
 
-    write_frame2(sock, _stamp({
-        "v": PROTO_V2, "op": "plan-delta", "tenant": session.tenant,
-        "digest": state.digest, "nrows": len(state.canon), "argv": argv,
-    }))
+    with _phase("send"):
+        write_frame2(sock, _stamp({
+            "v": PROTO_V2, "op": "plan-delta", "tenant": session.tenant,
+            "digest": state.digest, "nrows": len(state.canon),
+            "argv": argv,
+        }))
     resp = _read2()
     if resp is None:
         _note("transport_error")
@@ -546,11 +611,12 @@ def _forward_v2(
                 [(i, state.rows[i]) for i in changed]
             )
             try:
-                write_frame2(sock, _stamp({
-                    "v": PROTO_V2, "op": "plan-rows",
-                    "tenant": session.tenant, "digest": state.digest,
-                    "argv": argv,
-                }), rows_blob)
+                with _phase("send"):
+                    write_frame2(sock, _stamp({
+                        "v": PROTO_V2, "op": "plan-rows",
+                        "tenant": session.tenant, "digest": state.digest,
+                        "argv": argv,
+                    }), rows_blob)
             except ValueError as exc:
                 _declined(
                     f"request exceeds the protocol frame cap: {exc}"
@@ -571,10 +637,12 @@ def _forward_v2(
         # even this worst case skips the JSON escape pass
         _note("session_resync_full")
         try:
-            write_frame2(sock, _stamp({
-                "v": PROTO_V2, "op": "register", "tenant": session.tenant,
-                "argv": argv, "has_stdin": True,
-            }), session.text.encode("utf-8"))
+            with _phase("send"):
+                write_frame2(sock, _stamp({
+                    "v": PROTO_V2, "op": "register",
+                    "tenant": session.tenant,
+                    "argv": argv, "has_stdin": True,
+                }), session.text.encode("utf-8"))
         except ValueError as exc:
             _declined(f"request exceeds the protocol frame cap: {exc}")
             _note("frame_cap")

@@ -69,6 +69,7 @@ from typing import (
 )
 
 from kafkabalancer_tpu import __version__, obs
+from kafkabalancer_tpu.obs.edge import FOOTER_SPAN_CAP
 from kafkabalancer_tpu.obs.flight import PHASE_OF_SPAN, FlightRecorder
 from kafkabalancer_tpu.obs.hist import OTHER_LABEL
 from kafkabalancer_tpu.obs.trace import Span
@@ -103,7 +104,9 @@ DISPATCHER_WAIT_S = 600.0
 # the per-tenant label families the daemon feeds (obs.metrics registry,
 # bounded top-K + "other"); created at startup so the configured
 # tenant cap applies before the first observation
-_TENANT_HIST_FAMILIES = ("serve.request_s", "serve.phase.queue")
+_TENANT_HIST_FAMILIES = (
+    "serve.request_s", "serve.phase.queue", "serve.edge_ms",
+)
 _TENANT_COUNTER_FAMILIES = (
     "serve.requests", "serve.crashed_requests", "serve.delta_hits",
     "serve.resyncs_rows", "serve.resyncs_full", "serve.fallbacks",
@@ -149,7 +152,7 @@ class PlanRequest:
     __slots__ = (
         "argv", "stdin", "done", "response", "bucket", "bucketed", "staged",
         "mb_entered", "t_submit", "session_ctx", "tenant", "deadline",
-        "started", "internal",
+        "started", "internal", "trace",
     )
 
     def __init__(
@@ -192,6 +195,12 @@ class PlanRequest:
         # the flight request log or the `abandoned` identity — they
         # carry their own serve.spec.*/serve.watch.* telemetry
         self.internal: Optional[str] = None
+        # the client's trace context from the v2 header ("trace" key:
+        # id / parent / pre-send client phases / edge_pre_ms / rtt_ns,
+        # serve/protocol.py § End-to-end tracing); None on v1 frames
+        # and trace-less clients. Pure telemetry — never a correctness
+        # input, like `tenant`.
+        self.trace: Optional[Dict[str, Any]] = None
 
 
 class Coalescer:
@@ -756,6 +765,38 @@ class Daemon:
             "serve.requests": float(n),
             "serve.coalesced": float(n_coal),
         }
+        trace = req.trace if isinstance(req.trace, dict) else None
+        if trace is not None and internal is None:
+            # the client's trace context rides INTO the daemon-written
+            # -metrics-json line: one causal record per invocation —
+            # the trace id plus the client's pre-send edge phases as
+            # client.phase.* gauges (obs/edge.py glossary). The tenant
+            # serve.edge_ms family attributes client+network overhead
+            # (pre-send phases + measured RTT) per label in the scrape.
+            tid_hex = str(trace.get("id") or "")
+            if tid_hex:
+                attrs["trace_id"] = tid_hex
+            cphases = trace.get("phases")
+            if isinstance(cphases, dict):
+                for key, val in sorted(cphases.items()):
+                    if isinstance(val, (int, float)) and not isinstance(
+                        val, bool
+                    ):
+                        attrs[f"client.phase.{key}"] = round(
+                            float(val), 6
+                        )
+            edge_pre = trace.get("edge_pre_ms")
+            if isinstance(edge_pre, (int, float)) and not isinstance(
+                edge_pre, bool
+            ):
+                total_ms = float(edge_pre)
+                rtt_ns = trace.get("rtt_ns")
+                if isinstance(rtt_ns, int) and rtt_ns > 0:
+                    total_ms += rtt_ns / 1e6
+                attrs["client.edge_pre_ms"] = round(total_ms, 3)
+                obs.metrics.tenant_hist_observe(
+                    "serve.edge_ms", tenant_label, total_ms
+                )
         ctx = req.session_ctx
         if req.tenant:
             # the tenant rides the request's own -metrics-json line too:
@@ -1036,7 +1077,34 @@ class Daemon:
                     "phases": {k: round(v, 6) for k, v in sorted(
                         phases.items()
                     )},
+                    # end-to-end reconciliation (replay/harness.py):
+                    # every served request's flight record carries the
+                    # client's trace id, exactly; None for trace-less
+                    # (v1 / non-edge) clients
+                    "trace": (
+                        str(trace["id"])
+                        if trace is not None and trace.get("id")
+                        else None
+                    ),
                 })
+            if (
+                trace is not None
+                and internal is None
+                and isinstance(req.response, dict)
+                and req.response.get("ok")
+            ):
+                # the reply footer: this request's bounded daemon span
+                # subtree rides back for the client's merged -trace
+                # timeline (serve/protocol.py § End-to-end tracing).
+                # Raw perf_counter_ns stamps — the client maps them
+                # through its handshake clock-offset estimate.
+                req.response["trace"] = {
+                    "id": trace.get("id"),
+                    "wall_s": round(wall, 6),
+                    "spans": self.flight.spans_for_thread(
+                        thread_name, cap=FOOTER_SPAN_CAP
+                    ),
+                }
             if rc_val is None and internal is None:
                 with self._lock:
                     self._crashed += 1
@@ -1311,7 +1379,7 @@ class Daemon:
             # resident cluster sessions (serve/sessions.py): count,
             # resident bytes, delta hits/resyncs — serve-stats/3
             "sessions": self.sessions.stats(),
-            # the warm session tier (serve/spill.py; serve-stats/7):
+            # the warm session tier (serve/spill.py; serve-stats/8):
             # spill/restore/corrupt-drop counters under the
             # conservation identity spills + adopted == restores +
             # corrupt_drops + evictions + warm_entries, plus the live
@@ -1320,11 +1388,11 @@ class Daemon:
                 self.spill.stats() if self.spill is not None
                 else spill_mod.SpillStore.disabled_stats()
             ),
-            # speculative plan-ahead (serve-stats/7; serve/speculate.py)
+            # speculative plan-ahead (serve-stats/8; serve/speculate.py)
             # under the exact identity attempts == hits + misses +
             # poisoned + memos at every scrape instant
             "speculation": self.speculator.stats(),
-            # the watch-driven continuous controller (serve-stats/7):
+            # the watch-driven continuous controller (serve-stats/8):
             # ticks/reads/lag + emitted-plan attribution; same key set
             # with the mode off
             "watch": (
@@ -1414,6 +1482,9 @@ class Daemon:
         queue_fam = hfams.get("serve.phase.queue") or {
             "other": None, "labels": {},
         }
+        edge_fam = hfams.get("serve.edge_ms") or {
+            "other": None, "labels": {},
+        }
 
         def cval(name: str, label: str) -> int:
             fam = cfams.get(name)
@@ -1460,11 +1531,19 @@ class Daemon:
                 queue_fam.get("other") if label == OTHER_LABEL
                 else queue_fam["labels"].get(label)
             )
+            edge = (
+                edge_fam.get("other") if label == OTHER_LABEL
+                else edge_fam["labels"].get(label)
+            )
             return {
                 "requests": cval("serve.requests", label),
                 "crashed": cval("serve.crashed_requests", label),
                 "request_s": hist,
                 "queue_s": queue,
+                # serve-stats/8: client+network edge overhead per label
+                # (pre-send client phases + measured RTT, milliseconds)
+                # — None until a tracing client reports (obs/edge.py)
+                "edge_ms": edge,
                 "delta_hits": cval("serve.delta_hits", label),
                 "spec_hits": cval("serve.spec.hits", label),
                 "resyncs_rows": cval("serve.resyncs_rows", label),
@@ -1585,10 +1664,17 @@ class Daemon:
                 "v": PROTO_V2, "ok": False, "op": "error",
                 "error": str(resp.get("error", "request failed")),
             }, b""
-        return {
+        hdr: Dict[str, Any] = {
             "v": PROTO_V2, "ok": True, "rc": int(resp.get("rc", -1)),
             "stderr": str(resp.get("stderr", "")),
-        }, str(resp.get("stdout", "")).encode("utf-8")
+        }
+        if isinstance(resp.get("trace"), dict):
+            # the reply footer (daemon span subtree + wall) rides the
+            # v2 header back to tracing clients — ONLY when the request
+            # carried a trace context, so trace-less clients see the
+            # exact pre-tracing header shape
+            hdr["trace"] = resp["trace"]
+        return hdr, str(resp.get("stdout", "")).encode("utf-8")
 
     def _checkout_or_restore(
         self, key: Tuple[str, str], tenant: str
@@ -1654,6 +1740,7 @@ class Daemon:
         deadline: Optional[float],
         argv: List[str],
         t0: float,
+        trace: Optional[Dict[str, Any]] = None,
     ) -> Dict[str, Any]:
         """Answer a digest-and-argv-matching ``plan-delta`` from the
         speculative memo (serve/speculate.py): ZERO dispatch, ZERO
@@ -1711,12 +1798,28 @@ class Daemon:
                 "spec_hit": True,
                 "wall_s": round(wall, 6),
                 "phases": {},
+                "trace": (
+                    str(trace["id"])
+                    if trace is not None and trace.get("id")
+                    else None
+                ),
             })
             self._touch()
-            return {
+            resp: Dict[str, Any] = {
                 "v": PROTO_VERSION, "ok": True, "rc": memo.rc,
                 "stdout": memo.stdout, "stderr": memo.stderr,
             }
+            if trace is not None:
+                # the memo hit ran no request thread, so the footer's
+                # span subtree is empty — spec_hit marks WHY for the
+                # merged timeline (the answer predates the question)
+                resp["trace"] = {
+                    "id": trace.get("id"),
+                    "wall_s": round(wall, 6),
+                    "spans": [],
+                    "spec_hit": True,
+                }
+            return resp
         finally:
             self._admission.release(req)
 
@@ -1740,16 +1843,20 @@ class Daemon:
 
         tenant = str(hdr.get("tenant", ""))
         deadline = _deadline_of(hdr)
+        # the client's trace context (obs/edge.py), v2-only by
+        # construction — v1 frames never reach this parser. Telemetry
+        # only: it is threaded onto every PlanRequest the op creates
+        # and NEVER read by planning.
+        trace_hdr = hdr.get("trace")
+        trace = trace_hdr if isinstance(trace_hdr, dict) else None
         if op == "plan":
             stdin = (
                 blob.decode("utf-8", errors="replace")
                 if hdr.get("has_stdin") else None
             )
-            return self._v2_plan_resp(
-                self._dispatch_plan(
-                    PlanRequest(argv, stdin, tenant, deadline=deadline)
-                )
-            )
+            req = PlanRequest(argv, stdin, tenant, deadline=deadline)
+            req.trace = trace
+            return self._v2_plan_resp(self._dispatch_plan(req))
 
         key = (tenant, flags_signature(argv))
         if op == "register":
@@ -1762,6 +1869,7 @@ class Daemon:
                 sess.in_use = True
                 try:
                     req = PlanRequest(argv, text, tenant, deadline=deadline)
+                    req.trace = trace
                     req.session_ctx = ctx
                     sess.last_argv = list(argv)
                     resp = self._dispatch_plan(req)
@@ -1815,7 +1923,7 @@ class Daemon:
                         # falls through to the live ladder below)
                         resp = self._answer_from_memo(
                             key, sess, memo, tenant, deadline, argv,
-                            t_hit0,
+                            t_hit0, trace=trace,
                         )
                         enqueue_spec = bool(resp.get("ok"))
                         return self._v2_plan_resp(resp)
@@ -1851,6 +1959,7 @@ class Daemon:
                     req = PlanRequest(
                         argv, None, tenant, deadline=deadline
                     )
+                    req.trace = trace
                     req.session_ctx = ctx
                     sess.last_argv = list(argv)
                     resp = self._dispatch_plan(req)
@@ -1926,6 +2035,7 @@ class Daemon:
                 )
                 ctx = PlanSessionContext("rows", sess, restored=restored)
                 req = PlanRequest(argv, None, tenant, deadline=deadline)
+                req.trace = trace
                 req.session_ctx = ctx
                 sess.last_argv = list(argv)
                 resp = self._dispatch_plan(req)
@@ -2096,7 +2206,24 @@ class Daemon:
                 # scraper (-metrics-prom on a cron) must not pin an
                 # otherwise-idle daemon alive past -serve-idle-timeout
                 if op == "hello":
-                    write_frame(conn, self._hello())
+                    t_hello_ns = time.perf_counter_ns()
+                    doc = self._hello()
+                    if msg.get("clock"):
+                        # the opt-in clock handshake (obs/edge.py):
+                        # daemon-monotonic receive/send stamps for the
+                        # client's NTP-style offset estimate. STRICTLY
+                        # request-gated — a plain hello (liveness
+                        # probes, the stats scraper's handshake) gets
+                        # the exact historical doc, preserving the
+                        # hello/stats key-parity contract. recv is
+                        # stamped post-read, so any parse delay inflates
+                        # the client's RTT bound, never skews the
+                        # offset midpoint.
+                        doc["clock"] = {
+                            "recv_ns": t_hello_ns,
+                            "send_ns": time.perf_counter_ns(),
+                        }
+                    write_frame(conn, doc)
                     mv = msg.get("max_v")
                     if isinstance(mv, int) and mv >= PROTO_V2:
                         # both sides advertised v2: every further frame

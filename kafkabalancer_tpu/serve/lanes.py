@@ -642,6 +642,17 @@ class LaneScheduler:
             self._occupancy[occupancy] = (
                 self._occupancy.get(occupancy, 0) + 1
             )
+        # dispatch-TIME distributions (the BENCH_r06 diagnosis seam):
+        # the start-gauge snapshots bench scraped before could only
+        # show cumulative occupancy_max/padded_slots, hiding whether
+        # continuous mode actually fuses wider per dispatch than
+        # oneshot. One observation per fused dispatch, recorded as the
+        # dispatch lands — the throughput artifact reads these hists
+        # directly (bench.py).
+        obs.metrics.hist_observe(
+            "serve.dispatch_occupancy", float(occupancy)
+        )
+        obs.metrics.hist_observe("serve.dispatch_padded", float(padded))
 
     # -- lane health -------------------------------------------------------
     def health_stats(self) -> Dict[str, Any]:

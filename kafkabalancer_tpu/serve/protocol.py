@@ -82,6 +82,26 @@ v2-only session ops (serve/sessions.py, docs/serving.md):
 - ``release``    — drop a tenant's resident sessions, hot AND warm
   (the response reports both: ``released`` / ``released_warm``).
 
+End-to-end tracing (obs/edge.py, docs/observability.md § End-to-end
+tracing): a plan-family **v2** header may carry ``"trace"`` — the
+client's compact trace context ``{"id": <16 hex>, "parent": <client
+forward-span sid>, "phases": {<pre-send client phase>: seconds},
+"edge_pre_ms": N, "rtt_ns": N}``. The daemon adopts the remote trace:
+its request span attribution carries the trace id, its flight record
+stores it, and the client's pre-send phases land in the served
+request's metrics export as ``client.phase.*`` gauges. The matching
+**reply footer** rides the v2 response header as ``"trace"``: ``{"id",
+"wall_s", "spans": [<= FOOTER span records from the request thread's
+flight ring, raw daemon perf_counter_ns stamps]}`` — bounded, so a
+footer can never dominate a reply. Clock alignment: a client hello may
+carry ``"clock": true``; ONLY then does the hello response add
+``"clock": {"recv_ns", "send_ns"}`` (daemon ``perf_counter_ns`` at
+hello receipt/reply), giving the client one NTP-style 4-stamp sample
+per handshake (obs/edge.py ``estimate_offset``). v1 frames NEVER carry
+any of this — a v1 exchange stays byte-identical to every prior
+release, and scrape hellos that omit the clock key get the exact
+pre-v8 hello document.
+
 Session durability (serve/spill.py, docs/serving.md § Session
 durability): with ``-serve-session-spill-dir`` set, evicted/expired/
 flushed sessions persist as checksummed disk records, and a
@@ -105,7 +125,7 @@ import os
 import socket
 import struct
 import tempfile
-from typing import Any, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 PROTO_VERSION = 1
 # the binary-frame extension, negotiated per connection at hello; the
@@ -140,7 +160,11 @@ PROTO_V2 = 2
 #     -watch continuous controller: ticks / reads / events / resyncs /
 #     plans_emitted / lag fields; same key set with the mode off), and
 #     per-tenant "spec_hits" in the tenants block
-STATS_SCHEMA_VERSION = 7
+# v8: + per-tenant "edge_ms" in the tenants block (the client-reported
+#     edge cost — pre-send phase wall + wire RTT — as a streaming hist
+#     per top-K tenant, from each request's trace context; null for
+#     tenants whose clients never sent one)
+STATS_SCHEMA_VERSION = 8
 STATS_SCHEMA = f"kafkabalancer-tpu.serve-stats/{STATS_SCHEMA_VERSION}"
 
 # a frame larger than this is a protocol error, not a payload: the
@@ -198,12 +222,20 @@ def _recv_exact(sock: socket.socket, n: int) -> Optional[bytes]:
     return b"".join(chunks)
 
 
-def read_frame(sock: socket.socket) -> Optional[Dict[str, Any]]:
+def read_frame(
+    sock: socket.socket,
+    on_first: Optional[Callable[[], None]] = None,
+) -> Optional[Dict[str, Any]]:
     """One frame as a dict, or None on clean EOF. Raises on truncation,
-    an oversized length prefix, or non-JSON payload."""
+    an oversized length prefix, or non-JSON payload. ``on_first`` (when
+    given) fires once the length prefix has arrived — the seam the edge
+    recorder uses to split ``wait_first_byte`` from ``receive`` without
+    a second syscall layer; it must not raise."""
     head = _recv_exact(sock, _LEN.size)
     if head is None:
         return None
+    if on_first is not None:
+        on_first()
     (n,) = _LEN.unpack(head)
     if n > MAX_FRAME_BYTES:
         raise ValueError(f"frame length {n} exceeds {MAX_FRAME_BYTES}")
@@ -241,13 +273,17 @@ def write_frame2(
 
 def read_frame2(
     sock: socket.socket,
+    on_first: Optional[Callable[[], None]] = None,
 ) -> Optional[Tuple[Dict[str, Any], bytes]]:
     """One v2 frame as ``(header, blob)``, or None on clean EOF at a
     frame boundary. Raises on truncation, oversized lengths, or a
-    non-JSON header — exactly the v1 error model."""
+    non-JSON header — exactly the v1 error model. ``on_first`` is the
+    same first-byte seam as :func:`read_frame`."""
     head = _recv_exact(sock, _LEN2.size)
     if head is None:
         return None
+    if on_first is not None:
+        on_first()
     hn, bn = _LEN2.unpack(head)
     if hn > MAX_FRAME_BYTES or bn > MAX_FRAME_BYTES:
         raise ValueError(

@@ -29,7 +29,7 @@ Durability model (docs/serving.md § Session durability):
   recovery) and its ``*.tmp`` write orphans swept.
 
 Accounting is conservation-exact, scraped as the ``paging`` block of
-``serve-stats/7``::
+``serve-stats/8``::
 
     spills + adopted == restores + corrupt_drops + evictions
                         + warm_entries
@@ -495,7 +495,7 @@ class SpillStore:
 
     # -- accounting ------------------------------------------------------
     def stats(self) -> Dict[str, Any]:
-        """The scrape's ``paging`` block (serve-stats/7)."""
+        """The scrape's ``paging`` block (serve-stats/8)."""
         with self._lock:
             return {
                 "enabled": True,

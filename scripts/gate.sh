@@ -30,6 +30,14 @@
 #   7. serve smoke      — start the planning daemon, plan through it,
 #                         assert byte parity with the in-process path,
 #                         clean shutdown (docs/serving.md)
+#   7b. e2e-trace smoke — a served invocation with -trace: ONE merged
+#                         Perfetto doc with client + daemon process
+#                         tracks under a single trace id, daemon spans
+#                         parented under the client's serve.forward
+#                         span and never starting before it, and the
+#                         daemon-written -metrics-json carrying the
+#                         trace id + client.phase.* edge attribution
+#                         (docs/observability.md § End-to-end tracing)
 #   8. fused-shard      — byte parity of the sharded session vs the
 #      parity smoke       single-device plan, on real multi-device
 #                         hosts or a faked 2-device CPU mesh (skips on
@@ -58,7 +66,7 @@
 #                         present via -serve-stats-json
 #  10b. speculative     — register -> 3 outer-loop moves with
 #      plan-ahead smoke    memoizable answers: >= 1 serve.spec hit via
-#                         the serve-stats/7 scrape (attribution
+#                         the serve-stats/8 scrape (attribution
 #                         required), the speculation identity exact,
 #                         byte parity vs -no-daemon at every step
 #  10c. watch-mode      — a -watch daemon over the fake-ZK seam emits
@@ -66,7 +74,7 @@
 #                         identical to -no-daemon on the same state;
 #                         watch lag observable via the `watch` op
 #  11. replay smoke     — seeded 3-tenant churn replay against a
-#                         private daemon: serve-stats/7 schema,
+#                         private daemon: serve-stats/8 schema,
 #                         per-tenant counts reconciling exactly with
 #                         the driver, scrape-vs-flight latency within
 #                         one histogram bucket, plan byte parity vs
@@ -322,6 +330,82 @@ else
 fi
 rm -rf "$serve_tmp"
 
+step "e2e-trace smoke (merged client+daemon timeline, one trace id)"
+# The end-to-end tracing tentpole (docs/observability.md § End-to-end
+# tracing): a forwarded invocation with -trace must write ONE merged
+# Perfetto document — the client's edge phase chain plus the daemon's
+# reply-footer span subtree on a second process track, aligned by the
+# handshake clock-offset estimate — and the daemon-written
+# -metrics-json line must carry the same trace id with client.phase.*
+# edge attribution. A subprocess daemon: two processes, two clocks.
+et_tmp=$(mktemp -d)
+et_sock="$et_tmp/kb.sock"
+JAX_PLATFORMS=cpu JAX_COMPILATION_CACHE_DIR="$et_tmp" \
+  "$PYTHON" -m kafkabalancer_tpu -serve "-serve-socket=$et_sock" \
+  -serve-idle-timeout=120 -serve-lanes=1 >"$et_tmp/daemon.log" 2>&1 &
+et_pid=$!
+et_ready=0
+for _ in $(seq 1 60); do
+  if "$PYTHON" -c "import sys
+from kafkabalancer_tpu.serve.client import daemon_alive
+sys.exit(0 if daemon_alive('$et_sock') else 1)" 2>/dev/null; then
+    et_ready=1; break
+  fi
+  sleep 0.25
+done
+if [ "$et_ready" = 1 ]; then
+  if JAX_PLATFORMS=cpu "$PYTHON" -m kafkabalancer_tpu \
+      -input-json -input tests/data/test.json "-serve-socket=$et_sock" \
+      "-trace=$et_tmp/merged.trace.json" \
+      "-metrics-json=$et_tmp/served.metrics.json" \
+      >/dev/null 2>"$et_tmp/client.log" \
+    && "$PYTHON" - "$et_tmp" <<'EOF'
+import json, os, sys
+tmp = sys.argv[1]
+doc = json.load(open(os.path.join(tmp, "merged.trace.json")))
+other = doc["otherData"]
+assert other["served"] is True, "forward fell back in-process"
+tid = other["trace_id"]
+assert isinstance(tid, str) and len(tid) == 16
+xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+daemon_x = [e for e in xs if e.get("args", {}).get("daemon")]
+client_x = [e for e in xs if not e.get("args", {}).get("daemon")]
+assert daemon_x, "no daemon track in the merged doc"
+names = {e["name"] for e in client_x}
+for p in ("client.input_read", "client.send", "client.receive"):
+    assert p in names, sorted(names)
+fwd = [e for e in client_x if e["name"] == "serve.forward"]
+assert len(fwd) == 1 and fwd[0]["args"]["trace_id"] == tid
+fwd_sid = next(e["args"]["parent_sid"] for e in client_x
+               if e["name"] == "client.send")
+for e in daemon_x:
+    assert e["args"]["trace_id"] == tid
+    assert e["args"]["parent_sid"] == fwd_sid
+    assert e["ts"] >= fwd[0]["ts"], "daemon span precedes its parent"
+m = json.load(open(os.path.join(tmp, "served.metrics.json")))
+g = m["gauges"]
+assert g["trace_id"] == tid, "metrics line / trace doc id mismatch"
+assert any(k.startswith("client.phase.") for k in g), sorted(g)
+print("merged timeline: OK "
+      f"(trace {tid}, {len(daemon_x)} daemon spans, "
+      f"offset {other['clock_offset_ns']}ns rtt {other['clock_rtt_ns']}ns)")
+EOF
+  then
+    echo "e2e-trace smoke: OK"
+  else
+    echo "e2e-trace smoke FAILED (see $et_tmp)"; fail=1
+  fi
+  "$PYTHON" -c "from kafkabalancer_tpu.serve.client import request_shutdown
+request_shutdown('$et_sock')" || true
+  wait "$et_pid" 2>/dev/null
+else
+  echo "daemon never became ready (see $et_tmp/daemon.log)"
+  tail -20 "$et_tmp/daemon.log" 2>/dev/null
+  kill "$et_pid" 2>/dev/null
+  fail=1
+fi
+if [ "$fail" = 0 ]; then rm -rf "$et_tmp"; fi
+
 step "serve throughput smoke (2 concurrent clients, lane attribution)"
 # The multi-lane/microbatch serving path end to end: daemon up (default
 # auto lanes + microbatching), TWO concurrent clients with DISTINCT
@@ -549,7 +633,7 @@ if [ "$cb_ready" = 1 ]; then
       -serve-stats-json 2>/dev/null | "$PYTHON" -c '
 import json, sys
 p = json.loads(sys.stdin.read())
-assert p["schema"] == "kafkabalancer-tpu.serve-stats/7", p.get("schema")
+assert p["schema"] == "kafkabalancer-tpu.serve-stats/8", p.get("schema")
 assert "serve.request_s" in p["hists"], sorted(p["hists"])
 assert "serve.phase.parse" in p["hists"], sorted(p["hists"])
 assert isinstance(p["memory"], list) and p["memory"], p.get("memory")
@@ -721,7 +805,7 @@ step "speculative plan-ahead smoke (register + 3 moves, memo hits + parity)"
 # telemetry flags (memoizable answers). After each answered move the
 # daemon plans the NEXT one during the idle window; the following
 # digest-matching request must answer from the memo — serve.spec.hits
-# >= 1 through the serve-stats/7 scrape (hit attribution REQUIRED, so
+# >= 1 through the serve-stats/8 scrape (hit attribution REQUIRED, so
 # a silent live-path fallback cannot masquerade), the speculation
 # identity exact, and plan bytes identical to -no-daemon at EVERY step.
 sp_tmp=$(mktemp -d "${TMPDIR:-/tmp}/kb-gate-spec.XXXXXX")
@@ -784,7 +868,7 @@ PYEOF
       | "$PYTHON" -c '
 import json, sys
 p = json.loads(sys.stdin.read())
-assert p["schema"] == "kafkabalancer-tpu.serve-stats/7", p.get("schema")
+assert p["schema"] == "kafkabalancer-tpu.serve-stats/8", p.get("schema")
 s = p["speculation"]
 assert s["enabled"] is True, s
 assert s["hits"] >= 1, s
@@ -897,7 +981,7 @@ step "replay smoke (seeded 3-tenant churn, per-tenant reconciliation)"
 # docs/observability.md § Per-tenant attribution): a seeded 3-tenant
 # churn run — weight shifts, a topic storm, a broker failure — driven
 # closed-loop through the real client against a private self-spawned
-# daemon. Asserts the serve-stats/7 scrape schema, per-tenant request
+# daemon. Asserts the serve-stats/8 scrape schema, per-tenant request
 # counts reconciling EXACTLY with the driver's issued counts, the
 # scrape's per-tenant percentiles agreeing with the flight recorder's
 # tenant-labeled request log within one histogram bucket, and plan
@@ -911,8 +995,8 @@ if JAX_PLATFORMS=cpu "$PYTHON" -m kafkabalancer_tpu.replay \
   && "$PYTHON" -c '
 import json
 a = json.load(open("'"$rp_tmp"'/replay.json"))
-assert a["schema"] == "kafkabalancer-tpu.replay/4", a["schema"]
-assert a["scrape_schema"] == "kafkabalancer-tpu.serve-stats/7", (
+assert a["schema"] == "kafkabalancer-tpu.replay/5", a["schema"]
+assert a["scrape_schema"] == "kafkabalancer-tpu.serve-stats/8", (
     a["scrape_schema"])
 assert a["reconciled_counts"] is True
 assert a["latency_checked"] is True
@@ -941,7 +1025,7 @@ step "overload + chaos smoke (seeded fault injection, sheds, parity)"
 # a live retry-after estimate), EVERY answered plan byte-identical to
 # -no-daemon, no tenant starved to zero, the daemon's
 # shed/requeue/quarantine accounting reconciled exactly in the
-# serve-stats/7 scrape, and the daemon alive at the end.
+# serve-stats/8 scrape, and the daemon alive at the end.
 ch_tmp=$(mktemp -d)
 if JAX_PLATFORMS=cpu "$PYTHON" -m kafkabalancer_tpu.replay --chaos \
     --tenants 3 --requests 24 --seed 7 --arrival uniform --check \
@@ -950,7 +1034,7 @@ if JAX_PLATFORMS=cpu "$PYTHON" -m kafkabalancer_tpu.replay --chaos \
 import json
 a = json.load(open("'"$ch_tmp"'/chaos.json"))
 assert a["mode"] == "chaos", a["mode"]
-assert a["scrape_schema"] == "kafkabalancer-tpu.serve-stats/7"
+assert a["scrape_schema"] == "kafkabalancer-tpu.serve-stats/8"
 c = a["chaos"]
 assert c["ok"] is True, c
 assert c["wrong_plans"] == [], c["wrong_plans"]

@@ -662,7 +662,7 @@ def test_seeded_builder_key_not_in_golden(tree_copy):
     assert rules_of(fs) == ["R9"]
     (f,) = fs
     assert "emits key 'zz_drift_probe'" in f.message
-    assert "serve_stats_schema_v7.json" in f.message
+    assert "serve_stats_schema_v8.json" in f.message
 
 
 # ------------------------------------------------- the real tree

@@ -1,0 +1,302 @@
+"""Client-side edge telemetry (obs/edge.py) + the merged Perfetto
+export (obs/export.py merged_trace).
+
+Pins (ISSUE 18 satellite: clock-offset estimation under adversarial
+inputs):
+
+- the min-RTT NTP estimator recovers a pure clock-base skew EXACTLY
+  under symmetric delays, bounds its error by rtt/2 under asymmetric
+  delays, prefers the least-queued sample across many, accepts a
+  degenerate single sample, and discards negative-RTT garbage;
+- the stitched timeline NEVER shows a daemon span starting before its
+  client parent (the causality clamp), including the degenerate
+  no-offset fallback that pins the daemon track to the forward span;
+- the edge recorder's observer seam folds phases always-on while
+  CHAINING to a previously installed observer (the in-process daemon's
+  flight feed), and the trace context only ships pre-send phases.
+"""
+
+import os
+
+from kafkabalancer_tpu.obs import metrics
+from kafkabalancer_tpu.obs.edge import (
+    PRE_SEND_PHASES,
+    EdgeContext,
+    estimate_offset,
+    new_trace_id,
+)
+from kafkabalancer_tpu.obs.export import merged_trace
+from kafkabalancer_tpu.obs.trace import TRACER, Tracer
+
+
+# --- the min-RTT NTP offset estimator --------------------------------------
+
+
+def test_estimator_recovers_pure_skew_exactly():
+    """Symmetric delays, skewed clock bases: the midpoint formula is
+    exact, whatever the skew's sign or size."""
+    for true_offset in (0, 1_000, -123_456_789, 7_000_000_000_000):
+        t_send = 50_000
+        d_recv = t_send + 400 + true_offset   # 400ns uplink
+        d_send = d_recv + 90                  # daemon think time
+        t_recv = t_send + 400 + 90 + 400      # 400ns downlink (symmetric)
+        est = estimate_offset([(t_send, d_recv, d_send, t_recv)])
+        assert est is not None
+        offset, rtt = est
+        assert offset == true_offset
+        assert rtt == 800  # uplink + downlink, think time excluded
+
+
+def test_estimator_error_bounded_by_half_rtt_under_asymmetry():
+    """Fully one-sided delay (the worst case): the estimate is off by
+    exactly half the path imbalance — never more than rtt/2."""
+    true_offset = 5_000_000
+    up, down = 10_000, 0  # all delay on the uplink
+    t_send = 0
+    d_recv = t_send + up + true_offset
+    d_send = d_recv
+    t_recv = t_send + up + down
+    offset, rtt = estimate_offset([(t_send, d_recv, d_send, t_recv)])
+    assert rtt == up + down
+    assert abs(offset - true_offset) == (up - down) // 2
+    assert abs(offset - true_offset) <= rtt // 2
+
+
+def test_estimator_min_rtt_sample_wins():
+    """Across many requests the least-queued exchange carries the
+    tightest bound — a stable multi-sample session converges on it."""
+    true_offset = 42_000
+    samples = []
+    for i, (up, down) in enumerate(
+        [(9_000, 5_000), (300, 300), (50_000, 1_000), (2_000, 2_500)]
+    ):
+        t_send = i * 1_000_000
+        d_recv = t_send + up + true_offset
+        d_send = d_recv + 10
+        t_recv = t_send + up + 10 + down
+        samples.append((t_send, d_recv, d_send, t_recv))
+    offset, rtt = estimate_offset(samples)
+    assert rtt == 600  # the (300, 300) sample
+    assert offset == true_offset  # and it is symmetric, so: exact
+    # order independence: the minimum is the minimum
+    assert estimate_offset(reversed(samples)) == (offset, rtt)
+
+
+def test_estimator_degenerate_and_garbage_inputs():
+    # a single sample IS the minimum
+    assert estimate_offset([(0, 100, 110, 220)]) == (-5, 210)
+    # negative RTT (clock garbage, not physics) is discarded
+    assert estimate_offset([(0, 100, 10_100, 200)]) is None
+    # malformed shapes/types are skipped, good samples still land
+    assert estimate_offset(
+        [(0,), ("x", 1, 2, 3), None, (0, 100, 110, 220)]  # type: ignore[list-item]
+    ) == (-5, 210)
+    assert estimate_offset([]) is None
+
+
+# --- the edge recorder ------------------------------------------------------
+
+
+def _fresh_registry():
+    metrics.reset()
+    metrics.reset_hists()
+
+
+def test_edge_phases_fold_always_on_and_chain_observer():
+    """Phase spans are timed with tracing DISABLED (the observer seam
+    makes them real), fold into client.phase.* hists, and chain through
+    to a pre-installed observer."""
+    _fresh_registry()
+    seen = []
+    TRACER.set_observer(lambda sp: seen.append(sp.name))
+    try:
+        assert not TRACER.enabled
+        edge = EdgeContext()
+        with edge.install():
+            with edge.phase("digest"):
+                pass
+            with edge.phase("connect"):
+                pass
+        assert set(edge.phases) == {"digest", "connect"}
+        assert all(v >= 0.0 for v in edge.phases.values())
+        snap = metrics.snapshot()
+        hists = metrics.hist_snapshot()
+        assert hists["client.phase.digest"]["count"] == 1
+        assert hists["client.phase.connect"]["count"] == 1
+        assert set(snap["phases"]["client.phase"]) == {"digest", "connect"}
+        # the chained previous observer saw both spans too
+        assert seen == ["client.digest", "client.connect"]
+        # and install() restored it on exit
+        assert TRACER._observer is not None
+        with TRACER.span("client.late"):
+            pass
+        assert seen[-1] == "client.late"
+    finally:
+        TRACER.set_observer(None)
+
+
+def test_trace_context_ships_only_pre_send_phases():
+    edge = EdgeContext()
+    for name in ("input_read", "digest", "receive", "wait_first_byte"):
+        edge.phases[name] = 0.002
+    edge.parent_sid = 7
+    ctx = edge.trace_context()
+    assert len(ctx["id"]) == 16 and int(ctx["id"], 16) >= 0
+    assert ctx["parent"] == 7
+    assert set(ctx["phases"]) == {"input_read", "digest"}
+    assert set(ctx["phases"]) <= set(PRE_SEND_PHASES)
+    assert ctx["edge_pre_ms"] == 4.0
+    assert "rtt_ns" not in ctx  # no handshake sample yet
+    edge.note_clock_sample(0, {"recv_ns": 100, "send_ns": 110}, 220)
+    assert edge.trace_context()["rtt_ns"] == 210
+
+
+def test_clock_sample_validation_and_finish_gauges():
+    _fresh_registry()
+    edge = EdgeContext()
+    edge.note_clock_sample(0, None, 10)          # no clock block
+    edge.note_clock_sample(0, {"recv_ns": "x"}, 10)  # malformed
+    assert edge.clock_samples == [] and edge.clock_offset() is None
+    edge.footer = None
+    edge.finish({"id": edge.trace_id, "wall_s": 0.0, "spans": []})
+    assert edge.e2e_s is not None and edge.e2e_s >= 0.0
+    gauges = metrics.snapshot()["gauges"]
+    # the replay reconciliation anchor + the edge gauge
+    assert gauges["client.trace_id"] == edge.trace_id
+    assert gauges["serve.edge_ms"] >= 0.0
+    assert metrics.hist_snapshot()["client.edge_s"]["count"] == 1
+
+
+def test_note_fallback_records_the_wasted_edge_wall():
+    _fresh_registry()
+    edge = EdgeContext()
+    edge.note_fallback()
+    assert edge.phases["fallback"] > 0.0
+    assert metrics.hist_snapshot()["client.phase.fallback"]["count"] == 1
+
+
+def test_trace_ids_are_distinct():
+    ids = {new_trace_id() for _ in range(64)}
+    assert len(ids) == 64
+
+
+# --- the merged Perfetto export ---------------------------------------------
+
+
+def _client_tracer_with_forward():
+    """A private tracer holding one completed serve.forward span;
+    returns (tracer, forward_sid, forward_start_us)."""
+    t = Tracer()
+    t.reset(enabled=True)
+    with t.span("cli.run"):
+        with t.span("serve.forward") as fwd:
+            pass
+    rows = {sp["name"]: sp for sp in t.snapshot()}
+    sid = rows["serve.forward"]["sid"]
+    return t, sid, float(rows["serve.forward"]["start_us"])
+
+
+def _symmetric_sample(t_send, offset, delay=500, think=50):
+    d_recv = t_send + delay + offset
+    d_send = d_recv + think
+    return (t_send, d_recv, d_send, t_send + 2 * delay + think)
+
+
+def test_merged_trace_aligns_and_clamps_daemon_spans():
+    """The causality pin: with a KNOWN daemon clock offset, mapped
+    daemon spans land at their true client-clock position — and a span
+    whose estimate-mapped start precedes the client parent is clamped
+    to the forward span's start, never shown before it."""
+    tracer, fwd_sid, fwd_start_us = _client_tracer_with_forward()
+    offset = 3_600_000_000_000  # daemon clock 1h ahead
+    edge = EdgeContext()
+    edge.parent_sid = fwd_sid
+    edge.clock_samples.append(_symmetric_sample(tracer.base_ns, offset))
+    assert edge.clock_offset()[0] == offset
+    fwd_start_ns = tracer.base_ns + int(fwd_start_us * 1e3)
+    # span A: truly 10us after the forward start (daemon clockspace);
+    # span B: engineered to map 50us BEFORE the client parent (what a
+    # worst-case asymmetric estimate produces) -> must clamp
+    a0 = fwd_start_ns + 10_000 + offset
+    b0 = fwd_start_ns - 50_000 + offset
+    edge.footer = {
+        "id": edge.trace_id, "wall_s": 0.0002,
+        "spans": [
+            {"name": "serve.request", "t0_ns": b0, "t1_ns": b0 + 90_000},
+            {"name": "serve.phase.plan", "t0_ns": a0, "t1_ns": a0 + 20_000},
+            {"name": "bogus", "t0_ns": None, "t1_ns": 1},  # skipped
+        ],
+    }
+    doc = merged_trace(tracer, edge)
+    dpid = os.getpid() + 1
+    daemon_x = [
+        e for e in doc["traceEvents"]
+        if e["ph"] == "X" and e["pid"] == dpid
+    ]
+    assert [e["name"] for e in daemon_x] == [
+        "serve.request", "serve.phase.plan"
+    ]
+    for e in daemon_x:
+        assert e["args"]["daemon"] is True
+        assert e["args"]["trace_id"] == edge.trace_id
+        assert e["args"]["parent_sid"] == fwd_sid
+        # the pin: never earlier than the client parent
+        assert e["ts"] >= round(fwd_start_us, 1)
+    clamped = daemon_x[0]
+    assert clamped["ts"] == round(fwd_start_us, 1)
+    aligned = daemon_x[1]
+    assert abs(aligned["ts"] - (fwd_start_us + 10.0)) <= 0.2
+    assert abs(aligned["dur"] - 20.0) <= 0.2
+    meta = [
+        e for e in doc["traceEvents"]
+        if e["ph"] == "M" and e["pid"] == dpid
+        and e["name"] == "process_name"
+    ]
+    assert meta and meta[0]["args"]["name"] == "kafkabalancer-tpu daemon"
+    other = doc["otherData"]
+    assert other["served"] is True
+    assert other["trace_id"] == edge.trace_id
+    assert other["clock_offset_ns"] == offset
+    assert other["daemon_wall_s"] == 0.0002
+
+
+def test_merged_trace_degenerate_no_offset_pins_to_forward_start():
+    """No usable handshake sample: the earliest daemon span is pinned
+    AT the forward span's start (relative daemon timing preserved) and
+    the offset is reported null."""
+    tracer, fwd_sid, fwd_start_us = _client_tracer_with_forward()
+    edge = EdgeContext()
+    edge.parent_sid = fwd_sid
+    d0 = 999_000_000_000  # unrelated daemon clockspace
+    edge.footer = {
+        "id": edge.trace_id, "wall_s": 0.0001,
+        "spans": [
+            {"name": "serve.request", "t0_ns": d0, "t1_ns": d0 + 80_000},
+            {"name": "serve.phase.plan", "t0_ns": d0 + 30_000,
+             "t1_ns": d0 + 60_000},
+        ],
+    }
+    doc = merged_trace(tracer, edge)
+    daemon_x = [
+        e for e in doc["traceEvents"]
+        if e["ph"] == "X" and e.get("args", {}).get("daemon")
+    ]
+    assert len(daemon_x) == 2
+    earliest = min(e["ts"] for e in daemon_x)
+    assert earliest == round(fwd_start_us, 1)
+    # relative offsets inside the daemon track survive the pin
+    assert abs(daemon_x[1]["ts"] - daemon_x[0]["ts"] - 30.0) <= 0.2
+    assert doc["otherData"]["clock_offset_ns"] is None
+    assert doc["otherData"]["clock_rtt_ns"] is None
+    for e in daemon_x:
+        assert e["ts"] >= round(fwd_start_us, 1)  # the causality pin
+
+
+def test_merged_trace_without_footer_is_plain_chrome_trace():
+    """A fallback (or -no-daemon) invocation: no footer, no daemon
+    track, no served marker — the doc is exactly the client's own."""
+    tracer, _sid, _us = _client_tracer_with_forward()
+    edge = EdgeContext()
+    doc = merged_trace(tracer, edge)
+    assert all(e["pid"] != os.getpid() + 1 for e in doc["traceEvents"])
+    assert "served" not in doc.get("otherData", {})

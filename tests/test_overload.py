@@ -937,7 +937,7 @@ def test_live_daemon_still_refuses_second_daemon(sock_dir):
 
 
 def test_scrape_carries_overload_blocks(sock_dir):
-    """serve-stats/7: admission, lane_health and faults blocks are
+    """serve-stats/8: admission, lane_health and faults blocks are
     present with their golden key sets, and tenant entries carry
     sheds."""
     sock = os.path.join(sock_dir, "kb.sock")
@@ -948,7 +948,7 @@ def test_scrape_carries_overload_blocks(sock_dir):
     assert rv == 0
     doc = sclient.fetch_stats(sock)
     golden = json.load(open(os.path.join(
-        os.path.dirname(__file__), "data", "serve_stats_schema_v7.json"
+        os.path.dirname(__file__), "data", "serve_stats_schema_v8.json"
     )))
     assert set(doc["admission"]) == set(golden["admission_keys"])
     assert set(doc["lane_health"]) == set(golden["lane_health_keys"])

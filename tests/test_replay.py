@@ -7,9 +7,9 @@ Pins:
   regression gate, not a flaky load test;
 - churn events do what they claim (weight drift, broker failure with
   allowlist rewrite, topic storms growing the row set);
-- a seeded run against a live daemon produces a replay/4 artifact whose
+- a seeded run against a live daemon produces a replay/5 artifact whose
   per-tenant request counts reconcile EXACTLY with the daemon's
-  serve-stats/7 scrape, whose scrape percentiles agree with the flight
+  serve-stats/8 scrape, whose scrape percentiles agree with the flight
   recorder's tenant-labeled request log within one histogram bucket,
   and whose sampled request has plan byte parity vs -no-daemon.
 """
@@ -150,7 +150,7 @@ def test_replay_reconciles_against_live_daemon(daemon_sock):
     )
     art = run_replay(cfg, log=lambda _m: None)
     assert art["schema"] == REPLAY_SCHEMA
-    assert art["scrape_schema"] == "kafkabalancer-tpu.serve-stats/7"
+    assert art["scrape_schema"] == "kafkabalancer-tpu.serve-stats/8"
     assert art["requests_issued"] == 36
     assert art["request_errors"] == []
     assert art["reconciled_counts"] is True
@@ -174,10 +174,18 @@ def test_replay_reconciles_against_live_daemon(daemon_sock):
     ) >= 1
     assert art["events"]["plan"] == 36
     assert art["events"]["topic_storm"] >= 1
+    # replay/5: end-to-end trace-id reconciliation — every served
+    # request's daemon flight record carries the client's trace id,
+    # exactly (fresh private daemon: the flight ring is complete)
+    tr = art["trace"]
+    assert tr["checked"] is True
+    assert tr["reconciled"] is True
+    assert tr["ids_issued"] == 36 and tr["ids_unique"] is True
+    assert tr["flight_tagged"] == tr["flight_records"] == 36
 
 
 def test_replay_artifact_schema_keys(daemon_sock):
-    """The replay/4 artifact's top-level keys are the schema bench.py
+    """The replay/5 artifact's top-level keys are the schema bench.py
     lands in BENCH rounds — changing them requires a version bump."""
     cfg = ReplayConfig(
         seed=1, tenants=2, requests=8, socket=daemon_sock, spawn=False,
@@ -191,7 +199,7 @@ def test_replay_artifact_schema_keys(daemon_sock):
         "events", "per_tenant", "session_thrash", "fallback_rate",
         "padded_slots", "microbatched", "tenant_cap", "tenants_demoted",
         "parity", "reconciled_counts", "latency_checked",
-        "reconciled_latency", "reconciled",
+        "reconciled_latency", "trace", "reconciled",
     }
     # a churn run marks its mode and carries no chaos/restart/watch block
     assert art["mode"] == "churn"
@@ -231,7 +239,7 @@ def test_restart_replay_recovers_from_spill():
     art = run_replay(cfg, log=lambda _m: None)
     assert art["schema"] == REPLAY_SCHEMA
     assert art["mode"] == "restart"
-    assert art["scrape_schema"] == "kafkabalancer-tpu.serve-stats/7"
+    assert art["scrape_schema"] == "kafkabalancer-tpu.serve-stats/8"
     assert art["request_errors"] == []
     r = art["restart"]
     assert r["ok"] is True and art["reconciled"] is True
@@ -286,7 +294,7 @@ def test_watch_replay_zero_client_plan_ops():
     art = run_replay(cfg, log=lambda _m: None)
     assert art["schema"] == REPLAY_SCHEMA
     assert art["mode"] == "watch"
-    assert art["scrape_schema"] == "kafkabalancer-tpu.serve-stats/7"
+    assert art["scrape_schema"] == "kafkabalancer-tpu.serve-stats/8"
     assert art["chaos"] is None and art["restart"] is None
     w = art["watch"]
     assert w["ok"] is True and art["reconciled"] is True, w

@@ -1853,7 +1853,7 @@ def test_dev_cached_asarray_reuses_equal_content():
 # --- live daemon telemetry: the stats / dump-trace scrape ops --------------
 
 GOLDEN_STATS = os.path.join(
-    os.path.dirname(__file__), "data", "serve_stats_schema_v7.json"
+    os.path.dirname(__file__), "data", "serve_stats_schema_v8.json"
 )
 
 
@@ -1984,7 +1984,7 @@ def test_stats_scrape_never_blocks_on_inflight_plan(sock_dir, monkeypatch):
 def test_serve_stats_json_schema_golden(daemon):
     """Golden-file pin: the stats document's top-level keys, histogram
     entry keys, per-tenant entry keys and flight keys are VERSIONED
-    (kafkabalancer-tpu.serve-stats/7) — changing any requires a schema
+    (kafkabalancer-tpu.serve-stats/8) — changing any requires a schema
     bump and a new golden."""
     sock, _d = daemon
     rv, _out, _err = run_cli(
@@ -2087,7 +2087,7 @@ def test_scrape_cli_verbs_roundtrip(daemon, sock_dir):
     rv, out, _err = run_cli([f"-serve-socket={sock}", "-serve-stats-json"])
     assert rv == 0
     doc = json.loads(out)
-    assert doc["schema"] == "kafkabalancer-tpu.serve-stats/7"
+    assert doc["schema"] == "kafkabalancer-tpu.serve-stats/8"
     assert doc["hists"]["serve.request_s"]["count"] == doc["requests"]
     rv, out, _err = run_cli([f"-serve-socket={sock}", "-serve-stats"])
     assert rv == 0
@@ -2132,6 +2132,163 @@ def test_scrape_cli_verbs_roundtrip(daemon, sock_dir):
     rv, _out, err = run_cli(["-input-json", f"-input={FIXTURE}",
                              f"-serve-socket={sock}", "-serve-stats-json"])
     assert rv == 3 and "take no input" in err
+
+
+def test_served_trace_writes_merged_timeline(sock_dir):
+    """The ISSUE 18 tentpole, end to end: a forwarded invocation with
+    -trace writes ONE merged Perfetto doc — client track + daemon
+    footer track under a single trace id, daemon spans parented under
+    the client's serve.forward span and never starting before it — and
+    the forwarded -metrics-json line (daemon-written) carries the
+    trace id + client.phase.* edge attribution. A SUBPROCESS daemon:
+    stitching across two processes (two monotonic clock bases) is the
+    whole point — an in-process daemon thread would share the client's
+    tracer and hide alignment bugs."""
+    sock = os.path.join(sock_dir, "kb.sock")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kafkabalancer_tpu", "-serve",
+         f"-serve-socket={sock}", "-serve-idle-timeout=120",
+         "-serve-lanes=1"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            pytest.fail(f"daemon exited rc={proc.returncode} at startup")
+        if sclient.daemon_alive(sock) is not None:
+            break
+        time.sleep(0.05)
+    else:
+        proc.kill()
+        pytest.fail("daemon never became ready")
+    try:
+        tpath = os.path.join(sock_dir, "merged.trace.json")
+        mpath = os.path.join(sock_dir, "served.metrics.json")
+        rv, _out, _err = run_cli(
+            ["-input-json", f"-input={FIXTURE}", f"-serve-socket={sock}",
+             f"-trace={tpath}", f"-metrics-json={mpath}"]
+        )
+        assert rv == 0
+        _assert_merged_timeline(sock, tpath, mpath)
+    finally:
+        sclient.request_shutdown(sock)
+        try:
+            proc.wait(15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def _assert_merged_timeline(sock, tpath, mpath):
+    with open(tpath) as f:
+        doc = json.load(f)
+    other = doc["otherData"]
+    assert other["served"] is True
+    trace_id = other["trace_id"]
+    assert len(trace_id) == 16 and int(trace_id, 16) >= 0
+    # a same-host daemon handshake always yields a usable clock sample
+    assert isinstance(other["clock_offset_ns"], int)
+    assert other["clock_rtt_ns"] >= 0
+    assert other["daemon_wall_s"] > 0.0
+    events = doc["traceEvents"]
+    dpid = os.getpid() + 1
+    client_x = [
+        e for e in events if e["ph"] == "X" and e["pid"] != dpid
+    ]
+    daemon_x = [
+        e for e in events if e["ph"] == "X" and e["pid"] == dpid
+    ]
+    assert daemon_x, "the reply footer must land a daemon track"
+    client_names = {e["name"] for e in client_x}
+    # the edge phase chain on the client track
+    for name in ("client.input_read", "client.canonicalize",
+                 "client.connect", "client.handshake", "client.send",
+                 "client.wait_first_byte", "client.receive"):
+        assert name in client_names, sorted(client_names)
+    fwd = [e for e in client_x if e["name"] == "serve.forward"]
+    assert len(fwd) == 1
+    assert fwd[0]["args"]["trace_id"] == trace_id
+    # the wire phases opened INSIDE the forward span share its sid as
+    # their parent — which is exactly the sid the daemon track must
+    # parent under
+    fwd_sid = next(
+        e["args"]["parent_sid"] for e in client_x
+        if e["name"] == "client.send"
+    )
+    daemon_names = {e["name"] for e in daemon_x}
+    # the daemon's dispatch chain (the request thread's span subtree)
+    assert {"parse_input", "plan", "emit"} <= daemon_names, sorted(
+        daemon_names
+    )
+    for e in daemon_x:
+        assert e["args"]["daemon"] is True
+        assert e["args"]["trace_id"] == trace_id
+        assert e["args"]["parent_sid"] == fwd_sid
+        # causality: the daemon's work never precedes the forward span
+        assert e["ts"] >= fwd[0]["ts"]
+    # the daemon-written metrics line: trace id + edge attribution
+    with open(mpath) as f:
+        payload = json.load(f)
+    gauges = payload["gauges"]
+    assert gauges["trace_id"] == trace_id
+    for key in ("client.phase.input_read", "client.phase.canonicalize",
+                "client.phase.connect", "client.phase.handshake"):
+        assert key in gauges and gauges[key] >= 0.0, sorted(gauges)
+    assert gauges["client.edge_pre_ms"] >= 0.0
+    # the daemon's flight record reconciles to the same trace id
+    reqs = sclient.fetch_trace(sock)["trace"]["otherData"]["requests"]
+    assert reqs[-1]["trace"] == trace_id
+    # per-tenant edge attribution landed in the scrape
+    doc_stats = sclient.fetch_stats(sock)
+    entries = list(doc_stats["tenants"]["top"].values())
+    assert any(
+        isinstance(e["edge_ms"], dict) and e["edge_ms"]["count"] >= 1
+        for e in entries
+    ), entries
+
+
+def test_served_requests_get_distinct_trace_ids(daemon):
+    """Trace-less of nothing: EVERY forwarded invocation (no -trace,
+    no -stats) mints a trace id, and each served request's flight
+    record carries its own, distinct id."""
+    sock, _d = daemon
+    for _ in range(3):
+        rv, _out, _err = run_cli(
+            ["-input-json", f"-input={FIXTURE}", f"-serve-socket={sock}"]
+        )
+        assert rv == 0
+    reqs = sclient.fetch_trace(sock)["trace"]["otherData"]["requests"]
+    ids = [r["trace"] for r in reqs]
+    assert len(ids) == 3
+    assert all(isinstance(i, str) and len(i) == 16 for i in ids)
+    assert len(set(ids)) == 3
+
+
+def test_v1_clients_and_scrapes_see_no_trace_keys(daemon):
+    """Compatibility pins: the hello reply only carries the clock block
+    when the client OPTED IN (scrape hellos never do), and a v1-framed
+    plan round-trips with no trace/footer keys anywhere."""
+    sock, _d = daemon
+    hello = sclient.daemon_alive(sock)
+    assert "clock" not in hello
+    # a raw v1 plan exchange: no trace context sent, none returned
+    import socket as socket_mod
+
+    conn = socket_mod.socket(socket_mod.AF_UNIX, socket_mod.SOCK_STREAM)
+    conn.connect(sock)
+    try:
+        protocol.write_frame(conn, {"v": 1, "op": "hello"})
+        h = protocol.read_frame(conn)
+        assert h["ok"] is True and "clock" not in h, sorted(h)
+        protocol.write_frame(conn, {
+            "v": 1, "op": "plan",
+            "argv": ["-no-daemon=true", "-input-json=true"],
+            "stdin": open(FIXTURE).read(),
+        })
+        resp = protocol.read_frame(conn)
+        assert resp["ok"] is True and resp["rc"] == 0
+        assert "trace" not in resp, sorted(resp)
+    finally:
+        conn.close()
 
 
 def test_prometheus_exposition_keeps_counters_exact():
