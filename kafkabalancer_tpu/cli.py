@@ -229,7 +229,7 @@ _NO_FORWARD_FLAGS = frozenset((
     "serve-speculate", "serve-speculate-off",
     "watch", "watch-emit", "watch-poll",
     "serve-stats", "serve-stats-json", "serve-dump-trace", "metrics-prom",
-    "serve-session", "serve-no-session",
+    "serve-session", "serve-no-session", "edge-cache", "no-edge-cache",
     "no-daemon", "help", "pprof", "pprof-path", "jax-profile", "input",
     # -trace is answered by the CLIENT on a forwarded invocation: the
     # daemon's reply footer (its span subtree) merges with the client's
@@ -757,6 +757,22 @@ def _run_impl(
             "Never use resident cluster sessions when forwarding to a "
             "daemon; every request ships and re-parses the full state",
         )
+        f_edge_cache = f.bool(
+            "edge-cache",
+            True,
+            "Client: keep a per-tenant shadow digest cache beside the "
+            "daemon socket so an unchanged input skips the O(P) "
+            "read+parse+digest entirely and a changed one pays "
+            "O(changed rows) (serve/edge_cache.py; docs/serving.md "
+            "§ Edge residency)",
+        )
+        f_no_edge_cache = f.bool(
+            "no-edge-cache",
+            False,
+            "Client: disable the edge residency cache for this "
+            "invocation (every request re-reads and re-digests the "
+            "full input; wins over -edge-cache)",
+        )
         f_serve_stats = f.bool(
             "serve-stats",
             False,
@@ -1032,6 +1048,30 @@ def _run_impl(
             sock = resolve_socket_path(f_serve_socket.value)
             forwardable = serve_client.socket_exists(sock)
             stdin_text: Optional[str] = None
+            # edge residency (serve/edge_cache.py): the per-tenant
+            # shadow digest cache beside the socket. A stable stat hit
+            # skips the input read entirely; a changed file pays an
+            # O(changed-rows) splice instead of the O(P) full parse; a
+            # -from-zk invocation consumes row-level change events. All
+            # rungs degrade to the full read on any doubt — the cache
+            # can cost a fallback, never a wrong digest.
+            ec_on = (
+                forwardable
+                and f_edge_cache.value
+                and not f_no_edge_cache.value
+                and not f_serve_no_session.value
+            )
+            ec_topics = [
+                t for t in f_topics.value.split(",") if len(t) >= 1
+            ]
+            ec_probe = None
+            ec_state = None
+            ec_hit: Optional[bool] = None
+            ec_zk_fast = False
+            if ec_on:
+                from kafkabalancer_tpu.serve import (
+                    edge_cache as serve_ec,
+                )
             # the edge recorder (obs/edge.py): ALWAYS-ON for a forward
             # attempt, no flag needed — it owns the invocation's trace
             # id, times the client phase chain through the observer
@@ -1044,27 +1084,76 @@ def _run_impl(
                     edge_scope.enter_context(edge_rec.install())
                 if forwardable:
                     if f_input.value != "":
-                        # the CLIENT reads the input file and inlines it
-                        # as request stdin: the daemon needs no
-                        # filesystem access, and an unreadable file
-                        # falls through to the in-process open below —
-                        # whose error message names the path exactly as
-                        # the user spelled it (forwarding the flag
-                        # absolutized it, which broke
-                        # served-vs-stateless stderr parity for
-                        # relative paths on exit-1)
-                        try:
-                            with edge_rec.phase("input_read"):
-                                with open(f_input.value, "r") as fh:
-                                    stdin_text = fh.read()
-                        except OSError:
-                            forwardable = False
+                        if ec_on:
+                            with edge_rec.phase("cache_probe"):
+                                ec_probe = serve_ec.probe_file(
+                                    sock,
+                                    f_serve_session.value
+                                    or os.path.abspath(f_input.value),
+                                    f_input.value,
+                                    f_json.value,
+                                    ec_topics,
+                                )
+                        if (
+                            ec_probe is not None
+                            and not ec_probe.needs_text
+                        ):
+                            # rung 1: a stable stat hit — the entry
+                            # header carries the proven digest, so the
+                            # read itself is skipped (the daemon's
+                            # resident session supplies the plan; the
+                            # text stays lazy for resync/register)
+                            ec_state = ec_probe.state
+                            ec_hit = True
+                        else:
+                            # the CLIENT reads the input file and
+                            # inlines it as request stdin: the daemon
+                            # needs no filesystem access, and an
+                            # unreadable file falls through to the
+                            # in-process open below — whose error
+                            # message names the path exactly as the
+                            # user spelled it (forwarding the flag
+                            # absolutized it, which broke
+                            # served-vs-stateless stderr parity for
+                            # relative paths on exit-1)
+                            try:
+                                with edge_rec.phase("input_read"):
+                                    with open(f_input.value, "r") as fh:
+                                        stdin_text = fh.read()
+                            except OSError:
+                                forwardable = False
+                            if forwardable and ec_probe is not None:
+                                # rungs 2+3: content memcmp (proves the
+                                # cached digest) or the incremental
+                                # row-ladder splice (O(changed rows))
+                                with edge_rec.phase("cache_probe"):
+                                    (
+                                        ec_state, rhit,
+                                    ) = serve_ec.resolve_text(
+                                        ec_probe, stdin_text
+                                    )
+                                ec_hit = bool(rhit)
                     elif f_zk.value == "":
                         # the input rides the request; kept for the
                         # replay below when the daemon turns out
                         # unreachable
                         with edge_rec.phase("input_read"):
                             stdin_text = i.read()
+                    elif ec_on:
+                        # -from-zk fast path: probe the cached
+                        # synthesized state against per-topic payload
+                        # digests (row-level change events instead of a
+                        # full re-read). None → degrade to forwarding
+                        # the flag exactly as before, so the daemon
+                        # reproduces connection errors byte-identically.
+                        with edge_rec.phase("cache_probe"):
+                            zk_res = serve_ec.probe_zk(
+                                sock, f_zk.value, ec_topics
+                            )
+                        if zk_res is not None:
+                            ec_state = zk_res.state
+                            ec_hit = zk_res.hit
+                            ec_zk_fast = True
                 if forwardable:
                     declined: List[str] = []
                     with edge_rec.phase("canonicalize"):
@@ -1081,24 +1170,82 @@ def _run_impl(
                         tenant = f_serve_session.value or (
                             os.path.abspath(f_input.value)
                             if f_input.value != ""
-                            else ("-" if stdin_text is not None else "")
+                            else (
+                                "zk:" + f_zk.value
+                                if ec_zk_fast
+                                else (
+                                    "-" if stdin_text is not None
+                                    else ""
+                                )
+                            )
                         )
                         fwd_argv = _forward_argv(f)
+                        if ec_zk_fast:
+                            # the synthesized JSON state replaces the
+                            # daemon-side zookeeper read: strip the zk
+                            # flag and mark the riding input as JSON
+                            # (the local parse state is untouched, so
+                            # an eventual in-process fallback still
+                            # reads zookeeper directly; -topics stays —
+                            # the JSON reader ignores it and the filter
+                            # is baked into the synthesized text)
+                            fwd_argv = [
+                                a for a in fwd_argv
+                                if not a.startswith("-from-zk=")
+                            ]
+                            fwd_argv.append("-input-json=true")
                         session_spec = None
                         if (
-                            stdin_text is not None
-                            and not f_serve_no_session.value
-                            and f_zk.value == ""
+                            not f_serve_no_session.value
+                            and (f_zk.value == "" or ec_zk_fast)
+                            and (
+                                stdin_text is not None
+                                or ec_state is not None
+                            )
                         ):
                             session_spec = serve_client.SessionSpec(
                                 tenant=tenant,
-                                text=stdin_text,
-                                is_json=f_json.value,
-                                topics=[
-                                    t for t in f_topics.value.split(",")
-                                    if len(t) >= 1
-                                ],
+                                text=(
+                                    stdin_text
+                                    if stdin_text is not None
+                                    else ""
+                                ),
+                                is_json=(
+                                    True if ec_zk_fast
+                                    else f_json.value
+                                ),
+                                topics=ec_topics,
                             )
+                        if (
+                            ec_on
+                            and f_input.value != ""
+                            and ec_state is None
+                            and stdin_text is not None
+                            and session_spec is not None
+                            and ec_probe is not None
+                            and ec_probe.stat is not None
+                        ):
+                            # edge-cache miss: pay the O(P) digest HERE
+                            # (the exact phase forward_plan would
+                            # charge) so the canonical state can be
+                            # persisted for the next invocation; the
+                            # probe's pre-read stat key pins the text
+                            # to one stable stat point
+                            with edge_rec.phase("digest"):
+                                from kafkabalancer_tpu.serve import (
+                                    state as serve_sstate,
+                                )
+
+                                ec_state = serve_sstate.client_state(
+                                    stdin_text, f_json.value, ec_topics
+                                )
+                            if ec_state is not None:
+                                serve_ec.persist_state(
+                                    sock, tenant, f_input.value,
+                                    f_json.value, ec_topics,
+                                    stdin_text, ec_state,
+                                    ec_probe.stat,
+                                )
 
                     def _note_fallback(reason: str) -> None:
                         # attributable fallbacks: the reason lands as a
@@ -1118,6 +1265,16 @@ def _run_impl(
                         # way.
                         obs.metrics.count(f"serve.fallbacks.{reason}")
 
+                    if ec_hit is not None:
+                        # edge-residency attribution: rides the trace
+                        # context so the daemon stamps
+                        # client.edge_cache_hit into the served
+                        # -metrics-json export; the local gauge serves
+                        # the in-process bench/replay reader
+                        edge_rec.cache_hit = ec_hit
+                        obs.metrics.gauge(
+                            "client.edge_cache_hit", bool(ec_hit)
+                        )
                     with obs.span(
                         "serve.forward", socket=sock,
                         trace_id=edge_rec.trace_id,
@@ -1136,6 +1293,7 @@ def _run_impl(
                                 0.0, f_serve_client_timeout.value
                             ),
                             edge=edge_rec,
+                            cached_state=ec_state,
                         )
                     if served is None:
                         # the whole wasted edge wall becomes the

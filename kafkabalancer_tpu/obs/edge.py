@@ -24,6 +24,8 @@ client half of the end-to-end story:
 
 Phase glossary (``client.phase.<name>``):
 
+- ``cache_probe``     — the edge-residency probe (serve/edge_cache.py):
+  stat + entry-header load + hit classification, before any read;
 - ``input_read``      — reading the input bytes (file or stdin);
 - ``canonicalize``    — building the canonical forwarded argv + session
   identity from parsed flags;
@@ -54,8 +56,8 @@ from kafkabalancer_tpu.obs.trace import TRACER, Span
 
 #: the client phase chain, in causal order (see the module docstring)
 PHASES: Tuple[str, ...] = (
-    "input_read", "canonicalize", "digest", "connect", "handshake",
-    "send", "wait_first_byte", "receive", "fallback",
+    "cache_probe", "input_read", "canonicalize", "digest", "connect",
+    "handshake", "send", "wait_first_byte", "receive", "fallback",
 )
 
 #: phases that complete BEFORE the plan frame is written — the only
@@ -64,7 +66,8 @@ PHASES: Tuple[str, ...] = (
 #: ``client.phase.*`` gauges, so the served ``-metrics-json`` line
 #: carries the edge attribution without a second writer)
 PRE_SEND_PHASES: Tuple[str, ...] = (
-    "input_read", "canonicalize", "digest", "connect", "handshake",
+    "cache_probe", "input_read", "canonicalize", "digest", "connect",
+    "handshake",
 )
 
 #: streaming-hist / phase-group prefixes for the folded phases
@@ -136,11 +139,16 @@ class EdgeContext:
 
     __slots__ = (
         "trace_id", "parent_sid", "phases", "clock_samples", "footer",
-        "t_start_ns", "e2e_s",
+        "t_start_ns", "e2e_s", "cache_hit",
     )
 
     def __init__(self, trace_id: Optional[str] = None) -> None:
         self.trace_id = trace_id or new_trace_id()
+        # edge-residency attribution: None until the cache was probed,
+        # then True (digest served from the shadow cache) or False —
+        # rides the trace context so the daemon can stamp
+        # ``client.edge_cache_hit`` into the served metrics export
+        self.cache_hit: Optional[bool] = None
         # the client forward span's sid — informational in the context
         # (cross-process sids are not a namespace); the merged export
         # parents daemon events under the span itself
@@ -241,6 +249,8 @@ class EdgeContext:
         est = self.clock_offset()
         if est is not None:
             ctx["rtt_ns"] = est[1]
+        if self.cache_hit is not None:
+            ctx["edge_cache_hit"] = bool(self.cache_hit)
         return ctx
 
     def finish(self, footer: Any) -> None:

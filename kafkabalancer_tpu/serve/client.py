@@ -257,6 +257,7 @@ def forward_plan(
     tenant: str = "",
     client_timeout: float = 0.0,
     edge: Any = None,
+    cached_state: Any = None,
 ) -> Optional[ServedResult]:
     """Forward one invocation to the daemon at ``path``.
 
@@ -310,6 +311,15 @@ def forward_plan(
     recorder's trace context. ``edge=None`` (every pre-existing caller)
     changes nothing — and a v1 exchange stays byte-identical either
     way except for the opt-in ``clock`` hello key.
+
+    ``cached_state`` is an edge-residency state (serve/edge_cache.py
+    ``CachedState``, duck-typing ``ClientState``): when given alongside
+    ``session``, the O(P) parse+digest is skipped entirely — the digest
+    ships from the cache, and canon/rows/text materialize lazily only
+    on the rare resync/register rungs. ``stdin_text`` may then be None
+    even for session requests; any path that genuinely needs the raw
+    input (a v1 daemon, a register) loads it from the cached state and
+    degrades to the in-process fallback if the cache cannot deliver.
     """
 
     def _declined(reason: str) -> None:
@@ -375,8 +385,11 @@ def forward_plan(
         )
         # the session digest is attempt-invariant: compute it once and
         # share across overload retries (a multi-MB parse must not be
-        # re-paid 4 times in the middle of an overload storm)
+        # re-paid 4 times in the middle of an overload storm). An
+        # edge-residency hit pre-seeds it — the parse never happens.
         state_cache: Dict[str, Any] = {}
+        if cached_state is not None and session is not None:
+            state_cache["state"] = cached_state
         attempt = 0
         while True:
             try:
@@ -397,6 +410,14 @@ def forward_plan(
                 }
                 if not progress:
                     req["deadline_ms"] = _remaining_ms(deadline)
+                if stdin_text is None and cached_state is not None:
+                    # a v1 daemon cannot use the digest: materialize
+                    # the raw input from the cache (or degrade)
+                    try:
+                        stdin_text = cached_state.load_text()
+                    except Exception:
+                        _note("edge_cache_error")
+                        return None
                 if stdin_text is not None:
                     req["stdin"] = stdin_text
                 try:
@@ -529,6 +550,7 @@ def _forward_v2(
     with (_phase("digest") if session is not None
           else contextlib.nullcontext()):
         from kafkabalancer_tpu.serve import state as sstate
+        from kafkabalancer_tpu.serve.edge_cache import EdgeCacheError
 
     def _read2() -> "Optional[Tuple[Dict[str, Any], bytes]]":
         with _phase("wait_first_byte"):
@@ -579,10 +601,15 @@ def _forward_v2(
             return None
         return _v2_result(_read2(), _declined, _note)
 
+    # an edge-residency state knows its row count without materializing
+    # the canonical rows (the whole point of the stat-hit rung)
+    nrows = getattr(state, "nrows", None)
+    if not isinstance(nrows, int):
+        nrows = len(state.canon)
     with _phase("send"):
         write_frame2(sock, _stamp({
             "v": PROTO_V2, "op": "plan-delta", "tenant": session.tenant,
-            "digest": state.digest, "nrows": len(state.canon),
+            "digest": state.digest, "nrows": nrows,
             "argv": argv,
         }))
     resp = _read2()
@@ -591,63 +618,81 @@ def _forward_v2(
         return None
     hdr2, blob2 = resp
     resync = hdr2.get("resync")
-    if resync == "rows":
-        _note("session_digest_mismatch")
-        try:
-            theirs = sstate.unpack_hash_table(blob2)
-        except ValueError:
-            theirs = None
-        # per-row hashes are computed HERE, lazily: only a mismatch
-        # pays them (the steady state digests the canonical bytes once)
-        changed = (
-            sstate.diff_rows(sstate.hashes_of(state.canon), theirs)
-            if theirs is not None else None
-        )
-        if changed is not None and len(changed) <= max(
-            _MIN_RESYNC_ROWS,
-            int(len(state.canon) * _MAX_RESYNC_ROWS_FRACTION),
-        ):
-            rows_blob = sstate.pack_rows(
-                [(i, state.rows[i]) for i in changed]
+    try:
+        if resync == "rows":
+            _note("session_digest_mismatch")
+            try:
+                theirs = sstate.unpack_hash_table(blob2)
+            except ValueError:
+                theirs = None
+            # per-row hashes are computed HERE, lazily: only a mismatch
+            # pays them (the steady state digests the canonical bytes
+            # once) — and an edge-residency state already carries its
+            # row-hash ladder, so even a resync pays O(changed)
+            mine = getattr(state, "row_hashes", None)
+            if mine is None:
+                mine = sstate.hashes_of(state.canon)
+            changed = (
+                sstate.diff_rows(mine, theirs)
+                if theirs is not None else None
             )
+            if changed is not None and len(changed) <= max(
+                _MIN_RESYNC_ROWS,
+                int(nrows * _MAX_RESYNC_ROWS_FRACTION),
+            ):
+                rows_blob = sstate.pack_rows(
+                    [(i, state.rows[i]) for i in changed]
+                )
+                try:
+                    with _phase("send"):
+                        write_frame2(sock, _stamp({
+                            "v": PROTO_V2, "op": "plan-rows",
+                            "tenant": session.tenant,
+                            "digest": state.digest,
+                            "argv": argv,
+                        }), rows_blob)
+                except ValueError as exc:
+                    _declined(
+                        f"request exceeds the protocol frame cap: {exc}"
+                    )
+                    _note("frame_cap")
+                    return None
+                resp = _read2()
+                if resp is None:
+                    _note("transport_error")
+                    return None
+                hdr2, blob2 = resp
+                if not hdr2.get("resync"):
+                    return _v2_result((hdr2, blob2), _declined, _note)
+            resync = "full"
+        if resync:
+            # structural drift (or the daemon could not use the rows):
+            # re-register the full state — the blob is the raw text, so
+            # even this worst case skips the JSON escape pass
+            _note("session_resync_full")
+            reg_text = session.text
+            if reg_text == "" and hasattr(state, "load_text"):
+                reg_text = state.load_text()
             try:
                 with _phase("send"):
                     write_frame2(sock, _stamp({
-                        "v": PROTO_V2, "op": "plan-rows",
-                        "tenant": session.tenant, "digest": state.digest,
-                        "argv": argv,
-                    }), rows_blob)
+                        "v": PROTO_V2, "op": "register",
+                        "tenant": session.tenant,
+                        "argv": argv, "has_stdin": True,
+                    }), reg_text.encode("utf-8"))
             except ValueError as exc:
                 _declined(
                     f"request exceeds the protocol frame cap: {exc}"
                 )
                 _note("frame_cap")
                 return None
-            resp = _read2()
-            if resp is None:
-                _note("transport_error")
-                return None
-            hdr2, blob2 = resp
-            if not hdr2.get("resync"):
-                return _v2_result((hdr2, blob2), _declined, _note)
-        resync = "full"
-    if resync:
-        # structural drift (or the daemon could not use the rows):
-        # re-register the full state — the blob is the raw text, so
-        # even this worst case skips the JSON escape pass
-        _note("session_resync_full")
-        try:
-            with _phase("send"):
-                write_frame2(sock, _stamp({
-                    "v": PROTO_V2, "op": "register",
-                    "tenant": session.tenant,
-                    "argv": argv, "has_stdin": True,
-                }), session.text.encode("utf-8"))
-        except ValueError as exc:
-            _declined(f"request exceeds the protocol frame cap: {exc}")
-            _note("frame_cap")
-            return None
-        return _v2_result(_read2(), _declined, _note)
+            return _v2_result(_read2(), _declined, _note)
+    except EdgeCacheError:
+        # the cached body could not be materialized for a resync —
+        # degrade to the in-process fallback (content is then re-read
+        # from the real source; never a wrong plan, only a slower one)
+        _note("edge_cache_error")
+        return None
     return _v2_result((hdr2, blob2), _declined, _note)
 
 

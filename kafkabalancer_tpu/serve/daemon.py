@@ -797,6 +797,14 @@ class Daemon:
                 obs.metrics.tenant_hist_observe(
                     "serve.edge_ms", tenant_label, total_ms
                 )
+            ech = trace.get("edge_cache_hit")
+            if isinstance(ech, bool):
+                # the edge-residency attribution (serve/edge_cache.py):
+                # True when this request's digest came from the shadow
+                # cache without a client-side read+parse — the gate and
+                # bench assert it so a silent full-read can't masquerade
+                # as residency
+                attrs["client.edge_cache_hit"] = ech
         ctx = req.session_ctx
         if req.tenant:
             # the tenant rides the request's own -metrics-json line too:
@@ -1926,6 +1934,12 @@ class Daemon:
                             t_hit0, trace=trace,
                         )
                         enqueue_spec = bool(resp.get("ok"))
+                        if enqueue_spec and spec.rearm_memo(sess, memo):
+                            # fixed point: the plan moved nothing, so
+                            # the session did not advance — the same
+                            # memo keeps answering the same digest
+                            # with no re-dispatch
+                            enqueue_spec = False
                         return self._v2_plan_resp(resp)
                     # the memo cannot serve this request (drifted
                     # digest or changed flags): drop it and fall back

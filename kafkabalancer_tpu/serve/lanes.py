@@ -437,6 +437,23 @@ class ContinuousBatcher(MicrobatchGroup):
         self._max_k = max(1, max_k)
         self._pad_buckets = tuple(sorted(set(int(b) for b in pad_buckets)))
         self.admitted = 0
+        # occupancy-adaptive fast path: dispatches declined at
+        # occupancy 1 without paying the round machinery (BENCH_r06's
+        # continuous_vs_oneshot=0.89x was exactly this tax)
+        self.solo_fast = 0
+
+    def dispatch(self, args: Tuple, statics: Dict[str, Any]) -> Optional[Any]:
+        with self._cv:
+            if self._live == 1 and not self._pending:
+                # sole live member and nothing staged to fuse with: a
+                # round would only classify this entry solo after the
+                # signature hash and two condition round-trips. Decline
+                # immediately — the caller's solo dispatch is
+                # bit-identical, and the next admission re-enables
+                # fusion at the very next chunk boundary.
+                self.solo_fast += 1
+                return None
+        return super().dispatch(args, statics)
 
     def admit(self) -> None:
         """Grow the live membership by one — called by the lane's drain
@@ -545,6 +562,10 @@ class LaneScheduler:
         self.steals = 0
         self.microbatched = 0
         self.padded_slots = 0
+        # occupancy-adaptive fast-path engagements (solo inline runs
+        # that skipped the continuous machinery; unit-pinned, not part
+        # of the scrape schema)
+        self.solo_fast = 0
         self._occupancy: Dict[int, int] = {}
         self._workers = [
             threading.Thread(
@@ -612,6 +633,7 @@ class LaneScheduler:
                 "mesh_exclusive": float(self.mesh_exclusive),
                 "microbatched": float(self.microbatched),
                 "padded_slots": float(self.padded_slots),
+                "solo_fast": float(self.solo_fast),
                 "occupancy_max": float(
                     max(self._occupancy, default=0)
                 ),
@@ -1225,13 +1247,32 @@ class LaneScheduler:
                     rest.append(req)
             first = True
             if fusible and self._batch_mode != "oneshot":
-                # non-batchable riders waiting in this window gate the
-                # feed: with `rest` pending, no new arrivals are pulled
-                # (the batch drains, the riders run, the worker re-pops)
-                # — mid-flight admission must never starve them
-                self._run_continuous(
-                    lane, fusible, claimed, first=first, feed=not rest
-                )
+                solo_run = False
+                if len(fusible) == 1:
+                    # occupancy-adaptive batch mode: one fusible
+                    # request and an empty lane queue at dispatch time
+                    # means the continuous machinery (batcher, member
+                    # thread, drain loop, admission ticks) can only
+                    # ever produce occupancy-1 rounds — run it inline
+                    # instead. A request arriving a tick later re-pops
+                    # into its own group; fusion re-engages whenever
+                    # the queue actually has company.
+                    with self._cv:
+                        if not self._queues[lane.index]:
+                            solo_run = True
+                            self.solo_fast += 1
+                if solo_run:
+                    self._run_one(lane, fusible[0], coalesced=not first)
+                else:
+                    # non-batchable riders waiting in this window gate
+                    # the feed: with `rest` pending, no new arrivals
+                    # are pulled (the batch drains, the riders run, the
+                    # worker re-pops) — mid-flight admission must never
+                    # starve them
+                    self._run_continuous(
+                        lane, fusible, claimed, first=first,
+                        feed=not rest,
+                    )
                 first = False
             else:
                 # the one-shot control (-serve-batch-mode=oneshot): the

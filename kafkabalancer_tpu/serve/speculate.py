@@ -304,6 +304,32 @@ class Speculator:
                 self.hits -= 1
                 self._memos += 1
 
+    def rearm_memo(self, sess: Any, memo: SpecMemo) -> bool:
+        """Re-attach a just-consumed memo whose answer is a FIXED
+        POINT: the plan moved nothing (``next_digest == key_digest``),
+        so serving it did not advance the session and the identical
+        next request deserves the identical answer — without burning a
+        device dispatch re-deriving it. The steady-state poll loop
+        (edge residency: an unchanged input stat-hitting the client
+        cache every few seconds) collapses to zero speculative
+        dispatches this way. Counted as a fresh zero-cost attempt, so
+        the attempts == hits + misses + poisoned + memos identity is
+        undisturbed. False when the slot is no longer re-armable (a
+        concurrent release/poison or a newer memo won) — the caller
+        falls back to a normal plan-ahead enqueue."""
+        with self._lock:
+            if (
+                memo.next_digest != memo.key_digest
+                or memo.rc != 0
+                or getattr(sess, "spec_memo", None) is not None
+                or getattr(sess, "released", False)
+            ):
+                return False
+            sess.spec_memo = memo
+            self.attempts += 1
+            self._memos += 1
+            return True
+
     def retire_miss(self, sess: Any, memo: SpecMemo) -> None:
         """Retire ``memo`` as a MISS (a request arrived that cannot use
         it) — a no-op when a concurrent event already retired it."""

@@ -167,6 +167,41 @@ def _json_int_list(v: object) -> List[int]:
 _ABSENT = object()
 
 
+def row_fields_from_obj(o: object) -> RowFields:
+    """One raw partition dict's digest fields, in codecs-reader
+    semantics (absent-vs-null brokers, bool-is-not-int, float
+    coercion…). Raises :class:`_BadField` on any shape the reader
+    would reject — shared by :func:`_json_state`'s full pass and the
+    edge cache's incremental re-parse (serve/edge_cache.py), which
+    must agree field for field or the incremental digest would
+    drift."""
+    if not isinstance(o, dict):
+        raise _BadField()
+    topic = o.get("topic", "")
+    if not isinstance(topic, str):
+        raise _BadField()
+    partition = o.get("partition", 0)
+    if isinstance(partition, bool) or not isinstance(partition, int):
+        raise _BadField()
+    replicas = _json_int_list(o.get("replicas"))
+    w = o.get("weight", _ABSENT)
+    if w is _ABSENT:
+        weight = 0.0
+    elif isinstance(w, bool) or not isinstance(w, (int, float)):
+        raise _BadField()
+    else:
+        weight = float(w)
+    nrep = o.get("num_replicas", 0)
+    if isinstance(nrep, bool) or not isinstance(nrep, int):
+        raise _BadField()
+    b = o.get("brokers", _ABSENT)
+    brokers = None if b is _ABSENT else _json_int_list(b)
+    ncons = o.get("num_consumers", 0)
+    if isinstance(ncons, bool) or not isinstance(ncons, int):
+        raise _BadField()
+    return (topic, partition, replicas, weight, nrep, brokers, ncons)
+
+
 def _json_state(text: str) -> Optional[ClientState]:
     """The JSON-format canonicalizer, WITHOUT building Partition
     objects: one ``json.loads`` plus a single pass over the raw dicts,
@@ -196,33 +231,7 @@ def _json_state(text: str) -> Optional[ClientState]:
     canon: List[bytes] = []
     try:
         for o in raw:
-            if not isinstance(o, dict):
-                return None
-            topic = o.get("topic", "")
-            if not isinstance(topic, str):
-                return None
-            partition = o.get("partition", 0)
-            if isinstance(partition, bool) or not isinstance(partition, int):
-                return None
-            replicas = _json_int_list(o.get("replicas"))
-            w = o.get("weight", _ABSENT)
-            if w is _ABSENT:
-                weight = 0.0
-            elif isinstance(w, bool) or not isinstance(w, (int, float)):
-                return None
-            else:
-                weight = float(w)
-            nrep = o.get("num_replicas", 0)
-            if isinstance(nrep, bool) or not isinstance(nrep, int):
-                return None
-            b = o.get("brokers", _ABSENT)
-            brokers = None if b is _ABSENT else _json_int_list(b)
-            ncons = o.get("num_consumers", 0)
-            if isinstance(ncons, bool) or not isinstance(ncons, int):
-                return None
-            fields: RowFields = (
-                topic, partition, replicas, weight, nrep, brokers, ncons,
-            )
+            fields = row_fields_from_obj(o)
             rows.append(fields)
             canon.append(canonical_row_bytes(*fields))
     except _BadField:

@@ -73,6 +73,14 @@
 #      smoke              one plan with ZERO client plan ops, byte-
 #                         identical to -no-daemon on the same state;
 #                         watch lag observable via the `watch` op
+#  10d. edge-residency — the client shadow digest cache end to end:
+#      smoke              the same unchanged input served 3x through a
+#                         daemon; runs 2+3 must stamp
+#                         client.edge_cache_hit=true into the
+#                         daemon-written -metrics-json (the O(P) client
+#                         read+parse+digest skipped via the stat rung),
+#                         a .kbec entry persisted beside the socket,
+#                         byte parity vs -no-daemon on every run
 #  11. replay smoke     — seeded 3-tenant churn replay against a
 #                         private daemon: serve-stats/8 schema,
 #                         per-tenant counts reconciling exactly with
@@ -975,6 +983,81 @@ fi
 request_shutdown('$wm_sock')" || true
 wait "$wm_pid" 2>/dev/null
 rm -rf "$wm_tmp"
+
+step "edge-residency smoke (stat-hit steady state, parity + attribution)"
+# The edge residency client cache end to end (docs/serving.md § Edge
+# residency): the same unchanged input served three times through one
+# daemon. Run 0 seeds the per-tenant shadow digest cache beside the
+# socket; runs 1 and 2 must take the stat rung — the client skips the
+# O(P) read+parse+digest entirely and says so through the daemon-
+# written -metrics-json (client.edge_cache_hit) — and EVERY run's plan
+# must be byte-identical to a -no-daemon run on the same state.
+er_tmp=$(mktemp -d "${TMPDIR:-/tmp}/kb-gate-edge.XXXXXX")
+er_sock="$er_tmp/kb.sock"
+cp tests/data/test.json "$er_tmp/cluster.json"
+# backdate past the same-tick rewrite-stability window so run 0 can
+# persist a STABLE entry (a freshly-written mtime is never trusted)
+touch -d "1 hour ago" "$er_tmp/cluster.json" 2>/dev/null \
+  || touch -t 202001010000 "$er_tmp/cluster.json"
+JAX_PLATFORMS=cpu JAX_COMPILATION_CACHE_DIR="$er_tmp" \
+  "$PYTHON" -m kafkabalancer_tpu -serve "-serve-socket=$er_sock" \
+  -serve-idle-timeout=180 >"$er_tmp/daemon.log" 2>&1 &
+er_pid=$!
+er_ready=0
+for _ in $(seq 1 60); do
+  if "$PYTHON" -c "import sys
+from kafkabalancer_tpu.serve.client import daemon_alive
+sys.exit(0 if daemon_alive('$er_sock') else 1)" 2>/dev/null; then
+    er_ready=1; break
+  fi
+  sleep 0.25
+done
+if [ "$er_ready" = 1 ]; then
+  er_ok=1
+  JAX_PLATFORMS=cpu JAX_COMPILATION_CACHE_DIR="$er_tmp" \
+    "$PYTHON" -m kafkabalancer_tpu -input-json \
+    -input "$er_tmp/cluster.json" -solver=tpu -max-reassign=1 \
+    -no-daemon >"$er_tmp/local.out" 2>/dev/null
+  for stp in 0 1 2; do
+    JAX_PLATFORMS=cpu "$PYTHON" -m kafkabalancer_tpu -input-json \
+      -input "$er_tmp/cluster.json" -solver=tpu -max-reassign=1 \
+      "-serve-socket=$er_sock" "-metrics-json=$er_tmp/metrics$stp.json" \
+      >"$er_tmp/served$stp.out" 2>/dev/null
+    if ! cmp -s "$er_tmp/served$stp.out" "$er_tmp/local.out"; then
+      echo "edge-residency run $stp parity FAILED"; er_ok=0
+    fi
+  done
+  if [ "$er_ok" = 1 ] && "$PYTHON" - "$er_tmp" <<'PYEOF'
+import glob, json, sys
+tmp = sys.argv[1]
+hits = [
+    json.load(open(f"{tmp}/metrics{s}.json"))["gauges"]
+    .get("client.edge_cache_hit")
+    for s in (0, 1, 2)
+]
+assert hits[0] is False, hits  # the seeding run pays the full read once
+assert hits[1] is True and hits[2] is True, hits
+assert glob.glob(f"{tmp}/**/*.kbec", recursive=True), "no cache entry"
+PYEOF
+  then
+    echo "seed miss + 2 stat hits + entry persisted + parity: OK"
+  else
+    echo "edge-residency smoke FAILED (see $er_tmp)"; fail=1
+  fi
+  "$PYTHON" -c "from kafkabalancer_tpu.serve.client import request_shutdown
+request_shutdown('$er_sock')" || true
+  if wait "$er_pid"; then
+    echo "daemon clean shutdown: OK"
+  else
+    echo "daemon exited nonzero"; fail=1
+  fi
+else
+  echo "daemon never became ready (see $er_tmp/daemon.log)"
+  tail -20 "$er_tmp/daemon.log" 2>/dev/null
+  kill "$er_pid" 2>/dev/null
+  fail=1
+fi
+rm -rf "$er_tmp"
 
 step "replay smoke (seeded 3-tenant churn, per-tenant reconciliation)"
 # The fleet-churn replay harness end to end (ROADMAP item 5,

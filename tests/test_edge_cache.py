@@ -1,0 +1,467 @@
+"""Edge residency cache pins (serve/edge_cache.py).
+
+The cache's one contract: it NEVER yields a digest/canon the full
+serve/state.py parse of the same bytes would not — on any doubt it
+degrades to a miss (the caller re-reads and re-parses), so every test
+here is differential: whatever rung answers (stat hit, content hit,
+incremental splice, zk payload index), the result is compared field for
+field against ``client_state`` over the same text, or against
+``read_cluster`` over the same fake-ZK tree.
+"""
+
+import json
+import os
+
+import pytest
+
+from kafkabalancer_tpu.serve import edge_cache as ec
+from kafkabalancer_tpu.serve import state as sstate
+
+TENANT = "tenant-a"
+
+
+@pytest.fixture(autouse=True)
+def _fresh_memory():
+    # the in-memory layer is process-wide; tests must not hit a
+    # previous test's entry through it
+    ec.reset_memory_layer()
+    yield
+    ec.reset_memory_layer()
+
+
+@pytest.fixture
+def sock(tmp_path):
+    return str(tmp_path / "kb.sock")
+
+
+def _mk_rows(n, topic_prefix="t"):
+    """Rows exercising the canonicalization corners: unicode topics,
+    absent-vs-null-vs-present brokers, float weights."""
+    rows = []
+    for i in range(n):
+        topic = f"{topic_prefix}{i % 7}" if i % 11 else f"tøpic-ü{i % 7}"
+        row = {
+            "topic": topic,
+            "partition": i,
+            "replicas": [1 + i % 5, 2 + i % 5, 3 + i % 5],
+            "weight": 1.0 + 0.25 * (i % 9),
+        }
+        if i % 3 == 0:
+            row["brokers"] = [1, 2, 3, 4, 5]
+        elif i % 3 == 1:
+            row["brokers"] = None  # null != absent in canonical bytes
+        rows.append(row)
+    return rows
+
+
+def _text(rows):
+    return json.dumps({"version": 1, "partitions": rows})
+
+
+def _full(text):
+    st = sstate.client_state(text, True, [])
+    assert st is not None
+    return st
+
+
+def _write(path, text, backdate_s=5.0):
+    """Write the input; backdating the mtime past UNSTABLE_WINDOW_NS
+    makes the subsequent persist land a STABLE entry (a fresh mtime
+    would be same-tick-suspect by design)."""
+    with open(path, "w") as f:
+        f.write(text)
+    if backdate_s:
+        st = os.stat(path)
+        t = st.st_mtime_ns - int(backdate_s * 1e9)
+        os.utime(path, ns=(t, t))
+
+
+def _seed(sock, path, text, tenant=TENANT, topics=None):
+    """The cli.py miss path: probe, full-parse, persist."""
+    probe = ec.probe_file(sock, tenant, str(path), True, topics or [])
+    assert probe.needs_text
+    st = _full(text)
+    ec.persist_state(
+        sock, tenant, str(path), True, topics or [], text, st, probe.stat
+    )
+    return st
+
+
+def _assert_state_matches(state, text):
+    want = _full(text)
+    assert state.digest == want.digest
+    assert state.version == want.version
+    assert list(state.canon) == list(want.canon)
+    assert list(state.row_hashes) == sstate.hashes_of(want.canon)
+
+
+# --- rung 1: the stat hit --------------------------------------------------
+
+
+def test_stat_hit_skips_read(sock, tmp_path):
+    path = tmp_path / "cluster.json"
+    text = _text(_mk_rows(40))
+    _write(path, text)
+    _seed(sock, path, text)
+    probe = ec.probe_file(sock, TENANT, str(path), True, [])
+    assert probe.note == "stat_hit"
+    assert probe.hit and not probe.needs_text
+    _assert_state_matches(probe.state, text)
+    # the hit survives a process restart (no memory layer): same
+    # answer straight from the entry file
+    ec.reset_memory_layer()
+    probe = ec.probe_file(sock, TENANT, str(path), True, [])
+    assert probe.note == "stat_hit" and not probe.needs_text
+    _assert_state_matches(probe.state, text)
+
+
+def test_miss_content_hit_promotion_then_stat_hit(sock, tmp_path):
+    """The residency cycle: miss -> persist -> touched file (same
+    bytes, new mtime) content-hits and RE-KEYS the entry -> the next
+    probe stat-hits without a read."""
+    path = tmp_path / "cluster.json"
+    text = _text(_mk_rows(30))
+    _write(path, text)
+    _seed(sock, path, text)
+    # touch: same bytes, new stat point (still backdated => stable)
+    _write(path, text, backdate_s=3.0)
+    probe = ec.probe_file(sock, TENANT, str(path), True, [])
+    assert probe.note == "stat_changed" and probe.needs_text
+    state, hit = ec.resolve_text(probe, text)
+    assert hit and state is not None
+    _assert_state_matches(state, text)
+    probe = ec.probe_file(sock, TENANT, str(path), True, [])
+    assert probe.note == "stat_hit" and not probe.needs_text
+
+
+def test_entry_identity_mismatch_is_a_miss(sock, tmp_path):
+    """Same tenant, different request shape (topics filter / format
+    flag): the entry must not answer for a request it was not keyed
+    to."""
+    path = tmp_path / "cluster.json"
+    text = _text(_mk_rows(12))
+    _write(path, text)
+    _seed(sock, path, text)
+    probe = ec.probe_file(sock, TENANT, str(path), True, ["only-this"])
+    assert probe.needs_text and probe.state is None
+    probe = ec.probe_file(sock, TENANT, str(path), False, [])
+    assert probe.needs_text and probe.state is None
+
+
+# --- rung 3: the incremental splice, differentially ------------------------
+
+
+def _churn_cases():
+    def edit_weight(rows):
+        rows[7]["weight"] = 123.625
+
+    def edit_replicas(rows):
+        rows[3]["replicas"] = list(reversed(rows[3]["replicas"]))
+
+    def add_row_middle(rows):
+        rows.insert(11, {"topic": "new-tøpic", "partition": 99,
+                         "replicas": [9, 8, 7], "weight": 2.5})
+
+    def add_row_end(rows):
+        rows.append({"topic": "zz", "partition": 100,
+                     "replicas": [1, 2], "brokers": None})
+
+    def delete_row(rows):
+        del rows[5]
+
+    def reorder_rows(rows):
+        rows[2], rows[17] = rows[17], rows[2]
+
+    def unicode_edit(rows):
+        rows[11]["topic"] = "tøpic-ü-渋谷"
+
+    def brokers_absent_to_null(rows):
+        # row 2 (i%3==2) has NO brokers key; null must change the
+        # canonical bytes (absent-vs-null is reader-visible)
+        assert "brokers" not in rows[2]
+        rows[2]["brokers"] = None
+
+    def brokers_null_to_absent(rows):
+        assert rows[1]["brokers"] is None
+        del rows[1]["brokers"]
+
+    return [
+        edit_weight, edit_replicas, add_row_middle, add_row_end,
+        delete_row, reorder_rows, unicode_edit,
+        brokers_absent_to_null, brokers_null_to_absent,
+    ]
+
+
+@pytest.mark.parametrize("churn", _churn_cases(), ids=lambda f: f.__name__)
+def test_splice_differential(sock, tmp_path, churn):
+    """The O(changed) rung: every churn shape must produce EXACTLY the
+    digest/canon/hashes of a full re-parse of the new bytes."""
+    path = tmp_path / "cluster.json"
+    rows = _mk_rows(25)
+    text_a = _text(rows)
+    _write(path, text_a)
+    _seed(sock, path, text_a)
+    churn(rows)
+    text_b = _text(rows)
+    assert text_b != text_a
+    _write(path, text_b)
+    probe = ec.probe_file(sock, TENANT, str(path), True, [])
+    assert probe.note == "stat_changed" and probe.needs_text
+    state, hit = ec.resolve_text(probe, text_b)
+    assert state is not None and not hit
+    _assert_state_matches(state, text_b)
+    # and the persisted splice result stat-hits next time, still right
+    probe = ec.probe_file(sock, TENANT, str(path), True, [])
+    assert probe.note == "stat_hit"
+    _assert_state_matches(probe.state, text_b)
+
+
+def test_splice_chain_accumulates_no_drift(sock, tmp_path):
+    """Churn generations resolved incrementally, each on top of the
+    PREVIOUS generation's spliced entry: the digest never drifts from
+    the full parse no matter how many splices compound."""
+    path = tmp_path / "cluster.json"
+    rows = _mk_rows(25)
+    text = _text(rows)
+    _write(path, text)
+    _seed(sock, path, text)
+    for gen, churn in enumerate(_churn_cases()):
+        churn(rows)
+        rows[gen % len(rows)]["weight"] = 50.0 + gen
+        text = _text(rows)
+        _write(path, text)
+        probe = ec.probe_file(sock, TENANT, str(path), True, [])
+        state, _hit = ec.resolve_text(probe, text)
+        assert state is not None, f"generation {gen}"
+        _assert_state_matches(state, text)
+
+
+# --- the same-tick rewrite guard (the mtime-granularity hole) --------------
+
+
+def test_same_tick_rewrite_never_serves_stale_digest(sock, tmp_path):
+    """A rewrite forced onto the SAME (mtime_ns, size, inode) stat key
+    as the cached entry: the unstable marker keeps rung 1 from trusting
+    the stat key, and content verification resolves to the NEW bytes'
+    digest."""
+    path = tmp_path / "cluster.json"
+    rows = _mk_rows(20)
+    text_a = _text(rows)
+    # fresh mtime: the persist lands unstable by design
+    _write(path, text_a, backdate_s=0)
+    _seed(sock, path, text_a)
+    st_a = os.stat(path)
+    # same-length rewrite: "2.0" -> "7.5", byte count identical
+    assert rows[4]["weight"] == 2.0
+    rows[4]["weight"] = 7.5
+    text_b = _text(rows)
+    assert len(text_b) == len(text_a) and text_b != text_a
+    with open(path, "w") as f:
+        f.write(text_b)
+    # pin the rewrite onto the ORIGINAL stat key (same inode via
+    # in-place truncate, same size by construction, mtime forced back)
+    os.utime(path, ns=(st_a.st_mtime_ns, st_a.st_mtime_ns))
+    st_b = os.stat(path)
+    assert (st_b.st_ino, st_b.st_mtime_ns, st_b.st_size) == (
+        st_a.st_ino, st_a.st_mtime_ns, st_a.st_size
+    )
+    # the dangerous case this guard exists for: identical stat key,
+    # different bytes — the entry must answer "verify me", never
+    # "proven hit"
+    probe = ec.probe_file(sock, TENANT, str(path), True, [])
+    assert probe.note == "unstable"
+    assert probe.needs_text and not probe.hit
+    state, hit = ec.resolve_text(probe, text_b)
+    assert not hit and state is not None
+    _assert_state_matches(state, text_b)
+    assert _full(text_b).digest != _full(text_a).digest
+
+
+def test_persist_requires_matching_pre_stat(sock, tmp_path):
+    """No pre-read stat, or a file that moved between read and persist:
+    the entry must NOT land (it would key the read bytes to a stat
+    point they no longer belong to)."""
+    path = tmp_path / "cluster.json"
+    text = _text(_mk_rows(10))
+    _write(path, text)
+    st = _full(text)
+    ec.persist_state(sock, TENANT, str(path), True, [], text, st, None)
+    assert not os.path.exists(ec.entry_path(sock, TENANT))
+    pre = ec.probe_file(sock, TENANT, str(path), True, []).stat
+    _write(path, _text(_mk_rows(11)), backdate_s=1.0)  # moved underfoot
+    ec.persist_state(sock, TENANT, str(path), True, [], text, st, pre)
+    assert not os.path.exists(ec.entry_path(sock, TENANT))
+
+
+# --- entry poison matrix ---------------------------------------------------
+
+
+def _corruptions():
+    def truncate_head(buf):
+        return buf[:10]
+
+    def truncate_half(buf):
+        return buf[: len(buf) // 2]
+
+    def flip_magic(buf):
+        return b"XXXX" + buf[4:]
+
+    def flip_header_byte(buf):
+        i = 16
+        return buf[:i] + bytes([buf[i] ^ 0x5A]) + buf[i + 1:]
+
+    def flip_tail_byte(buf):
+        i = len(buf) - 8
+        return buf[:i] + bytes([buf[i] ^ 0x5A]) + buf[i + 1:]
+
+    def empty(buf):
+        return b""
+
+    return [truncate_head, truncate_half, flip_magic, flip_header_byte,
+            flip_tail_byte, empty]
+
+
+@pytest.mark.parametrize(
+    "corrupt", _corruptions(), ids=lambda f: f.__name__
+)
+def test_poisoned_entry_degrades_never_lies(sock, tmp_path, corrupt):
+    """Any byte damage to the entry file: the probe may miss (caller
+    re-parses — correct by construction) or may still answer from an
+    intact head, but whatever it answers must match the full parse."""
+    path = tmp_path / "cluster.json"
+    text = _text(_mk_rows(30))
+    _write(path, text)
+    _seed(sock, path, text)
+    ep = ec.entry_path(sock, TENANT)
+    with open(ep, "rb") as f:
+        buf = f.read()
+    with open(ep, "wb") as f:
+        f.write(corrupt(buf))
+    ec.reset_memory_layer()  # force the disk read to see the damage
+    probe = ec.probe_file(sock, TENANT, str(path), True, [])
+    if probe.needs_text:
+        state, _hit = ec.resolve_text(probe, text)
+        if state is not None:
+            _assert_state_matches(state, text)
+    else:
+        _assert_state_matches(probe.state, text)
+
+
+def test_body_corruption_behind_intact_head_reparses(sock, tmp_path):
+    """The two-phase read: a stat hit verifies only the entry HEAD, so
+    body damage may surface lazily — the lazy canon/hash accessors must
+    fall back to a full re-parse of the INPUT, not serve garbage."""
+    path = tmp_path / "cluster.json"
+    # enough rows that the packed body extends past the verified head
+    text = _text(_mk_rows(400))
+    _write(path, text)
+    _seed(sock, path, text)
+    ep = ec.entry_path(sock, TENANT)
+    with open(ep, "rb") as f:
+        buf = f.read()
+    assert len(buf) > 8192
+    i = len(buf) - 200  # deep in the row-hash/canon region
+    with open(ep, "wb") as f:
+        f.write(buf[:i] + bytes([buf[i] ^ 0x5A]) + buf[i + 1:])
+    ec.reset_memory_layer()
+    probe = ec.probe_file(sock, TENANT, str(path), True, [])
+    if not probe.needs_text:
+        # head verified: the digest is trustworthy; materializing the
+        # rows discovers the damage and re-derives from the input file
+        _assert_state_matches(probe.state, text)
+    else:
+        state, _hit = ec.resolve_text(probe, text)
+        if state is not None:
+            _assert_state_matches(state, text)
+
+
+# --- the -from-zk fast path ------------------------------------------------
+
+
+ZK_CONN = "localhost:2181"
+
+
+@pytest.fixture
+def zk_root(tmp_path, monkeypatch):
+    root = tmp_path / "zk"
+    (root / "brokers" / "topics").mkdir(parents=True)
+    monkeypatch.setenv("KAFKABALANCER_TPU_FAKE_ZK", str(root))
+    return root
+
+
+def _zk_write(root, topic, part_map):
+    p = root / "brokers" / "topics" / topic
+    p.write_text(json.dumps({"version": 1, "partitions": part_map}))
+
+
+def _zk_reference_digest(topics):
+    from kafkabalancer_tpu.codecs import zookeeper as zkc
+
+    zk = zkc.make_zk_client(ZK_CONN)
+    try:
+        pl = zkc.read_cluster(zk, topics or [])
+    finally:
+        zk.stop()
+        zk.close()
+    canon = [
+        sstate.canonical_row_bytes(*sstate.partition_fields(p))
+        for p in pl.iter_partitions()
+    ]
+    # the fast path synthesizes the version-1 JSON document the daemon
+    # would otherwise receive via -input-json, so version 1 keys the
+    # digest (read_cluster's PartitionList itself reports version 0)
+    return sstate.rows_digest(1, canon), canon
+
+
+def test_zk_miss_then_full_hit(sock, zk_root):
+    _zk_write(zk_root, "alpha", {"0": [1, 2], "1": [2, 3]})
+    _zk_write(zk_root, "beta", {"0": [3, 1]})
+    want, canon = _zk_reference_digest([])
+    res = ec.probe_zk(sock, ZK_CONN, [])
+    assert res is not None and not res.hit
+    assert res.state.digest == want
+    assert list(res.state.canon) == canon
+    res = ec.probe_zk(sock, ZK_CONN, [])
+    assert res is not None and res.hit and res.changed_topics == 0
+    assert res.state.digest == want
+
+
+def test_zk_one_changed_topic_redecodes_only_it(sock, zk_root):
+    _zk_write(zk_root, "alpha", {"0": [1, 2], "1": [2, 3]})
+    _zk_write(zk_root, "beta", {"0": [3, 1]})
+    _zk_write(zk_root, "gamma", {"0": [2, 1], "1": [1, 3]})
+    assert ec.probe_zk(sock, ZK_CONN, []) is not None
+    _zk_write(zk_root, "beta", {"0": [1, 3], "1": [2, 1]})
+    want, canon = _zk_reference_digest([])
+    res = ec.probe_zk(sock, ZK_CONN, [])
+    assert res is not None and not res.hit
+    assert res.changed_topics == 1
+    assert res.state.digest == want
+    assert list(res.state.canon) == canon
+
+
+def test_zk_topic_filter_and_set_drift(sock, zk_root):
+    _zk_write(zk_root, "alpha", {"0": [1, 2]})
+    _zk_write(zk_root, "beta", {"0": [3, 1]})
+    want_a, _ = _zk_reference_digest(["alpha"])
+    res = ec.probe_zk(sock, ZK_CONN, ["alpha"])
+    assert res is not None and res.state.digest == want_a
+    # topic added: the cached index cannot prove the cluster unchanged
+    _zk_write(zk_root, "gamma", {"0": [9, 8]})
+    want_all, _ = _zk_reference_digest([])
+    res = ec.probe_zk(sock, ZK_CONN, [])
+    assert res is not None and res.state.digest == want_all
+    # topic removed
+    os.unlink(zk_root / "brokers" / "topics" / "beta")
+    want_less, canon_less = _zk_reference_digest([])
+    res = ec.probe_zk(sock, ZK_CONN, [])
+    assert res is not None
+    assert res.state.digest == want_less
+    assert list(res.state.canon) == canon_less
+
+
+def test_zk_unreachable_is_none(sock, tmp_path, monkeypatch):
+    monkeypatch.setenv(
+        "KAFKABALANCER_TPU_FAKE_ZK", str(tmp_path / "absent")
+    )
+    assert ec.probe_zk(sock, ZK_CONN, []) is None

@@ -1062,6 +1062,48 @@ def test_continuous_batcher_bit_parity_every_occupancy():
             )
 
 
+def test_continuous_batcher_solo_fast_path_counter():
+    """The occupancy-adaptive pin (BENCH_r06's continuous_vs_oneshot =
+    0.89x was the padded-dispatch tax at occupancy 1): a sole live
+    member's dispatch is declined inline — counted in ``solo_fast``,
+    zero fused dispatches, plan bytes identical to the oneshot path."""
+    from kafkabalancer_tpu.serve.lanes import ContinuousBatcher
+    from kafkabalancer_tpu.solvers import scan
+
+    pl, cfg = _load_variant(None)
+    oneshot = _emit_plan(scan.plan(pl, cfg, 4, batch=4))
+    cb = ContinuousBatcher(4)
+    for _ in range(3):
+        cb.admit()
+        with cb.member():
+            pl, cfg = _load_variant(None)
+            got = _emit_plan(scan.plan(pl, cfg, 4, batch=4))
+        assert got == oneshot
+    assert cb.solo_fast >= 3
+    assert cb.fused_dispatches == 0
+    assert cb.padded_slots == 0
+
+
+def test_lane_scheduler_stats_carry_solo_fast():
+    """The telemetry seam: LaneScheduler.stats() exposes the fast-path
+    engagement count (unit-pinned here; the daemon scrape copies only
+    its own named keys, so the scrape schema is untouched)."""
+    from kafkabalancer_tpu.serve import lanes as lanes_mod
+
+    sched = lanes_mod.LaneScheduler(
+        lambda req, coalesced, lane, mb: None,
+        lambda r: None,
+        [lanes_mod.Lane(0)],
+    )
+    try:
+        st = sched.stats()
+        assert st["solo_fast"] == 0.0
+        sched.solo_fast = 7
+        assert sched.stats()["solo_fast"] == 7.0
+    finally:
+        sched.stop()
+
+
 def test_continuous_batcher_bucket_boundary_promotion():
     """The padding-bucket transition: a 3-member wave rides the K=4
     bucket (1 padded slot), a later 5-member wave on the SAME batcher

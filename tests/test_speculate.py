@@ -479,6 +479,55 @@ def test_memo_retirement_is_exactly_once():
     assert _identity_ok(sp.stats())
 
 
+def test_fixed_point_memo_rearm():
+    """The steady-state re-arm: a consumed memo whose answer moved
+    nothing (next_digest == key_digest, rc 0) re-attaches instead of
+    re-dispatching — a fresh zero-cost attempt, identity undisturbed.
+    Anything else (plan advanced, failed rc, slot occupied, released
+    session) refuses and falls back to a normal plan-ahead enqueue."""
+
+    class _D:
+        pass
+
+    class _S:
+        released = False
+        spec_memo = None
+
+    sp = spec_mod.Speculator(_D(), enabled=True)
+    sess = _S()
+    fixed = spec_mod.SpecMemo("d0", [], 0, "out", "", "d0")
+    sp.attach_memo(sess, fixed)
+    assert sp.take_memo(sess, fixed)
+    assert sp.rearm_memo(sess, fixed)
+    assert sess.spec_memo is fixed
+    st = sp.stats()
+    assert (st["attempts"], st["hits"], st["memos"]) == (2, 1, 1)
+    assert _identity_ok(st), st
+    # the re-armed memo keeps serving the same digest
+    assert sp.take_memo(sess, fixed)
+    sp.retire_miss(sess, fixed)  # consumed-and-not-rearmed: plain miss
+    st = sp.stats()
+    assert (st["hits"], st["misses"], st["memos"]) == (2, 0, 0)
+    assert _identity_ok(st), st
+
+    # refusals: an advancing plan, a failed rc, an occupied slot, a
+    # released session
+    moved = spec_mod.SpecMemo("d0", [], 0, "", "", "d1")
+    assert not sp.rearm_memo(sess, moved)
+    failed = spec_mod.SpecMemo("d0", [], 2, "", "", "d0")
+    assert not sp.rearm_memo(sess, failed)
+    newer = spec_mod.SpecMemo("d0", [], 0, "", "", "d0")
+    sp.attach_memo(sess, newer)
+    assert not sp.rearm_memo(sess, fixed)  # a newer memo won the slot
+    assert sess.spec_memo is newer
+    assert sp.take_memo(sess, newer)
+    sess.released = True
+    assert not sp.rearm_memo(sess, newer)
+    assert sess.spec_memo is None
+    sp.retire_miss(sess, newer)
+    assert _identity_ok(sp.stats())
+
+
 def test_watch_flag_validation():
     """-watch without -serve, -watch without a sink, and -watch-emit
     without -watch all refuse loudly (exit 3) — a sink-less watcher
